@@ -1,0 +1,12 @@
+"""kwok-tpu: a TPU-native cluster-simulation framework.
+
+Re-expresses KWOK's Stage finite-state-machine (reference:
+pkg/utils/lifecycle, pkg/kwok/controllers) as a vectorized,
+device-resident state-transition kernel in JAX/XLA: every simulated
+Node/Pod is one row in a struct-of-arrays; stage matching, weighted
+transitions, delay timers and heartbeats run as a single batched tick
+on TPU. A host-side CPU engine with identical semantics serves as the
+parity oracle and the slow path for arbitrary custom resources.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,322 @@
+"""kq — a small jq-subset query engine over JSON-standard objects.
+
+The reference drives all Stage selector matchExpressions, weightFrom and
+durationFrom expressions through gojq (reference: pkg/utils/expression/query.go:25-88).
+The stage vocabulary only ever uses a narrow jq subset — field paths,
+string indexing, array iteration, `select(...)` with equality — so kq
+implements exactly that subset with gojq-compatible behavior:
+
+- results are a stream; `null` outputs are dropped from the result list
+  (reference: query.go:60-66);
+- any evaluation error aborts the query and yields an *empty* result
+  (gojq errors are swallowed: query.go:57-59 returns nil, nil);
+- iterating a non-iterable (including null/missing) is an error;
+- field access on null/missing yields null, not an error.
+
+Queries that fall outside the subset raise ``KqCompileError`` at parse
+time; callers route those objects to the host slow path.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+
+class KqCompileError(ValueError):
+    """The query is not valid kq (parse/compile-time)."""
+
+
+class _KqRuntimeError(Exception):
+    """Evaluation error; swallowed by Query.execute (gojq parity)."""
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<op>==|!=|\||\(|\)|\[|\]|\.|,)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    """,
+    re.VERBOSE,
+)
+
+
+def _tokenize(src: str) -> List[Tuple[str, str]]:
+    tokens: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise KqCompileError(f"unexpected character {src[pos]!r} at {pos} in {src!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        tokens.append((kind, m.group()))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Field:
+    name: str
+
+
+@dataclass(frozen=True)
+class Iterate:
+    pass
+
+
+@dataclass(frozen=True)
+class Path:
+    """A `.a.b["c"].[]`-style navigation; ops are Field/Iterate."""
+
+    ops: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Compare:
+    left: Any
+    op: str  # "==" or "!="
+    right: Any
+
+
+@dataclass(frozen=True)
+class Select:
+    cond: Any
+
+
+@dataclass(frozen=True)
+class Pipe:
+    stages: Tuple[Any, ...]
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]], src: str):
+        self.tokens = tokens
+        self.src = src
+        self.i = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def next(self) -> Tuple[str, str]:
+        tok = self.peek()
+        if tok is None:
+            raise KqCompileError(f"unexpected end of query: {self.src!r}")
+        self.i += 1
+        return tok
+
+    def expect(self, text: str) -> None:
+        tok = self.next()
+        if tok[1] != text:
+            raise KqCompileError(f"expected {text!r}, got {tok[1]!r} in {self.src!r}")
+
+    def parse_query(self) -> Any:
+        node = self.parse_pipe()
+        if self.peek() is not None:
+            raise KqCompileError(f"trailing tokens in {self.src!r}")
+        return node
+
+    def parse_pipe(self) -> Any:
+        stages = [self.parse_term()]
+        while self.peek() is not None and self.peek()[1] == "|":
+            self.next()
+            stages.append(self.parse_term())
+        if len(stages) == 1:
+            return stages[0]
+        return Pipe(tuple(stages))
+
+    def parse_term(self) -> Any:
+        """One pipe stage: a path, select(...), or a literal — optionally
+        followed by an ==/!= comparison."""
+        node = self.parse_primary()
+        tok = self.peek()
+        if tok is not None and tok[1] in ("==", "!="):
+            op = self.next()[1]
+            right = self.parse_primary()
+            node = Compare(node, op, right)
+        return node
+
+    def parse_primary(self) -> Any:
+        tok = self.peek()
+        if tok is None:
+            raise KqCompileError(f"unexpected end of query: {self.src!r}")
+        kind, text = tok
+        if text == ".":
+            return self.parse_path()
+        if text == "(":
+            self.next()
+            node = self.parse_pipe()
+            self.expect(")")
+            return node
+        if kind == "string":
+            self.next()
+            return Literal(_unquote(text))
+        if kind == "number":
+            self.next()
+            return Literal(float(text) if "." in text else int(text))
+        if kind == "ident":
+            if text == "select":
+                self.next()
+                self.expect("(")
+                cond = self.parse_pipe()
+                self.expect(")")
+                return Select(cond)
+            if text in ("true", "false", "null"):
+                self.next()
+                return Literal({"true": True, "false": False, "null": None}[text])
+            raise KqCompileError(f"unsupported function {text!r} in {self.src!r}")
+        raise KqCompileError(f"unexpected token {text!r} in {self.src!r}")
+
+    def parse_path(self) -> Path:
+        ops: List[Any] = []
+        self.expect(".")
+        while True:
+            tok = self.peek()
+            if tok is None:
+                break
+            kind, text = tok
+            if kind == "ident":
+                self.next()
+                ops.append(Field(text))
+            elif text == "[":
+                self.next()
+                nxt = self.next()
+                if nxt[1] == "]":
+                    ops.append(Iterate())
+                elif nxt[0] == "string":
+                    self.expect("]")
+                    ops.append(Field(_unquote(nxt[1])))
+                else:
+                    raise KqCompileError(
+                        f"unsupported index {nxt[1]!r} in {self.src!r}"
+                    )
+            elif text == ".":
+                # `.a.b` / `.a.[]` — separator between segments
+                self.next()
+                nxt = self.peek()
+                if nxt is None or (nxt[0] != "ident" and nxt[1] != "["):
+                    raise KqCompileError(f"dangling '.' in {self.src!r}")
+            else:
+                break
+        return Path(tuple(ops))
+
+
+def _unquote(s: str) -> str:
+    body = s[1:-1]
+    return body.replace('\\"', '"').replace("\\\\", "\\")
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+def _truthy(v: Any) -> bool:
+    # jq: false and null are falsy; everything else truthy.
+    return v is not None and v is not False
+
+
+def _eval(node: Any, value: Any) -> Iterator[Any]:
+    if isinstance(node, Literal):
+        yield node.value
+    elif isinstance(node, Path):
+        yield from _eval_path(node.ops, 0, value)
+    elif isinstance(node, Pipe):
+        yield from _eval_pipe(node.stages, 0, value)
+    elif isinstance(node, Select):
+        for out in _eval(node.cond, value):
+            if _truthy(out):
+                yield value
+    elif isinstance(node, Compare):
+        for lv in _eval(node.left, value):
+            for rv in _eval(node.right, value):
+                eq = _json_equal(lv, rv)
+                yield eq if node.op == "==" else not eq
+    else:  # pragma: no cover
+        raise _KqRuntimeError(f"unknown node {node!r}")
+
+
+def _eval_pipe(stages: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
+    if i == len(stages):
+        yield value
+        return
+    for out in _eval(stages[i], value):
+        yield from _eval_pipe(stages, i + 1, out)
+
+
+def _eval_path(ops: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
+    if i == len(ops):
+        yield value
+        return
+    op = ops[i]
+    if isinstance(op, Field):
+        if value is None:
+            yield from _eval_path(ops, i + 1, None)
+        elif isinstance(value, dict):
+            yield from _eval_path(ops, i + 1, value.get(op.name))
+        else:
+            raise _KqRuntimeError(
+                f"cannot index {type(value).__name__} with {op.name!r}"
+            )
+    else:  # Iterate
+        if isinstance(value, list):
+            for item in value:
+                yield from _eval_path(ops, i + 1, item)
+        elif isinstance(value, dict):
+            for item in value.values():
+                yield from _eval_path(ops, i + 1, item)
+        else:
+            raise _KqRuntimeError(f"cannot iterate over {type(value).__name__}")
+
+
+def _json_equal(a: Any, b: Any) -> bool:
+    # Avoid bool == int coercion surprises (jq: true != 1).
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    return a == b
+
+
+class Query:
+    """Compiled kq query (reference: expression.Query, query.go:28-49)."""
+
+    def __init__(self, src: str):
+        self.src = src
+        self._ast = _Parser(_tokenize(src), src).parse_query()
+
+    def execute(self, value: Any) -> Optional[List[Any]]:
+        """Run the query; returns the non-null output stream.
+
+        Mirrors reference query.go:48-68: errors swallow the whole result
+        (returns None), null outputs are dropped.
+        """
+        out: List[Any] = []
+        try:
+            for v in _eval(self._ast, value):
+                if v is None:
+                    continue
+                out.append(v)
+        except (_KqRuntimeError, RecursionError):
+            return None
+        return out
+
+
+def compile_query(src: str) -> Query:
+    return Query(src)

@@ -1,0 +1,185 @@
+"""Patch application: JSON Patch, JSON Merge Patch, strategic merge.
+
+The reference applies stage effects as one of three patch types against
+the apiserver (reference: pkg/utils/lifecycle/next.go:96-121,
+pkg/kwok/controllers/utils.go:162-304 for no-op detection). Here the
+store is in-process, so we implement the appliers directly:
+
+- JSON Patch (RFC 6902) subset: add/remove/replace — what the finalizer
+  ops emit (reference finalizers.go:32-116).
+- JSON Merge Patch (RFC 7386): recursive merge, null deletes.
+- Strategic merge: like merge patch, but lists of objects merge by a
+  patch-merge key (k8s semantics). We carry a small key table for the
+  types the simulator touches (containers/conditions by name/type);
+  unknown lists replace wholesale, which matches the RFC 7386 fallback
+  the reference gets for unregistered types.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional
+
+PATCH_JSON = "json"
+PATCH_MERGE = "merge"
+PATCH_STRATEGIC = "strategic"
+
+# patch-merge keys for k8s list types (subset of the OpenAPI metadata the
+# reference discovers dynamically via pkg/utils/patch/openapi.go:43-248).
+_MERGE_KEYS = {
+    "conditions": "type",
+    "containers": "name",
+    "initContainers": "name",
+    "ephemeralContainers": "name",
+    "containerStatuses": "name",
+    "initContainerStatuses": "name",
+    "ephemeralContainerStatuses": "name",
+    "volumes": "name",
+    "env": "name",
+    "ports": "containerPort",
+    "addresses": "type",
+    "taints": "key",
+    "tolerations": "key",
+    "images": "names",
+    "finalizers": None,  # set-merge
+}
+
+
+def apply_json_patch(obj: Any, ops: List[Dict[str, Any]]) -> Any:
+    """Apply an RFC 6902 patch (add/remove/replace subset)."""
+    out = copy.deepcopy(obj)
+    for op in ops:
+        path = op["path"]
+        parts = [p.replace("~1", "/").replace("~0", "~") for p in path.split("/")[1:]]
+        action = op["op"]
+        parent, last = _traverse(out, parts)
+        if action == "add":
+            value = copy.deepcopy(op["value"])
+            if isinstance(parent, list):
+                if last == "-":
+                    parent.append(value)
+                else:
+                    parent.insert(int(last), value)
+            else:
+                parent[last] = value
+        elif action == "remove":
+            if isinstance(parent, list):
+                del parent[int(last)]
+            else:
+                if last not in parent:
+                    raise KeyError(f"path not found: {path}")
+                del parent[last]
+        elif action == "replace":
+            value = copy.deepcopy(op["value"])
+            if isinstance(parent, list):
+                parent[int(last)] = value
+            else:
+                parent[last] = value
+        else:
+            raise ValueError(f"unsupported json patch op {action!r}")
+    return out
+
+
+def _traverse(obj: Any, parts: List[str]):
+    cur = obj
+    for p in parts[:-1]:
+        if isinstance(cur, list):
+            cur = cur[int(p)]
+        else:
+            cur = cur[p]
+    return cur, parts[-1]
+
+
+def apply_merge_patch(obj: Any, patch: Any) -> Any:
+    """RFC 7386 JSON Merge Patch."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(obj, dict):
+        obj = {}
+    out = dict(obj)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = apply_merge_patch(out.get(k), v)
+    return out
+
+
+def apply_strategic_merge_patch(obj: Any, patch: Any, field_name: str = "") -> Any:
+    """Strategic merge: dicts merge recursively; lists of objects merge
+    by the field's patch-merge key; other lists replace."""
+    if isinstance(patch, dict) and isinstance(obj, dict):
+        out = dict(obj)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)
+            elif k in out:
+                out[k] = apply_strategic_merge_patch(out[k], v, k)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+    if isinstance(patch, list) and isinstance(obj, list):
+        key = _MERGE_KEYS.get(field_name)
+        if key is None:
+            if field_name in _MERGE_KEYS:  # set-merge (e.g. finalizers)
+                merged = list(obj)
+                for item in patch:
+                    if item not in merged:
+                        merged.append(copy.deepcopy(item))
+                return merged
+            return copy.deepcopy(patch)
+        merged = [copy.deepcopy(i) for i in obj]
+        index = {i.get(key): n for n, i in enumerate(merged) if isinstance(i, dict)}
+        for item in patch:
+            if isinstance(item, dict) and item.get(key) in index:
+                n = index[item[key]]
+                merged[n] = apply_strategic_merge_patch(merged[n], item, "")
+            else:
+                merged.append(copy.deepcopy(item))
+                if isinstance(item, dict):
+                    index[item.get(key)] = len(merged) - 1
+        return merged
+    return copy.deepcopy(patch)
+
+
+def apply_patch(obj: Any, data: Any, patch_type: str) -> Any:
+    if patch_type == PATCH_JSON:
+        if isinstance(data, (str, bytes)):
+            data = json.loads(data)
+        return apply_json_patch(obj, data)
+    if isinstance(data, (str, bytes)):
+        data = json.loads(data)
+    if patch_type == PATCH_STRATEGIC:
+        return apply_strategic_merge_patch(obj, data)
+    return apply_merge_patch(obj, data)
+
+
+def wrap_with_root(root: str, patch: Any) -> Any:
+    """Wrap rendered patch data under a root field (merge-patch flavor),
+    mirroring reference next.go:147-155 wrapMergePatchData."""
+    if not root:
+        return patch
+    return {root: patch}
+
+
+def wrap_json_patch_with_root(root: str, ops: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Prefix JSON-patch op paths with /root (reference next.go:157-170)."""
+    if not root:
+        return ops
+    out = []
+    for op in ops:
+        op = dict(op)
+        if "path" in op:
+            op["path"] = f"/{root}{op['path']}"
+        out.append(op)
+    return out
+
+
+def is_noop_patch(obj: Any, data: Any, patch_type: str) -> bool:
+    """Would applying this patch change the object?
+    (reference controllers/utils.go:162-304 checkNeedPatch*)"""
+    try:
+        return apply_patch(obj, data, patch_type) == obj
+    except (KeyError, IndexError, ValueError, TypeError):
+        return False

@@ -1,0 +1,91 @@
+"""Stage API types: YAML round-trip and deprecated-field folding
+(reference pkg/apis/v1alpha1/stage_types.go, internalversion/conversion.go:394-425)."""
+
+import yaml
+
+from kwok_tpu.api.loader import load_stages
+from kwok_tpu.api.types import Stage
+
+STAGE_YAML = """
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: test-stage
+spec:
+  resourceRef:
+    apiGroup: v1
+    kind: Pod
+  selector:
+    matchLabels:
+      app: demo
+    matchExpressions:
+    - key: '.metadata.deletionTimestamp'
+      operator: 'DoesNotExist'
+  weight: 2
+  weightFrom:
+    expressionFrom: '.metadata.annotations["w"]'
+  delay:
+    durationMilliseconds: 1000
+    jitterDurationMilliseconds: 5000
+  next:
+    event:
+      type: Normal
+      reason: Created
+      message: Created container
+    finalizers:
+      add:
+      - value: 'kwok.x-k8s.io/fake'
+    patches:
+    - subresource: status
+      root: status
+      type: merge
+      template: 'phase: Running'
+"""
+
+DEPRECATED_YAML = """
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: old-style
+spec:
+  resourceRef:
+    kind: Node
+  next:
+    statusTemplate: 'phase: Running'
+"""
+
+
+def test_parse_full_stage():
+    s = Stage.from_dict(yaml.safe_load(STAGE_YAML))
+    assert s.name == "test-stage"
+    assert s.resource_ref.kind == "Pod"
+    assert s.selector.match_labels == {"app": "demo"}
+    assert s.selector.match_expressions[0].operator == "DoesNotExist"
+    assert s.weight == 2
+    assert s.weight_from.expression_from == '.metadata.annotations["w"]'
+    assert s.delay.duration_milliseconds == 1000
+    assert s.delay.jitter_duration_milliseconds == 5000
+    assert s.next.event.reason == "Created"
+    assert s.next.finalizers.add[0].value == "kwok.x-k8s.io/fake"
+    assert s.next.patches[0].type == "merge"
+
+
+def test_round_trip():
+    s = Stage.from_dict(yaml.safe_load(STAGE_YAML))
+    s2 = Stage.from_dict(s.to_dict())
+    assert s2 == s
+
+
+def test_deprecated_status_template_folds_to_patch():
+    s = Stage.from_dict(yaml.safe_load(DEPRECATED_YAML))
+    assert len(s.next.patches) == 1
+    p = s.next.patches[0]
+    assert p.subresource == "status"
+    assert p.root == "status"
+    assert p.template == "phase: Running"
+    assert p.type is None  # default -> merge patch
+
+
+def test_load_stages_multidoc():
+    stages = load_stages(STAGE_YAML + "\n---\n" + DEPRECATED_YAML)
+    assert [s.name for s in stages] == ["test-stage", "old-style"]

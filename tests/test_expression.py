@@ -1,0 +1,102 @@
+"""Requirement / IntGetter / DurationGetter parity
+(reference pkg/utils/expression/{selector,value_int_from,value_duration_from}.go)."""
+
+import datetime
+
+from kwok_tpu.utils.expression import (
+    DurationGetter,
+    IntGetter,
+    Requirement,
+    parse_go_duration,
+)
+
+NOW = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+POD = {
+    "metadata": {"annotations": {"delay": "20s", "w": "5", "bad": "xx", "empty": ""}},
+    "status": {"phase": "Running", "conditions": [{"type": "Ready", "status": "True"}]},
+}
+
+
+class TestRequirement:
+    def test_in(self):
+        assert Requirement(".status.phase", "In", ["Running"]).matches(POD)
+        assert not Requirement(".status.phase", "In", ["Pending"]).matches(POD)
+
+    def test_not_in(self):
+        assert Requirement(".status.phase", "NotIn", ["Pending"]).matches(POD)
+
+    def test_exists(self):
+        assert Requirement(".status.phase", "Exists").matches(POD)
+        assert not Requirement(".metadata.deletionTimestamp", "Exists").matches(POD)
+
+    def test_does_not_exist(self):
+        assert Requirement(".metadata.deletionTimestamp", "DoesNotExist").matches(POD)
+        assert not Requirement(".status.phase", "DoesNotExist").matches(POD)
+
+    def test_missing_in_is_false_notin_true(self):
+        assert not Requirement(".no.such", "In", ["x"]).matches(POD)
+        assert Requirement(".no.such", "NotIn", ["x"]).matches(POD)
+
+    def test_error_behaves_as_missing(self):
+        # iterate over missing -> swallowed error -> DoesNotExist matches
+        assert Requirement(".status.list.[].x", "DoesNotExist").matches(POD)
+
+    def test_bool_compared_as_string(self):
+        data = {"x": True}
+        assert Requirement(".x", "In", ["true"]).matches(data)
+
+    def test_condition_select(self):
+        r = Requirement(
+            '.status.conditions.[] | select( .type == "Ready" ) | .status',
+            "In",
+            ["True"],
+        )
+        assert r.matches(POD)
+
+
+class TestIntGetter:
+    def test_static(self):
+        assert IntGetter(7, None).get(POD) == (7, True)
+
+    def test_no_value(self):
+        assert IntGetter(None, None).get(POD) == (0, False)
+
+    def test_expr_overrides(self):
+        assert IntGetter(7, '.metadata.annotations["w"]').get(POD) == (5, True)
+
+    def test_expr_missing_falls_back(self):
+        assert IntGetter(7, '.metadata.annotations["nope"]').get(POD) == (7, True)
+
+    def test_expr_unparsable_not_ok(self):
+        assert IntGetter(7, '.metadata.annotations["bad"]').get(POD) == (0, False)
+
+    def test_expr_empty_string_not_ok(self):
+        assert IntGetter(7, '.metadata.annotations["empty"]').get(POD) == (0, False)
+
+
+class TestDurationGetter:
+    def test_static(self):
+        assert DurationGetter(1.5, None).get(POD, NOW) == (1.5, True)
+
+    def test_expr_go_duration(self):
+        g = DurationGetter(1.0, '.metadata.annotations["delay"]')
+        assert g.get(POD, NOW) == (20.0, True)
+
+    def test_expr_missing_falls_back(self):
+        g = DurationGetter(1.0, '.metadata.annotations["nope"]')
+        assert g.get(POD, NOW) == (1.0, True)
+
+    def test_rfc3339_deadline(self):
+        data = {"t": "2026-01-01T00:01:40Z"}
+        g = DurationGetter(None, ".t")
+        assert g.get(data, NOW) == (100.0, True)
+
+
+def test_parse_go_duration():
+    assert parse_go_duration("10s") == 10.0
+    assert parse_go_duration("1.5h") == 5400.0
+    assert parse_go_duration("1m30s") == 90.0
+    assert parse_go_duration("100ms") == 0.1
+    assert parse_go_duration("-10s") == -10.0
+    assert parse_go_duration("junk") is None

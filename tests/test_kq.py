@@ -1,0 +1,107 @@
+"""kq query engine semantics (parity with reference
+pkg/utils/expression/query.go + gojq behavior for the stage subset)."""
+
+import pytest
+
+from kwok_tpu.utils.kq import KqCompileError, Query
+
+POD = {
+    "metadata": {
+        "name": "p0",
+        "annotations": {"k/delay": "10s", "weight": "3"},
+        "labels": {"chaos": "true"},
+        "finalizers": ["kwok.x-k8s.io/fake"],
+    },
+    "spec": {
+        "nodeName": "n0",
+        "containers": [{"name": "c1"}, {"name": "c2"}],
+    },
+    "status": {
+        "phase": "Running",
+        "podIP": "10.0.0.5",
+        "conditions": [
+            {"type": "Initialized", "status": "True"},
+            {"type": "Ready", "status": "False"},
+        ],
+        "containerStatuses": [
+            {"name": "c1", "state": {"running": {"startedAt": "t"}}},
+            {"name": "c2", "state": {"waiting": {"reason": "X"}}},
+        ],
+    },
+}
+
+
+def q(src, data=POD):
+    return Query(src).execute(data)
+
+
+def test_simple_field():
+    assert q(".status.phase") == ["Running"]
+
+
+def test_missing_field_drops_null():
+    assert q(".metadata.deletionTimestamp") == []
+
+
+def test_deep_missing_is_null_not_error():
+    assert q(".status.nosuch.deeper") == []
+
+
+def test_string_index():
+    assert q('.metadata.annotations["k/delay"]') == ["10s"]
+    assert q('.metadata.annotations["absent"]') == []
+
+
+def test_iterate_with_select():
+    src = '.status.conditions.[] | select( .type == "Initialized" ) | .status'
+    assert q(src) == ["True"]
+
+
+def test_iterate_chained_path():
+    assert q(".status.containerStatuses.[].state.running.startedAt") == ["t"]
+
+
+def test_iterate_missing_array_is_error_swallowed():
+    # gojq: iterating null errors; reference swallows -> None
+    assert q(".status.initContainerStatuses.[].state") is None
+
+
+def test_iterate_over_list():
+    assert q(".spec.containers.[].name") == ["c1", "c2"]
+
+
+def test_select_no_match():
+    src = '.status.conditions.[] | select( .type == "Nope" ) | .status'
+    assert q(src) == []
+
+
+def test_compare_not_equal():
+    src = '.status.conditions.[] | select( .type != "Ready" ) | .type'
+    assert q(src) == ["Initialized"]
+
+
+def test_bracket_without_dot():
+    assert q(".spec.containers[].name") == ["c1", "c2"]
+
+
+def test_literal():
+    assert q("3") == [3]
+
+
+def test_identity():
+    assert Query(".").execute(5) == [5]
+
+
+def test_bool_not_equal_int():
+    assert Query(". == 1").execute(True) == [False]
+
+
+def test_compile_error():
+    with pytest.raises(KqCompileError):
+        Query(".a + .b")  # arithmetic is out of subset
+    with pytest.raises(KqCompileError):
+        Query("map(.x)")
+
+
+def test_field_on_scalar_is_error():
+    assert q(".status.phase.deeper") is None

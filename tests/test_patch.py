@@ -1,0 +1,109 @@
+"""Patch appliers: JSON patch, merge patch, strategic merge, no-op detection
+(reference pkg/kwok/controllers/utils.go:162-304, lifecycle/finalizers.go)."""
+
+from kwok_tpu.utils.patch import (
+    apply_json_patch,
+    apply_merge_patch,
+    apply_strategic_merge_patch,
+    is_noop_patch,
+    wrap_json_patch_with_root,
+    wrap_with_root,
+)
+
+
+class TestJsonPatch:
+    def test_add_to_missing_list(self):
+        obj = {"metadata": {}}
+        out = apply_json_patch(
+            obj, [{"op": "add", "path": "/metadata/finalizers", "value": ["f1"]}]
+        )
+        assert out["metadata"]["finalizers"] == ["f1"]
+        assert obj == {"metadata": {}}  # original untouched
+
+    def test_append(self):
+        obj = {"metadata": {"finalizers": ["f1"]}}
+        out = apply_json_patch(
+            obj, [{"op": "add", "path": "/metadata/finalizers/-", "value": "f2"}]
+        )
+        assert out["metadata"]["finalizers"] == ["f1", "f2"]
+
+    def test_remove_index(self):
+        obj = {"metadata": {"finalizers": ["f1", "f2"]}}
+        out = apply_json_patch(obj, [{"op": "remove", "path": "/metadata/finalizers/0"}])
+        assert out["metadata"]["finalizers"] == ["f2"]
+
+    def test_remove_whole(self):
+        obj = {"metadata": {"finalizers": ["f1"]}}
+        out = apply_json_patch(obj, [{"op": "remove", "path": "/metadata/finalizers"}])
+        assert "finalizers" not in out["metadata"]
+
+
+class TestMergePatch:
+    def test_merge(self):
+        obj = {"status": {"phase": "Pending", "podIP": "1.2.3.4"}}
+        out = apply_merge_patch(obj, {"status": {"phase": "Running"}})
+        assert out == {"status": {"phase": "Running", "podIP": "1.2.3.4"}}
+
+    def test_null_deletes(self):
+        out = apply_merge_patch({"a": 1, "b": 2}, {"b": None})
+        assert out == {"a": 1}
+
+    def test_list_replaces(self):
+        out = apply_merge_patch({"l": [1, 2]}, {"l": [3]})
+        assert out == {"l": [3]}
+
+
+class TestStrategicMerge:
+    def test_conditions_merge_by_type(self):
+        obj = {
+            "status": {
+                "conditions": [
+                    {"type": "Ready", "status": "False", "reason": "old"},
+                    {"type": "PIDPressure", "status": "False"},
+                ]
+            }
+        }
+        patch = {"status": {"conditions": [{"type": "Ready", "status": "True"}]}}
+        out = apply_strategic_merge_patch(obj, patch)
+        conds = {c["type"]: c for c in out["status"]["conditions"]}
+        assert conds["Ready"]["status"] == "True"
+        assert conds["Ready"]["reason"] == "old"  # merged, not replaced
+        assert "PIDPressure" in conds
+
+    def test_container_statuses_merge_by_name(self):
+        obj = {"status": {"containerStatuses": [{"name": "c1", "ready": False}]}}
+        patch = {
+            "status": {
+                "containerStatuses": [
+                    {"name": "c1", "ready": True},
+                    {"name": "c2", "ready": True},
+                ]
+            }
+        }
+        out = apply_strategic_merge_patch(obj, patch)
+        assert [c["name"] for c in out["status"]["containerStatuses"]] == ["c1", "c2"]
+        assert out["status"]["containerStatuses"][0]["ready"] is True
+
+    def test_unknown_list_replaces(self):
+        out = apply_strategic_merge_patch({"x": [1, 2]}, {"x": [3]})
+        assert out == {"x": [3]}
+
+
+def test_wrap_with_root():
+    assert wrap_with_root("status", {"phase": "Running"}) == {
+        "status": {"phase": "Running"}
+    }
+    assert wrap_with_root("", {"a": 1}) == {"a": 1}
+
+
+def test_wrap_json_patch_with_root():
+    ops = [{"op": "remove", "path": "/finalizers"}]
+    assert wrap_json_patch_with_root("metadata", ops) == [
+        {"op": "remove", "path": "/metadata/finalizers"}
+    ]
+
+
+def test_noop_detection():
+    obj = {"status": {"phase": "Running"}}
+    assert is_noop_patch(obj, {"status": {"phase": "Running"}}, "merge")
+    assert not is_noop_patch(obj, {"status": {"phase": "Failed"}}, "merge")
