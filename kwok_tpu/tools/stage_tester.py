@@ -1,0 +1,144 @@
+"""Offline stage tester: apply Stages to one object without a cluster.
+
+Equivalent of reference pkg/tools/stage/stage.go:37-212 (driven by
+hack/test_stage/main.go): deterministic fake template funcs render
+placeholders like ``<Now>`` / ``<NodeIPWith("node")>`` so outputs are
+stable, and the result structure matches the reference's golden files
+(kustomize/stage/*/testdata/*.output.yaml) byte-for-structure.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from kwok_tpu.api.types import Stage
+from kwok_tpu.engine.lifecycle import Lifecycle, NextEffects
+
+_FAKE_FUNC_NAMES = [
+    "NodeIP",
+    "NodeName",
+    "NodePort",
+    "PodIP",
+    "NodeIPWith",
+    "PodIPWith",
+    "Now",
+    "now",
+    "Version",
+]
+
+
+def _go_repr(v: Any) -> str:
+    """Go %#v for the JSON scalar types the fake funcs receive
+    (reference stage.go:172-193 wrapFunction)."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, str):
+        return json.dumps(v)
+    if v is None:
+        return "interface {}(nil)"
+    return str(v)
+
+
+def _wrap_function(name: str):
+    def fake(*args: Any) -> str:
+        if not args:
+            return f"<{name}>"
+        return f"<{name}({', '.join(_go_repr(a) for a in args)})>"
+
+    return fake
+
+
+def fake_funcs() -> Dict[str, Any]:
+    return {name: _wrap_function(name) for name in _FAKE_FUNC_NAMES}
+
+
+def testing_stages(target: Dict[str, Any], stages: List[Stage]) -> Dict[str, Any]:
+    """Test stages against a target object (reference stage.go:37-86)."""
+    api_version = target.get("apiVersion", "v1")
+    kind = target.get("kind", "")
+    meta_obj = target.get("metadata") or {}
+
+    out_meta: Dict[str, Any] = {
+        "apiGroup": api_version,
+        "kind": kind,
+        "name": meta_obj.get("name", ""),
+    }
+    if meta_obj.get("namespace"):
+        out_meta["namespace"] = meta_obj["namespace"]
+
+    matching = [
+        s
+        for s in stages
+        if s.resource_ref.api_group == api_version and s.resource_ref.kind == kind
+    ]
+    lc = Lifecycle(matching)
+
+    labels = meta_obj.get("labels") or {}
+    annotations = meta_obj.get("annotations") or {}
+    candidates = lc.list_all_possible(labels, annotations, target)
+
+    out_meta["stages"] = [_testing_stage(lc, target, s) for s in candidates]
+    return out_meta
+
+
+def _testing_stage(lc: Lifecycle, target: Dict[str, Any], stage) -> Dict[str, Any]:
+    meta: Dict[str, Any] = {"stage": stage.name}
+
+    # Reference bug-compatibility (stage.go:122): delay is evaluated with
+    # the *compiled stage* as data, which marshals to {} — so expression
+    # overrides always fall back to the static values.
+    delay, ok = stage.delay({}, now=None, rng=_ZeroRandom())
+    if ok:
+        meta["delay"] = int(round(delay * 1e9))  # time.Duration ns in YAML
+
+    weight, ok = stage.weight(target)
+    if ok:
+        meta["weight"] = weight
+
+    if stage.next is None:
+        # The reference's StageNext is a value struct, never nil; a stage
+        # without a next block produces an empty effects list.
+        meta["next"] = []
+        return meta
+
+    effects = NextEffects(stage.next, lc.renderer)
+    out: List[Any] = []
+
+    fin = effects.finalizers_patch((target.get("metadata") or {}).get("finalizers") or [])
+    if fin is not None:
+        out.append(_format_patch(fin))
+
+    if effects.delete:
+        out.append({"kind": "delete"})
+        meta["next"] = out
+        return meta
+
+    for patch in effects.patches(target, fake_funcs()):
+        out.append(_format_patch(patch))
+
+    if stage.immediate_next_stage:
+        out.append({"kind": "immediate"})
+
+    meta["next"] = out
+    return meta
+
+
+class _ZeroRandom:
+    """Deterministic rng: jitter always resolves to the lower bound."""
+
+    def random(self) -> float:
+        return 0.0
+
+    def randrange(self, n: int) -> int:
+        return 0
+
+
+def _format_patch(patch) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"kind": "patch", "type": patch.content_type}
+    if patch.subresource:
+        out["subresource"] = patch.subresource
+    out["data"] = patch.data
+    if patch.impersonation:
+        out["impersonation"] = patch.impersonation
+    return out

@@ -146,8 +146,9 @@ class IntGetter:
                 return 0, False
             return self.value, True
         out = self.query.execute(data)
-        if out is None:
-            return 0, False  # query error
+        # Runtime query errors are swallowed to an empty result by the
+        # reference (query.go:57-59 returns nil, nil), so both None and []
+        # fall back to the static value.
         if not out:
             if self.value is not None:
                 return self.value, True
@@ -185,8 +186,7 @@ class DurationGetter:
                 return 0.0, False
             return self.value, True
         out = self.query.execute(data)
-        if out is None:
-            return 0.0, False
+        # None (swallowed error) and [] both mean "no data" -> static fallback.
         if not out:
             if self.value is not None:
                 return self.value, True

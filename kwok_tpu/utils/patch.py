@@ -39,9 +39,8 @@ _MERGE_KEYS = {
     "env": "name",
     "ports": "containerPort",
     "addresses": "type",
-    "taints": "key",
-    "tolerations": "key",
-    "images": "names",
+    # NOTE: node status.images, taints and tolerations are atomic lists in
+    # k8s (no patchMergeKey) and must replace wholesale.
     "finalizers": None,  # set-merge
 }
 

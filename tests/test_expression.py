@@ -100,3 +100,11 @@ def test_parse_go_duration():
     assert parse_go_duration("100ms") == 0.1
     assert parse_go_duration("-10s") == -10.0
     assert parse_go_duration("junk") is None
+
+
+def test_query_runtime_error_falls_back_to_static():
+    # gojq errors are swallowed to empty results (query.go:57-59), so the
+    # static value wins — NOT a hard failure.
+    assert IntGetter(5, ".metadata.name.foo").get({"metadata": {"name": "abc"}}) == (5, True)
+    g = DurationGetter(2.0, ".metadata.name.foo")
+    assert g.get({"metadata": {"name": "abc"}}, NOW) == (2.0, True)

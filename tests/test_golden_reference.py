@@ -1,0 +1,54 @@
+"""Golden parity against the reference's offline stage-tester testdata.
+
+Runs our stage tester (kwok_tpu.tools.stage_tester) over the reference
+tree's checked-in golden inputs (kustomize/stage/*/testdata/*.input.yaml)
+and compares structurally with the matching *.output.yaml. These files
+are consumed as PUBLIC test *inputs* at runtime — nothing is copied.
+
+Skipped when the reference tree is not mounted.
+"""
+
+import os
+import re
+import glob
+
+import pytest
+import yaml
+
+from kwok_tpu.api.loader import load_stages
+from kwok_tpu.tools.stage_tester import testing_stages as run_stage_tester
+
+REFERENCE = "/root/reference"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE), reason="reference tree not available"
+)
+
+
+def _collect_cases():
+    if not os.path.isdir(REFERENCE):
+        return []
+    inputs = glob.glob(f"{REFERENCE}/kustomize/stage/*/*/testdata/*.input.yaml")
+    return sorted(inputs)
+
+
+def _load_case(input_path):
+    with open(input_path, "r", encoding="utf-8") as f:
+        text = f.read()
+    stage_files = re.findall(r"^#\s*@Stage:\s*(\S+)", text, re.MULTILINE)
+    stages = []
+    base = os.path.dirname(input_path)
+    for rel in stage_files:
+        stages.extend(load_stages(os.path.normpath(os.path.join(base, rel))))
+    target = yaml.safe_load(text)
+    return target, stages
+
+
+@pytest.mark.parametrize("input_path", _collect_cases(), ids=os.path.basename)
+def test_golden(input_path):
+    target, stages = _load_case(input_path)
+    got = run_stage_tester(target, stages)
+    output_path = input_path.replace(".input.yaml", ".output.yaml")
+    with open(output_path, "r", encoding="utf-8") as f:
+        want = yaml.safe_load(f)
+    assert got == want

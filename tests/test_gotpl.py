@@ -1,0 +1,201 @@
+"""gotpl subset renderer semantics (reference pkg/utils/gotpl)."""
+
+import pytest
+
+from kwok_tpu.utils.gotpl import (
+    NODE_CONDITIONS,
+    Renderer,
+    Template,
+    TemplateError,
+)
+
+POD = {
+    "metadata": {"name": "p0", "annotations": {"k": "v"}},
+    "spec": {
+        "nodeName": "n0",
+        "containers": [
+            {"name": "app", "image": "img:1"},
+            {"name": "sidecar", "image": "img:2"},
+        ],
+    },
+    "status": {},
+}
+
+
+def render(src, data=POD, funcs=None):
+    return Renderer().render(src, data, funcs)
+
+
+def test_field_output():
+    assert render("{{ .metadata.name }}") == "p0"
+
+
+def test_quote_string():
+    assert render("{{ .metadata.name | Quote }}") == '"p0"'
+
+
+def test_quote_number():
+    assert render("{{ 5 | Quote }}") == '"5"'
+
+
+def test_variable_assign_and_use():
+    assert render("{{ $x := .metadata.name }}a={{ $x }}") == "a=p0"
+
+
+def test_if_else():
+    assert render("{{ if .spec.containers }}yes{{ else }}no{{ end }}") == "yes"
+    assert render("{{ if .spec.initContainers }}yes{{ else }}no{{ end }}") == "no"
+
+
+def test_else_if():
+    src = "{{ if .a }}A{{ else if .b }}B{{ else }}C{{ end }}"
+    assert render(src, {"b": 1}) == "B"
+    assert render(src, {}) == "C"
+
+
+def test_range_with_dot():
+    src = "{{ range .spec.containers }}[{{ .name }}]{{ end }}"
+    assert render(src) == "[app][sidecar]"
+
+
+def test_range_index_value():
+    src = "{{ range $i, $c := .spec.containers }}{{ $i }}:{{ $c.name }} {{ end }}"
+    assert render(src) == "0:app 1:sidecar "
+
+
+def test_range_else_on_empty():
+    src = "{{ range .spec.initContainers }}x{{ else }}empty{{ end }}"
+    assert render(src) == "empty"
+
+
+def test_with_rebinds_dot():
+    src = "{{ with .metadata }}{{ .name }}{{ end }}"
+    assert render(src) == "p0"
+
+
+def test_with_else():
+    src = "{{ with .status.addresses }}has{{ else }}none{{ end }}"
+    assert render(src) == "none"
+
+
+def test_or_fallback():
+    assert render('{{ or .status.phase "Pending" }}') == "Pending"
+    assert render('{{ or .metadata.name "x" }}') == "p0"
+
+
+def test_or_with_nil_chain():
+    # field access through a missing map key must not error
+    src = '{{ $ni := .status.nodeInfo }}{{ or $ni.architecture "amd64" }}'
+    assert render(src) == "amd64"
+
+
+def test_eq_and_not():
+    src = '{{ if eq .metadata.name "p0" }}y{{ end }}'
+    assert render(src) == "y"
+    assert render("{{ not .status.phase }}") == "true"
+
+
+def test_index_fn():
+    src = '{{ index .metadata.annotations "k" }}'
+    assert render(src) == "v"
+
+
+def test_index_into_list():
+    src = "{{ $c := index .spec.containers 1 }}{{ $c.name }}"
+    assert render(src) == "sidecar"
+
+
+def test_printf_version():
+    out = render('{{ printf "kwok-%s" "1.2" }}')
+    assert out == "kwok-1.2"
+
+
+def test_dict_and_or():
+    src = "{{ $a := or .metadata.missing dict }}{{ len $a }}"
+    assert render(src) == "0"
+
+
+def test_node_conditions_range():
+    src = "{{ range NodeConditions }}{{ .type }},{{ end }}"
+    assert render(src) == ",".join(c["type"] for c in NODE_CONDITIONS) + ","
+
+
+def test_env_funcs_injected():
+    src = "{{ NodeIPWith .spec.nodeName | Quote }}"
+    out = render(src, POD, {"NodeIPWith": lambda n: f"10.0.0.{len(n)}"})
+    assert out == '"10.0.0.2"'
+
+
+def test_backtick_raw_string():
+    assert render('{{ or .status.bootID `""` }}') == '""'
+
+
+def test_parenthesized_call():
+    src = '{{ or ( index .metadata.annotations "k" ) "d" }}'
+    assert render(src) == "v"
+
+
+def test_now_is_rfc3339():
+    out = render("{{ Now }}")
+    assert out.endswith("Z") and "T" in out
+
+
+def test_yaml_fn_with_indent():
+    out = render("x:{{ YAML .metadata.annotations 1 }}", POD)
+    assert "\n  k: v" in out
+
+
+def test_trim_markers():
+    assert render("a {{- `b` -}} c") == "abc"
+
+
+def test_root_var():
+    src = "{{ range .spec.containers }}{{ $.metadata.name }}:{{ .name }} {{ end }}"
+    assert render(src) == "p0:app p0:sidecar "
+
+
+def test_render_to_json():
+    r = Renderer()
+    out = r.render_to_json("phase: Running\nready: true", {})
+    assert out == {"phase": "Running", "ready": True}
+
+
+def test_unbalanced_end_raises():
+    with pytest.raises(TemplateError):
+        Template("{{ if .a }}x")
+
+
+def test_unknown_function_raises():
+    with pytest.raises(TemplateError):
+        render("{{ Bogus }}")
+
+
+def test_pod_status_template_end_to_end():
+    """A realistic pod status template exercising the full construct mix."""
+    src = (
+        "{{ $now := Now }}\n"
+        "conditions:\n"
+        "{{ range .spec.readinessGates }}\n"
+        "- lastTransitionTime: {{ $now | Quote }}\n"
+        "  type: {{ .conditionType | Quote }}\n"
+        "{{ end }}\n"
+        "containerStatuses:\n"
+        "{{ range .spec.containers }}\n"
+        "- image: {{ .image | Quote }}\n"
+        "  name: {{ .name | Quote }}\n"
+        "  ready: true\n"
+        "{{ end }}\n"
+        "phase: Running\n"
+    )
+    out = Renderer().render_to_json(src, POD)
+    assert out["phase"] == "Running"
+    assert [c["name"] for c in out["containerStatuses"]] == ["app", "sidecar"]
+
+
+def test_unicode_string_literal():
+    assert render('{{ "café ☕" }}') == "café ☕"
+
+
+def test_escape_sequences():
+    assert render('{{ "a\\nb\\tc" }}') == "a\nb\tc"
+    assert render('{{ "\\u0041" }}') == "A"

@@ -1,0 +1,351 @@
+"""Host lifecycle engine: full FSM trajectories through the builtin
+stage zoo, weighted-choice ladder, delay semantics, finalizer ops
+(reference pkg/utils/lifecycle + pkg/kwok/controllers behavior)."""
+
+import datetime
+import random
+
+from kwok_tpu.api.types import Stage
+from kwok_tpu.engine.lifecycle import Lifecycle
+from kwok_tpu.stages import (
+    NODE_FAST,
+    POD_CHAOS,
+    POD_FAST,
+    POD_GENERAL,
+    default_node_stages,
+    load_builtin,
+)
+from kwok_tpu.utils.patch import apply_patch
+
+NOW = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+ENV_FUNCS = {
+    "NodeIP": lambda: "196.168.0.1",
+    "NodeName": lambda: "node-0",
+    "NodePort": lambda: 10250,
+    "NodeIPWith": lambda name: "196.168.0.1",
+    "PodIP": lambda: "10.0.0.1",
+    "PodIPWith": lambda *a: "10.0.0.1",
+}
+
+
+def new_pod(name="p0", owner_job=False, init_containers=False, **meta_extra):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "uid": "u-" + name},
+        "spec": {
+            "nodeName": "node-0",
+            "containers": [{"name": "app", "image": "img"}],
+        },
+        "status": {},
+    }
+    pod["metadata"].update(meta_extra)
+    if owner_job:
+        pod["metadata"]["ownerReferences"] = [{"kind": "Job", "name": "j"}]
+    if init_containers:
+        pod["spec"]["initContainers"] = [{"name": "setup", "image": "init-img"}]
+    return pod
+
+
+def drive(lc, obj, max_steps=10, rng=None):
+    """Drive an object through the FSM until no stage matches or it is
+    deleted; returns (obj, trajectory, deleted)."""
+    rng = rng or random.Random(0)
+    trajectory = []
+    for _ in range(max_steps):
+        meta = obj.get("metadata") or {}
+        stage = lc.select(meta.get("labels") or {}, meta.get("annotations") or {}, obj, rng)
+        if stage is None:
+            return obj, trajectory, False
+        trajectory.append(stage.name)
+        effects = lc.effects(stage)
+        fin = effects.finalizers_patch(meta.get("finalizers") or [])
+        if fin is not None:
+            obj = apply_patch(obj, fin.data, fin.type)
+        if effects.delete:
+            return obj, trajectory, True
+        for p in effects.patches(obj, ENV_FUNCS):
+            obj = apply_patch(obj, p.data, p.type)
+    raise AssertionError(f"did not converge; trajectory={trajectory}")
+
+
+class TestPodFast:
+    def test_plain_pod_reaches_running(self):
+        lc = Lifecycle(load_builtin(POD_FAST))
+        obj, traj, deleted = drive(lc, new_pod())
+        assert traj == ["pod-ready"]
+        assert not deleted
+        assert obj["status"]["phase"] == "Running"
+        assert obj["status"]["podIP"] == "10.0.0.1"
+        conds = {c["type"]: c["status"] for c in obj["status"]["conditions"]}
+        assert conds["Ready"] == "True"
+        cs = obj["status"]["containerStatuses"][0]
+        assert cs["ready"] is True and "running" in cs["state"]
+
+    def test_job_pod_completes(self):
+        lc = Lifecycle(load_builtin(POD_FAST))
+        obj, traj, deleted = drive(lc, new_pod(owner_job=True))
+        assert traj == ["pod-ready", "pod-complete"]
+        assert obj["status"]["phase"] == "Succeeded"
+        assert "terminated" in obj["status"]["containerStatuses"][0]["state"]
+
+    def test_deleted_pod_is_deleted(self):
+        lc = Lifecycle(load_builtin(POD_FAST))
+        pod = new_pod(deletionTimestamp="2026-01-01T00:00:00Z")
+        pod["metadata"]["finalizers"] = ["kwok.x-k8s.io/fake"]
+        obj, traj, deleted = drive(lc, pod)
+        assert traj == ["pod-delete"]
+        assert deleted
+        # finalizers emptied before delete
+        assert "finalizers" not in obj["metadata"]
+
+
+class TestPodGeneral:
+    def test_plain_pod_full_path(self):
+        lc = Lifecycle(load_builtin(POD_GENERAL))
+        obj, traj, deleted = drive(lc, new_pod())
+        assert traj == ["pod-create", "pod-ready"]
+        assert obj["status"]["phase"] == "Running"
+        assert obj["metadata"]["finalizers"] == ["kwok.x-k8s.io/fake"]
+
+    def test_init_container_path(self):
+        lc = Lifecycle(load_builtin(POD_GENERAL))
+        obj, traj, deleted = drive(lc, new_pod(init_containers=True))
+        assert traj == [
+            "pod-create",
+            "pod-init-container-running",
+            "pod-init-container-completed",
+            "pod-ready",
+        ]
+        assert obj["status"]["phase"] == "Running"
+        ics = obj["status"]["initContainerStatuses"][0]
+        assert "terminated" in ics["state"]
+
+    def test_job_pod_completes_and_delete_path(self):
+        lc = Lifecycle(load_builtin(POD_GENERAL))
+        obj, traj, _ = drive(lc, new_pod(owner_job=True))
+        assert traj[-1] == "pod-complete"
+        assert obj["status"]["phase"] == "Succeeded"
+        # now mark deleted: remove-finalizer then delete
+        obj["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+        obj, traj2, deleted = drive(lc, obj)
+        assert traj2 == ["pod-remove-finalizer", "pod-delete"]
+        assert deleted
+
+
+class TestPodChaos:
+    def test_chaos_wins_over_general_by_weight_and_churns(self):
+        """The chaos stage (weight 10000 vs 1) beats the normal path, and
+        the resulting Failed->ready->Failed oscillation is the intended
+        CrashLoopBackOff-style churn — the FSM must NOT converge."""
+        stages = load_builtin(POD_GENERAL) + load_builtin(POD_CHAOS)
+        lc = Lifecycle(stages)
+        obj = new_pod(labels={"pod-container-running-failed.stage.kwok.x-k8s.io": "true"})
+        rng = random.Random(0)
+        traj = []
+        for _ in range(6):
+            meta = obj["metadata"]
+            stage = lc.select(meta.get("labels") or {}, meta.get("annotations") or {}, obj, rng)
+            assert stage is not None  # churn: always another transition
+            traj.append(stage.name)
+            for p in lc.effects(stage).patches(obj, ENV_FUNCS):
+                obj = apply_patch(obj, p.data, p.type)
+        assert traj[0] == "pod-create"
+        assert traj.count("pod-container-running-failed") >= 2  # keeps failing
+        failed = [t for t in traj if t == "pod-container-running-failed"]
+        assert failed, traj
+
+    def test_chaos_respects_annotation_overrides(self):
+        lc = Lifecycle(load_builtin(POD_CHAOS))
+        pod = new_pod(
+            labels={"pod-container-running-failed.stage.kwok.x-k8s.io": "true"},
+            annotations={
+                "pod-container-running-failed.stage.kwok.x-k8s.io/reason": "OOMKilled",
+                "pod-container-running-failed.stage.kwok.x-k8s.io/exit-code": "137",
+            },
+        )
+        pod["status"] = {"phase": "Running"}
+        obj, traj, _ = drive(lc, pod, max_steps=2)
+        term = obj["status"]["containerStatuses"][0]["state"]["terminated"]
+        assert term["reason"] == "OOMKilled"
+        assert term["exitCode"] == 137
+
+
+class TestNode:
+    def test_node_initialize_then_heartbeat_loop(self):
+        lc = Lifecycle(default_node_stages())
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": "node-0", "creationTimestamp": "2026-01-01T00:00:00Z"},
+            "status": {},
+        }
+        stage = lc.select({}, {}, node, random.Random(0))
+        assert stage.name == "node-initialize"
+        for p in lc.effects(stage).patches(node, ENV_FUNCS):
+            node = apply_patch(node, p.data, p.type)
+        assert node["status"]["phase"] == "Running"
+        conds = {c["type"]: c for c in node["status"]["conditions"]}
+        assert conds["Ready"]["status"] == "True"
+        assert node["status"]["nodeInfo"]["architecture"] == "amd64"
+        assert node["status"]["allocatable"]["pods"] == "1M"
+        # now the heartbeat stage self-matches forever
+        stage2 = lc.select({}, {}, node, random.Random(0))
+        assert stage2.name == "node-heartbeat"
+        assert stage2.immediate_next_stage
+        delay, ok = stage2.delay(node, NOW)
+        assert ok and 20.0 <= delay <= 25.0
+
+
+class TestDelaySemantics:
+    def make_stage(self, delay_spec):
+        return Stage.from_dict(
+            {
+                "metadata": {"name": "s"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {"matchExpressions": []},
+                    "delay": delay_spec,
+                },
+            }
+        )
+
+    def test_annotation_delay_override(self):
+        lc = Lifecycle(
+            [
+                self.make_stage(
+                    {
+                        "durationMilliseconds": 1000,
+                        "durationFrom": {
+                            "expressionFrom": '.metadata.annotations["d"]'
+                        },
+                    }
+                )
+            ]
+        )
+        s = lc.stages[0]
+        pod = {"metadata": {"annotations": {"d": "90s"}}}
+        assert s.delay(pod, NOW) == (90.0, True)
+        assert s.delay({"metadata": {}}, NOW) == (1.0, True)
+
+    def test_jitter_below_duration_returns_jitter(self):
+        s = Lifecycle(
+            [
+                self.make_stage(
+                    {"durationMilliseconds": 5000, "jitterDurationMilliseconds": 2000}
+                )
+            ]
+        ).stages[0]
+        assert s.delay({}, NOW) == (2.0, True)
+
+    def test_jitter_uniform_range(self):
+        s = Lifecycle(
+            [
+                self.make_stage(
+                    {"durationMilliseconds": 1000, "jitterDurationMilliseconds": 5000}
+                )
+            ]
+        ).stages[0]
+        rng = random.Random(7)
+        for _ in range(50):
+            d, ok = s.delay({}, NOW, rng)
+            assert ok and 1.0 <= d < 5.0
+
+    def test_deletion_timestamp_deadline_jitter(self):
+        # pod-delete (general): jitterDurationFrom .metadata.deletionTimestamp
+        s = Lifecycle(
+            [
+                self.make_stage(
+                    {
+                        "durationMilliseconds": 1000,
+                        "jitterDurationFrom": {
+                            "expressionFrom": ".metadata.deletionTimestamp"
+                        },
+                    }
+                )
+            ]
+        ).stages[0]
+        pod = {"metadata": {"deletionTimestamp": "2026-01-01T00:00:00.5Z"}}
+        d, ok = s.delay(pod, NOW)
+        assert ok and d == 0.5  # jitter(0.5s) < duration(1s) -> jitter
+
+
+class TestWeightedLadder:
+    def make(self, name, weight=None, weight_from=None):
+        spec = {
+            "resourceRef": {"kind": "Pod"},
+            "selector": {"matchExpressions": []},
+        }
+        if weight is not None:
+            spec["weight"] = weight
+        if weight_from:
+            spec["weightFrom"] = {"expressionFrom": weight_from}
+        return Stage.from_dict({"metadata": {"name": name}, "spec": spec})
+
+    def test_zero_total_uniform(self):
+        lc = Lifecycle([self.make("a"), self.make("b")])
+        picks = {lc.select({}, {}, {}, random.Random(i)).name for i in range(20)}
+        assert picks == {"a", "b"}
+
+    def test_weighted_choice_distribution(self):
+        lc = Lifecycle([self.make("a", weight=1), self.make("b", weight=9)])
+        rng = random.Random(42)
+        counts = {"a": 0, "b": 0}
+        for _ in range(500):
+            counts[lc.select({}, {}, {}, rng).name] += 1
+        assert counts["b"] > counts["a"] * 3
+
+    def test_single_match_short_circuits(self):
+        lc = Lifecycle([self.make("only", weight=0)])
+        assert lc.select({}, {}, {}).name == "only"
+
+    def test_match_labels(self):
+        s = self.make("labeled")
+        s.selector.match_labels = {"app": "x"}
+        lc = Lifecycle([s])
+        assert lc.select({"app": "x"}, {}, {}) is not None
+        assert lc.select({"app": "y"}, {}, {}) is None
+        assert lc.select({}, {}, {}) is None
+
+    def test_selectorless_stage_dropped(self):
+        s = Stage.from_dict(
+            {"metadata": {"name": "nosel"}, "spec": {"resourceRef": {"kind": "Pod"}}}
+        )
+        assert Lifecycle([s]).stages == []
+
+
+class TestJsonStandard:
+    def test_yaml_datetime_normalized(self):
+        import datetime as dt
+        from kwok_tpu.engine.lifecycle import to_json_standard
+        from kwok_tpu.utils.expression import Requirement
+
+        obj = {
+            "metadata": {
+                "deletionTimestamp": dt.datetime(2006, 1, 2, 15, 4, 5, tzinfo=dt.timezone.utc)
+            }
+        }
+        norm = to_json_standard(obj)
+        assert norm["metadata"]["deletionTimestamp"] == "2006-01-02T15:04:05Z"
+        # original untouched
+        assert isinstance(obj["metadata"]["deletionTimestamp"], dt.datetime)
+        r = Requirement(".metadata.deletionTimestamp", "In", ["2006-01-02T15:04:05Z"])
+        assert r.matches(norm)
+
+    def test_clean_object_not_copied(self):
+        from kwok_tpu.engine.lifecycle import to_json_standard
+
+        obj = {"a": [1, {"b": "x"}]}
+        assert to_json_standard(obj) is obj
+
+    def test_lifecycle_normalizes_at_entry(self):
+        import datetime as dt
+
+        lc = Lifecycle(load_builtin(POD_FAST))
+        pod = new_pod()
+        pod["metadata"]["deletionTimestamp"] = dt.datetime(
+            2026, 1, 1, tzinfo=dt.timezone.utc
+        )
+        stage = lc.select({}, {}, pod, random.Random(0))
+        assert stage.name == "pod-delete"
