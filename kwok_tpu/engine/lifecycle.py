@@ -242,6 +242,12 @@ class Lifecycle:
         self, labels: Dict[str, str], annotations: Dict[str, str], data: Any
     ) -> List[CompiledStage]:
         data = to_json_standard(data)
+        return self._match_std(labels, annotations, data)
+
+    def _match_std(
+        self, labels: Dict[str, str], annotations: Dict[str, str], data: Any
+    ) -> List[CompiledStage]:
+        """match() over already-standardized data (internal fast path)."""
         return [s for s in self.stages if s.match(labels, annotations, data)]
 
     def select(
@@ -255,7 +261,7 @@ class Lifecycle:
         (lifecycle.go:125-191)."""
         rng = rng or random
         data = to_json_standard(data)
-        stages = self.match(labels, annotations, data)
+        stages = self._match_std(labels, annotations, data)
         if not stages:
             return None
         if len(stages) == 1:
@@ -265,7 +271,7 @@ class Lifecycle:
         total = 0
         count_error = 0
         for s in stages:
-            w, ok = s.weight(data)
+            w, ok = s.weight_getter.get(data)
             if ok:
                 total += w
                 weights.append(w)
@@ -296,7 +302,7 @@ class Lifecycle:
     ) -> List[CompiledStage]:
         """Deterministic candidate set (lifecycle.go:66-122)."""
         data = to_json_standard(data)
-        stages = self.match(labels, annotations, data)
+        stages = self._match_std(labels, annotations, data)
         if len(stages) <= 1:
             return stages
 
@@ -304,7 +310,7 @@ class Lifecycle:
         total = 0
         count_error = 0
         for s in stages:
-            w, ok = s.weight(data)
+            w, ok = s.weight_getter.get(data)
             if ok:
                 total += w
                 weights.append(w)

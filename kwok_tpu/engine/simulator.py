@@ -1,0 +1,324 @@
+"""DeviceSimulator: the TPU execution backend behind the Stage API.
+
+Owns the device-resident SoA and the host-side object mirror. The
+division of labor mirrors the Go<->device bridge mandated by the north
+star (SURVEY.md §2.9, §7): objects are admitted/updated/deleted on the
+host (feature extraction + signature/override classing), the tick
+kernel advances the FSM on device, and only *dirty rows* come back —
+the host then materializes their full JSON status with the same
+renderer the CPU backend uses, which is what makes device/host parity
+checkable feature-by-feature.
+
+Virtual time: int32 milliseconds since ``epoch`` (a wall-clock
+datetime); ~24 days of simulated time per run, which bounds nothing in
+practice since runs are restartable from snapshots.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kwok_tpu.api.types import Stage
+from kwok_tpu.engine.compiler import (
+    IDLE,
+    NEVER,
+    SENTINEL,
+    CompiledStageSet,
+    StageCompileError,
+)
+from kwok_tpu.engine.lifecycle import to_json_standard
+from kwok_tpu.ops.tick import SoA, TickParams, params_from_compiled, tick
+from kwok_tpu.utils.patch import apply_patch
+
+DEFAULT_EPOCH = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def default_env_funcs() -> Dict[str, Callable]:
+    """Deterministic NodeIP/PodIP-style funcs for materialization
+    (reference: node_controller.go:521-531, pod_controller.go:559-615
+    derive these from the node IP pool; here they are hash-derived)."""
+
+    def node_ip(name: str = "") -> str:
+        h = int(hashlib.sha1(name.encode()).hexdigest(), 16)
+        return f"10.{(h >> 16) % 256}.{(h >> 8) % 256}.{h % 254 + 1}"
+
+    def pod_ip(*args: Any) -> str:
+        h = int(hashlib.sha1(json.dumps([str(a) for a in args]).encode()).hexdigest(), 16)
+        return f"10.{64 + (h >> 16) % 64}.{(h >> 8) % 256}.{h % 254 + 1}"
+
+    return {
+        "NodeIP": lambda: "10.0.0.1",
+        "NodeName": lambda: "kwok-node",
+        "NodePort": lambda: 10250,
+        "NodeIPWith": node_ip,
+        "PodIP": lambda: pod_ip("default"),
+        "PodIPWith": pod_ip,
+    }
+
+
+class Transition:
+    """One materializable FSM transition drained from the device."""
+
+    __slots__ = ("row", "stage_idx", "stage_name", "t_ms", "deleted", "event")
+
+    def __init__(self, row, stage_idx, stage_name, t_ms, deleted, event):
+        self.row = row
+        self.stage_idx = stage_idx
+        self.stage_name = stage_name
+        self.t_ms = t_ms
+        self.deleted = deleted
+        self.event = event
+
+    def __repr__(self):
+        return (
+            f"Transition(row={self.row}, stage={self.stage_name!r}, "
+            f"t_ms={self.t_ms}, deleted={self.deleted})"
+        )
+
+
+class DeviceSimulator:
+    """Vectorized Stage-FSM simulator for one resource class."""
+
+    def __init__(
+        self,
+        stages: List[Stage],
+        capacity: int,
+        epoch: datetime.datetime = DEFAULT_EPOCH,
+        seed: int = 0,
+        env_funcs: Optional[Dict[str, Callable]] = None,
+    ):
+        self.cset = CompiledStageSet(stages)
+        self.capacity = capacity
+        self.epoch = epoch
+        self.env_funcs = dict(env_funcs) if env_funcs is not None else default_env_funcs()
+        C = self.cset.C
+
+        # host-side row storage (numpy until to_device)
+        self.features = np.zeros((capacity, C), np.int32)
+        self.sig = np.zeros(capacity, np.int32)
+        self.ovc = np.zeros(capacity, np.int32)
+        self.stage = np.full(capacity, IDLE, np.int32)
+        self.fire_at = np.full(capacity, NEVER, np.int32)
+        self.active = np.zeros(capacity, np.bool_)
+        self.rematch = np.zeros(capacity, np.bool_)
+        self.del_ts = np.full(capacity, SENTINEL, np.int32)
+
+        self.objects: List[Optional[dict]] = [None] * capacity
+        self.num_rows = 0
+        self._seed = seed
+        self._admit_cache: Dict[str, Tuple[int, int, np.ndarray]] = {}
+        self._name_fast_path = not any(
+            c.path_prefix[:2] in (("metadata", "name"), ("metadata", "namespace"), ("metadata", "uid"))
+            for c in self.cset.schema.columns
+            if c.path_prefix
+        )
+
+        self._soa: Optional[SoA] = None
+        self._params: Optional[TickParams] = None
+        self._params_version = -1
+
+    # ------------------------------------------------------------------ host ops
+
+    def admit(self, obj: dict) -> int:
+        """Add an object; returns its row index."""
+        if self.num_rows >= self.capacity:
+            raise ValueError("simulator capacity exhausted")
+        obj = to_json_standard(obj)
+        row = self.num_rows
+        self.num_rows += 1
+
+        cache_key = None
+        if self._name_fast_path:
+            meta = obj.get("metadata") or {}
+            content = {
+                "spec": obj.get("spec"),
+                "labels": meta.get("labels"),
+                "annotations": meta.get("annotations"),
+                "ownerReferences": meta.get("ownerReferences"),
+                "status": obj.get("status"),
+                "deletionTimestamp": meta.get("deletionTimestamp"),
+                "finalizers": meta.get("finalizers"),
+            }
+            cache_key = hashlib.sha1(
+                json.dumps(content, sort_keys=True, default=str).encode()
+            ).hexdigest()
+            hit = self._admit_cache.get(cache_key)
+            if hit is not None:
+                sig, ovc, feats = hit
+                self.sig[row] = sig
+                self.ovc[row] = ovc
+                self.features[row] = feats
+                self._finish_admit(row, obj)
+                return row
+
+        sig = self.cset.signature_for(obj)
+        ovc = self.cset.override_class_for(obj)
+        feats = self.cset.extract_features(obj)
+        self.sig[row] = sig
+        self.ovc[row] = ovc
+        self.features[row] = feats
+        if cache_key is not None:
+            self._admit_cache[cache_key] = (sig, ovc, feats)
+        self._finish_admit(row, obj)
+        return row
+
+    def _finish_admit(self, row: int, obj: dict) -> None:
+        self.objects[row] = obj
+        self.active[row] = True
+        self.rematch[row] = True
+        self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
+        self._soa = None  # host arrays changed; re-upload lazily
+
+    def request_delete(self, row: int, at_ms: int) -> None:
+        """External delete request: set deletionTimestamp and re-evaluate
+        (the apiserver's graceful-delete path)."""
+        obj = self.objects[row]
+        if obj is None:
+            return
+        ts = self.epoch + datetime.timedelta(milliseconds=int(at_ms))
+        obj.setdefault("metadata", {})["deletionTimestamp"] = (
+            ts.isoformat(timespec="seconds").replace("+00:00", "Z")
+        )
+        self.refresh_row(row)
+
+    def refresh_row(self, row: int) -> None:
+        """Re-extract features after an external mutation and force rematch."""
+        obj = self.objects[row]
+        self.features[row] = self.cset.extract_features(obj)
+        self.ovc[row] = self.cset.override_class_for(obj)
+        self.sig[row] = self.cset.signature_for(obj)
+        self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
+        self.rematch[row] = True
+        self._soa = None
+
+    # ---------------------------------------------------------------- device ops
+
+    def to_device(self) -> Tuple[TickParams, SoA]:
+        if self._params is None or self._params_version != self.cset.version:
+            self._params = params_from_compiled(self.cset)
+            self._params_version = self.cset.version
+        if self._soa is None:
+            self._soa = SoA(
+                features=jnp.asarray(self.features),
+                sig=jnp.asarray(self.sig),
+                ovc=jnp.asarray(self.ovc),
+                stage=jnp.asarray(self.stage),
+                fire_at=jnp.asarray(self.fire_at),
+                active=jnp.asarray(self.active),
+                rematch=jnp.asarray(self.rematch),
+                del_ts=jnp.asarray(self.del_ts),
+                now=jnp.int32(0),
+                key=jax.random.PRNGKey(self._seed),
+            )
+        return self._params, self._soa
+
+    def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
+        """One tick; drains and (optionally) materializes transitions."""
+        params, soa = self.to_device()
+        new_soa, out = tick(params, soa, dt_ms)
+        self._soa = new_soa
+
+        transitions: List[Transition] = []
+        if int(out.fired_count) > 0:
+            fired = np.asarray(out.fired)
+            fired_stage = np.asarray(out.fired_stage)
+            deleted = np.asarray(out.deleted)
+            t_ms = int(new_soa.now)
+            for row in np.nonzero(fired)[0]:
+                s_idx = int(fired_stage[row])
+                cs = self.cset.compiled[s_idx]
+                event = None
+                eid = int(self.cset.stage_event[s_idx])
+                if eid >= 0:
+                    event = self.cset.events[eid]
+                tr = Transition(
+                    row=int(row),
+                    stage_idx=s_idx,
+                    stage_name=cs.name,
+                    t_ms=t_ms,
+                    deleted=bool(deleted[row]),
+                    event=event,
+                )
+                transitions.append(tr)
+                if materialize:
+                    self.materialize(tr)
+        # mirror device-side row state the host needs for bookkeeping
+        self._sync_row_state(new_soa)
+        return transitions
+
+    def _sync_row_state(self, soa: SoA) -> None:
+        # np.array (not asarray): device views are read-only and the host
+        # mutates these on refresh_row/admit.
+        self.stage = np.array(soa.stage)
+        self.fire_at = np.array(soa.fire_at)
+        self.active = np.array(soa.active)
+        self.features = np.array(soa.features)
+        self.rematch = np.zeros(self.capacity, np.bool_)
+
+    # ------------------------------------------------------------- materialization
+
+    def now_string(self, t_ms: int) -> str:
+        t = self.epoch + datetime.timedelta(milliseconds=int(t_ms))
+        return t.isoformat(timespec="microseconds").replace("+00:00", "Z")
+
+    def materialize(self, tr: Transition) -> Optional[dict]:
+        """Apply a drained transition to the host mirror object with the
+        same renderer the CPU backend uses (virtual-time Now)."""
+        obj = self.objects[tr.row]
+        if obj is None:
+            return None
+        cs = self.cset.compiled[tr.stage_idx]
+        effects = self.cset.lifecycle.effects(cs)
+        if effects is None:
+            return obj
+        meta = obj.get("metadata") or {}
+        fin = effects.finalizers_patch(meta.get("finalizers") or [])
+        if fin is not None:
+            obj = apply_patch(obj, fin.data, fin.type)
+        if tr.deleted or effects.delete:
+            self.objects[tr.row] = None
+            return None
+        funcs = dict(self.env_funcs)
+        funcs["Now"] = lambda: self.now_string(tr.t_ms)
+        for p in effects.patches(obj, funcs):
+            obj = apply_patch(obj, p.data, p.type)
+        self.objects[tr.row] = obj
+        return obj
+
+    def check_feature_parity(self, rows) -> None:
+        """Assert device feature rows == features re-extracted from the
+        host-materialized mirror objects (the core parity invariant)."""
+        for row in rows:
+            obj = self.objects[row]
+            if obj is None:
+                continue
+            expect = self.cset.extract_features(obj)
+            got = self.features[row]
+            if not np.array_equal(expect, got):
+                cols = [
+                    (c.key, int(expect[i]), int(got[i]))
+                    for i, c in enumerate(self.cset.schema.columns)
+                    if expect[i] != got[i]
+                ]
+                raise AssertionError(
+                    f"feature parity violation on row {row}: {cols}"
+                )
+
+    # --------------------------------------------------------------------- stats
+
+    def phase_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for obj in self.objects[: self.num_rows]:
+            if obj is None:
+                counts["<deleted>"] = counts.get("<deleted>", 0) + 1
+                continue
+            phase = (obj.get("status") or {}).get("phase", "<none>")
+            counts[phase] = counts.get(phase, 0) + 1
+        return counts

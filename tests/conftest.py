@@ -1,11 +1,18 @@
 """Test configuration: force an 8-device virtual CPU platform so the
-multi-chip sharding paths are exercised without TPU hardware."""
+multi-chip sharding paths are exercised without TPU hardware.
+
+Note: the axon TPU plugin presets jax_platforms to "axon,cpu", so the
+JAX_PLATFORMS env var alone is NOT enough — jax.config must be updated
+after import (before any computation)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

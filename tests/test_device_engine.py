@@ -1,0 +1,286 @@
+"""Device (JAX) stage engine: compiler + tick kernel + host parity.
+
+The core invariant: after every drained transition, the device feature
+row must equal the features re-extracted from the host-materialized
+mirror object (which is produced by the same renderer the CPU oracle
+uses). Trajectory-level assertions cover the deterministic FSM paths;
+distribution assertions cover weighted choice.
+"""
+
+import numpy as np
+import pytest
+
+from kwok_tpu.api.types import Stage
+from kwok_tpu.engine.compiler import StageCompileError
+from kwok_tpu.engine.simulator import DeviceSimulator
+from kwok_tpu.stages import POD_CHAOS, POD_FAST, POD_GENERAL, load_builtin
+
+
+def new_pod(i=0, owner_job=False, init_containers=False, labels=None, annotations=None):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": f"p{i}", "namespace": "d", "uid": f"u{i}"},
+        "spec": {"nodeName": "n0", "containers": [{"name": "c", "image": "img"}]},
+        "status": {},
+    }
+    if owner_job:
+        pod["metadata"]["ownerReferences"] = [{"kind": "Job", "name": "j"}]
+    if init_containers:
+        pod["spec"]["initContainers"] = [{"name": "ic", "image": "i2"}]
+    if labels:
+        pod["metadata"]["labels"] = labels
+    if annotations:
+        pod["metadata"]["annotations"] = annotations
+    return pod
+
+
+def run_sim(sim, ticks, dt_ms=100):
+    all_tr = []
+    for _ in range(ticks):
+        trs = sim.step(dt_ms=dt_ms)
+        all_tr.extend(trs)
+        sim.check_feature_parity([t.row for t in trs])
+    return all_tr
+
+
+class TestPodFastDevice:
+    def test_trajectories_and_parity(self):
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=8)
+        r_plain = sim.admit(new_pod(0))
+        r_job = sim.admit(new_pod(1, owner_job=True))
+        trs = run_sim(sim, 10)
+        by_row = {}
+        for t in trs:
+            by_row.setdefault(t.row, []).append(t.stage_name)
+        assert by_row[r_plain] == ["pod-ready"]
+        assert by_row[r_job] == ["pod-ready", "pod-complete"]
+        assert sim.objects[r_plain]["status"]["phase"] == "Running"
+        assert sim.objects[r_job]["status"]["phase"] == "Succeeded"
+        # materialized status is complete (host renderer ran)
+        cs = sim.objects[r_plain]["status"]["containerStatuses"][0]
+        assert cs["ready"] is True and "startedAt" in cs["state"]["running"]
+
+    def test_delete_path(self):
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=4)
+        row = sim.admit(new_pod(0))
+        run_sim(sim, 5)
+        assert sim.objects[row]["status"]["phase"] == "Running"
+        sim.request_delete(row, at_ms=500)
+        trs = run_sim(sim, 5)
+        assert [t.stage_name for t in trs if t.row == row] == ["pod-delete"]
+        assert trs[-1].deleted
+        assert sim.objects[row] is None
+        assert not sim.active[row]
+
+    def test_idle_rows_stay_idle(self):
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=4)
+        row = sim.admit(new_pod(0))
+        run_sim(sim, 5)
+        # Running non-job pod matches nothing: no further transitions
+        trs = run_sim(sim, 10)
+        assert trs == []
+        assert sim.fire_at[row] == np.iinfo(np.int32).max
+
+
+class TestPodGeneralDevice:
+    def test_init_container_path_with_delays(self):
+        sim = DeviceSimulator(load_builtin(POD_GENERAL), capacity=4, seed=3)
+        row = sim.admit(new_pod(0, init_containers=True))
+        trs = run_sim(sim, 300)  # delays are 1-5s, dt=100ms
+        names = [t.stage_name for t in trs if t.row == row]
+        assert names == [
+            "pod-create",
+            "pod-init-container-running",
+            "pod-init-container-completed",
+            "pod-ready",
+        ]
+        obj = sim.objects[row]
+        assert obj["status"]["phase"] == "Running"
+        assert obj["metadata"]["finalizers"] == ["kwok.x-k8s.io/fake"]
+        # delays respected: each hop at least 1000ms after the previous
+        times = [t.t_ms for t in trs if t.row == row]
+        assert all(b - a >= 1000 for a, b in zip(times, times[1:]))
+
+    def test_annotation_delay_override(self):
+        ann = {"pod-create.stage.kwok.x-k8s.io/delay": "8s",
+               "pod-create.stage.kwok.x-k8s.io/jitter-delay": "8s"}
+        sim = DeviceSimulator(load_builtin(POD_GENERAL), capacity=4, seed=0)
+        fast = sim.admit(new_pod(0))
+        slow = sim.admit(new_pod(1, annotations=ann))
+        trs = run_sim(sim, 120)
+        t_fast = next(t.t_ms for t in trs if t.row == fast and t.stage_name == "pod-create")
+        t_slow = next(t.t_ms for t in trs if t.row == slow and t.stage_name == "pod-create")
+        assert t_fast <= 5100
+        assert t_slow >= 8000
+
+    def test_full_delete_path_with_finalizers(self):
+        sim = DeviceSimulator(load_builtin(POD_GENERAL), capacity=4, seed=1)
+        row = sim.admit(new_pod(0))
+        run_sim(sim, 150)
+        assert sim.objects[row]["metadata"]["finalizers"] == ["kwok.x-k8s.io/fake"]
+        sim.request_delete(row, at_ms=int(sim._soa.now))
+        trs = run_sim(sim, 150)
+        names = [t.stage_name for t in trs if t.row == row]
+        assert names == ["pod-remove-finalizer", "pod-delete"]
+        assert sim.objects[row] is None
+
+
+class TestChaosDevice:
+    def test_churn_and_weighted_choice(self):
+        sim = DeviceSimulator(
+            load_builtin(POD_GENERAL) + load_builtin(POD_CHAOS), capacity=4, seed=5
+        )
+        row = sim.admit(
+            new_pod(0, labels={"pod-container-running-failed.stage.kwok.x-k8s.io": "true"})
+        )
+        trs = run_sim(sim, 400)
+        names = [t.stage_name for t in trs if t.row == row]
+        # chaos (weight 10000) dominates pod-ready (weight 1) whenever the
+        # pod is Running: expect repeated failures (churn), no quiescence
+        assert names.count("pod-container-running-failed") >= 2
+        assert sim.objects[row]["status"]["phase"] in ("Failed", "Running")
+
+    def test_weighted_distribution_matches_host(self):
+        """Two stages matching the same state with weights 1 vs 9: the
+        device's cumsum-inversion sampler must reproduce the reference
+        distribution (weighted rung of the ladder)."""
+        import yaml
+
+        def make(name, weight):
+            return Stage.from_dict(
+                yaml.safe_load(
+                    f"""
+metadata: {{name: {name}}}
+spec:
+  resourceRef: {{kind: Pod}}
+  selector:
+    matchExpressions:
+    - key: '.status.phase'
+      operator: 'DoesNotExist'
+  weight: {weight}
+  next:
+    statusTemplate: 'phase: {name}'
+"""
+                )
+            )
+
+        counts = {"rare": 0, "common": 0}
+        sim = DeviceSimulator([make("rare", 1), make("common", 9)], capacity=256, seed=11)
+        rows = [sim.admit(new_pod(i)) for i in range(256)]
+        trs = run_sim(sim, 3)
+        assert len(trs) == 256
+        for t in trs:
+            counts[t.stage_name] += 1
+        # E[common] = 230.4; allow generous slack
+        assert counts["common"] > counts["rare"] * 4
+
+    def test_single_match_fires_regardless_of_weight_zero(self):
+        """Reference lifecycle.go:137-139: a single matched stage is
+        returned without consulting weight — weight only arbitrates among
+        multiple candidates. So a weight-0 chaos stage still fires when
+        it is the only match."""
+        sim = DeviceSimulator(
+            load_builtin(POD_GENERAL) + load_builtin(POD_CHAOS), capacity=4, seed=5
+        )
+        row = sim.admit(
+            new_pod(
+                0,
+                labels={"pod-container-running-failed.stage.kwok.x-k8s.io": "true"},
+                annotations={"pod-container-running-failed.stage.kwok.x-k8s.io/weight": "0"},
+            )
+        )
+        trs = run_sim(sim, 250)
+        names = [t.stage_name for t in trs if t.row == row]
+        assert "pod-container-running-failed" in names
+
+
+class TestHostDeviceEquivalence:
+    def test_final_states_match_host_oracle(self):
+        """Drive the same population through device and host backends;
+        deterministic FSM -> identical final phase per pod."""
+        import random
+
+        from kwok_tpu.engine.lifecycle import Lifecycle
+        from kwok_tpu.engine.simulator import default_env_funcs
+        from kwok_tpu.utils.patch import apply_patch
+
+        pods = [
+            new_pod(0),
+            new_pod(1, owner_job=True),
+            new_pod(2, init_containers=True),
+            new_pod(3, owner_job=True, init_containers=True),
+        ]
+        sim = DeviceSimulator(load_builtin(POD_GENERAL), capacity=8, seed=9)
+        rows = [sim.admit(p) for p in pods]
+        run_sim(sim, 400)
+        device_phases = [
+            sim.objects[r]["status"]["phase"] for r in rows
+        ]
+
+        lc = Lifecycle(load_builtin(POD_GENERAL))
+        env = default_env_funcs()
+        host_phases = []
+        for p in pods:
+            obj = p
+            rng = random.Random(0)
+            for _ in range(10):
+                meta = obj["metadata"]
+                st = lc.select(meta.get("labels") or {}, meta.get("annotations") or {}, obj, rng)
+                if st is None:
+                    break
+                eff = lc.effects(st)
+                fin = eff.finalizers_patch(meta.get("finalizers") or [])
+                if fin is not None:
+                    obj = apply_patch(obj, fin.data, fin.type)
+                for patch in eff.patches(obj, env):
+                    obj = apply_patch(obj, patch.data, patch.type)
+            host_phases.append(obj["status"]["phase"])
+        assert device_phases == host_phases
+
+
+class TestCompileErrors:
+    def test_non_annotation_weight_from_rejected(self):
+        s = Stage.from_dict(
+            {
+                "metadata": {"name": "bad"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {"matchExpressions": []},
+                    "weightFrom": {"expressionFrom": ".status.someField"},
+                },
+            }
+        )
+        with pytest.raises(StageCompileError):
+            DeviceSimulator([s], capacity=1)
+
+    def test_json_patch_type_rejected(self):
+        s = Stage.from_dict(
+            {
+                "metadata": {"name": "bad"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {"matchExpressions": []},
+                    "next": {"patches": [{"type": "json", "template": "[]"}]},
+                },
+            }
+        )
+        with pytest.raises(StageCompileError):
+            DeviceSimulator([s], capacity=1)
+
+    def test_out_of_subset_jq_rejected(self):
+        s = Stage.from_dict(
+            {
+                "metadata": {"name": "bad"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {
+                        "matchExpressions": [
+                            {"key": ".spec.containers | length", "operator": "Exists"}
+                        ]
+                    },
+                },
+            }
+        )
+        with pytest.raises(StageCompileError):
+            DeviceSimulator([s], capacity=1)
