@@ -61,7 +61,9 @@ DELETION_TS_EXPR = ".metadata.deletionTimestamp"
 
 # Deterministic env funcs for compile-time template rendering: only the
 # *existence* and vocabulary-membership of rendered values reach the
-# feature columns, so fixed strings are exact.
+# feature columns, so fixed strings are exact. Now/StartTime are pinned
+# so exploration states are render-deterministic (the BFS would never
+# terminate on self-loop stages otherwise).
 COMPILE_ENV_FUNCS = {
     "NodeIP": lambda: "10.0.0.1",
     "NodeName": lambda: "node",
@@ -69,7 +71,13 @@ COMPILE_ENV_FUNCS = {
     "PodIP": lambda: "10.64.0.1",
     "NodeIPWith": lambda name: "10.0.0.1",
     "PodIPWith": lambda *a: "10.64.0.1",
+    "Now": lambda: "2026-01-01T00:00:00.000000Z",
+    "StartTime": lambda: "2026-01-01T00:00:00.000000Z",
 }
+
+# Safety bound on per-signature exploration (pathological template-
+# driven state growth raises StageCompileError instead of spinning).
+MAX_EXPLORED_STATES = 4096
 
 
 class StageCompileError(ValueError):
@@ -232,6 +240,9 @@ class CompiledStageSet:
         )
         self.stage_delete = np.array([s.delete for s in self.scalars], np.bool_)
         self.stage_event = np.array([s.event_id for s in self.scalars], np.int32)
+        # consumed by the cluster/controller layer, not the tick kernel:
+        # on-device rematch is always immediate; non-immediate stages wait
+        # for the store round-trip before external visibility.
         self.stage_immediate = np.array([s.immediate for s in self.scalars], np.bool_)
 
         # --- signatures / effects / override classes -------------------------
@@ -242,8 +253,39 @@ class CompiledStageSet:
         self._sig_effect_known: List[np.ndarray] = []  # per sig: [S] bool
         self._ov_ids: Dict[str, int] = {}
         self._ov_rows: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-        # per-sig set of feature-state tuples already explored (BFS cache)
+        # per-sig set of exploration-state keys already explored (BFS cache)
         self._explored: Dict[int, set] = {}
+
+        # Template-read analysis: the object paths stage templates read
+        # beyond spec/labels/annotations (which are in the signature key).
+        # Exploration states are keyed on (features, projection of these
+        # paths), so objects that would render differently explore
+        # separately and pre-state-dependent effects are detected.
+        # metadata name/namespace/uid are excluded: they only feed env
+        # funcs (NodeIPWith/PodIPWith) whose values never reach feature
+        # columns — selectors on IP *values* are outside the subset.
+        self._read_paths: List[Tuple[str, ...]] = []
+        seen_paths = set()
+        from kwok_tpu.utils.gotpl import Template, template_read_paths
+
+        for cs in self.compiled:
+            if cs.next is None:
+                continue
+            for p in cs.next.patches:
+                for path in template_read_paths(Template(p.template)):
+                    if not path or path[0] not in ("status", "metadata"):
+                        continue
+                    if path[:2] in (
+                        ("metadata", "name"),
+                        ("metadata", "namespace"),
+                        ("metadata", "uid"),
+                        ("metadata", "labels"),
+                        ("metadata", "annotations"),
+                    ):
+                        continue
+                    if path not in seen_paths:
+                        seen_paths.add(path)
+                        self._read_paths.append(path)
         # bumped whenever signatures/effects/override classes grow, so the
         # simulator knows to re-upload TickParams
         self.version = 0
@@ -311,20 +353,53 @@ class CompiledStageSet:
 
     # -- abstract FSM exploration -----------------------------------------------
 
+    def state_projection(self, obj: dict) -> str:
+        """Hash of the template-read path values (see _read_paths)."""
+        if not self._read_paths:
+            return ""
+        proj = []
+        for path in self._read_paths:
+            cur: Any = obj
+            for seg in path:
+                if isinstance(cur, dict):
+                    cur = cur.get(seg)
+                else:
+                    cur = None
+                    break
+            proj.append(cur)
+        return hashlib.sha1(
+            json.dumps(proj, sort_keys=True, default=str).encode()
+        ).hexdigest()
+
+    def _state_key(self, obj: dict) -> Tuple:
+        return (
+            tuple(self.schema.extract_row(obj)),
+            self.state_projection(obj),
+        )
+
     def _explore(self, sig: int, start_obj: dict) -> None:
-        """BFS over feature-states reachable from start_obj, recording each
-        (stage -> feature effect) discovered along the way. The seen-set is
-        cached per signature, so admitting many objects of one signature
-        explores once."""
+        """BFS over FSM states reachable from start_obj, recording each
+        (stage -> feature effect) discovered along the way. States are
+        keyed on (feature row, template-read projection): objects whose
+        templates would render differently explore separately, and the
+        per-(sig, stage) consistency assertion turns pre-state-dependent
+        effects into StageCompileError. The seen-set is cached per
+        signature, so admitting many identical objects explores once."""
         seen = self._explored.setdefault(sig, set())
-        if tuple(self.schema.extract_row(start_obj)) in seen:
+        if self._state_key(start_obj) in seen:
             return
         worklist = [copy.deepcopy(start_obj)]
         while worklist:
             obj = worklist.pop()
-            fkey = tuple(self.schema.extract_row(obj))
+            fkey = self._state_key(obj)
             if fkey in seen:
                 continue
+            if len(seen) >= MAX_EXPLORED_STATES:
+                raise StageCompileError(
+                    "FSM exploration exceeded "
+                    f"{MAX_EXPLORED_STATES} states; stage set not "
+                    "device-compilable"
+                )
             seen.add(fkey)
             meta = obj.get("metadata") or {}
             matched = self.lifecycle.match(

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from kwok_tpu.api.types import Stage
+from kwok_tpu.utils.expression import value_as_string
 from kwok_tpu.utils.kq import Field as KqField
 from kwok_tpu.utils.kq import Iterate, Path, Pipe, Query
 
@@ -82,22 +83,12 @@ class FeatureColumn:
             outputs = out or []
         bits = 0
         for o in outputs:
-            s = _as_string(o)
+            s = value_as_string(o)
             if s is not None and s in self.vocab:
                 bits |= 1 << self.vocab[s]
             else:
                 bits |= OTHER_BIT
         return bits
-
-
-def _as_string(v: Any) -> Optional[str]:
-    if isinstance(v, bool):
-        return "true" if v else "false"
-    if isinstance(v, str):
-        return v
-    if isinstance(v, int):
-        return str(v)
-    return None
 
 
 def query_path_prefix(src: str) -> Tuple[str, ...]:

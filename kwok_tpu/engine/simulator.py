@@ -114,15 +114,34 @@ class DeviceSimulator:
         self.num_rows = 0
         self._seed = seed
         self._admit_cache: Dict[str, Tuple[int, int, np.ndarray]] = {}
-        self._name_fast_path = not any(
-            c.path_prefix[:2] in (("metadata", "name"), ("metadata", "namespace"), ("metadata", "uid"))
+        # The admit fast path caches (sig, ovc, features) by content hash.
+        # It is sound only when every feature column reads fields the
+        # cache key covers: spec/status plus the well-known metadata
+        # fields. A selector on any other metadata field (creationTimestamp,
+        # generateName, ...) disables the cache.
+        self._cacheable = all(
+            c.path_prefix
+            and (
+                c.path_prefix[0] in ("spec", "status")
+                or c.path_prefix[:2]
+                in (
+                    ("metadata", "labels"),
+                    ("metadata", "annotations"),
+                    ("metadata", "deletionTimestamp"),
+                    ("metadata", "finalizers"),
+                    ("metadata", "ownerReferences"),
+                )
+            )
             for c in self.cset.schema.columns
-            if c.path_prefix
         )
 
         self._soa: Optional[SoA] = None
         self._params: Optional[TickParams] = None
         self._params_version = -1
+        self._dev_now = None  # preserved virtual clock across re-uploads
+        self._dev_key = None  # preserved PRNG state across re-uploads
+        self._rematch_pending = False
+        self._host_synced = True
 
     # ------------------------------------------------------------------ host ops
 
@@ -135,7 +154,7 @@ class DeviceSimulator:
         self.num_rows += 1
 
         cache_key = None
-        if self._name_fast_path:
+        if self._cacheable:
             meta = obj.get("metadata") or {}
             content = {
                 "spec": obj.get("spec"),
@@ -145,6 +164,9 @@ class DeviceSimulator:
                 "status": obj.get("status"),
                 "deletionTimestamp": meta.get("deletionTimestamp"),
                 "finalizers": meta.get("finalizers"),
+                # template-read projection (e.g. creationTimestamp for the
+                # node stages): objects differing here must re-explore
+                "proj": self.cset.state_projection(obj),
             }
             cache_key = hashlib.sha1(
                 json.dumps(content, sort_keys=True, default=str).encode()
@@ -170,11 +192,21 @@ class DeviceSimulator:
         return row
 
     def _finish_admit(self, row: int, obj: dict) -> None:
+        self._invalidate_device()
         self.objects[row] = obj
         self.active[row] = True
         self.rematch[row] = True
         self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
-        self._soa = None  # host arrays changed; re-upload lazily
+
+    def _invalidate_device(self) -> None:
+        """Pull device progress into the host arrays (so a host mutation
+        + re-upload does not lose it) and preserve the virtual clock and
+        PRNG state across the re-upload."""
+        if self._soa is not None:
+            self._ensure_synced()
+            self._dev_now = self._soa.now
+            self._dev_key = self._soa.key
+            self._soa = None
 
     def request_delete(self, row: int, at_ms: int) -> None:
         """External delete request: set deletionTimestamp and re-evaluate
@@ -184,19 +216,19 @@ class DeviceSimulator:
             return
         ts = self.epoch + datetime.timedelta(milliseconds=int(at_ms))
         obj.setdefault("metadata", {})["deletionTimestamp"] = (
-            ts.isoformat(timespec="seconds").replace("+00:00", "Z")
+            ts.isoformat(timespec="milliseconds").replace("+00:00", "Z")
         )
         self.refresh_row(row)
 
     def refresh_row(self, row: int) -> None:
         """Re-extract features after an external mutation and force rematch."""
+        self._invalidate_device()
         obj = self.objects[row]
         self.features[row] = self.cset.extract_features(obj)
         self.ovc[row] = self.cset.override_class_for(obj)
         self.sig[row] = self.cset.signature_for(obj)
         self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
         self.rematch[row] = True
-        self._soa = None
 
     # ---------------------------------------------------------------- device ops
 
@@ -214,9 +246,14 @@ class DeviceSimulator:
                 active=jnp.asarray(self.active),
                 rematch=jnp.asarray(self.rematch),
                 del_ts=jnp.asarray(self.del_ts),
-                now=jnp.int32(0),
-                key=jax.random.PRNGKey(self._seed),
+                now=self._dev_now if self._dev_now is not None else jnp.int32(0),
+                key=(
+                    self._dev_key
+                    if self._dev_key is not None
+                    else jax.random.PRNGKey(self._seed)
+                ),
             )
+            self._rematch_pending = bool(self.rematch.any())
         return self._params, self._soa
 
     def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
@@ -249,11 +286,20 @@ class DeviceSimulator:
                 transitions.append(tr)
                 if materialize:
                     self.materialize(tr)
-        # mirror device-side row state the host needs for bookkeeping
-        self._sync_row_state(new_soa)
+        # Host mirror of device row state is pulled lazily: when nothing
+        # fired and no uploaded rematch flags were pending, the device
+        # changed nothing but now/key, so the host arrays stay valid
+        # ("only dirty rows come back").
+        if transitions or self._rematch_pending:
+            self._host_synced = False
+            self._rematch_pending = False
+            self._ensure_synced()
         return transitions
 
-    def _sync_row_state(self, soa: SoA) -> None:
+    def _ensure_synced(self) -> None:
+        if self._host_synced or self._soa is None:
+            return
+        soa = self._soa
         # np.array (not asarray): device views are read-only and the host
         # mutates these on refresh_row/admit.
         self.stage = np.array(soa.stage)
@@ -261,6 +307,7 @@ class DeviceSimulator:
         self.active = np.array(soa.active)
         self.features = np.array(soa.features)
         self.rematch = np.zeros(self.capacity, np.bool_)
+        self._host_synced = True
 
     # ------------------------------------------------------------- materialization
 
@@ -295,6 +342,7 @@ class DeviceSimulator:
     def check_feature_parity(self, rows) -> None:
         """Assert device feature rows == features re-extracted from the
         host-materialized mirror objects (the core parity invariant)."""
+        self._ensure_synced()
         for row in rows:
             obj = self.objects[row]
             if obj is None:

@@ -1,0 +1,126 @@
+"""Multi-chip scale-out: shard the SoA rows over a device mesh.
+
+The reference scales across kwok instances by Lease-holder identity —
+each instance manages the nodes whose leases it holds (reference:
+pkg/kwok/controllers/controller.go:286-296,
+node_lease_controller.go:150-171). The TPU-native equivalent (SURVEY.md
+§2.9, §7 step 7) shards the struct-of-arrays *rows* across chips of a
+``jax.sharding.Mesh``: the tick kernel is row-parallel by construction
+(no cross-row dataflow), so under pjit the only collective XLA inserts
+is the psum for the global fired-count — everything else is pure local
+compute riding each chip's HBM. Stage tensors (predicates, effect
+tables, override tables) are small and replicated.
+
+Row placement is by simulated *node* (a node's row and its pods' rows
+share a shard — the analog of lease ownership per instance), which the
+cluster layer arranges by admission order; the kernel itself is
+placement-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kwok_tpu.ops.tick import SoA, TickParams, _tick_impl
+
+ROWS_AXIS = "rows"
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """1-D mesh over the row axis."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (ROWS_AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def soa_shardings(mesh: Mesh) -> SoA:
+    """Sharding pytree for the SoA: row-sharded arrays, replicated
+    scalars/key."""
+    rows = row_sharding(mesh)
+    rep = replicated(mesh)
+    return SoA(
+        features=rows,
+        sig=rows,
+        ovc=rows,
+        stage=rows,
+        fire_at=rows,
+        active=rows,
+        rematch=rows,
+        del_ts=rows,
+        now=rep,
+        key=rep,
+    )
+
+
+def params_shardings(mesh: Mesh) -> TickParams:
+    rep = replicated(mesh)
+    return TickParams(*([rep] * len(TickParams._fields)))
+
+
+def pad_rows(n: int, n_shards: int) -> int:
+    """Capacity padded so rows divide evenly across shards."""
+    return ((n + n_shards - 1) // n_shards) * n_shards
+
+
+def place(params: TickParams, soa: SoA, mesh: Mesh) -> Tuple[TickParams, SoA]:
+    """Device-place params (replicated) and SoA (row-sharded)."""
+    params = jax.device_put(params, params_shardings(mesh))
+    soa = jax.device_put(soa, soa_shardings(mesh))
+    return params, soa
+
+
+def sharded_tick(mesh: Mesh, dt_ms: int = 100):
+    """The tick jitted with explicit row shardings over the mesh. XLA
+    inserts a single psum (fired-count) — all FSM math stays local to
+    each shard's rows."""
+    soa_s = soa_shardings(mesh)
+    par_s = params_shardings(mesh)
+    rows = row_sharding(mesh)
+    rep = replicated(mesh)
+    from kwok_tpu.ops.tick import TickOut
+
+    out_s = (
+        soa_s,
+        TickOut(fired=rows, fired_stage=rows, deleted=rows, fired_count=rep),
+    )
+    return jax.jit(
+        lambda params, soa: _tick_impl(params, soa, dt_ms),
+        in_shardings=(par_s, soa_s),
+        out_shardings=out_s,
+    )
+
+
+def sharded_run_ticks(mesh: Mesh, dt_ms: int = 100, num_ticks: int = 100):
+    """Multi-tick device loop under the mesh (bench / steady-state)."""
+    soa_s = soa_shardings(mesh)
+    par_s = params_shardings(mesh)
+    rep = replicated(mesh)
+
+    def run(params, soa):
+        def body(_, carry):
+            soa, count = carry
+            soa, out = _tick_impl(params, soa, dt_ms)
+            return soa, count + out.fired_count
+
+        return jax.lax.fori_loop(0, num_ticks, body, (soa, jnp.int32(0)))
+
+    return jax.jit(
+        run,
+        in_shardings=(par_s, soa_s),
+        out_shardings=((soa_s, rep)),
+    )

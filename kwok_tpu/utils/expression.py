@@ -59,7 +59,10 @@ class Requirement:
         return not _exists_value(out)
 
 
-def _value_as_string(d: Any) -> Optional[str]:
+def value_as_string(d: Any) -> Optional[str]:
+    """Selector value stringification (selector.go:96-110 hasValue):
+    strings as-is, bools lowercase, ints base-10; other types don't
+    participate in In/NotIn comparison."""
     if isinstance(d, bool):
         return "true" if d else "false"
     if isinstance(d, str):
@@ -67,6 +70,9 @@ def _value_as_string(d: Any) -> Optional[str]:
     if isinstance(d, int):
         return str(d)
     return None
+
+
+_value_as_string = value_as_string
 
 
 def _has_values(out: List[Any], values: Sequence[str]) -> bool:

@@ -660,6 +660,155 @@ def _to_display(v: Any) -> str:
     return str(v)
 
 
+def template_read_paths(tpl: "Template") -> set:
+    """Conservative static analysis: the set of root-relative object
+    paths a template's output can depend on, as tuples of field names.
+
+    Used by the stage compiler to key FSM exploration states: two objects
+    agreeing on all read paths render identically (template funcs are
+    pure). Unused variable assignments are pruned to a fixpoint first
+    (e.g. the zoo's never-referenced ``$origin``/``$root`` bindings), so
+    a dead ``index $root.status.containerStatuses $index`` does not drag
+    the whole status in. A bare reference to a variable bound to ``.``
+    conservatively returns the root path ``()`` (reads everything).
+
+    Reads inside range/with bodies resolve relative to the body's
+    source path, which is itself collected — subtree projection
+    subsumes them — so only root-context paths and variable-rooted
+    paths need recording.
+    """
+    # 1. count variable uses (excluding their own assignment)
+    uses: Dict[str, int] = {}
+
+    def count_pipe(pipe):
+        _, cmds = pipe
+        for _, terms in cmds:
+            for t in terms:
+                if t[0] == "var" and t[1] != "$":
+                    uses[t[1]] = uses.get(t[1], 0) + 1
+                elif t[0] == "pipe":
+                    count_pipe(t)
+
+    def count_nodes(nodes):
+        for n in nodes:
+            if isinstance(n, _Output):
+                count_pipe(n.pipe)
+            elif isinstance(n, _Assign):
+                count_pipe(n.pipe)
+            elif isinstance(n, _If):
+                for cond, body in n.branches:
+                    count_pipe(cond)
+                    count_nodes(body)
+                count_nodes(n.else_body)
+            elif isinstance(n, (_Range, _With)):
+                count_pipe(n.pipe)
+                count_nodes(n.body)
+                count_nodes(n.else_body)
+
+    count_nodes(tpl.nodes)
+
+    # 2. prune assignments of unused variables to a fixpoint
+    pruned = dict(uses)
+    changed = True
+    live_assigns: Dict[str, Any] = {}
+
+    def assigns_of(nodes, out):
+        for n in nodes:
+            if isinstance(n, _Assign):
+                out.setdefault(n.name, []).append(n.pipe)
+            elif isinstance(n, _If):
+                for _, body in n.branches:
+                    assigns_of(body, out)
+                assigns_of(n.else_body, out)
+            elif isinstance(n, (_Range, _With)):
+                assigns_of(n.body, out)
+                assigns_of(n.else_body, out)
+
+    all_assigns: Dict[str, list] = {}
+    assigns_of(tpl.nodes, all_assigns)
+    def count_one(pipe, acc):
+        _, cmds = pipe
+        for _, terms in cmds:
+            for t in terms:
+                if t[0] == "var" and t[1] != "$":
+                    acc[t[1]] = acc.get(t[1], 0) + 1
+                elif t[0] == "pipe":
+                    count_one(t, acc)
+
+    while changed:
+        changed = False
+        for name in list(all_assigns):
+            if pruned.get(name, 0) == 0:
+                removed: Dict[str, int] = {}
+                for p in all_assigns[name]:
+                    count_one(p, removed)
+                del all_assigns[name]
+                changed = True
+                for k, v in removed.items():
+                    if pruned.get(k, 0) > 0:
+                        pruned[k] = pruned[k] - v
+                break
+
+    live_vars = {k for k, v in pruned.items() if v > 0} | set(all_assigns)
+
+    # 3. collect paths: root-context Path terms + live var sources/derefs
+    paths: set = set()
+    var_sources: Dict[str, Any] = {}  # var -> path tuple or None (opaque)
+
+    def collect_pipe(pipe, root_ctx):
+        _, cmds = pipe
+        for _, terms in cmds:
+            for t in terms:
+                if t[0] == "field":
+                    if root_ctx:
+                        paths.add(tuple(t[1]))
+                elif t[0] == "var":
+                    name, sub = t[1], tuple(t[2])
+                    if name == "$":
+                        paths.add(sub)
+                    else:
+                        src = var_sources.get(name)
+                        if src is not None:
+                            paths.add(src + sub)
+                        elif name in live_vars and name not in var_sources:
+                            pass  # range/with-bound: subsumed by source path
+                elif t[0] == "pipe":
+                    collect_pipe(t, root_ctx)
+
+    def pipe_as_path(pipe):
+        """If a pipeline is a bare path term, return its tuple."""
+        _, cmds = pipe
+        if len(cmds) == 1 and len(cmds[0][1]) == 1:
+            t = cmds[0][1][0]
+            if t[0] == "field":
+                return tuple(t[1])
+        return None
+
+    def walk(nodes, root_ctx):
+        for n in nodes:
+            if isinstance(n, _Output):
+                collect_pipe(n.pipe, root_ctx)
+            elif isinstance(n, _Assign):
+                if n.name not in all_assigns:
+                    continue  # pruned dead assignment
+                collect_pipe(n.pipe, root_ctx)
+                if root_ctx:
+                    var_sources[n.name] = pipe_as_path(n.pipe)
+            elif isinstance(n, _If):
+                for cond, body in n.branches:
+                    collect_pipe(cond, root_ctx)
+                    walk(body, root_ctx)
+                walk(n.else_body, root_ctx)
+            elif isinstance(n, (_Range, _With)):
+                collect_pipe(n.pipe, root_ctx)
+                # body reads are relative to the (collected) source subtree
+                walk(n.body, False)
+                walk(n.else_body, root_ctx)
+
+    walk(tpl.nodes, True)
+    return paths
+
+
 class Renderer:
     """Template renderer with an extra func environment
     (reference gotpl/renderer.go:50-118)."""
