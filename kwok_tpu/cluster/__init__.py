@@ -1,0 +1,13 @@
+from kwok_tpu.cluster.store import (  # noqa: F401
+    ADDED,
+    DELETED,
+    MODIFIED,
+    SYNC,
+    Conflict,
+    Expired,
+    NotFound,
+    ResourceStore,
+    ResourceType,
+    WatchEvent,
+    Watcher,
+)

@@ -1,0 +1,193 @@
+"""Generic informer: list+watch a resource into an event queue.
+
+Mirrors the reference's three informer flavors
+(reference: pkg/utils/informer/informer.go:33-319):
+
+- ``watch_with_cache`` — reflector loop keeping a local cache; returns a
+  ``CacheGetter`` (the store-backed Getter) and forwards every event.
+- ``watch`` — cache-less: a dummy store, events forwarded only.
+- ``sync`` — on-demand re-list, delivered as SYNC events (used to
+  re-feed pods when their node becomes managed, reference
+  controller.go:559-573).
+
+Threading model: one daemon thread per informer doing list-then-drain;
+an ``Expired`` resume triggers a fresh re-list (reflector behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+from kwok_tpu.cluster.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    SYNC,
+    Expired,
+    ResourceStore,
+    Selector,
+)
+from kwok_tpu.utils.queue import Queue
+
+
+@dataclass
+class InformerEvent:
+    type: str  # ADDED | MODIFIED | DELETED | SYNC
+    object: dict
+
+
+@dataclass
+class WatchOptions:
+    namespace: Optional[str] = None
+    label_selector: Selector = None
+    field_selector: Selector = None
+    #: client-side predicate applied after selectors (reference filters
+    #: managed nodes in the controller, not the informer; this hook keeps
+    #: the informer generic)
+    predicate: Optional[Callable[[dict], bool]] = None
+
+
+class CacheGetter:
+    """Read access to the informer's local mirror (informer.go Getter)."""
+
+    def __init__(self):
+        self._mut = threading.Lock()
+        self._items: Dict[Tuple[str, str], dict] = {}
+
+    def get(self, name: str, namespace: str = "") -> Optional[dict]:
+        with self._mut:
+            obj = self._items.get((namespace, name))
+            return obj
+
+    def list(self):
+        with self._mut:
+            return list(self._items.values())
+
+    def _apply(self, etype: str, obj: dict) -> None:
+        meta = obj.get("metadata") or {}
+        key = (meta.get("namespace") or "", meta.get("name") or "")
+        with self._mut:
+            if etype == DELETED:
+                self._items.pop(key, None)
+            else:
+                self._items[key] = obj
+
+    def __len__(self) -> int:
+        with self._mut:
+            return len(self._items)
+
+
+class Informer:
+    """List/watch one resource kind from a ResourceStore."""
+
+    def __init__(self, store: ResourceStore, kind: str):
+        self._store = store
+        self._kind = kind
+        self._threads = []
+
+    def _list(self, opt: WatchOptions):
+        items, rv = self._store.list(
+            self._kind,
+            namespace=opt.namespace,
+            label_selector=opt.label_selector,
+            field_selector=opt.field_selector,
+        )
+        if opt.predicate is not None:
+            items = [o for o in items if opt.predicate(o)]
+        return items, rv
+
+    def sync(self, opt: WatchOptions, events: Queue) -> int:
+        """Re-list matching objects as SYNC events (informer.go Sync)."""
+        items, _ = self._list(opt)
+        for obj in items:
+            events.add(InformerEvent(SYNC, obj))
+        return len(items)
+
+    def watch(
+        self,
+        opt: WatchOptions,
+        events: Queue,
+        done: Optional[threading.Event] = None,
+        cache: Optional[CacheGetter] = None,
+    ) -> CacheGetter:
+        """Start the reflector thread; returns the cache (empty-but-live
+        for the cache-less flavor)."""
+        getter = cache if cache is not None else CacheGetter()
+        use_cache = cache is not None
+        done = done or threading.Event()
+
+        def loop():
+            while not done.is_set():
+                items, rv = self._list(opt)
+                if use_cache:
+                    # reconcile: reflector "replace" semantics. Objects
+                    # that vanished during a watch gap surface as DELETED;
+                    # unchanged objects are not re-emitted.
+                    fresh = {}
+                    for obj in items:
+                        meta = obj.get("metadata") or {}
+                        fresh[(meta.get("namespace") or "", meta.get("name") or "")] = obj
+                    for stale in getter.list():
+                        meta = stale.get("metadata") or {}
+                        key = (meta.get("namespace") or "", meta.get("name") or "")
+                        if key not in fresh:
+                            getter._apply(DELETED, stale)
+                            events.add(InformerEvent(DELETED, stale))
+                    for obj in items:
+                        meta = obj.get("metadata") or {}
+                        prev = getter.get(meta.get("name") or "", meta.get("namespace") or "")
+                        if prev is not None and prev.get("metadata", {}).get(
+                            "resourceVersion"
+                        ) == meta.get("resourceVersion"):
+                            continue
+                        getter._apply(ADDED, obj)
+                        events.add(
+                            InformerEvent(ADDED if prev is None else MODIFIED, obj)
+                        )
+                else:
+                    for obj in items:
+                        events.add(InformerEvent(ADDED, obj))
+                try:
+                    w = self._store.watch(
+                        self._kind,
+                        namespace=opt.namespace,
+                        since_rv=rv,
+                        label_selector=opt.label_selector,
+                        field_selector=opt.field_selector,
+                    )
+                except Expired:
+                    continue
+                try:
+                    while not done.is_set():
+                        ev = w.next(timeout=0.2)
+                        if ev is None:
+                            continue
+                        obj = ev.object
+                        if opt.predicate is not None and not opt.predicate(obj):
+                            # object left the predicate set: surface as a
+                            # delete so controllers stop managing it
+                            if use_cache and getter.get(
+                                (obj.get("metadata") or {}).get("name") or "",
+                                (obj.get("metadata") or {}).get("namespace") or "",
+                            ):
+                                getter._apply(DELETED, obj)
+                                events.add(InformerEvent(DELETED, obj))
+                            continue
+                        if use_cache:
+                            getter._apply(ev.type, obj)
+                        events.add(InformerEvent(ev.type, obj))
+                    return
+                finally:
+                    w.stop()
+
+        t = threading.Thread(target=loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return getter
+
+    def watch_with_cache(
+        self, opt: WatchOptions, events: Queue, done: Optional[threading.Event] = None
+    ) -> CacheGetter:
+        return self.watch(opt, events, done=done, cache=CacheGetter())

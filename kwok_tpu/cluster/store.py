@@ -1,0 +1,569 @@
+"""In-process resource store with kube-apiserver semantics.
+
+The reference's communication backend *is* the kube-apiserver: watch
+streams in, PATCH/DELETE + Events out (SURVEY.md §2.9). This store is
+the standalone equivalent — the bus every other component rides:
+
+- monotonically increasing global resourceVersion; every mutation bumps
+  it and appends to a bounded per-type history ring so watchers can
+  resume from a version (too-old resume raises ``Expired`` and the
+  informer re-lists, mirroring watch-gone semantics).
+- CRUD + patch (json / merge / strategic) with subresource isolation
+  (a ``status`` patch can only change ``status``, like the apiserver's
+  subresource routing).
+- finalizer-aware graceful delete: delete on an object with finalizers
+  sets ``deletionTimestamp`` (reference stages then remove finalizers
+  via JSON-Patch, pkg/utils/lifecycle/finalizers.go:32-116); the object
+  is reaped when its finalizer list empties.
+- label/field selector filtering on list and watch (the informer's
+  ``spec.nodeName`` pod re-list rides this — reference
+  controller.go:559-573).
+
+An HTTP facade with kube-API routes sits on top in
+``kwok_tpu.cluster.httpapi`` for out-of-process clients; in-process
+controllers use this object directly (the Go↔device bridge boundary).
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import threading
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.patch import apply_patch
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+SYNC = "SYNC"  # informer re-list marker, never emitted by the store
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(ValueError):
+    """resourceVersion precondition failed."""
+
+
+class Expired(ValueError):
+    """watch resume version fell out of the history ring."""
+
+
+@dataclass(frozen=True)
+class ResourceType:
+    api_version: str
+    kind: str
+    plural: str
+    namespaced: bool = True
+
+
+#: builtin registry (the types the simulator itself needs; CRs register
+#: dynamically like CRDs do)
+BUILTIN_TYPES = [
+    ResourceType("v1", "Node", "nodes", namespaced=False),
+    ResourceType("v1", "Pod", "pods"),
+    ResourceType("v1", "Event", "events"),
+    ResourceType("v1", "Namespace", "namespaces", namespaced=False),
+    ResourceType("v1", "ConfigMap", "configmaps"),
+    ResourceType("v1", "Service", "services"),
+    ResourceType("coordination.k8s.io/v1", "Lease", "leases"),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "Stage", "stages", namespaced=False),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "Metric", "metrics", namespaced=False),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "ResourceUsage", "resourceusages"),
+    ResourceType(
+        "kwok.x-k8s.io/v1alpha1", "ClusterResourceUsage", "clusterresourceusages", namespaced=False
+    ),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "Logs", "logs"),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "ClusterLogs", "clusterlogs", namespaced=False),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "Exec", "execs"),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "ClusterExec", "clusterexecs", namespaced=False),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "Attach", "attaches"),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "ClusterAttach", "clusterattaches", namespaced=False),
+    ResourceType("kwok.x-k8s.io/v1alpha1", "PortForward", "portforwards"),
+    ResourceType(
+        "kwok.x-k8s.io/v1alpha1", "ClusterPortForward", "clusterportforwards", namespaced=False
+    ),
+]
+
+Selector = Union[None, str, Dict[str, str]]
+
+
+def _parse_selector(sel: Selector) -> List[Tuple[str, str, str]]:
+    """Parse 'k=v,k!=v,k' into (key, op, value) requirements."""
+    if sel is None:
+        return []
+    if isinstance(sel, dict):
+        return [(k, "=", v) for k, v in sel.items()]
+    reqs: List[Tuple[str, str, str]] = []
+    for part in str(sel).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, v = part.split("!=", 1)
+            reqs.append((k.strip(), "!=", v.strip()))
+        elif "=" in part:
+            k, v = part.split("==", 1) if "==" in part else part.split("=", 1)
+            reqs.append((k.strip(), "=", v.strip()))
+        else:
+            reqs.append((part, "exists", ""))
+    return reqs
+
+
+def match_label_selector(obj: dict, sel: Selector) -> bool:
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for k, op, v in _parse_selector(sel):
+        if op == "=" and labels.get(k) != v:
+            return False
+        if op == "!=" and labels.get(k) == v:
+            return False
+        if op == "exists" and k not in labels:
+            return False
+    return True
+
+
+def _dotted_get(obj: Any, path: str) -> Any:
+    cur = obj
+    for p in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(p)
+    return cur
+
+
+def match_field_selector(obj: dict, sel: Selector) -> bool:
+    for k, op, v in _parse_selector(sel):
+        raw = _dotted_get(obj, k)
+        if op == "exists":
+            if raw is None:
+                return False
+            continue
+        got = "" if raw is None else str(raw)
+        if op == "=" and got != v:
+            return False
+        if op == "!=" and got == v:
+            return False
+    return True
+
+
+class Watcher:
+    """One watch subscription; iterate or poll its events."""
+
+    def __init__(self, store: "ResourceStore", filt: Callable[[dict], bool]):
+        self._store = store
+        self._filter = filt
+        self._events: deque = deque()
+        self._signal = threading.Event()
+        self._stopped = threading.Event()
+
+    def _push(self, ev: "WatchEvent") -> None:
+        if self._stopped.is_set():
+            return
+        if not self._filter(ev.object):
+            return
+        self._events.append(ev)
+        self._signal.set()
+
+    def next(self, timeout: Optional[float] = 0.5) -> Optional["WatchEvent"]:
+        while True:
+            try:
+                return self._events.popleft()
+            except IndexError:
+                pass
+            if self._stopped.is_set():
+                return None
+            self._signal.clear()
+            try:
+                return self._events.popleft()
+            except IndexError:
+                pass
+            if not self._signal.wait(timeout):
+                return None
+
+    def __iter__(self):
+        while not self._stopped.is_set():
+            ev = self.next(timeout=0.5)
+            if ev is not None:
+                yield ev
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._signal.set()
+        self._store._drop_watcher(self)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set()
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+    rv: int = 0
+
+
+@dataclass
+class _TypeState:
+    rtype: ResourceType
+    history: deque
+    objects: Dict[Tuple[str, str], dict] = field(default_factory=dict)
+    watchers: List[Watcher] = field(default_factory=list)
+
+
+class ResourceStore:
+    """The in-memory cluster state bus."""
+
+    HISTORY = 16384
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self._clock = clock or RealClock()
+        self._mut = threading.RLock()
+        self._rv = 0
+        self._uid = 0
+        self._types: Dict[str, _TypeState] = {}
+        self._audit: List[Tuple[str, str, Optional[str]]] = []  # (verb, key, as_user)
+        for t in BUILTIN_TYPES:
+            self.register_type(t)
+
+    # ------------------------------------------------------------------ registry
+
+    def register_type(self, rtype: ResourceType) -> None:
+        with self._mut:
+            key = rtype.kind.lower()
+            if key not in self._types:
+                self._types[key] = _TypeState(
+                    rtype=rtype, history=deque(maxlen=self.HISTORY)
+                )
+            self._types[rtype.plural.lower()] = self._types[key]
+
+    def resource_type(self, kind: str) -> ResourceType:
+        return self._state(kind).rtype
+
+    def kinds(self) -> List[ResourceType]:
+        seen = []
+        for st in self._types.values():
+            if st.rtype not in seen:
+                seen.append(st.rtype)
+        return seen
+
+    def _state(self, kind: str) -> _TypeState:
+        st = self._types.get(kind.lower())
+        if st is None:
+            raise NotFound(f"unknown resource type {kind!r}")
+        return st
+
+    # ----------------------------------------------------------------- internals
+
+    def _now_string(self) -> str:
+        t = datetime.datetime.fromtimestamp(self._clock.now(), datetime.timezone.utc)
+        return t.isoformat(timespec="seconds").replace("+00:00", "Z")
+
+    def _next_uid(self) -> str:
+        self._uid += 1
+        return f"00000000-0000-0000-0000-{self._uid:012d}"
+
+    def _key(self, st: _TypeState, obj: dict) -> Tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace") or "" if st.rtype.namespaced else ""
+        return (ns, meta.get("name") or "")
+
+    def _emit(self, st: _TypeState, etype: str, obj: dict, rv: int) -> None:
+        ev = WatchEvent(type=etype, object=copy.deepcopy(obj), rv=rv)
+        st.history.append(ev)
+        for w in list(st.watchers):
+            w._push(ev)
+
+    def _drop_watcher(self, watcher: Watcher) -> None:
+        with self._mut:
+            for st in self._types.values():
+                if watcher in st.watchers:
+                    st.watchers.remove(watcher)
+
+    def _bump(self, obj: dict) -> int:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        return self._rv
+
+    # --------------------------------------------------------------------- CRUD
+
+    def create(
+        self, obj: dict, namespace: Optional[str] = None, as_user: Optional[str] = None
+    ) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind") or ""
+        with self._mut:
+            st = self._state(kind)
+            meta = obj.setdefault("metadata", {})
+            if st.rtype.namespaced and not meta.get("namespace"):
+                meta["namespace"] = namespace or "default"
+            if not meta.get("name") and meta.get("generateName"):
+                meta["name"] = meta["generateName"] + f"{self._uid + 1:05x}"
+            key = self._key(st, obj)
+            if key in st.objects:
+                raise Conflict(f"{kind} {key} already exists")
+            meta.setdefault("uid", self._next_uid())
+            meta.setdefault("creationTimestamp", self._now_string())
+            obj.setdefault("apiVersion", st.rtype.api_version)
+            self._audit.append(("create", f"{kind}:{key}", as_user))
+            rv = self._bump(obj)
+            st.objects[key] = obj
+            self._emit(st, ADDED, obj, rv)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, name: str, namespace: Optional[str] = None) -> dict:
+        with self._mut:
+            st = self._state(kind)
+            ns = (namespace or "default") if st.rtype.namespaced else ""
+            obj = st.objects.get((ns, name))
+            if obj is None:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Selector = None,
+        field_selector: Selector = None,
+    ) -> Tuple[List[dict], int]:
+        with self._mut:
+            st = self._state(kind)
+            items = []
+            for (ns, _), obj in sorted(st.objects.items()):
+                if st.rtype.namespaced and namespace is not None and ns != namespace:
+                    continue
+                if not match_label_selector(obj, label_selector):
+                    continue
+                if not match_field_selector(obj, field_selector):
+                    continue
+                items.append(copy.deepcopy(obj))
+            return items, self._rv
+
+    def update(
+        self,
+        obj: dict,
+        subresource: str = "",
+        as_user: Optional[str] = None,
+    ) -> dict:
+        obj = copy.deepcopy(obj)
+        kind = obj.get("kind") or ""
+        with self._mut:
+            st = self._state(kind)
+            key = self._key(st, obj)
+            cur = st.objects.get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {key} not found")
+            expect_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if expect_rv and expect_rv != cur["metadata"].get("resourceVersion"):
+                raise Conflict(
+                    f"resourceVersion mismatch: have {cur['metadata'].get('resourceVersion')}, "
+                    f"got {expect_rv}"
+                )
+            if subresource:
+                new = copy.deepcopy(cur)
+                new[subresource] = obj.get(subresource)
+            else:
+                new = obj
+                # immutable fields survive
+                for f in ("uid", "creationTimestamp"):
+                    if cur["metadata"].get(f) is not None:
+                        new.setdefault("metadata", {})[f] = cur["metadata"][f]
+                if cur["metadata"].get("deletionTimestamp") is not None:
+                    new["metadata"].setdefault(
+                        "deletionTimestamp", cur["metadata"]["deletionTimestamp"]
+                    )
+            self._audit.append(("update", f"{kind}:{key}", as_user))
+            return self._store_mutation(st, key, new)
+
+    def patch(
+        self,
+        kind: str,
+        name: str,
+        data: Any,
+        patch_type: str = "merge",
+        namespace: Optional[str] = None,
+        subresource: str = "",
+        as_user: Optional[str] = None,
+    ) -> dict:
+        with self._mut:
+            st = self._state(kind)
+            ns = (namespace or "default") if st.rtype.namespaced else ""
+            key = (ns, name)
+            cur = st.objects.get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            new = apply_patch(cur, data, patch_type)
+            if subresource:
+                # subresource patches may only change that one field
+                scoped = copy.deepcopy(cur)
+                scoped[subresource] = new.get(subresource)
+                new = scoped
+            else:
+                # metadata invariants
+                new.setdefault("metadata", {})["uid"] = cur["metadata"].get("uid")
+                new["metadata"]["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
+                new["metadata"]["name"] = cur["metadata"].get("name")
+                if st.rtype.namespaced:
+                    new["metadata"]["namespace"] = cur["metadata"].get("namespace")
+                if cur["metadata"].get("deletionTimestamp") is not None:
+                    new["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+            self._audit.append(("patch", f"{kind}:{key}", as_user))
+            return self._store_mutation(st, key, new)
+
+    def _store_mutation(self, st: _TypeState, key: Tuple[str, str], new: dict) -> dict:
+        """Commit an updated object; reap it if it is terminating with no
+        finalizers left (the apiserver's finalizer GC)."""
+        meta = new.setdefault("metadata", {})
+        if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
+            rv = self._bump(new)
+            del st.objects[key]
+            self._emit(st, DELETED, new, rv)
+            return copy.deepcopy(new)
+        rv = self._bump(new)
+        st.objects[key] = new
+        self._emit(st, MODIFIED, new, rv)
+        return copy.deepcopy(new)
+
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: Optional[str] = None,
+        as_user: Optional[str] = None,
+    ) -> Optional[dict]:
+        """Graceful delete: objects holding finalizers get a
+        deletionTimestamp and live on until the finalizers clear."""
+        with self._mut:
+            st = self._state(kind)
+            ns = (namespace or "default") if st.rtype.namespaced else ""
+            key = (ns, name)
+            cur = st.objects.get(key)
+            if cur is None:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            self._audit.append(("delete", f"{kind}:{key}", as_user))
+            meta = cur.setdefault("metadata", {})
+            if meta.get("finalizers"):
+                if meta.get("deletionTimestamp") is None:
+                    meta["deletionTimestamp"] = self._now_string()
+                    rv = self._bump(cur)
+                    self._emit(st, MODIFIED, cur, rv)
+                return copy.deepcopy(cur)
+            rv = self._bump(cur)
+            del st.objects[key]
+            self._emit(st, DELETED, cur, rv)
+            return None
+
+    # -------------------------------------------------------------------- watch
+
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        since_rv: Optional[int] = None,
+        label_selector: Selector = None,
+        field_selector: Selector = None,
+    ) -> Watcher:
+        with self._mut:
+            st = self._state(kind)
+
+            def filt(obj: dict, _ns=namespace, _st=st) -> bool:
+                if _st.rtype.namespaced and _ns is not None:
+                    if (obj.get("metadata") or {}).get("namespace") != _ns:
+                        return False
+                return match_label_selector(obj, label_selector) and match_field_selector(
+                    obj, field_selector
+                )
+
+            w = Watcher(self, filt)
+            if since_rv is not None and since_rv < self._rv:
+                hist = list(st.history)
+                if hist and hist[0].rv > since_rv + 1 and len(hist) == st.history.maxlen:
+                    raise Expired(f"resourceVersion {since_rv} is too old")
+                for ev in hist:
+                    if ev.rv > since_rv:
+                        w._push(ev)
+            st.watchers.append(w)
+            return w
+
+    # -------------------------------------------------------------------- stats
+
+    @property
+    def resource_version(self) -> int:
+        with self._mut:
+            return self._rv
+
+    def count(self, kind: str) -> int:
+        with self._mut:
+            return len(self._state(kind).objects)
+
+    def audit_log(self) -> List[Tuple[str, str, Optional[str]]]:
+        with self._mut:
+            return list(self._audit)
+
+
+class EventRecorder:
+    """Aggregating k8s Event recorder (reference: controllers emit
+    events via an EventBroadcaster, pod_controller.go:304-311; repeats
+    aggregate by bumping ``count``)."""
+
+    #: correlation-cache bound; oldest aggregation keys are evicted (k8s
+    #: event correlators use an LRU the same way)
+    MAX_KEYS = 65536
+
+    def __init__(self, store: ResourceStore, source: str = "kwok"):
+        self._store = store
+        self._source = source
+        self._mut = threading.Lock()
+        self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
+
+    def event(self, involved: dict, etype: str, reason: str, message: str) -> dict:
+        meta = involved.get("metadata") or {}
+        key = (meta.get("uid"), etype, reason, message)
+        ns = meta.get("namespace") or "default"
+        now = self._store._now_string()
+        with self._mut:
+            name = self._keys.get(key)
+            if name is not None:
+                try:
+                    cur = self._store.get("Event", name, namespace=ns)
+                    self._keys.move_to_end(key)
+                    return self._store.patch(
+                        "Event",
+                        name,
+                        {"count": int(cur.get("count") or 1) + 1, "lastTimestamp": now},
+                        "merge",
+                        namespace=ns,
+                    )
+                except NotFound:
+                    del self._keys[key]
+            name = f"{meta.get('name', 'unknown')}.{self._store.resource_version + 1:x}"
+            ev = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": ns},
+                "involvedObject": {
+                    "apiVersion": involved.get("apiVersion"),
+                    "kind": involved.get("kind"),
+                    "name": meta.get("name"),
+                    "namespace": meta.get("namespace"),
+                    "uid": meta.get("uid"),
+                },
+                "reason": reason,
+                "message": message,
+                "type": etype,
+                "count": 1,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "source": {"component": self._source},
+            }
+            created = self._store.create(ev)
+            self._keys[key] = name
+            while len(self._keys) > self.MAX_KEYS:
+                self._keys.popitem(last=False)
+            return created

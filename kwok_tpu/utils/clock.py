@@ -1,0 +1,128 @@
+"""Clock abstraction: real and fake (virtual) time.
+
+The reference injects ``clock.Clock`` everywhere for testability
+(reference: pkg/kwok/controllers/controller.go:102, queue Clock iface
+pkg/utils/queue/delaying_queue.go:27-31). Here the same seam also
+carries the record/replay speed scaling (reference: pkg/kwokctl/
+recording/speed.go:24-62): a ``ScaledClock`` over the real clock plays
+time faster/slower, and ``FakeClock`` drives deterministic tests and
+the device tick's virtual-time column.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+
+class Clock:
+    """Monotonic-ish wall clock in float seconds."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        """Block until ``signal`` is set or ``timeout`` *clock* seconds
+        elapse (the Go ``select { <-After(d); <-signal }``)."""
+        raise NotImplementedError
+
+    def subscribe(self, signal: threading.Event) -> None:
+        """Register a signal to be pinged when virtual time advances
+        (no-op for real clocks)."""
+
+
+class RealClock(Clock):
+    def now(self) -> float:
+        return time.time()
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        signal.wait(timeout)
+
+    def subscribe(self, signal: threading.Event) -> None:
+        pass
+
+
+class ScaledClock(Clock):
+    """Real time scaled by a live-adjustable factor (replay speed).
+
+    ``now`` advances at ``speed`` × real rate from the moment the speed
+    was last changed; ``speed=0`` pauses (reference: recording/handle.go
+    pause/speed keyboard control).
+    """
+
+    def __init__(self, speed: float = 1.0, base: Optional[Clock] = None):
+        self._base = base or RealClock()
+        self._speed = speed
+        self._origin_real = self._base.now()
+        self._origin_virtual = 0.0
+        self._mut = threading.Lock()
+
+    @property
+    def speed(self) -> float:
+        with self._mut:
+            return self._speed
+
+    def set_speed(self, speed: float) -> None:
+        with self._mut:
+            now = self._now_locked()
+            self._origin_virtual = now
+            self._origin_real = self._base.now()
+            self._speed = max(0.0, speed)
+
+    def _now_locked(self) -> float:
+        return self._origin_virtual + (self._base.now() - self._origin_real) * self._speed
+
+    def now(self) -> float:
+        with self._mut:
+            return self._now_locked()
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        if timeout is None:
+            signal.wait(None)
+            return
+        with self._mut:
+            speed = self._speed
+        # virtual timeout -> real timeout; when paused, poll slowly
+        real = timeout / speed if speed > 0 else 0.5
+        signal.wait(min(real, 10.0))
+
+    def subscribe(self, signal: threading.Event) -> None:
+        pass
+
+
+class FakeClock(Clock):
+    """Manually advanced virtual clock for deterministic tests."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._mut = threading.Lock()
+        self._subscribers: List[threading.Event] = []
+
+    def now(self) -> float:
+        with self._mut:
+            return self._now
+
+    def subscribe(self, signal: threading.Event) -> None:
+        with self._mut:
+            self._subscribers.append(signal)
+
+    def advance(self, dt: float) -> None:
+        with self._mut:
+            self._now += dt
+            subs = list(self._subscribers)
+        for s in subs:
+            s.set()
+
+    def set(self, t: float) -> None:
+        with self._mut:
+            self._now = max(self._now, t)
+            subs = list(self._subscribers)
+        for s in subs:
+            s.set()
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        # Virtual timeouts only elapse via advance(); advance pings all
+        # subscribed signals, so just wait on the signal (bounded so a
+        # missing advance in a test cannot hang forever).
+        signal.wait(5.0)

@@ -1,0 +1,96 @@
+"""Informer tests: cache mirroring, event forwarding, predicate
+filtering, sync re-list (reference: pkg/utils/informer/informer_test.go)."""
+
+import threading
+import time
+
+from kwok_tpu.cluster.informer import Informer, InformerEvent, WatchOptions
+from kwok_tpu.cluster.store import ADDED, DELETED, MODIFIED, SYNC, ResourceStore
+from kwok_tpu.utils.queue import Queue
+
+
+def pod(name, node="node-1"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"nodeName": node},
+        "status": {},
+    }
+
+
+def drain(q, n, timeout=2.0):
+    out = []
+    deadline = time.time() + timeout
+    while len(out) < n and time.time() < deadline:
+        item, ok = q.get_or_wait(timeout=0.2)
+        if ok:
+            out.append(item)
+    return out
+
+
+def test_watch_with_cache_seeds_and_follows():
+    s = ResourceStore()
+    s.create(pod("a"))
+    q = Queue()
+    done = threading.Event()
+    inf = Informer(s, "Pod")
+    cache = inf.watch_with_cache(WatchOptions(), q, done=done)
+
+    evs = drain(q, 1)
+    assert [e.type for e in evs] == [ADDED]
+    s.create(pod("b"))
+    s.patch("Pod", "b", {"status": {"phase": "Running"}}, "merge", subresource="status")
+    s.delete("Pod", "a")
+    evs = drain(q, 3)
+    assert [e.type for e in evs] == [ADDED, MODIFIED, DELETED]
+    time.sleep(0.05)
+    assert cache.get("b", "default")["status"]["phase"] == "Running"
+    assert cache.get("a", "default") is None
+    done.set()
+
+
+def test_predicate_filters_and_emits_delete_on_exit():
+    """Objects leaving the predicate set surface as DELETED so the
+    controller stops managing them (reference need()/disregard logic,
+    pod_controller.go:392-409)."""
+    s = ResourceStore()
+    q = Queue()
+    done = threading.Event()
+    inf = Informer(s, "Pod")
+    opt = WatchOptions(predicate=lambda o: o["spec"].get("nodeName") == "managed")
+    cache = inf.watch_with_cache(opt, q, done=done)
+    s.create(pod("a", node="managed"))
+    s.create(pod("b", node="other"))
+    evs = drain(q, 1)
+    assert [e.object["metadata"]["name"] for e in evs] == ["a"]
+    # move a off the managed node -> DELETED surfaced
+    s.patch("Pod", "a", {"spec": {"nodeName": "other"}}, "merge")
+    evs = drain(q, 1)
+    assert evs[0].type == DELETED
+    done.set()
+
+
+def test_sync_relists_as_sync_events():
+    s = ResourceStore()
+    s.create(pod("a", node="n1"))
+    s.create(pod("b", node="n2"))
+    q = Queue()
+    inf = Informer(s, "Pod")
+    n = inf.sync(WatchOptions(field_selector={"spec.nodeName": "n1"}), q)
+    assert n == 1
+    ev, ok = q.get_or_wait(timeout=1.0)
+    assert ok and ev.type == SYNC and ev.object["metadata"]["name"] == "a"
+
+
+def test_cacheless_watch_forwards_only():
+    s = ResourceStore()
+    q = Queue()
+    done = threading.Event()
+    inf = Informer(s, "Pod")
+    cache = inf.watch(WatchOptions(), q, done=done)
+    s.create(pod("a"))
+    evs = drain(q, 1)
+    assert [e.type for e in evs] == [ADDED]
+    assert len(cache) == 0  # dummy store: no mirroring
+    done.set()

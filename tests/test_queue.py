@@ -1,0 +1,102 @@
+"""Queue semantics tests, mirroring the reference's queue suite
+(reference: pkg/utils/queue/{queue,weight_queue,delaying_queue,
+weight_delaying_queue}_test.go)."""
+
+import time
+
+from kwok_tpu.utils.clock import FakeClock
+from kwok_tpu.utils.queue import DelayingQueue, Queue, WeightDelayingQueue, WeightQueue
+
+
+def test_queue_fifo():
+    q = Queue()
+    for i in range(5):
+        q.add(i)
+    assert len(q) == 5
+    got = [q.get()[0] for _ in range(5)]
+    assert got == [0, 1, 2, 3, 4]
+    assert q.get() == (None, False)
+
+
+def test_queue_get_or_wait():
+    q = Queue()
+    q.add("a")
+    item, ok = q.get_or_wait(timeout=0.1)
+    assert ok and item == "a"
+    item, ok = q.get_or_wait(timeout=0.05)
+    assert not ok
+
+
+def test_weight_queue_priority():
+    """Weight 0 is the main queue; weighted buckets drain 'weight' items
+    per step, highest weight first (weight_queue.go:84-110)."""
+    q = WeightQueue()
+    q.add_weight("w1-a", 1)
+    q.add_weight("w1-b", 1)
+    q.add_weight("w2-a", 2)
+    q.add_weight("w2-b", 2)
+    q.add_weight("main", 0)
+    # main queue first
+    assert q.get() == ("main", True)
+    # then a drain step: weight 2 contributes 2 items, weight 1 one item
+    got = [q.get()[0] for _ in range(4)]
+    assert got == ["w2-a", "w2-b", "w1-a", "w1-b"]
+
+
+def test_delaying_queue_promotes_on_deadline():
+    clock = FakeClock()
+    q = DelayingQueue(clock)
+    q.add_after("later", 5.0)
+    q.add("now")
+    assert q.get_or_wait(timeout=1.0) == ("now", True)
+    assert q.get() == (None, False)
+    clock.advance(5.0)
+    item, ok = q.get_or_wait(timeout=2.0)
+    assert ok and item == "later"
+    q.stop()
+
+
+def test_delaying_queue_cancel():
+    clock = FakeClock()
+    q = DelayingQueue(clock)
+    q.add_after("x", 5.0)
+    assert q.cancel("x")
+    assert not q.cancel("x")
+    clock.advance(10.0)
+    time.sleep(0.05)
+    assert q.get() == (None, False)
+    q.stop()
+
+
+def test_delaying_queue_zero_delay_is_immediate():
+    q = DelayingQueue(FakeClock())
+    q.add_after("x", 0)
+    assert q.get() == ("x", True)
+    q.stop()
+
+
+def test_weight_delaying_queue_orders_by_weight_after_deadline():
+    """Fresh work (weight 0) is served before retries (weight 1) once
+    both are due (pod_controller.go:660-671 retry path)."""
+    clock = FakeClock()
+    q = WeightDelayingQueue(clock)
+    q.add_weight_after("retry", 1, 1.0)
+    q.add_weight_after("fresh", 0, 1.0)
+    clock.advance(1.5)
+    a, ok = q.get_or_wait(timeout=2.0)
+    assert ok
+    b, ok = q.get_or_wait(timeout=2.0)
+    assert ok
+    assert (a, b) == ("fresh", "retry")
+    q.stop()
+
+
+def test_weight_delaying_queue_cancel_weighted():
+    clock = FakeClock()
+    q = WeightDelayingQueue(clock)
+    q.add_weight_after("a", 3, 5.0)
+    assert q.cancel("a")
+    clock.advance(10.0)
+    time.sleep(0.05)
+    assert q.get() == (None, False)
+    q.stop()

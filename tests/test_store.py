@@ -1,0 +1,179 @@
+"""ResourceStore semantics: RV monotonicity, patch/subresource scoping,
+finalizer-aware delete, watch resume, selectors, event aggregation."""
+
+import pytest
+
+from kwok_tpu.cluster.store import (
+    ADDED,
+    Conflict,
+    DELETED,
+    EventRecorder,
+    MODIFIED,
+    NotFound,
+    ResourceStore,
+    ResourceType,
+)
+
+
+def pod(name, ns="default", node="node-1", labels=None, finalizers=None):
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
+    if finalizers:
+        meta["finalizers"] = finalizers
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {"nodeName": node},
+        "status": {},
+    }
+
+
+def test_create_get_list_rv_monotonic():
+    s = ResourceStore()
+    p1 = s.create(pod("a"))
+    p2 = s.create(pod("b"))
+    assert int(p2["metadata"]["resourceVersion"]) > int(p1["metadata"]["resourceVersion"])
+    assert p1["metadata"]["uid"] != p2["metadata"]["uid"]
+    assert p1["metadata"]["creationTimestamp"].endswith("Z")
+    items, rv = s.list("Pod")
+    assert [i["metadata"]["name"] for i in items] == ["a", "b"]
+    assert rv == s.resource_version
+    with pytest.raises(Conflict):
+        s.create(pod("a"))
+
+
+def test_update_conflict_on_stale_rv():
+    s = ResourceStore()
+    p = s.create(pod("a"))
+    p1 = dict(p)
+    s.update(p)  # bumps rv
+    with pytest.raises(Conflict):
+        s.update(p1)
+
+
+def test_patch_subresource_scoping():
+    """A status patch cannot touch spec (apiserver subresource routing)."""
+    s = ResourceStore()
+    s.create(pod("a"))
+    out = s.patch(
+        "Pod",
+        "a",
+        {"spec": {"nodeName": "evil"}, "status": {"phase": "Running"}},
+        "strategic",
+        subresource="status",
+    )
+    assert out["status"]["phase"] == "Running"
+    assert out["spec"]["nodeName"] == "node-1"
+
+
+def test_patch_preserves_metadata_invariants():
+    s = ResourceStore()
+    p = s.create(pod("a"))
+    out = s.patch("Pod", "a", {"metadata": {"uid": "forged"}}, "merge")
+    assert out["metadata"]["uid"] == p["metadata"]["uid"]
+
+
+def test_finalizer_graceful_delete():
+    """Delete with finalizers -> deletionTimestamp; removing the last
+    finalizer reaps the object (reference pod-general FSM depends on
+    this: finalizer add -> delete -> remove finalizer -> gone)."""
+    s = ResourceStore()
+    s.create(pod("a", finalizers=["kwok.x-k8s.io/fake"]))
+    w = s.watch("Pod")
+    out = s.delete("Pod", "a")
+    assert out is not None and out["metadata"]["deletionTimestamp"]
+    assert s.count("Pod") == 1
+    ev = w.next(timeout=1.0)
+    assert ev.type == MODIFIED
+    # clearing finalizers reaps
+    s.patch("Pod", "a", [{"op": "replace", "path": "/metadata/finalizers", "value": []}], "json")
+    assert s.count("Pod") == 0
+    ev = w.next(timeout=1.0)
+    assert ev.type == DELETED
+    with pytest.raises(NotFound):
+        s.get("Pod", "a")
+
+
+def test_delete_without_finalizers_is_immediate():
+    s = ResourceStore()
+    s.create(pod("a"))
+    assert s.delete("Pod", "a") is None
+    assert s.count("Pod") == 0
+
+
+def test_watch_stream_and_resume():
+    s = ResourceStore()
+    s.create(pod("a"))
+    _, rv = s.list("Pod")
+    w = s.watch("Pod", since_rv=rv)
+    s.create(pod("b"))
+    s.patch("Pod", "b", {"status": {"phase": "Running"}}, "merge", subresource="status")
+    evs = [w.next(timeout=1.0) for _ in range(2)]
+    assert [e.type for e in evs] == [ADDED, MODIFIED]
+    assert evs[1].object["status"]["phase"] == "Running"
+    # resume from an old rv replays history
+    w2 = s.watch("Pod", since_rv=rv)
+    evs2 = [w2.next(timeout=1.0) for _ in range(2)]
+    assert [e.type for e in evs2] == [ADDED, MODIFIED]
+
+
+def test_watch_selectors():
+    s = ResourceStore()
+    w = s.watch("Pod", field_selector={"spec.nodeName": "node-2"})
+    s.create(pod("a", node="node-1"))
+    s.create(pod("b", node="node-2"))
+    ev = w.next(timeout=1.0)
+    assert ev.object["metadata"]["name"] == "b"
+    assert w.next(timeout=0.1) is None
+
+
+def test_list_selectors():
+    s = ResourceStore()
+    s.create(pod("a", labels={"app": "x"}))
+    s.create(pod("b", labels={"app": "y"}))
+    items, _ = s.list("Pod", label_selector={"app": "x"})
+    assert [i["metadata"]["name"] for i in items] == ["a"]
+    items, _ = s.list("Pod", label_selector="app!=x")
+    assert [i["metadata"]["name"] for i in items] == ["b"]
+    items, _ = s.list("Pod", field_selector="spec.nodeName=node-1")
+    assert len(items) == 2
+
+
+def test_namespace_scoping():
+    s = ResourceStore()
+    s.create(pod("a", ns="ns1"))
+    s.create(pod("a", ns="ns2"))
+    items, _ = s.list("Pod", namespace="ns1")
+    assert len(items) == 1
+    assert s.get("Pod", "a", namespace="ns2")["metadata"]["namespace"] == "ns2"
+
+
+def test_cluster_scoped_type():
+    s = ResourceStore()
+    n = s.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"}})
+    assert "namespace" not in n["metadata"]
+    assert s.get("Node", "n1")["metadata"]["name"] == "n1"
+
+
+def test_register_dynamic_type_and_plural_lookup():
+    s = ResourceStore()
+    s.register_type(ResourceType("example.com/v1", "Widget", "widgets"))
+    s.create({"apiVersion": "example.com/v1", "kind": "Widget", "metadata": {"name": "w"}})
+    assert s.count("widgets") == 1
+    assert s.get("widgets", "w")["kind"] == "Widget"
+
+
+def test_event_recorder_aggregates():
+    s = ResourceStore()
+    p = s.create(pod("a"))
+    rec = EventRecorder(s)
+    rec.event(p, "Normal", "Created", "Pod created")
+    rec.event(p, "Normal", "Created", "Pod created")
+    events, _ = s.list("Event")
+    assert len(events) == 1
+    assert events[0]["count"] == 2
+    rec.event(p, "Warning", "Failed", "boom")
+    events, _ = s.list("Event")
+    assert len(events) == 2
