@@ -1,0 +1,6 @@
+from kwok_tpu.controllers.controller import Controller  # noqa: F401
+from kwok_tpu.controllers.node_controller import NodeController  # noqa: F401
+from kwok_tpu.controllers.node_lease_controller import NodeLeaseController  # noqa: F401
+from kwok_tpu.controllers.pod_controller import PodController  # noqa: F401
+from kwok_tpu.controllers.stage_controller import StageController  # noqa: F401
+from kwok_tpu.controllers.stages_manager import StagesManager  # noqa: F401

@@ -1,0 +1,210 @@
+"""NodeLeaseController: simulated kubelet heartbeat + multi-instance
+node ownership.
+
+(reference: pkg/kwok/controllers/node_lease_controller.go:39-338)
+
+Each managed node gets a ``coordination.k8s.io/Lease`` in
+``kube-node-lease``, renewed every leaseDuration/4 with one-sided
+jitter 0.04 (controller.go:245-249). Holding the lease IS owning the
+node: a node whose lease another instance holds is read-only to us
+(controller.go:286-296), which is how multiple simulator instances
+shard a cluster — and the host-side analog of sharding SoA rows
+across device shards (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import datetime
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from kwok_tpu.cluster.store import Conflict, NotFound, ResourceStore
+from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.queue import DelayingQueue
+
+NAMESPACE_NODE_LEASE = "kube-node-lease"
+
+
+def _parse_micro(ts: str) -> Optional[datetime.datetime]:
+    try:
+        return datetime.datetime.fromisoformat(ts.replace("Z", "+00:00"))
+    except (ValueError, AttributeError):
+        return None
+
+
+class NodeLeaseController:
+    def __init__(
+        self,
+        store: ResourceStore,
+        holder_identity: str,
+        lease_duration_seconds: int = 40,
+        parallelism: int = 4,
+        clock: Optional[Clock] = None,
+        on_node_managed: Optional[Callable[[str], None]] = None,
+        mutate_lease: Optional[Callable[[dict], dict]] = None,
+        rng: Optional[random.Random] = None,
+    ):
+        self.store = store
+        self.holder = holder_identity
+        self.lease_duration = lease_duration_seconds
+        self.renew_interval = lease_duration_seconds / 4.0
+        self.renew_jitter = 0.04  # one-sided (reference controller.go:245-249)
+        self.clock = clock or RealClock()
+        self._on_node_managed = on_node_managed
+        self._mutate = mutate_lease
+        self.rng = rng or random.Random()
+
+        self._holding: Set[str] = set()
+        self._wanted: Set[str] = set()
+        #: names currently cycling through the queue/worker — guards
+        #: against double entries when a node is re-managed while its
+        #: old entry is still in flight
+        self._queued: Set[str] = set()
+        self._mut = threading.Lock()
+        self._queue: DelayingQueue = DelayingQueue(self.clock)
+        self._done = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._parallelism = parallelism
+        self.renew_count = 0
+        #: per-node last renew lag (seconds past due) — feeds the p99
+        #: heartbeat-lag metric in BASELINE.json
+        self.renew_lag: Dict[str, float] = {}
+
+    def start(self) -> None:
+        for _ in range(self._parallelism):
+            t = threading.Thread(target=self._sync_worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._done.set()
+        self._queue.stop()
+
+    # ---------------------------------------------------------------- ownership
+
+    def try_hold(self, name: str) -> None:
+        """Start trying to acquire/renew this node's lease
+        (node_lease_controller.go:150-162 TryHold)."""
+        with self._mut:
+            if name in self._wanted:
+                return
+            self._wanted.add(name)
+            if name in self._queued:
+                return  # old entry still cycling; it will renew
+            self._queued.add(name)
+        self._queue.add(name)
+
+    def release_hold(self, name: str) -> None:
+        with self._mut:
+            self._wanted.discard(name)
+            self._holding.discard(name)
+            if self._queue.cancel(name):
+                self._queued.discard(name)
+            # else: the worker holds it; it will drop it on next pop
+
+    def held(self, name: str) -> bool:
+        """(node_lease_controller.go:164-171)"""
+        with self._mut:
+            return name in self._holding
+
+    def held_nodes(self) -> Set[str]:
+        with self._mut:
+            return set(self._holding)
+
+    # -------------------------------------------------------------------- sync
+
+    def _sync_worker(self) -> None:
+        while not self._done.is_set():
+            name, ok = self._queue.get_or_wait(timeout=0.2)
+            if not ok:
+                continue
+            with self._mut:
+                if name not in self._wanted:
+                    self._queued.discard(name)
+                    continue
+            try:
+                next_try = self._sync(name)
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                next_try = self.renew_interval
+            self._queue.add_after(name, next_try)
+
+    def _now(self) -> datetime.datetime:
+        return datetime.datetime.fromtimestamp(self.clock.now(), datetime.timezone.utc)
+
+    def _micro(self, t: datetime.datetime) -> str:
+        return t.isoformat(timespec="microseconds").replace("+00:00", "Z")
+
+    def _sync(self, name: str) -> float:
+        """Renew or acquire; returns seconds until next try
+        (node_lease_controller.go:174-214 sync + :322-338
+        nextTryDuration)."""
+        now = self._now()
+        try:
+            lease = self.store.get("Lease", name, namespace=NAMESPACE_NODE_LEASE)
+        except NotFound:
+            lease = None
+
+        if lease is not None:
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity")
+            if holder != self.holder:
+                # someone else's lease: take over only once expired
+                # (node_lease_controller.go:293-306 tryAcquireOrRenew)
+                renew = _parse_micro(spec.get("renewTime") or "")
+                dur = spec.get("leaseDurationSeconds") or self.lease_duration
+                if renew is not None and renew + datetime.timedelta(seconds=dur) > now:
+                    with self._mut:
+                        self._holding.discard(name)
+                    expire = renew + datetime.timedelta(seconds=dur)
+                    return max((expire - now).total_seconds(), 0.1)
+            else:
+                renew = _parse_micro(spec.get("renewTime") or "")
+                if renew is not None:
+                    due = renew + datetime.timedelta(seconds=self.renew_interval)
+                    lag = (now - due).total_seconds()
+                    if lag > 0:
+                        self.renew_lag[name] = lag
+            lease["spec"] = dict(lease.get("spec") or {})
+            lease["spec"]["holderIdentity"] = self.holder
+            lease["spec"]["leaseDurationSeconds"] = self.lease_duration
+            lease["spec"]["renewTime"] = self._micro(now)
+            if self._mutate is not None:
+                lease = self._mutate(lease)
+            try:
+                self.store.update(lease)
+            except (Conflict, NotFound):
+                return 0.1  # re-read immediately
+        else:
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": NAMESPACE_NODE_LEASE},
+                "spec": {
+                    "holderIdentity": self.holder,
+                    "leaseDurationSeconds": self.lease_duration,
+                    "acquireTime": self._micro(now),
+                    "renewTime": self._micro(now),
+                },
+            }
+            if self._mutate is not None:
+                lease = self._mutate(lease)
+            try:
+                self.store.create(lease)
+            except Conflict:
+                return 0.1
+
+        first = False
+        with self._mut:
+            if name not in self._holding:
+                self._holding.add(name)
+                first = True
+        self.renew_count += 1
+        if first and self._on_node_managed is not None:
+            self._on_node_managed(name)
+
+        # renewInterval + one-sided jitter in [iv, iv*(1+0.04)]
+        return self.renew_interval * (1.0 + self.renew_jitter * self.rng.random())

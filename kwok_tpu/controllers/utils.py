@@ -1,0 +1,113 @@
+"""Controller support: IP pools, retry backoff, stage jobs.
+
+(reference: pkg/kwok/controllers/utils.go:40-160)
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Set
+
+from kwok_tpu.engine.lifecycle import CompiledStage
+
+
+class IPPool:
+    """Sequential allocator over a CIDR with recycle
+    (reference utils.go:48-114 ipPool)."""
+
+    def __init__(self, cidr: str):
+        iface = ipaddress.ip_interface(cidr)
+        self._net = iface.network
+        # allocate from the CIDR's host address + 1: skips the network
+        # address and the conventional node IP (e.g. 10.0.0.1/24 -> pods
+        # start at 10.0.0.2, never colliding with hostIP)
+        self._base = iface.ip if iface.ip != self._net.network_address else self._net.network_address
+        self._mut = threading.Lock()
+        self._used: Set[str] = set()
+        self._usable: Set[str] = set()
+        self._index = 1
+
+    def _new(self) -> str:
+        while True:
+            ip = str(self._base + self._index)
+            self._index += 1
+            if ip in self._used:
+                continue
+            self._used.add(ip)
+            return ip
+
+    def get(self) -> str:
+        with self._mut:
+            if self._usable:
+                ip = next(iter(self._usable))
+                self._usable.discard(ip)
+            else:
+                ip = self._new()
+            self._used.add(ip)
+            return ip
+
+    def put(self, ip: str) -> None:
+        with self._mut:
+            try:
+                if ipaddress.ip_address(ip) not in self._net:
+                    return
+            except ValueError:
+                return
+            self._used.discard(ip)
+            self._usable.add(ip)
+
+    def use(self, ip: str) -> None:
+        with self._mut:
+            try:
+                if ipaddress.ip_address(ip) not in self._net:
+                    return
+            except ValueError:
+                return
+            self._used.add(ip)
+
+
+@dataclass
+class Backoff:
+    """Capped exponential backoff with jitter
+    (reference utils.go:133-143 defaultBackoff/backoffDelayByStep:
+    1s × 2ⁿ, jitter 0.2, cap 32 min)."""
+
+    duration: float = 1.0
+    factor: float = 2.0
+    jitter: float = 0.2
+    cap: float = 32 * 60.0
+
+    def delay(self, steps: int, rng: Optional[random.Random] = None) -> float:
+        d = min(self.duration * (self.factor**steps), self.cap)
+        r = (rng or random).random()
+        return d * (1.0 + self.jitter * r)
+
+
+@dataclass
+class StageJob:
+    """One queued transition (reference utils.go:123-130
+    resourceStageJob[T])."""
+
+    resource: dict
+    stage: CompiledStage
+    key: str
+    retry_count: int = 0
+
+    # jobs are queue items; identity (not value) equality lets the queue
+    # cancel a superseded job by reference
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+def should_retry(err: Exception) -> bool:
+    """Retry only connection/timeout-ish failures (utils.go:146-160).
+    The in-process store can only fail transiently on Conflict."""
+    from kwok_tpu.cluster.store import Conflict
+
+    return isinstance(err, (ConnectionError, TimeoutError, Conflict))

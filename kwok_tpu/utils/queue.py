@@ -53,6 +53,15 @@ class Queue(Generic[T]):
                 return self._items.popleft(), True
         return None, False
 
+    def remove(self, item: T) -> bool:
+        """Remove a not-yet-consumed item from the FIFO."""
+        with self._mut:
+            try:
+                self._items.remove(item)
+                return True
+            except ValueError:
+                return False
+
     def get_or_wait(self, timeout: Optional[float] = None, done: Optional[threading.Event] = None) -> Tuple[Optional[T], bool]:
         """Block until an item is available, ``done`` is set, or timeout."""
         while True:
@@ -182,8 +191,10 @@ class DelayingQueue(Queue[T]):
         self._hsignal.set()
 
     def cancel(self, item: T) -> bool:
+        """Remove an item whether still delayed or already promoted."""
         with self._hmut:
-            return self._heap.remove(item)
+            removed = self._heap.remove(item)
+        return self.remove(item) or removed
 
     def _promote(self, item: T, weight: int) -> None:
         self.add(item)
@@ -267,13 +278,30 @@ class WeightDelayingQueue(WeightQueue[T]):
     def add_after(self, item: T, delay: float) -> None:
         self.add_weight_after(item, 0, delay)
 
+    def remove(self, item: T) -> bool:
+        """Remove from the main FIFO or any weight bucket."""
+        with self._mut:
+            try:
+                self._items.remove(item)
+                return True
+            except ValueError:
+                pass
+            for bucket in self._buckets.values():
+                try:
+                    bucket.remove(item)
+                    return True
+                except ValueError:
+                    continue
+        return False
+
     def cancel(self, item: T) -> bool:
+        """Remove an item whether still delayed or already promoted."""
         with self._hmut:
             removed = self._heap.remove(item)
             for h in self._wheaps.values():
                 if h.remove(item):
                     removed = True
-            return removed
+        return self.remove(item) or removed
 
     def _next(self) -> Tuple[Optional[T], int, bool, Optional[float]]:
         now = self._clock.now()
