@@ -35,8 +35,11 @@ class KwokConfiguration:
     node_port: int = 10247
     enable_crds: bool = False
     #: simulation backend: "host" (per-object reference semantics) or
-    #: "device" (vectorized TPU tick kernel)
+    #: "device" (vectorized TPU tick kernel, host fallback per kind when
+    #: a stage set does not lower)
     backend: str = "host"
+    device_capacity: int = 4096
+    device_tick_ms: int = 100
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "KwokConfiguration":
@@ -62,4 +65,6 @@ class KwokConfiguration:
             node_port=int(g("nodePort", 10247)),
             enable_crds=bool(g("enableCRDs", False)),
             backend=g("backend", "host"),
+            device_capacity=int(g("deviceCapacity", 4096)),
+            device_tick_ms=int(g("deviceTickMilliseconds", 100)),
         )

@@ -74,7 +74,12 @@ class Controller:
         self.pods: Optional[PodController] = None
         self.node_leases: Optional[NodeLeaseController] = None
         self.stage_controllers: Dict[str, StageController] = {}
-        self.stages_manager = StagesManager(store, on_ref_added=self._on_ref_added)
+        self.device_players: Dict[str, object] = {}
+        self.stages_manager = StagesManager(
+            store,
+            on_ref_added=self._on_ref_added,
+            on_ref_updated=self._on_ref_updated,
+        )
 
     @staticmethod
     def _validate(conf: KwokConfiguration) -> None:
@@ -178,11 +183,15 @@ class Controller:
 
     def _on_node_owned(self, node_name: str) -> None:
         """Lease acquired (or leases disabled): simulate the node and
-        re-feed its pods (reference controller.go:276-279)."""
+        re-feed its pods (reference controller.go:276-279). Device
+        players get the same catch-up — events dropped while read-only
+        are replayed."""
         if self.nodes is not None:
             self.nodes.manage_node(node_name)
         if self.pods is not None:
             self.pods.sync_node(node_name)
+        for dp in self.device_players.values():
+            dp.sync_node(node_name)
 
     def _on_node_unmanaged(self, node_name: str) -> None:
         if self.node_leases is not None:
@@ -195,7 +204,21 @@ class Controller:
                 return
             self._start_controller_for(kind)
 
+    def _on_ref_updated(self, kind: str) -> None:
+        """A kind's stage set changed: host controllers see it through
+        the live lifecycle getter; an AOT-compiled device player must be
+        rebuilt against the new set (its informer re-lists the world)."""
+        with self._mut:
+            if not self._started or self._done.is_set():
+                return
+            player = self.device_players.pop(kind, None)
+            if player is not None:
+                player.stop()
+            self._start_controller_for(kind)
+
     def _start_controller_for(self, kind: str) -> None:
+        if self.conf.backend == "device" and self._start_device_controller(kind):
+            return
         getter = self.stages_manager.lifecycle_getter(kind)
         if kind == "Pod":
             if self.pods is not None:
@@ -244,6 +267,64 @@ class Controller:
             )
             self.stage_controllers[kind] = sc
             sc.start()
+
+    def _start_device_controller(self, kind: str) -> bool:
+        """Try the vectorized device backend for this kind; returns
+        False (host fallback) when the stage set does not lower to the
+        AOT tick kernel (SURVEY.md §7.1 compile-time vocabulary split)."""
+        from kwok_tpu.controllers.device_player import DeviceStagePlayer
+        from kwok_tpu.controllers.pod_controller import PodEnv
+        from kwok_tpu.engine.compiler import StageCompileError
+
+        if kind in self.device_players:
+            return True
+        stages = self._stages_for(kind)
+        if not stages:
+            return False
+        predicate = None
+        funcs_for = None
+        on_delete = None
+        if kind == "Pod":
+            env = PodEnv(
+                cidr=self.conf.cidr,
+                node_ip=self.conf.node_ip,
+                node_getter=self.node_cache,
+            )
+            predicate = self._pod_managed
+            funcs_for = env.funcs
+            on_delete = env.release
+        elif kind == "Node":
+            from kwok_tpu.controllers.node_controller import node_funcs
+
+            predicate = self._node_predicate
+            nf = node_funcs(self.conf.node_ip, self.conf.node_name, self.conf.node_port)
+            funcs_for = lambda obj: nf  # noqa: E731
+        try:
+            player = DeviceStagePlayer(
+                self.store,
+                kind,
+                stages,
+                capacity=self.conf.device_capacity,
+                tick_ms=self.conf.device_tick_ms,
+                clock=self.clock,
+                recorder=self.recorder,
+                read_only=self._read_only,
+                predicate=predicate,
+                funcs_for=funcs_for,
+                on_delete=on_delete,
+                seed=self.rng.randrange(2**31),
+            )
+        except StageCompileError:
+            return False
+        self.device_players[kind] = player
+        player.start()
+        return True
+
+    def _stages_for(self, kind: str) -> List[Stage]:
+        if self._local_stages is not None:
+            return self._local_stages.get(kind) or []
+        lc = self.stages_manager.lifecycle_getter(kind)()
+        return [cs.raw for cs in lc.stages]
 
     def start(self) -> None:
         """(reference controller.go:533-557 Start)"""
@@ -302,12 +383,21 @@ class Controller:
                 c.stop()
         for sc in self.stage_controllers.values():
             sc.stop()
+        with self._mut:
+            players = list(self.device_players.values())
+        for dp in players:
+            dp.stop()
 
     # -------------------------------------------------------------------- stats
 
     def transition_count(self) -> int:
         total = 0
-        for c in [self.nodes, self.pods, *self.stage_controllers.values()]:
+        for c in [
+            self.nodes,
+            self.pods,
+            *self.stage_controllers.values(),
+            *self.device_players.values(),
+        ]:
             if c is not None:
                 total += c.transitions
         return total

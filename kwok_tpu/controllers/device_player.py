@@ -1,0 +1,278 @@
+"""DeviceStagePlayer: the TPU execution backend behind the controller
+seam.
+
+Where ``StagePlayer`` (host backend) runs the reference's per-object
+loop, this player keeps every object as a row of the device-resident
+SoA and replaces informer-dedup + Lifecycle.Match + WeightDelayingQueue
++ N play workers with ONE batched tick kernel (SURVEY.md §2.9, §7.3):
+
+    watch deltas -> admit/refresh rows (host, batched between ticks)
+    -> tick() on device (match + weighted choice + timers + effects)
+    -> dirty rows drain -> store PATCH/DELETE/events (host)
+    -> store result refreshes the row (features stay parity-exact)
+
+Only dirty rows cross the host<->device boundary. Stage sets the AOT
+compiler cannot lower raise StageCompileError at construction; the
+facade falls back to the host backend for that kind.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kwok_tpu.api.types import Stage
+from kwok_tpu.cluster.informer import Informer, InformerEvent, WatchOptions
+from kwok_tpu.cluster.store import DELETED, EventRecorder, NotFound, ResourceStore
+from kwok_tpu.engine.simulator import DEFAULT_EPOCH, DeviceSimulator, Transition
+from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.patch import is_noop_patch
+from kwok_tpu.utils.queue import Queue
+
+
+class DeviceStagePlayer:
+    """Vectorized stage player for one resource kind."""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        kind: str,
+        stages: List[Stage],
+        capacity: int = 1024,
+        tick_ms: int = 100,
+        clock: Optional[Clock] = None,
+        recorder: Optional[EventRecorder] = None,
+        read_only: Optional[Callable[[dict], bool]] = None,
+        predicate: Optional[Callable[[dict], bool]] = None,
+        funcs_for: Optional[Callable[[dict], Dict[str, Callable]]] = None,
+        on_delete: Optional[Callable[[dict], None]] = None,
+        seed: int = 0,
+    ):
+        self.store = store
+        self.kind = kind
+        self.clock = clock or RealClock()
+        self.recorder = recorder
+        self.read_only = read_only
+        self._predicate = predicate
+        self.funcs_for = funcs_for or (lambda obj: {})
+        self.on_delete = on_delete
+        self.tick_ms = tick_ms
+        self.sim = DeviceSimulator(stages, capacity=capacity, seed=seed)
+        self._informer = Informer(store, kind)
+        self.events: Queue = Queue()
+        #: (namespace, name) -> row
+        self._rows: Dict[Tuple[str, str], int] = {}
+        #: row -> resourceVersion we last wrote (echo suppression)
+        self._written_rv: Dict[int, str] = {}
+        self._mut = threading.Lock()
+        self._done = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.transitions = 0
+        self.patches = 0
+        # virtual-time anchor: device ms 0 == clock.now() at start
+        self._t0: Optional[float] = None
+        self.cache = None
+
+    # ------------------------------------------------------------------- wiring
+
+    def start(self) -> None:
+        self._t0 = self.clock.now()
+        self.sim.epoch = _epoch_from(self._t0)
+        self.cache = self._informer.watch_with_cache(
+            WatchOptions(predicate=self._predicate), self.events, done=self._done
+        )
+        t = threading.Thread(target=self._tick_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._done.set()
+        # join the tick thread: a daemon thread killed mid-XLA-dispatch
+        # at interpreter exit aborts the process ("exception not
+        # rethrown"); a bounded join drains it cleanly
+        for t in self._threads:
+            t.join(timeout=max(2.0, 4 * self.tick_ms / 1000.0))
+
+    # ------------------------------------------------------------ event ingest
+
+    def _key(self, obj: dict) -> Tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        return (meta.get("namespace") or "", meta.get("name") or "")
+
+    def _drain_events(self) -> None:
+        """Apply queued watch deltas to the SoA (batched: at most one
+        device re-upload per tick)."""
+        while True:
+            ev, ok = self.events.get()
+            if not ok:
+                return
+            self._apply_event(ev)
+
+    def _apply_event(self, ev: InformerEvent) -> None:
+        obj = ev.object
+        key = self._key(obj)
+        with self._mut:
+            row = self._rows.get(key)
+            if ev.type == DELETED:
+                if row is not None:
+                    self.sim.release(row)
+                    del self._rows[key]
+                    self._written_rv.pop(row, None)
+                if self.on_delete is not None:
+                    self.on_delete(obj)
+                return
+            if self.read_only is not None and self.read_only(obj):
+                return
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if row is None:
+                row = self.sim.admit(obj)
+                self._rows[key] = row
+            else:
+                if self._written_rv.get(row) == rv:
+                    return  # echo of our own patch; row is already current
+                self.sim.objects[row] = obj
+                self.sim.refresh_row(row)
+
+    # --------------------------------------------------------------- tick loop
+
+    def sync_node(self, node_name: str) -> None:
+        """Re-feed this kind's objects tied to a node that just became
+        owned (the device analog of the host sync_node / manage_node
+        catch-up, reference controller.go:559-573): events dropped while
+        read-only or unmanaged are replayed as SYNC."""
+        if self.kind == "Node":
+            opt = WatchOptions(
+                field_selector={"metadata.name": node_name}, predicate=self._predicate
+            )
+        else:
+            opt = WatchOptions(
+                field_selector={"spec.nodeName": node_name}, predicate=self._predicate
+            )
+        self._informer.sync(opt, self.events)
+
+    def _tick_loop(self) -> None:
+        next_tick = self.clock.now()
+        while not self._done.is_set():
+            try:
+                self._drain_events()
+                self.step()
+            except Exception:  # noqa: BLE001 — one bad batch must not
+                # kill the simulation for this kind
+                import traceback
+
+                traceback.print_exc()
+            next_tick += self.tick_ms / 1000.0
+            sleep = next_tick - self.clock.now()
+            if sleep > 0:
+                time.sleep(min(sleep, self.tick_ms / 1000.0))
+            else:
+                next_tick = self.clock.now()  # fell behind; don't spiral
+
+    def step(self, dt_ms: Optional[int] = None) -> List[Transition]:
+        """One device tick + host drain of dirty rows."""
+        transitions = self.sim.step(
+            dt_ms if dt_ms is not None else self.tick_ms, materialize=False
+        )
+        for tr in transitions:
+            try:
+                self._play_transition(tr)
+            except Exception:  # noqa: BLE001 — one bad row must not stop the drain
+                import traceback
+
+                traceback.print_exc()
+        return transitions
+
+    # ----------------------------------------------------------- store effects
+
+    def _play_transition(self, tr: Transition) -> None:
+        """Route one fired row's effects to the store (same semantics as
+        StagePlayer.play_stage), then refresh the row from the store's
+        result so device features stay parity-exact."""
+        with self._mut:
+            obj = self.sim.objects[tr.row]
+        if obj is None:
+            return
+        meta = obj.get("metadata") or {}
+        name = meta.get("name") or ""
+        ns = meta.get("namespace")
+        key = self._key(obj)
+        cs = self.sim.cset.compiled[tr.stage_idx]
+        effects = self.sim.cset.lifecycle.effects(cs)
+        if effects is None:
+            return
+
+        if tr.event is not None and self.recorder is not None:
+            self.recorder.event(
+                obj, tr.event.type or "Normal", tr.event.reason, tr.event.message
+            )
+
+        result: Optional[dict] = None
+        fin = effects.finalizers_patch(meta.get("finalizers") or [])
+        if fin is not None:
+            try:
+                result = self.store.patch(self.kind, name, fin.data, fin.type, namespace=ns)
+            except NotFound:
+                self._release(key)
+                return
+
+        if effects.delete:
+            try:
+                out = self.store.delete(self.kind, name, namespace=ns)
+            except NotFound:
+                out = None
+            if out is None:
+                self._release(key)
+            else:
+                self._refresh(key, out)  # terminating (finalizers pending)
+            self.transitions += 1
+            return
+
+        funcs = dict(self.funcs_for(obj))
+        funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
+        base = result if result is not None else obj
+        for patch in effects.patches(base, funcs):
+            if is_noop_patch(base, patch.data, patch.type):
+                continue
+            try:
+                result = self.store.patch(
+                    self.kind,
+                    name,
+                    patch.data,
+                    patch.type,
+                    namespace=ns,
+                    subresource=patch.subresource,
+                    as_user=patch.impersonation,
+                )
+                base = result
+                self.patches += 1
+            except NotFound:
+                self._release(key)
+                return
+        self.transitions += 1
+        if result is not None:
+            self._refresh(key, result)
+
+    def _release(self, key: Tuple[str, str]) -> None:
+        with self._mut:
+            row = self._rows.pop(key, None)
+            if row is not None:
+                self.sim.release(row)
+                self._written_rv.pop(row, None)
+
+    def _refresh(self, key: Tuple[str, str], obj: dict) -> None:
+        with self._mut:
+            row = self._rows.get(key)
+            if row is None:
+                return
+            # store reaped it (deletionTimestamp + no finalizers)?
+            mm = obj.get("metadata") or {}
+            self._written_rv[row] = mm.get("resourceVersion")
+            self.sim.objects[row] = obj
+            self.sim.refresh_row(row)
+
+
+def _epoch_from(t: float):
+    import datetime
+
+    return datetime.datetime.fromtimestamp(t, datetime.timezone.utc)

@@ -19,6 +19,16 @@ from kwok_tpu.controllers.base import StagePlayer
 from kwok_tpu.engine.lifecycle import Lifecycle
 
 
+def node_funcs(node_ip: str, node_name: str, node_port: int) -> Dict[str, Callable]:
+    """Node template env funcs, shared by host and device backends
+    (reference node_controller.go:521-531)."""
+    return {
+        "NodeIP": lambda: node_ip,
+        "NodeName": lambda: node_name,
+        "NodePort": lambda: node_port,
+    }
+
+
 class NodeController(StagePlayer):
     def __init__(
         self,
@@ -39,12 +49,7 @@ class NodeController(StagePlayer):
         self.cache = None
 
     def _funcs(self, obj: dict) -> Dict[str, Callable]:
-        # template env funcs (reference node_controller.go:521-531)
-        return {
-            "NodeIP": lambda: self.node_ip,
-            "NodeName": lambda: self.node_name,
-            "NodePort": lambda: self.node_port,
-        }
+        return node_funcs(self.node_ip, self.node_name, self.node_port)
 
     def start(self) -> None:
         self.cache = self._informer.watch_with_cache(

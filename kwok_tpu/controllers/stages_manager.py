@@ -25,10 +25,15 @@ class StagesManager:
         store: ResourceStore,
         on_ref_added: Callable[[str], None],
         on_ref_removed: Optional[Callable[[str], None]] = None,
+        on_ref_updated: Optional[Callable[[str], None]] = None,
     ):
         self._store = store
         self._on_ref_added = on_ref_added
         self._on_ref_removed = on_ref_removed
+        #: fired when an existing kind's stage set changes — lets AOT
+        #: (device) backends recompile; host backends see the change
+        #: through the live lifecycle getter already
+        self._on_ref_updated = on_ref_updated
         self._mut = threading.Lock()
         #: kind -> {stage name -> Stage}
         self._by_ref: Dict[str, Dict[str, Stage]] = {}
@@ -89,5 +94,7 @@ class StagesManager:
                 empty = not group
             if fresh_ref:
                 self._on_ref_added(kind)
+            elif not empty and self._on_ref_updated is not None:
+                self._on_ref_updated(kind)
             if empty and self._on_ref_removed is not None:
                 self._on_ref_removed(kind)

@@ -24,7 +24,7 @@ class IPPool:
         # allocate from the CIDR's host address + 1: skips the network
         # address and the conventional node IP (e.g. 10.0.0.1/24 -> pods
         # start at 10.0.0.2, never colliding with hostIP)
-        self._base = iface.ip if iface.ip != self._net.network_address else self._net.network_address
+        self._base = iface.ip
         self._mut = threading.Lock()
         self._used: Set[str] = set()
         self._usable: Set[str] = set()
@@ -50,12 +50,11 @@ class IPPool:
             return ip
 
     def put(self, ip: str) -> None:
+        """Recycle an IP allocated from THIS pool. Callers record the
+        owning pool at allocation time (PodEnv), so no membership check
+        — an over-capacity allocation past the CIDR end (the pool never
+        deadlocks) is recycled like any other."""
         with self._mut:
-            try:
-                if ipaddress.ip_address(ip) not in self._net:
-                    return
-            except ValueError:
-                return
             self._used.discard(ip)
             self._usable.add(ip)
 

@@ -111,7 +111,8 @@ class DeviceSimulator:
         self.del_ts = np.full(capacity, SENTINEL, np.int32)
 
         self.objects: List[Optional[dict]] = [None] * capacity
-        self.num_rows = 0
+        self.num_rows = 0  # high-water mark
+        self._free: List[int] = []  # released rows available for reuse
         self._seed = seed
         self._admit_cache: Dict[str, Tuple[int, int, np.ndarray]] = {}
         # The admit fast path caches (sig, ovc, features) by content hash.
@@ -146,12 +147,16 @@ class DeviceSimulator:
     # ------------------------------------------------------------------ host ops
 
     def admit(self, obj: dict) -> int:
-        """Add an object; returns its row index."""
-        if self.num_rows >= self.capacity:
-            raise ValueError("simulator capacity exhausted")
+        """Add an object; returns its row index. Reuses released rows;
+        grows the SoA (2x, device re-upload) when full."""
         obj = to_json_standard(obj)
-        row = self.num_rows
-        self.num_rows += 1
+        if self._free:
+            row = self._free.pop()
+        else:
+            if self.num_rows >= self.capacity:
+                self.ensure_capacity(self.num_rows + 1)
+            row = self.num_rows
+            self.num_rows += 1
 
         cache_key = None
         if self._cacheable:
@@ -207,6 +212,43 @@ class DeviceSimulator:
             self._dev_now = self._soa.now
             self._dev_key = self._soa.key
             self._soa = None
+
+    def release(self, row: int) -> None:
+        """Retire a row (object gone from the cluster); the row is
+        recycled by the next admit."""
+        if self.objects[row] is None and not self.active[row]:
+            return
+        self._invalidate_device()
+        self.objects[row] = None
+        self.active[row] = False
+        self.stage[row] = IDLE
+        self.fire_at[row] = NEVER
+        self.rematch[row] = False
+        self.del_ts[row] = SENTINEL
+        self._free.append(row)
+
+    def ensure_capacity(self, n: int) -> None:
+        """Grow the SoA to hold at least n rows (amortized doubling)."""
+        if n <= self.capacity:
+            return
+        new_cap = max(self.capacity * 2, n, 64)
+        self._invalidate_device()
+        grow = new_cap - self.capacity
+
+        def pad(arr, fill):
+            ext = np.full((grow,) + arr.shape[1:], fill, arr.dtype)
+            return np.concatenate([arr, ext], axis=0)
+
+        self.features = pad(self.features, 0)
+        self.sig = pad(self.sig, 0)
+        self.ovc = pad(self.ovc, 0)
+        self.stage = pad(self.stage, IDLE)
+        self.fire_at = pad(self.fire_at, NEVER)
+        self.active = pad(self.active, False)
+        self.rematch = pad(self.rematch, False)
+        self.del_ts = pad(self.del_ts, SENTINEL)
+        self.objects.extend([None] * grow)
+        self.capacity = new_cap
 
     def request_delete(self, row: int, at_ms: int) -> None:
         """External delete request: set deletionTimestamp and re-evaluate

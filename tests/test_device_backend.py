@@ -1,0 +1,257 @@
+"""Device-backend controller tests: the vectorized tick kernel drives
+the same store-facing semantics as the host backend (SURVEY.md §7.3-4:
+e2e success = status parity vs the CPU backend)."""
+
+import time
+
+import pytest
+
+from kwok_tpu.api.config import KwokConfiguration
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.controllers import Controller
+from kwok_tpu.stages import default_node_stages, default_pod_stages, load_builtin
+
+from tests.test_controllers import make_node, make_pod, wait_for
+
+
+@pytest.fixture
+def device_cluster():
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            device_tick_ms=20,
+            node_lease_duration_seconds=40,
+        ),
+        local_stages={
+            "Node": default_node_stages(lease=True),
+            "Pod": default_pod_stages(),
+        },
+        seed=0,
+    )
+    ctr.start()
+    yield store, ctr
+    ctr.stop()
+
+
+def test_device_backend_selected(device_cluster):
+    store, ctr = device_cluster
+    assert "Pod" in ctr.device_players, "pod stages should lower to the device"
+    assert "Node" in ctr.device_players, "node stages should lower to the device"
+    assert ctr.pods is None and ctr.nodes is None
+
+
+def test_device_node_initialize(device_cluster):
+    store, ctr = device_cluster
+    store.create(make_node("node-0"))
+    assert wait_for(
+        lambda: any(
+            c.get("type") == "Ready" and c.get("status") == "True"
+            for c in (store.get("Node", "node-0").get("status") or {}).get("conditions", [])
+        ),
+        timeout=15.0,
+    ), "node never became Ready on device backend"
+    assert store.get("Node", "node-0")["status"]["phase"] == "Running"
+
+
+def test_device_pod_lifecycle_parity(device_cluster):
+    store, ctr = device_cluster
+    store.create(make_node("node-0"))
+    assert wait_for(lambda: ctr.manages("node-0"))
+    for i in range(10):
+        store.create(make_pod(f"p{i}"))
+    assert wait_for(
+        lambda: all(
+            (store.get("Pod", f"p{i}").get("status") or {}).get("phase") == "Running"
+            for i in range(10)
+        ),
+        timeout=15.0,
+    ), "pods never Running on device backend"
+    # status parity with the host backend's contract
+    pod = store.get("Pod", "p0")
+    assert pod["status"]["podIP"]
+    assert pod["status"]["hostIP"]
+    assert any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in pod["status"].get("conditions", [])
+    )
+    # pod IPs unique
+    ips = {store.get("Pod", f"p{i}")["status"]["podIP"] for i in range(10)}
+    assert len(ips) == 10
+    # graceful delete -> reaped by the pod-delete stage
+    store.delete("Pod", "p0")
+    assert wait_for(lambda: store.count("Pod") == 9, timeout=15.0), "pod never reaped"
+
+
+def test_device_row_recycling(device_cluster):
+    """Rows released by deletes are reused by later admits."""
+    store, ctr = device_cluster
+    store.create(make_node("node-0"))
+    assert wait_for(lambda: ctr.manages("node-0"))
+    for i in range(5):
+        store.create(make_pod(f"a{i}"))
+    assert wait_for(
+        lambda: all(
+            (store.get("Pod", f"a{i}").get("status") or {}).get("phase") == "Running"
+            for i in range(5)
+        ),
+        timeout=15.0,
+    )
+    for i in range(5):
+        store.delete("Pod", f"a{i}")
+    assert wait_for(lambda: store.count("Pod") == 0, timeout=15.0)
+    player = ctr.device_players["Pod"]
+    assert wait_for(lambda: len(player.sim._free) > 0, timeout=5.0)
+    hw = player.sim.num_rows
+    for i in range(5):
+        store.create(make_pod(f"b{i}"))
+    assert wait_for(
+        lambda: all(
+            (store.get("Pod", f"b{i}").get("status") or {}).get("phase") == "Running"
+            for i in range(5)
+        ),
+        timeout=15.0,
+    )
+    assert player.sim.num_rows <= hw + 1, "released rows were not recycled"
+
+
+def test_device_chaos_stages_compile():
+    """The chaos stage set (weighted failure paths) lowers to the device
+    and produces CrashLoopBackOff-style churn."""
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            device_tick_ms=20,
+            node_lease_duration_seconds=0,
+        ),
+        local_stages={
+            "Node": default_node_stages(),
+            "Pod": load_builtin("pod-general") + load_builtin("pod-chaos"),
+        },
+        seed=3,
+    )
+    ctr.start()
+    try:
+        assert "Pod" in ctr.device_players
+        store.create(make_node("node-0"))
+        assert wait_for(lambda: ctr.manages("node-0"))
+        pod = make_pod("crashy")
+        pod["metadata"]["labels"] = {
+            "pod-container-running-failed.stage.kwok.x-k8s.io": "true"
+        }
+        store.create(pod)
+        assert wait_for(
+            lambda: (store.get("Pod", "crashy").get("status") or {}).get("phase")
+            is not None,
+            timeout=15.0,
+        )
+    finally:
+        ctr.stop()
+
+
+def test_device_pod_on_node_managed_later_catches_up(device_cluster):
+    """Pods created before their node is managed are replayed to the
+    device player on lease acquisition (device analog of sync_node)."""
+    store, ctr = device_cluster
+    store.create(make_pod("early", node="node-9"))
+    time.sleep(0.3)
+    store.create(make_node("node-9"))
+    assert wait_for(
+        lambda: (store.get("Pod", "early").get("status") or {}).get("phase") == "Running",
+        timeout=15.0,
+    )
+
+
+def test_device_cr_mode_recompiles_on_new_stages():
+    """Stage CRs arriving after the first recompile the device player
+    (AOT sets are immutable; the facade rebuilds on update)."""
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            device_tick_ms=20,
+            node_lease_duration_seconds=0,
+        ),
+        local_stages=None,
+        seed=0,
+    )
+    ctr.start()
+    try:
+        all_stages = default_pod_stages()
+        # deliver only pod-ready first
+        store.create(next(s for s in all_stages if s.name == "pod-ready").to_dict())
+        for s in default_node_stages():
+            store.create(s.to_dict())
+        store.create(make_node("node-0"))
+        assert wait_for(lambda: ctr.manages("node-0"))
+        store.create(make_pod("p0"))
+        assert wait_for(
+            lambda: (store.get("Pod", "p0").get("status") or {}).get("phase") == "Running",
+            timeout=15.0,
+        )
+        # now deliver pod-delete; a graceful delete must be honored
+        for s in all_stages:
+            if s.name != "pod-ready":
+                store.create(s.to_dict())
+        store.delete("Pod", "p0")
+        assert wait_for(lambda: store.count("Pod") == 0, timeout=15.0), (
+            "recompiled device player never reaped the pod"
+        )
+    finally:
+        ctr.stop()
+
+
+def test_host_fallback_for_unlowerable_stages():
+    """A stage set using arbitrary templates the AOT compiler cannot
+    lower falls back to the host backend transparently."""
+    from kwok_tpu.api.loader import load_stages
+
+    stages = load_stages(
+        """
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: odd-stage
+spec:
+  resourceRef:
+    apiGroup: v1
+    kind: Pod
+  selector:
+    matchExpressions:
+      - key: .status.phase
+        operator: DoesNotExist
+  next:
+    statusTemplate: |
+      phase: {{ if .metadata.labels.special }}Special{{ else }}Running{{ end }}
+      oddField: {{ .metadata.name }}-{{ .spec.nodeName }}
+"""
+    )
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            node_lease_duration_seconds=0,
+        ),
+        local_stages={"Node": default_node_stages(), "Pod": stages},
+        seed=0,
+    )
+    ctr.start()
+    try:
+        store.create(make_node("node-0"))
+        assert wait_for(lambda: ctr.manages("node-0"))
+        store.create(make_pod("p0"))
+        assert wait_for(
+            lambda: (store.get("Pod", "p0").get("status") or {}).get("phase") == "Running"
+        )
+        assert store.get("Pod", "p0")["status"]["oddField"] == "p0-node-0"
+    finally:
+        ctr.stop()
