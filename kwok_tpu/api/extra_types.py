@@ -1,0 +1,879 @@
+"""Non-Stage CRD API types: Metric, ResourceUsage, debug-endpoint configs,
+and the ResourcePatch recording action.
+
+Dataclass mirrors of the reference API surface:
+- Metric            — pkg/apis/v1alpha1/metric_types.go:61-151
+- ResourceUsage     — pkg/apis/v1alpha1/resource_usage_types.go:60-79
+- ClusterResourceUsage — pkg/apis/v1alpha1/cluster_resource_usage_types.go
+- Logs/ClusterLogs  — pkg/apis/v1alpha1/logs_types.go:50-72
+- Attach/ClusterAttach — pkg/apis/v1alpha1/attach_types.go:49-67
+- Exec/ClusterExec  — pkg/apis/v1alpha1/exec_types.go:46-101
+- PortForward/ClusterPortForward — pkg/apis/v1alpha1/port_forward_types.go:44-87
+- ObjectSelector    — pkg/apis/v1alpha1/object_selector.go:20-27
+- ResourcePatch     — pkg/apis/action/v1alpha1/resource_patch_types.go:35-77
+
+All types round-trip via ``from_dict``/``to_dict`` and are registered with
+the multi-doc config loader by kind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+API_VERSION = "kwok.x-k8s.io/v1alpha1"
+ACTION_API_VERSION = "action.kwok.x-k8s.io/v1alpha1"
+
+
+def _meta_from(d: Dict[str, Any]) -> Dict[str, Any]:
+    return dict(d.get("metadata") or {})
+
+
+# ---------------------------------------------------------------------------
+# ObjectSelector — shared by every Cluster* config kind
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ObjectSelector:
+    """Namespace/name filter for Cluster-scoped debug configs."""
+
+    match_namespaces: List[str] = field(default_factory=list)
+    match_names: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ObjectSelector":
+        d = d or {}
+        return cls(
+            match_namespaces=list(d.get("matchNamespaces") or []),
+            match_names=list(d.get("matchNames") or []),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.match_namespaces:
+            out["matchNamespaces"] = list(self.match_namespaces)
+        if self.match_names:
+            out["matchNames"] = list(self.match_names)
+        return out
+
+    def matches(self, namespace: str, name: str) -> bool:
+        if self.match_namespaces and namespace not in self.match_namespaces:
+            return False
+        if self.match_names and name not in self.match_names:
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Metric
+# ---------------------------------------------------------------------------
+
+KIND_COUNTER = "counter"
+KIND_GAUGE = "gauge"
+KIND_HISTOGRAM = "histogram"
+
+DIMENSION_NODE = "node"
+DIMENSION_POD = "pod"
+DIMENSION_CONTAINER = "container"
+
+
+@dataclass
+class MetricLabel:
+    name: str
+    value: str  # CEL expression
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricLabel":
+        return cls(name=d["name"], value=d["value"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "value": self.value}
+
+
+@dataclass
+class MetricBucket:
+    le: float
+    value: str  # CEL expression
+    hidden: bool = False
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricBucket":
+        return cls(le=float(d["le"]), value=d["value"], hidden=bool(d.get("hidden", False)))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"le": self.le, "value": self.value}
+        if self.hidden:
+            out["hidden"] = True
+        return out
+
+
+@dataclass
+class MetricConfig:
+    name: str
+    kind: str  # counter | gauge | histogram
+    help: str = ""
+    labels: List[MetricLabel] = field(default_factory=list)
+    value: str = ""  # CEL expression (counter/gauge)
+    buckets: List[MetricBucket] = field(default_factory=list)
+    dimension: str = DIMENSION_NODE
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MetricConfig":
+        if d.get("kind") not in (KIND_COUNTER, KIND_GAUGE, KIND_HISTOGRAM):
+            raise ValueError(f"metric {d.get('name')!r}: invalid kind {d.get('kind')!r}")
+        return cls(
+            name=d["name"],
+            kind=d["kind"],
+            help=(d.get("help") or "").strip(),
+            labels=[MetricLabel.from_dict(x) for x in d.get("labels") or []],
+            value=d.get("value") or "",
+            buckets=[MetricBucket.from_dict(x) for x in d.get("buckets") or []],
+            dimension=d.get("dimension") or DIMENSION_NODE,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name, "kind": self.kind}
+        if self.help:
+            out["help"] = self.help
+        if self.labels:
+            out["labels"] = [x.to_dict() for x in self.labels]
+        if self.value:
+            out["value"] = self.value
+        if self.buckets:
+            out["buckets"] = [x.to_dict() for x in self.buckets]
+        if self.dimension != DIMENSION_NODE:
+            out["dimension"] = self.dimension
+        return out
+
+
+@dataclass
+class Metric:
+    """A synthetic Prometheus endpoint spec; ``path`` may contain
+    ``{nodeName}`` which fans the route out per simulated node."""
+
+    name: str
+    path: str
+    metrics: List[MetricConfig] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "Metric"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Metric":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        if not spec.get("path"):
+            raise ValueError("Metric spec.path is required")
+        return cls(
+            name=meta.get("name", ""),
+            path=spec["path"],
+            metrics=[MetricConfig.from_dict(x) for x in spec.get("metrics") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": {
+                "path": self.path,
+                "metrics": [m.to_dict() for m in self.metrics],
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# ResourceUsage / ClusterResourceUsage
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceUsageValue:
+    """Either a fixed quantity string or a CEL expression."""
+
+    value: Optional[str] = None
+    expression: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceUsageValue":
+        return cls(value=d.get("value"), expression=d.get("expression"))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.value is not None:
+            out["value"] = self.value
+        if self.expression is not None:
+            out["expression"] = self.expression
+        return out
+
+
+@dataclass
+class ResourceUsageContainer:
+    containers: List[str] = field(default_factory=list)
+    usage: Dict[str, ResourceUsageValue] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceUsageContainer":
+        return cls(
+            containers=list(d.get("containers") or []),
+            usage={
+                k: ResourceUsageValue.from_dict(v) for k, v in (d.get("usage") or {}).items()
+            },
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.containers:
+            out["containers"] = list(self.containers)
+        if self.usage:
+            out["usage"] = {k: v.to_dict() for k, v in self.usage.items()}
+        return out
+
+
+@dataclass
+class ResourceUsage:
+    """Per-pod container resource usage (name/namespace address one pod)."""
+
+    name: str
+    namespace: str
+    usages: List[ResourceUsageContainer] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ResourceUsage"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourceUsage":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            usages=[ResourceUsageContainer.from_dict(x) for x in spec.get("usages") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata)
+            or {"name": self.name, "namespace": self.namespace},
+            "spec": {"usages": [u.to_dict() for u in self.usages]},
+        }
+
+
+@dataclass
+class ClusterResourceUsage:
+    """Cluster-wide usage config, filtered by ObjectSelector."""
+
+    name: str
+    selector: ObjectSelector = field(default_factory=ObjectSelector)
+    usages: List[ResourceUsageContainer] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ClusterResourceUsage"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterResourceUsage":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            selector=ObjectSelector.from_dict(spec.get("selector")),
+            usages=[ResourceUsageContainer.from_dict(x) for x in spec.get("usages") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"usages": [u.to_dict() for u in self.usages]}
+        sel = self.selector.to_dict()
+        if sel:
+            spec["selector"] = sel
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": spec,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Debug endpoint configs: Logs / Attach / Exec / PortForward
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Log:
+    containers: List[str] = field(default_factory=list)
+    logs_file: Optional[str] = None
+    follow: bool = False
+    previous_logs_file: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Log":
+        return cls(
+            containers=list(d.get("containers") or []),
+            logs_file=d.get("logsFile"),
+            follow=bool(d.get("follow") or False),
+            previous_logs_file=d.get("previousLogsFile"),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.containers:
+            out["containers"] = list(self.containers)
+        if self.logs_file is not None:
+            out["logsFile"] = self.logs_file
+        if self.follow:
+            out["follow"] = True
+        if self.previous_logs_file is not None:
+            out["previousLogsFile"] = self.previous_logs_file
+        return out
+
+
+def _match_container(entries: List[Any], container: str) -> Optional[Any]:
+    """First entry whose container list is empty or contains the name —
+    the reference's lookup rule (pkg/kwok/server/debugging_logs.go et al.)."""
+    for e in entries:
+        if not e.containers or container in e.containers:
+            return e
+    return None
+
+
+@dataclass
+class Logs:
+    name: str
+    namespace: str
+    logs: List[Log] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "Logs"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Logs":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            logs=[Log.from_dict(x) for x in spec.get("logs") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata)
+            or {"name": self.name, "namespace": self.namespace},
+            "spec": {"logs": [x.to_dict() for x in self.logs]},
+        }
+
+    def find(self, container: str) -> Optional[Log]:
+        return _match_container(self.logs, container)
+
+
+@dataclass
+class ClusterLogs:
+    name: str
+    selector: ObjectSelector = field(default_factory=ObjectSelector)
+    logs: List[Log] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ClusterLogs"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterLogs":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            selector=ObjectSelector.from_dict(spec.get("selector")),
+            logs=[Log.from_dict(x) for x in spec.get("logs") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"logs": [x.to_dict() for x in self.logs]}
+        sel = self.selector.to_dict()
+        if sel:
+            spec["selector"] = sel
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": spec,
+        }
+
+    def find(self, container: str) -> Optional[Log]:
+        return _match_container(self.logs, container)
+
+
+@dataclass
+class AttachConfig:
+    containers: List[str] = field(default_factory=list)
+    logs_file: Optional[str] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "AttachConfig":
+        return cls(
+            containers=list(d.get("containers") or []),
+            logs_file=d.get("logsFile"),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.containers:
+            out["containers"] = list(self.containers)
+        if self.logs_file is not None:
+            out["logsFile"] = self.logs_file
+        return out
+
+
+@dataclass
+class Attach:
+    name: str
+    namespace: str
+    attaches: List[AttachConfig] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "Attach"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Attach":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            attaches=[AttachConfig.from_dict(x) for x in spec.get("attaches") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata)
+            or {"name": self.name, "namespace": self.namespace},
+            "spec": {"attaches": [x.to_dict() for x in self.attaches]},
+        }
+
+    def find(self, container: str) -> Optional[AttachConfig]:
+        return _match_container(self.attaches, container)
+
+
+@dataclass
+class ClusterAttach:
+    name: str
+    selector: ObjectSelector = field(default_factory=ObjectSelector)
+    attaches: List[AttachConfig] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ClusterAttach"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterAttach":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            selector=ObjectSelector.from_dict(spec.get("selector")),
+            attaches=[AttachConfig.from_dict(x) for x in spec.get("attaches") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"attaches": [x.to_dict() for x in self.attaches]}
+        sel = self.selector.to_dict()
+        if sel:
+            spec["selector"] = sel
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": spec,
+        }
+
+    def find(self, container: str) -> Optional[AttachConfig]:
+        return _match_container(self.attaches, container)
+
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EnvVar":
+        return cls(name=d["name"], value=d.get("value", ""))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"name": self.name}
+        if self.value:
+            out["value"] = self.value
+        return out
+
+
+@dataclass
+class SecurityContext:
+    run_as_user: Optional[int] = None
+    run_as_group: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["SecurityContext"]:
+        if not d:
+            return None
+        return cls(run_as_user=d.get("runAsUser"), run_as_group=d.get("runAsGroup"))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.run_as_user is not None:
+            out["runAsUser"] = self.run_as_user
+        if self.run_as_group is not None:
+            out["runAsGroup"] = self.run_as_group
+        return out
+
+
+@dataclass
+class ExecTargetLocal:
+    work_dir: str = ""
+    envs: List[EnvVar] = field(default_factory=list)
+    security_context: Optional[SecurityContext] = None
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["ExecTargetLocal"]:
+        if d is None:
+            return None
+        return cls(
+            work_dir=d.get("workDir", ""),
+            envs=[EnvVar.from_dict(x) for x in d.get("envs") or []],
+            security_context=SecurityContext.from_dict(d.get("securityContext")),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.work_dir:
+            out["workDir"] = self.work_dir
+        if self.envs:
+            out["envs"] = [x.to_dict() for x in self.envs]
+        if self.security_context is not None:
+            out["securityContext"] = self.security_context.to_dict()
+        return out
+
+
+@dataclass
+class ExecTarget:
+    containers: List[str] = field(default_factory=list)
+    local: Optional[ExecTargetLocal] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ExecTarget":
+        return cls(
+            containers=list(d.get("containers") or []),
+            local=ExecTargetLocal.from_dict(d.get("local")),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.containers:
+            out["containers"] = list(self.containers)
+        if self.local is not None:
+            out["local"] = self.local.to_dict()
+        return out
+
+
+@dataclass
+class Exec:
+    name: str
+    namespace: str
+    execs: List[ExecTarget] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "Exec"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Exec":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            execs=[ExecTarget.from_dict(x) for x in spec.get("execs") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata)
+            or {"name": self.name, "namespace": self.namespace},
+            "spec": {"execs": [x.to_dict() for x in self.execs]},
+        }
+
+    def find(self, container: str) -> Optional[ExecTarget]:
+        return _match_container(self.execs, container)
+
+
+@dataclass
+class ClusterExec:
+    name: str
+    selector: ObjectSelector = field(default_factory=ObjectSelector)
+    execs: List[ExecTarget] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ClusterExec"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterExec":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            selector=ObjectSelector.from_dict(spec.get("selector")),
+            execs=[ExecTarget.from_dict(x) for x in spec.get("execs") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"execs": [x.to_dict() for x in self.execs]}
+        sel = self.selector.to_dict()
+        if sel:
+            spec["selector"] = sel
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": spec,
+        }
+
+    def find(self, container: str) -> Optional[ExecTarget]:
+        return _match_container(self.execs, container)
+
+
+@dataclass
+class ForwardTarget:
+    port: int
+    address: str
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["ForwardTarget"]:
+        if d is None:
+            return None
+        return cls(port=int(d["port"]), address=d["address"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"port": self.port, "address": self.address}
+
+
+@dataclass
+class Forward:
+    ports: List[int] = field(default_factory=list)
+    target: Optional[ForwardTarget] = None
+    command: List[str] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Forward":
+        return cls(
+            ports=[int(p) for p in d.get("ports") or []],
+            target=ForwardTarget.from_dict(d.get("target")),
+            command=list(d.get("command") or []),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.ports:
+            out["ports"] = list(self.ports)
+        if self.target is not None:
+            out["target"] = self.target.to_dict()
+        if self.command:
+            out["command"] = list(self.command)
+        return out
+
+
+def _match_port(forwards: List[Forward], port: int) -> Optional[Forward]:
+    for f in forwards:
+        if not f.ports or port in f.ports:
+            return f
+    return None
+
+
+@dataclass
+class PortForward:
+    name: str
+    namespace: str
+    forwards: List[Forward] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "PortForward"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "PortForward":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            forwards=[Forward.from_dict(x) for x in spec.get("forwards") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata)
+            or {"name": self.name, "namespace": self.namespace},
+            "spec": {"forwards": [x.to_dict() for x in self.forwards]},
+        }
+
+    def find(self, port: int) -> Optional[Forward]:
+        return _match_port(self.forwards, port)
+
+
+@dataclass
+class ClusterPortForward:
+    name: str
+    selector: ObjectSelector = field(default_factory=ObjectSelector)
+    forwards: List[Forward] = field(default_factory=list)
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    KIND = "ClusterPortForward"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterPortForward":
+        meta = _meta_from(d)
+        spec = d.get("spec") or {}
+        return cls(
+            name=meta.get("name", ""),
+            selector=ObjectSelector.from_dict(spec.get("selector")),
+            forwards=[Forward.from_dict(x) for x in spec.get("forwards") or []],
+            metadata=meta,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        spec: Dict[str, Any] = {"forwards": [x.to_dict() for x in self.forwards]}
+        sel = self.selector.to_dict()
+        if sel:
+            spec["selector"] = sel
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.KIND,
+            "metadata": dict(self.metadata) or {"name": self.name},
+            "spec": spec,
+        }
+
+    def find(self, port: int) -> Optional[Forward]:
+        return _match_port(self.forwards, port)
+
+
+# ---------------------------------------------------------------------------
+# ResourcePatch — record/replay action format
+# ---------------------------------------------------------------------------
+
+PATCH_METHOD_CREATE = "create"
+PATCH_METHOD_PATCH = "patch"
+PATCH_METHOD_DELETE = "delete"
+
+
+@dataclass
+class GroupVersionResource:
+    version: str
+    resource: str
+    group: str = ""
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GroupVersionResource":
+        return cls(
+            version=d.get("version", "v1"),
+            resource=d["resource"],
+            group=d.get("group", ""),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"version": self.version, "resource": self.resource}
+        if self.group:
+            out["group"] = self.group
+        return out
+
+
+@dataclass
+class ResourcePatch:
+    """One recorded mutation: ``durationNanosecond`` is the offset from the
+    start of the recording; ``template`` is the full object (create) or the
+    patch body (patch)."""
+
+    resource: GroupVersionResource
+    name: str
+    namespace: str
+    duration_ns: int
+    method: str  # create | patch | delete
+    template: Optional[Any] = None
+
+    KIND = "ResourcePatch"
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourcePatch":
+        target = d.get("target") or {}
+        if d.get("method") not in (
+            PATCH_METHOD_CREATE,
+            PATCH_METHOD_PATCH,
+            PATCH_METHOD_DELETE,
+        ):
+            raise ValueError(f"invalid ResourcePatch method: {d.get('method')!r}")
+        return cls(
+            resource=GroupVersionResource.from_dict(d.get("resource") or {}),
+            name=target.get("name", ""),
+            namespace=target.get("namespace", ""),
+            duration_ns=int(d.get("durationNanosecond") or 0),
+            method=d["method"],
+            template=d.get("template"),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        target: Dict[str, Any] = {"name": self.name}
+        if self.namespace:
+            target["namespace"] = self.namespace
+        out: Dict[str, Any] = {
+            "apiVersion": ACTION_API_VERSION,
+            "kind": self.KIND,
+            "resource": self.resource.to_dict(),
+            "target": target,
+            "durationNanosecond": self.duration_ns,
+            "method": self.method,
+        }
+        if self.template is not None:
+            out["template"] = self.template
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Registry for the multi-doc config loader
+# ---------------------------------------------------------------------------
+
+CONFIG_KINDS = {
+    Metric.KIND: Metric,
+    ResourceUsage.KIND: ResourceUsage,
+    ClusterResourceUsage.KIND: ClusterResourceUsage,
+    Logs.KIND: Logs,
+    ClusterLogs.KIND: ClusterLogs,
+    Attach.KIND: Attach,
+    ClusterAttach.KIND: ClusterAttach,
+    Exec.KIND: Exec,
+    ClusterExec.KIND: ClusterExec,
+    PortForward.KIND: PortForward,
+    ClusterPortForward.KIND: ClusterPortForward,
+    ResourcePatch.KIND: ResourcePatch,
+}
+
+
+def from_document(d: Dict[str, Any]) -> Any:
+    """Instantiate the typed config for one YAML document by ``kind``."""
+    kind = d.get("kind")
+    cls = CONFIG_KINDS.get(kind or "")
+    if cls is None:
+        raise ValueError(f"unknown config kind: {kind!r}")
+    return cls.from_dict(d)

@@ -1,0 +1,996 @@
+"""CEL expression engine for Metric / ResourceUsage evaluation.
+
+The reference evaluates Metric and ResourceUsage expressions with cel-go
+(``pkg/utils/cel/environment.go:39`` ``NewEnvironment``, program cache at
+``environment.go:98-114``, ``AsFloat64:117``), exposing vars ``node``/``pod``/
+``container``, funcs ``Now``/``Rand``/``SinceSecond``/``UnixSecond``/``Quantity``
+(``pkg/utils/cel/default.go:77-84``, ``funcs.go:27-45``) and a ``Quantity``
+wrapper with full arithmetic traits (``pkg/utils/cel/quantity.go``).
+
+This is a from-scratch implementation of the CEL subset those configs use
+(see ``charts/metrics-usage/templates/*.yaml``): literals, field selection,
+indexing, ``in``, function/method calls, unary ``!``/``-``, the full binary
+operator ladder, and the ternary conditional.  Programs compile to Python
+closures for the host path, and the AST is exposed (``Program.ast``) so the
+metrics layer can lower row-local arithmetic onto the device SoA instead of
+looping objects — the TPU-side equivalent of kwok's per-object cel-go calls.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import re
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CELError",
+    "Quantity",
+    "parse_quantity",
+    "Environment",
+    "EnvironmentConfig",
+    "Program",
+    "as_float64",
+    "as_string",
+    "parse",
+]
+
+
+class CELError(ValueError):
+    """Raised for lexing, parsing, or evaluation failures."""
+
+
+# ---------------------------------------------------------------------------
+# Quantity — k8s resource.Quantity semantics (suffix parse/format, arithmetic)
+# ---------------------------------------------------------------------------
+
+_BINARY_SUFFIXES = {
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+}
+_DECIMAL_SUFFIXES = {
+    "n": 10**-9,
+    "u": 10**-6,
+    "m": 10**-3,
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+}
+
+_QUANTITY_RE = re.compile(
+    r"^([+-]?[0-9]+(?:\.[0-9]*)?|[+-]?\.[0-9]+)"
+    r"(Ki|Mi|Gi|Ti|Pi|Ei|n|u|m|k|M|G|T|P|E|"
+    r"[eE][+-]?[0-9]+)?$"
+)
+
+
+def parse_quantity(s: str) -> float:
+    """Parse a k8s quantity string (``100m``, ``1Gi``, ``12e6``) to a float."""
+    s = s.strip()
+    m = _QUANTITY_RE.match(s)
+    if not m:
+        raise CELError(f"invalid quantity: {s!r}")
+    number, suffix = m.group(1), m.group(2) or ""
+    value = float(number)
+    if suffix in _BINARY_SUFFIXES:
+        return value * _BINARY_SUFFIXES[suffix]
+    if suffix in _DECIMAL_SUFFIXES:
+        return value * _DECIMAL_SUFFIXES[suffix]
+    # exponent form 12e6 / 3E2
+    return float(number + suffix)
+
+
+class Quantity:
+    """k8s ``resource.Quantity`` with nano-scaled integer arithmetic.
+
+    Mirrors the adder/comparer/divider/multiplier/negator/subtractor traits of
+    the reference's CEL wrapper (``pkg/utils/cel/quantity.go:30-38``): internal
+    representation is an int64 count of nano-units so ``100m + 100m == 200m``
+    exactly, with float conversion via :meth:`as_float` (``AsApproximateFloat64``).
+    """
+
+    __slots__ = ("nano", "_text")
+
+    def __init__(self, value: Any = 0, _text: Optional[str] = None):
+        if isinstance(value, Quantity):
+            self.nano = value.nano
+            self._text = value._text
+        elif isinstance(value, str):
+            self.nano = round(parse_quantity(value) * 10**9)
+            self._text = value.strip()
+        elif isinstance(value, bool):
+            raise CELError("cannot make a Quantity from bool")
+        elif isinstance(value, (int, float)):
+            self.nano = round(float(value) * 10**9)
+            self._text = _text
+        else:
+            raise CELError(f"cannot make a Quantity from {type(value).__name__}")
+
+    def as_float(self) -> float:
+        return self.nano / 10**9
+
+    def __repr__(self) -> str:
+        return f"Quantity({self.format()!r})"
+
+    def format(self) -> str:
+        """Canonical-ish formatting: keep original text when untouched."""
+        if self._text is not None:
+            return self._text
+        nano = self.nano
+        if nano == 0:
+            return "0"
+        if nano % 10**9 == 0:
+            return str(nano // 10**9)
+        if nano % 10**6 == 0:
+            return f"{nano // 10**6}m"
+        if nano % 10**3 == 0:
+            return f"{nano // 10**3}u"
+        return f"{nano}n"
+
+    # arithmetic traits ----------------------------------------------------
+    def _coerce(self, other: Any) -> "Quantity":
+        if isinstance(other, Quantity):
+            return other
+        if isinstance(other, (int, float)) and not isinstance(other, bool):
+            return Quantity(other)
+        raise CELError(f"no such overload: Quantity and {type(other).__name__}")
+
+    def __add__(self, other):
+        q = Quantity(0)
+        q.nano = self.nano + self._coerce(other).nano
+        return q
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        q = Quantity(0)
+        q.nano = self.nano - self._coerce(other).nano
+        return q
+
+    def __rsub__(self, other):
+        return self._coerce(other).__sub__(self)
+
+    def __mul__(self, other):
+        if isinstance(other, Quantity):
+            other = other.as_float()
+        elif not _is_number(other):
+            raise CELError(f"no such overload: Quantity * {type(other).__name__}")
+        q = Quantity(0)
+        q.nano = round(self.nano * float(other))
+        return q
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if isinstance(other, Quantity):
+            if other.nano == 0:
+                raise CELError("quantity division by zero")
+            return self.nano / other.nano  # ratio → double
+        if not _is_number(other):
+            raise CELError(f"no such overload: Quantity / {type(other).__name__}")
+        if float(other) == 0:
+            raise CELError("quantity division by zero")
+        q = Quantity(0)
+        q.nano = round(self.nano / float(other))
+        return q
+
+    def __neg__(self):
+        q = Quantity(0)
+        q.nano = -self.nano
+        return q
+
+    def __eq__(self, other):
+        # Only Quantity==Quantity at the Python level so hash stays consistent
+        # with eq; CEL's number-coercing `==` lives in Environment._equals.
+        if not isinstance(other, Quantity):
+            return NotImplemented
+        return self.nano == other.nano
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    def __lt__(self, other):
+        return self.nano < self._coerce(other).nano
+
+    def __le__(self, other):
+        return self.nano <= self._coerce(other).nano
+
+    def __gt__(self, other):
+        return self.nano > self._coerce(other).nano
+
+    def __ge__(self, other):
+        return self.nano >= self._coerce(other).nano
+
+    def __hash__(self):
+        return hash(self.nano)
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>//[^\n]*)
+  | (?P<float>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+)
+  | (?P<int>0x[0-9a-fA-F]+|\d+)
+  | (?P<string>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|==|!=|&&|\|\||[-+*/%!<>?:.,()\[\]{}])
+    """,
+    re.VERBOSE,
+)
+
+_ESCAPES = {
+    "n": "\n",
+    "t": "\t",
+    "r": "\r",
+    "\\": "\\",
+    '"': '"',
+    "'": "'",
+    "a": "\a",
+    "b": "\b",
+    "f": "\f",
+    "v": "\v",
+    "0": "\0",
+}
+
+
+@dataclass
+class _Tok:
+    kind: str
+    text: str
+    pos: int
+
+
+def _lex(src: str) -> List[_Tok]:
+    toks: List[_Tok] = []
+    pos = 0
+    n = len(src)
+    while pos < n:
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise CELError(f"unexpected character {src[pos]!r} at {pos}")
+        kind = m.lastgroup or ""
+        if kind not in ("ws", "comment"):
+            toks.append(_Tok(kind, m.group(), pos))
+        pos = m.end()
+    toks.append(_Tok("eof", "", n))
+    return toks
+
+
+def _unquote(text: str) -> str:
+    body = text[1:-1]
+    out: List[str] = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\" and i + 1 < len(body):
+            nxt = body[i + 1]
+            if nxt == "u" and i + 5 < len(body):
+                out.append(chr(int(body[i + 2 : i + 6], 16)))
+                i += 6
+                continue
+            out.append(_ESCAPES.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Lit:
+    value: Any
+
+
+@dataclass(frozen=True)
+class Ident:
+    name: str
+
+
+@dataclass(frozen=True)
+class Select:
+    operand: Any
+    field: str
+
+
+@dataclass(frozen=True)
+class Index:
+    operand: Any
+    index: Any
+
+
+@dataclass(frozen=True)
+class Call:
+    target: Optional[Any]  # None for global function
+    name: str
+    args: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class Unary:
+    op: str
+    operand: Any
+
+
+@dataclass(frozen=True)
+class Binary:
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Ternary:
+    cond: Any
+    then: Any
+    other: Any
+
+
+@dataclass(frozen=True)
+class ListLit:
+    items: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class MapLit:
+    entries: Tuple[Tuple[Any, Any], ...]
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> _Tok:
+        t = self.next()
+        if t.text != text:
+            raise CELError(f"expected {text!r}, got {t.text!r} at {t.pos}")
+        return t
+
+    # CEL precedence: ternary < || < && < relational < additive <
+    # multiplicative < unary < member/index/call < primary
+    def parse(self):
+        e = self.ternary()
+        t = self.peek()
+        if t.kind != "eof":
+            raise CELError(f"trailing input at {t.pos}: {t.text!r}")
+        return e
+
+    def ternary(self):
+        cond = self.logical_or()
+        if self.peek().text == "?":
+            self.next()
+            then = self.ternary()
+            self.expect(":")
+            other = self.ternary()
+            return Ternary(cond, then, other)
+        return cond
+
+    def logical_or(self):
+        e = self.logical_and()
+        while self.peek().text == "||":
+            self.next()
+            e = Binary("||", e, self.logical_and())
+        return e
+
+    def logical_and(self):
+        e = self.relation()
+        while self.peek().text == "&&":
+            self.next()
+            e = Binary("&&", e, self.relation())
+        return e
+
+    def relation(self):
+        e = self.additive()
+        while True:
+            t = self.peek()
+            if t.text in ("<", "<=", ">", ">=", "==", "!=") or (
+                t.kind == "ident" and t.text == "in"
+            ):
+                self.next()
+                e = Binary(t.text, e, self.additive())
+            else:
+                return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while self.peek().text in ("+", "-"):
+            op = self.next().text
+            e = Binary(op, e, self.multiplicative())
+        return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while self.peek().text in ("*", "/", "%"):
+            op = self.next().text
+            e = Binary(op, e, self.unary())
+        return e
+
+    def unary(self):
+        t = self.peek()
+        if t.text in ("!", "-"):
+            self.next()
+            return Unary(t.text, self.unary())
+        return self.member()
+
+    def member(self):
+        e = self.primary()
+        while True:
+            t = self.peek()
+            if t.text == ".":
+                self.next()
+                name = self.next()
+                if name.kind != "ident":
+                    raise CELError(f"expected field name at {name.pos}")
+                if self.peek().text == "(":
+                    e = Call(e, name.text, self.call_args())
+                else:
+                    e = Select(e, name.text)
+            elif t.text == "[":
+                self.next()
+                idx = self.ternary()
+                self.expect("]")
+                e = Index(e, idx)
+            else:
+                return e
+
+    def call_args(self) -> Tuple[Any, ...]:
+        self.expect("(")
+        args: List[Any] = []
+        if self.peek().text != ")":
+            args.append(self.ternary())
+            while self.peek().text == ",":
+                self.next()
+                args.append(self.ternary())
+        self.expect(")")
+        return tuple(args)
+
+    def primary(self):
+        t = self.next()
+        if t.kind == "int":
+            return Lit(int(t.text, 0))
+        if t.kind == "float":
+            return Lit(float(t.text))
+        if t.kind == "string":
+            return Lit(_unquote(t.text))
+        if t.kind == "ident":
+            if t.text == "true":
+                return Lit(True)
+            if t.text == "false":
+                return Lit(False)
+            if t.text == "null":
+                return Lit(None)
+            if self.peek().text == "(":
+                return Call(None, t.text, self.call_args())
+            return Ident(t.text)
+        if t.text == "(":
+            e = self.ternary()
+            self.expect(")")
+            return e
+        if t.text == "[":
+            items: List[Any] = []
+            if self.peek().text != "]":
+                items.append(self.ternary())
+                while self.peek().text == ",":
+                    self.next()
+                    items.append(self.ternary())
+            self.expect("]")
+            return ListLit(tuple(items))
+        if t.text == "{":
+            entries: List[Tuple[Any, Any]] = []
+            if self.peek().text != "}":
+                while True:
+                    k = self.ternary()
+                    self.expect(":")
+                    v = self.ternary()
+                    entries.append((k, v))
+                    if self.peek().text != ",":
+                        break
+                    self.next()
+            self.expect("}")
+            return MapLit(tuple(entries))
+        raise CELError(f"unexpected token {t.text!r} at {t.pos}")
+
+
+def parse(src: str):
+    """Parse a CEL expression into its AST."""
+    return _Parser(_lex(src)).parse()
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+class _Obj:
+    """Typed wrapper for node/pod/container vars.
+
+    The reference dispatches CEL methods by Go type (``corev1.Node`` vs
+    ``corev1.Pod`` — ``pkg/kwok/metrics/evaluator.go:75-121``); here the
+    wrapper carries the k8s ``role`` so Usage/CumulativeUsage overloads can
+    resolve, while plain field selection falls through to the dict.
+    """
+
+    __slots__ = ("role", "obj")
+
+    def __init__(self, role: str, obj: dict):
+        self.role = role
+        self.obj = obj or {}
+
+
+def _is_number(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _truthy(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    raise CELError(f"condition is not a bool: {type(v).__name__}")
+
+
+_STRING_METHODS = {
+    "startsWith": lambda s, p: s.startswith(p),
+    "endsWith": lambda s, p: s.endswith(p),
+    "contains": lambda s, p: p in s,
+    "matches": lambda s, p: re.search(p, s) is not None,
+    "size": lambda s: len(s),
+}
+
+
+@dataclass
+class Program:
+    """A compiled CEL program: ``eval`` it with var bindings."""
+
+    source: str
+    ast: Any
+    env: "Environment"
+
+    def eval(self, bindings: Optional[Dict[str, Any]] = None) -> Any:
+        return self.env._eval(self.ast, bindings or {})
+
+
+@dataclass
+class EnvironmentConfig:
+    """Hooks mirroring the reference's ``EnvironmentConfig``
+    (``pkg/kwok/metrics/evaluator.go:35-49``)."""
+
+    now: Optional[Callable[[], float]] = None
+    rand: Optional[Callable[[], float]] = None
+    started_containers_total: Optional[Callable[[str], float]] = None
+    container_resource_usage: Optional[Callable[[str, str, str, str], float]] = None
+    pod_resource_usage: Optional[Callable[[str, str, str], float]] = None
+    node_resource_usage: Optional[Callable[[str, str], float]] = None
+    container_resource_cumulative_usage: Optional[
+        Callable[[str, str, str, str], float]
+    ] = None
+    pod_resource_cumulative_usage: Optional[Callable[[str, str, str], float]] = None
+    node_resource_cumulative_usage: Optional[Callable[[str, str], float]] = None
+    funcs: Dict[str, Callable] = field(default_factory=dict)
+
+
+def _rfc3339_to_unix(s: str) -> float:
+    from kwok_tpu.utils.expression import parse_rfc3339
+
+    t = parse_rfc3339(s)
+    if t is None:
+        raise CELError(f"invalid RFC3339 timestamp: {s!r}")
+    return t.timestamp()
+
+
+class Environment:
+    """CEL evaluation environment with a program cache.
+
+    Equivalent of ``pkg/utils/cel/environment.go:39`` ``NewEnvironment`` +
+    ``pkg/kwok/metrics/evaluator.go:52`` with vars ``node``/``pod``/``container``.
+    """
+
+    def __init__(self, conf: Optional[EnvironmentConfig] = None):
+        self.conf = conf or EnvironmentConfig()
+        self._cache: Dict[str, Program] = {}
+        self._lock = threading.Lock()
+
+    # -- compilation -------------------------------------------------------
+    def compile(self, src: str) -> Program:
+        with self._lock:
+            prog = self._cache.get(src)
+            if prog is None:
+                prog = Program(src, parse(src), self)
+                self._cache[src] = prog
+            return prog
+
+    # -- vars --------------------------------------------------------------
+    @staticmethod
+    def node_var(node: dict) -> _Obj:
+        return _Obj("node", node)
+
+    @staticmethod
+    def pod_var(pod: dict) -> _Obj:
+        return _Obj("pod", pod)
+
+    @staticmethod
+    def container_var(container: dict) -> _Obj:
+        return _Obj("container", container)
+
+    # -- evaluation --------------------------------------------------------
+    def _eval(self, node: Any, env: Dict[str, Any]) -> Any:
+        ev = self._eval
+        if isinstance(node, Lit):
+            return node.value
+        if isinstance(node, Ident):
+            if node.name in env:
+                return env[node.name]
+            raise CELError(f"undeclared reference: {node.name}")
+        if isinstance(node, Select):
+            operand = ev(node.operand, env)
+            return self._select(operand, node.field)
+        if isinstance(node, Index):
+            operand = ev(node.operand, env)
+            idx = ev(node.index, env)
+            return self._index(operand, idx)
+        if isinstance(node, Call):
+            return self._call(node, env)
+        if isinstance(node, Unary):
+            v = ev(node.operand, env)
+            if node.op == "!":
+                return not _truthy(v)
+            if node.op == "-":
+                if isinstance(v, Quantity) or _is_number(v):
+                    return -v
+                raise CELError(f"no such overload: -{type(v).__name__}")
+        if isinstance(node, Binary):
+            return self._binary(node, env)
+        if isinstance(node, Ternary):
+            if _truthy(ev(node.cond, env)):
+                return ev(node.then, env)
+            return ev(node.other, env)
+        if isinstance(node, ListLit):
+            return [ev(i, env) for i in node.items]
+        if isinstance(node, MapLit):
+            return {ev(k, env): ev(v, env) for k, v in node.entries}
+        raise CELError(f"unknown AST node: {node!r}")
+
+    @staticmethod
+    def _select(operand: Any, fld: str) -> Any:
+        if isinstance(operand, _Obj):
+            operand = operand.obj
+        if isinstance(operand, dict):
+            if fld in operand:
+                return operand[fld]
+            return None
+        raise CELError(f"cannot select {fld!r} from {type(operand).__name__}")
+
+    @staticmethod
+    def _index(operand: Any, idx: Any) -> Any:
+        if isinstance(operand, _Obj):
+            operand = operand.obj
+        if isinstance(operand, dict):
+            if idx in operand:
+                return operand[idx]
+            raise CELError(f"no such key: {idx!r}")
+        if isinstance(operand, (list, str)):
+            if not isinstance(idx, int) or isinstance(idx, bool):
+                raise CELError("index must be an int")
+            if not 0 <= idx < len(operand):
+                raise CELError(f"index out of range: {idx}")
+            return operand[idx]
+        raise CELError(f"cannot index {type(operand).__name__}")
+
+    def _binary(self, node: Binary, env: Dict[str, Any]) -> Any:
+        op = node.op
+        if op == "&&":
+            return _truthy(self._eval(node.left, env)) and _truthy(
+                self._eval(node.right, env)
+            )
+        if op == "||":
+            return _truthy(self._eval(node.left, env)) or _truthy(
+                self._eval(node.right, env)
+            )
+        left = self._eval(node.left, env)
+        right = self._eval(node.right, env)
+        if op == "in":
+            if isinstance(right, _Obj):
+                right = right.obj
+            if isinstance(right, dict):
+                return left in right
+            if isinstance(right, (list, str)):
+                return left in right
+            raise CELError(f"cannot apply 'in' to {type(right).__name__}")
+        if op == "==":
+            return self._equals(left, right)
+        if op == "!=":
+            return not self._equals(left, right)
+        if op in ("<", "<=", ">", ">="):
+            self._check_comparable(left, right, op)
+            if op == "<":
+                return left < right
+            if op == "<=":
+                return left <= right
+            if op == ">":
+                return left > right
+            return left >= right
+        # arithmetic
+        if op == "+":
+            if isinstance(left, str) and isinstance(right, str):
+                return left + right
+            if isinstance(left, list) and isinstance(right, list):
+                return left + right
+            return self._arith(left, right, op)
+        if op in ("-", "*", "/", "%"):
+            return self._arith(left, right, op)
+        raise CELError(f"unknown operator {op!r}")
+
+    @staticmethod
+    def _equals(left: Any, right: Any) -> bool:
+        if isinstance(left, Quantity) or isinstance(right, Quantity):
+            try:
+                lq = left if isinstance(left, Quantity) else Quantity(left)
+                rq = right if isinstance(right, Quantity) else Quantity(right)
+                return lq.nano == rq.nano
+            except CELError:
+                return False
+        return bool(left == right)
+
+    @staticmethod
+    def _check_comparable(left: Any, right: Any, op: str) -> None:
+        ok = (
+            (_is_number(left) and _is_number(right))
+            or (isinstance(left, str) and isinstance(right, str))
+            or (isinstance(left, bool) and isinstance(right, bool))
+            or isinstance(left, Quantity)
+            or isinstance(right, Quantity)
+        )
+        if not ok:
+            raise CELError(
+                f"no such overload: {type(left).__name__} {op} {type(right).__name__}"
+            )
+
+    @staticmethod
+    def _arith(left: Any, right: Any, op: str) -> Any:
+        if isinstance(left, Quantity) or isinstance(right, Quantity):
+            if op == "+":
+                return left + right
+            if op == "-":
+                return left - right
+            if op == "*":
+                return left * right
+            if op == "/":
+                return left / right
+            raise CELError(f"no such overload: Quantity {op} Quantity")
+        if not (_is_number(left) and _is_number(right)):
+            raise CELError(
+                f"no such overload: {type(left).__name__} {op} {type(right).__name__}"
+            )
+        if op == "+":
+            return left + right
+        if op == "-":
+            return left - right
+        if op == "*":
+            return left * right
+        if op == "/":
+            if isinstance(left, int) and isinstance(right, int):
+                if right == 0:
+                    raise CELError("division by zero")
+                q = abs(left) // abs(right)  # CEL int division truncates
+                return -q if (left < 0) != (right < 0) else q
+            if right == 0:
+                raise CELError("division by zero")
+            return left / right
+        if op == "%":
+            if not (isinstance(left, int) and isinstance(right, int)):
+                raise CELError("modulo requires ints")
+            if right == 0:
+                raise CELError("modulo by zero")
+            r = abs(left) % abs(right)  # Go-style truncated modulo
+            return -r if left < 0 else r
+        raise CELError(f"unknown arithmetic op {op!r}")
+
+    # -- calls -------------------------------------------------------------
+    def _call(self, node: Call, env: Dict[str, Any]) -> Any:
+        args = [self._eval(a, env) for a in node.args]
+        name = node.name
+        if node.target is None:
+            return self._global_call(name, args)
+        target = self._eval(node.target, env)
+        return self._method_call(target, name, args)
+
+    def _now(self) -> float:
+        return self.conf.now() if self.conf.now else _time.time()
+
+    def _global_call(self, name: str, args: List[Any]) -> Any:
+        conf = self.conf
+        if name in conf.funcs:
+            return conf.funcs[name](*args)
+        if name in ("Now", "now") and not args:
+            return self._now()
+        if name == "Rand" and not args:
+            return conf.rand() if conf.rand else random.random()
+        if name == "UnixSecond" and len(args) == 1:
+            return self._unix_second(args[0])
+        if name == "SinceSecond" and len(args) == 1:
+            return self._since_second(args[0])
+        if name == "Quantity" and len(args) == 1:
+            return Quantity(args[0])
+        if name in ("StartedContainersTotal", "startedContainersTotal") and len(args) == 1:
+            return self._started_containers_total(args[0])
+        if name == "size" and len(args) == 1:
+            if isinstance(args[0], (str, list, dict, bytes)):
+                return len(args[0])
+            raise CELError(f"size: unsupported type {type(args[0]).__name__}")
+        if name == "string" and len(args) == 1:
+            return self._to_string(args[0])
+        if name == "int" and len(args) == 1:
+            v = args[0]
+            if isinstance(v, str):
+                try:
+                    return int(v, 0)
+                except ValueError as exc:
+                    raise CELError(f"int: cannot parse {v!r}") from exc
+            return int(as_float64(v))
+        if name == "double" and len(args) == 1:
+            v = args[0]
+            if isinstance(v, str):
+                try:
+                    return float(v)
+                except ValueError as exc:
+                    raise CELError(f"double: cannot parse {v!r}") from exc
+            return as_float64(v)
+        if name == "bool" and len(args) == 1:
+            v = args[0]
+            if isinstance(v, bool):
+                return v
+            if isinstance(v, str):  # CEL bool(string) parses the literal
+                if v.lower() in ("true", "1", "t"):
+                    return True
+                if v.lower() in ("false", "0", "f"):
+                    return False
+                raise CELError(f"bool: cannot parse {v!r}")
+            raise CELError(f"bool: unsupported type {type(v).__name__}")
+        if name in ("min", "max") and args:
+            vals = args[0] if len(args) == 1 and isinstance(args[0], list) else args
+            if not vals:
+                raise CELError(f"{name}: empty argument list")
+            try:
+                return (min if name == "min" else max)(vals)
+            except TypeError as exc:
+                raise CELError(f"{name}: incomparable arguments") from exc
+        if name in ("ceil", "floor") and len(args) == 1:
+            f = as_float64(args[0])  # numbers, bools, Quantity
+            return math.ceil(f) if name == "ceil" else math.floor(f)
+        raise CELError(f"undeclared function: {name}/{len(args)}")
+
+    @staticmethod
+    def _to_string(v: Any) -> str:
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, Quantity):
+            return v.format()
+        if isinstance(v, float) and v.is_integer():
+            return str(int(v))
+        return str(v)
+
+    def _unix_second(self, v: Any) -> float:
+        if _is_number(v):
+            return float(v)
+        if isinstance(v, str):
+            return _rfc3339_to_unix(v)
+        raise CELError(f"UnixSecond: unsupported type {type(v).__name__}")
+
+    def _since_second(self, v: Any) -> float:
+        # reference: time.Since(creationTimestamp) — funcs.go:34-40
+        obj = v.obj if isinstance(v, _Obj) else v
+        if not isinstance(obj, dict):
+            raise CELError("SinceSecond expects a resource object")
+        ts = (obj.get("metadata") or {}).get("creationTimestamp")
+        if not ts:
+            return 0.0
+        return self._now() - _rfc3339_to_unix(ts)
+
+    def _started_containers_total(self, v: Any) -> float:
+        hook = self.conf.started_containers_total
+        if hook is None:
+            raise CELError("StartedContainersTotal is not configured")
+        if isinstance(v, _Obj):
+            name = ((v.obj.get("metadata") or {}).get("name")) or ""
+            return float(hook(name))
+        if isinstance(v, str):
+            return float(hook(v))
+        raise CELError("StartedContainersTotal expects a node or node name")
+
+    def _method_call(self, target: Any, name: str, args: List[Any]) -> Any:
+        conf = self.conf
+        if isinstance(target, str) and name in _STRING_METHODS:
+            return _STRING_METHODS[name](target, *args)
+        if name == "size" and not args:
+            if isinstance(target, _Obj):
+                target = target.obj
+            return len(target)
+        if name in ("SinceSecond",) and not args:
+            return self._since_second(target)
+        if name in ("UnixSecond",) and not args:
+            return self._unix_second(target)
+        if name in ("StartedContainersTotal", "startedContainersTotal") and not args:
+            return self._started_containers_total(target)
+        if isinstance(target, _Obj):
+            meta = target.obj.get("metadata") or {}
+            ns = meta.get("namespace") or ""
+            obj_name = meta.get("name") or ""
+            if name == "Usage":
+                if target.role == "pod" and len(args) == 2:
+                    if conf.container_resource_usage is None:
+                        raise CELError("container Usage is not configured")
+                    return conf.container_resource_usage(args[0], ns, obj_name, args[1])
+                if target.role == "pod" and len(args) == 1:
+                    if conf.pod_resource_usage is None:
+                        raise CELError("pod Usage is not configured")
+                    return conf.pod_resource_usage(args[0], ns, obj_name)
+                if target.role == "node" and len(args) == 1:
+                    if conf.node_resource_usage is None:
+                        raise CELError("node Usage is not configured")
+                    return conf.node_resource_usage(args[0], obj_name)
+            if name == "CumulativeUsage":
+                if target.role == "pod" and len(args) == 2:
+                    if conf.container_resource_cumulative_usage is None:
+                        raise CELError("container CumulativeUsage is not configured")
+                    return conf.container_resource_cumulative_usage(
+                        args[0], ns, obj_name, args[1]
+                    )
+                if target.role == "pod" and len(args) == 1:
+                    if conf.pod_resource_cumulative_usage is None:
+                        raise CELError("pod CumulativeUsage is not configured")
+                    return conf.pod_resource_cumulative_usage(args[0], ns, obj_name)
+                if target.role == "node" and len(args) == 1:
+                    if conf.node_resource_cumulative_usage is None:
+                        raise CELError("node CumulativeUsage is not configured")
+                    return conf.node_resource_cumulative_usage(args[0], obj_name)
+        raise CELError(
+            f"no such method: {type(target).__name__}.{name}/{len(args)}"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Result conversion — reference environment.go:117 AsFloat64 / :139 AsString
+# ---------------------------------------------------------------------------
+
+
+def as_float64(v: Any) -> float:
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, Quantity):
+        return v.as_float()
+    raise CELError(f"unsupported type for AsFloat64: {type(v).__name__}")
+
+
+def as_string(v: Any) -> str:
+    if isinstance(v, str):
+        return v
+    raise CELError(f"unsupported type for AsString: {type(v).__name__}")

@@ -1,0 +1,309 @@
+"""CEL engine tests — mirrors the expression shapes used by the reference's
+Metric/ResourceUsage configs (charts/metrics-usage/templates/*.yaml) and the
+evaluator surface of pkg/kwok/metrics/evaluator.go."""
+
+import math
+
+import pytest
+
+from kwok_tpu.utils.cel import (
+    CELError,
+    Environment,
+    EnvironmentConfig,
+    Quantity,
+    as_float64,
+    parse,
+    parse_quantity,
+)
+
+
+def ev(src, bindings=None, conf=None):
+    env = Environment(conf)
+    return env.compile(src).eval(bindings)
+
+
+# -- quantities -------------------------------------------------------------
+
+
+def test_parse_quantity_suffixes():
+    assert parse_quantity("100m") == pytest.approx(0.1)
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("1Ki") == 1024
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity("12e6") == 12e6
+    assert parse_quantity("1.5") == 1.5
+    assert parse_quantity("10n") == pytest.approx(1e-8)
+    assert parse_quantity("-5m") == pytest.approx(-0.005)
+
+
+def test_parse_quantity_invalid():
+    with pytest.raises(CELError):
+        parse_quantity("abc")
+    with pytest.raises(CELError):
+        parse_quantity("1X")
+
+
+def test_quantity_arithmetic_exact():
+    q = Quantity("100m") + Quantity("100m")
+    assert q == Quantity("200m")
+    assert (Quantity("1Gi") - Quantity("512Mi")).as_float() == 2**29
+    assert (Quantity("100m") * 3) == Quantity("300m")
+    assert Quantity("1") / Quantity("250m") == pytest.approx(4.0)
+    assert -Quantity("5m") == Quantity("-5m")
+    assert Quantity("1Ki") > Quantity("1k")
+
+
+def test_quantity_format_roundtrip():
+    assert Quantity("100m").format() == "100m"
+    assert (Quantity("100m") + Quantity("150m")).format() == "250m"
+    assert Quantity(2).format() == "2"
+
+
+# -- literals & operators ---------------------------------------------------
+
+
+def test_literals():
+    assert ev("42") == 42
+    assert ev("4.5") == 4.5
+    assert ev('"hi"') == "hi"
+    assert ev("'hi'") == "hi"
+    assert ev("true") is True
+    assert ev("null") is None
+    assert ev("[1, 2, 3]") == [1, 2, 3]
+    assert ev('{"a": 1}') == {"a": 1}
+
+
+def test_arithmetic_and_precedence():
+    assert ev("1 + 2 * 3") == 7
+    assert ev("(1 + 2) * 3") == 9
+    assert ev("7 / 2") == 3  # CEL int division truncates
+    assert ev("-7 / 2") == -3
+    assert ev("7.0 / 2") == 3.5
+    assert ev("7 % 3") == 1
+    assert ev("-7 % 3") == -1  # Go-style truncated modulo
+    assert ev('"a" + "b"') == "ab"
+    assert ev("[1] + [2]") == [1, 2]
+
+
+def test_comparisons_and_logic():
+    assert ev("1 < 2 && 2 <= 2") is True
+    assert ev("1 > 2 || 3 >= 3") is True
+    assert ev('"a" != "b"') is True
+    assert ev("!(1 == 1)") is False
+
+
+def test_ternary_and_in():
+    assert ev('"a" in {"a": 1} ? 10 : 20') == 10
+    assert ev('"x" in ["x", "y"]') is True
+    assert ev('2 in [1, 2]') is True
+    assert ev('"zz" in "fizz"') is True
+
+
+def test_division_by_zero():
+    with pytest.raises(CELError):
+        ev("1 / 0")
+    with pytest.raises(CELError):
+        ev("1 % 0")
+
+
+def test_type_errors():
+    with pytest.raises(CELError):
+        ev('1 + "a"')
+    with pytest.raises(CELError):
+        ev("1 ? 2 : 3")  # condition must be bool
+    with pytest.raises(CELError):
+        ev("nope")
+
+
+# -- selection / indexing on objects ---------------------------------------
+
+POD = {
+    "metadata": {
+        "name": "pod-0",
+        "namespace": "default",
+        "creationTimestamp": "2024-01-01T00:00:00Z",
+        "annotations": {"kwok.x-k8s.io/usage-cpu": "250m"},
+    },
+    "spec": {"nodeName": "node-0", "containers": [{"name": "app"}]},
+    "status": {"phase": "Running"},
+}
+
+
+def bindings(conf=None):
+    return {
+        "pod": Environment.pod_var(POD),
+        "node": Environment.node_var(
+            {"metadata": {"name": "node-0", "creationTimestamp": "2024-01-01T00:00:00Z"}}
+        ),
+        "container": Environment.container_var({"name": "app"}),
+    }
+
+
+def test_field_selection():
+    assert ev("pod.metadata.name", bindings()) == "pod-0"
+    assert ev("pod.spec.nodeName", bindings()) == "node-0"
+    assert ev("container.name", bindings()) == "app"
+    # missing fields select to null, like protobuf defaults
+    assert ev("pod.metadata.labels", bindings()) is None
+
+
+def test_index_annotations():
+    out = ev('pod.metadata.annotations["kwok.x-k8s.io/usage-cpu"]', bindings())
+    assert out == "250m"
+    with pytest.raises(CELError):
+        ev('pod.metadata.annotations["missing"]', bindings())
+
+
+def test_usage_from_annotation_expression():
+    # verbatim shape from charts/metrics-usage/templates/usage-from-annotation.yaml
+    src = (
+        '"kwok.x-k8s.io/usage-cpu" in pod.metadata.annotations\n'
+        '? Quantity(pod.metadata.annotations["kwok.x-k8s.io/usage-cpu"])\n'
+        ': Quantity("1m")'
+    )
+    out = ev(src, bindings())
+    assert isinstance(out, Quantity)
+    assert out == Quantity("250m")
+    # fallback branch
+    src2 = src.replace("usage-cpu", "usage-gpu")
+    assert ev(src2, bindings()) == Quantity("1m")
+
+
+# -- funcs ------------------------------------------------------------------
+
+
+def test_now_and_rand():
+    conf = EnvironmentConfig(now=lambda: 1000.0, rand=lambda: 0.25)
+    assert ev("Now()", conf=conf) == 1000.0
+    assert ev("Rand()", conf=conf) == 0.25
+    assert ev("Rand() * 10.0", conf=conf) == 2.5
+
+
+def test_since_second():
+    import datetime
+
+    base = datetime.datetime(2024, 1, 1, tzinfo=datetime.timezone.utc).timestamp()
+    conf = EnvironmentConfig(now=lambda: base + 3600)
+    assert ev("pod.SinceSecond()", bindings(), conf) == pytest.approx(3600)
+    assert ev("SinceSecond(node)", bindings(), conf) == pytest.approx(3600)
+
+
+def test_unix_second():
+    assert ev('UnixSecond("2024-01-01T00:00:00Z")') == pytest.approx(1704067200.0)
+    assert ev("UnixSecond(5)") == 5.0
+
+
+def test_usage_methods_dispatch():
+    calls = []
+    conf = EnvironmentConfig(
+        container_resource_usage=lambda r, ns, p, c: calls.append(("c", r, ns, p, c))
+        or 1.0,
+        pod_resource_usage=lambda r, ns, p: calls.append(("p", r, ns, p)) or 2.0,
+        node_resource_usage=lambda r, n: calls.append(("n", r, n)) or 3.0,
+        container_resource_cumulative_usage=lambda r, ns, p, c: 4.0,
+        pod_resource_cumulative_usage=lambda r, ns, p: 5.0,
+        node_resource_cumulative_usage=lambda r, n: 6.0,
+    )
+    assert ev('pod.Usage("memory", container.name)', bindings(), conf) == 1.0
+    assert calls[-1] == ("c", "memory", "default", "pod-0", "app")
+    assert ev('pod.Usage("memory")', bindings(), conf) == 2.0
+    assert ev('node.Usage("cpu")', bindings(), conf) == 3.0
+    assert calls[-1] == ("n", "cpu", "node-0")
+    assert ev('pod.CumulativeUsage("cpu", container.name)', bindings(), conf) == 4.0
+    assert ev('pod.CumulativeUsage("cpu")', bindings(), conf) == 5.0
+    assert ev('node.CumulativeUsage("cpu")', bindings(), conf) == 6.0
+
+
+def test_usage_unconfigured_raises():
+    with pytest.raises(CELError):
+        ev('pod.Usage("cpu")', bindings(), EnvironmentConfig())
+
+
+def test_started_containers_total():
+    conf = EnvironmentConfig(started_containers_total=lambda n: 7 if n == "node-0" else 0)
+    assert ev("node.StartedContainersTotal()", bindings(), conf) == 7.0
+    assert ev('StartedContainersTotal("node-0")', conf=conf) == 7.0
+
+
+def test_string_methods():
+    assert ev('"foobar".startsWith("foo")', bindings()) is True
+    assert ev('pod.metadata.name.contains("-")', bindings()) is True
+    assert ev('"abc".size()') == 3
+    assert ev('size("abc")') == 3
+
+
+def test_conversions():
+    assert ev('double(Quantity("100m"))') == pytest.approx(0.1)
+    assert ev("int(3.9)") == 3
+    assert ev("string(5)") == "5"
+    assert ev("string(true)") == "true"
+
+
+def test_as_float64():
+    assert as_float64(True) == 1.0
+    assert as_float64(False) == 0.0
+    assert as_float64(3) == 3.0
+    assert as_float64(Quantity("500m")) == pytest.approx(0.5)
+    with pytest.raises(CELError):
+        as_float64("nope")
+
+
+def test_program_cache():
+    env = Environment()
+    p1 = env.compile("1 + 1")
+    p2 = env.compile("1 + 1")
+    assert p1 is p2
+
+
+def test_comments_and_multiline():
+    assert ev("1 + // one\n 2") == 3
+
+
+def test_bool_string_parses_literal():
+    assert ev('bool("false")') is False
+    assert ev('bool("true")') is True
+    with pytest.raises(CELError):
+        ev('bool("maybe")')
+
+
+def test_quantity_string_operand_raises_celerror():
+    with pytest.raises(CELError):
+        ev('Quantity("1") * "abc"')
+    with pytest.raises(CELError):
+        ev('Quantity("2") * "3"')  # CEL has no Quantity*string overload
+    with pytest.raises(CELError):
+        ev('Quantity("1") / "2"')
+
+
+def test_builtin_type_errors_are_celerror():
+    with pytest.raises(CELError):
+        ev('ceil("abc")')
+    with pytest.raises(CELError):
+        ev('min(1, "a")')
+    with pytest.raises(CELError):
+        ev("size(5)")
+    # ceil/floor accept Quantity like the other arithmetic paths
+    assert ev('ceil(Quantity("1500m"))') == 2
+    assert ev('floor(Quantity("1500m"))') == 1
+
+
+def test_quantity_hash_eq_consistent():
+    # Python-level eq is Quantity-only so hash/eq stay consistent
+    assert len({Quantity(1), 1.0}) == 2
+    assert len({Quantity(1), Quantity("1")}) == 1
+    # CEL-level == still coerces numbers
+    assert ev('Quantity("1") == 1') is True
+    assert ev('Quantity("250m") == 0.25') is True
+
+
+def test_int_double_parse_strings():
+    assert ev('int("42")') == 42
+    assert ev('double("2.5")') == 2.5
+    with pytest.raises(CELError):
+        ev('int("x")')
+
+
+def test_ast_exposed_for_lowering():
+    prog = Environment().compile('pod.Usage("cpu") * 2.0')
+    # The device metrics path pattern-matches on this AST
+    assert parse('pod.Usage("cpu") * 2.0') == prog.ast

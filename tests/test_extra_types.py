@@ -1,0 +1,231 @@
+"""Round-trip and behavior tests for the non-Stage CRD types (§2.4)."""
+
+import pytest
+
+from kwok_tpu.api.extra_types import (
+    Attach,
+    ClusterExec,
+    ClusterLogs,
+    ClusterPortForward,
+    ClusterResourceUsage,
+    Exec,
+    Logs,
+    Metric,
+    ObjectSelector,
+    PortForward,
+    ResourcePatch,
+    ResourceUsage,
+    from_document,
+)
+
+METRIC_DOC = {
+    "apiVersion": "kwok.x-k8s.io/v1alpha1",
+    "kind": "Metric",
+    "metadata": {"name": "metrics-resource"},
+    "spec": {
+        "path": "/metrics/nodes/{nodeName}/metrics/resource",
+        "metrics": [
+            {"name": "scrape_error", "dimension": "node", "kind": "gauge", "value": "0"},
+            {
+                "name": "container_cpu_usage_seconds_total",
+                "dimension": "container",
+                "kind": "counter",
+                "labels": [
+                    {"name": "container", "value": "container.name"},
+                    {"name": "pod", "value": "pod.metadata.name"},
+                ],
+                "value": 'pod.CumulativeUsage("cpu", container.name)',
+            },
+            {
+                "name": "latency",
+                "kind": "histogram",
+                "buckets": [
+                    {"le": 0.1, "value": "1"},
+                    {"le": 1.0, "value": "2", "hidden": True},
+                ],
+            },
+        ],
+    },
+}
+
+
+def test_metric_roundtrip():
+    m = Metric.from_dict(METRIC_DOC)
+    assert m.path.endswith("/metrics/resource")
+    assert m.metrics[1].dimension == "container"
+    assert m.metrics[1].labels[0].name == "container"
+    assert m.metrics[2].buckets[1].hidden is True
+    again = Metric.from_dict(m.to_dict())
+    assert again == m
+
+
+def test_metric_requires_path_and_kind():
+    with pytest.raises(ValueError):
+        Metric.from_dict({"kind": "Metric", "metadata": {"name": "x"}, "spec": {}})
+    bad = {
+        "kind": "Metric",
+        "metadata": {"name": "x"},
+        "spec": {"path": "/m", "metrics": [{"name": "a", "kind": "summary"}]},
+    }
+    with pytest.raises(ValueError):
+        Metric.from_dict(bad)
+
+
+def test_resource_usage_roundtrip():
+    doc = {
+        "kind": "ResourceUsage",
+        "metadata": {"name": "p", "namespace": "ns"},
+        "spec": {
+            "usages": [
+                {
+                    "containers": ["app"],
+                    "usage": {
+                        "cpu": {"expression": 'Quantity("100m")'},
+                        "memory": {"value": "1Gi"},
+                    },
+                }
+            ]
+        },
+    }
+    ru = ResourceUsage.from_dict(doc)
+    assert ru.namespace == "ns"
+    assert ru.usages[0].usage["memory"].value == "1Gi"
+    assert ru.usages[0].usage["cpu"].expression == 'Quantity("100m")'
+    assert ResourceUsage.from_dict(ru.to_dict()) == ru
+
+
+def test_cluster_resource_usage_selector():
+    doc = {
+        "kind": "ClusterResourceUsage",
+        "metadata": {"name": "usage-from-annotation"},
+        "spec": {
+            "selector": {"matchNamespaces": ["default"]},
+            "usages": [{"usage": {"cpu": {"expression": "Quantity('1m')"}}}],
+        },
+    }
+    cru = ClusterResourceUsage.from_dict(doc)
+    assert cru.selector.matches("default", "any") is True
+    assert cru.selector.matches("kube-system", "any") is False
+    assert ClusterResourceUsage.from_dict(cru.to_dict()) == cru
+
+
+def test_object_selector_empty_matches_all():
+    sel = ObjectSelector()
+    assert sel.matches("anything", "goes")
+
+
+def test_logs_find_container():
+    doc = {
+        "kind": "Logs",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "logs": [
+                {"containers": ["web"], "logsFile": "/var/log/web.log", "follow": True},
+                {"logsFile": "/var/log/default.log"},
+            ]
+        },
+    }
+    lg = Logs.from_dict(doc)
+    assert lg.find("web").logs_file == "/var/log/web.log"
+    assert lg.find("other").logs_file == "/var/log/default.log"
+    assert Logs.from_dict(lg.to_dict()) == lg
+
+
+def test_cluster_logs():
+    doc = {
+        "kind": "ClusterLogs",
+        "metadata": {"name": "all"},
+        "spec": {"selector": {"matchNames": ["p1"]}, "logs": [{"logsFile": "/l"}]},
+    }
+    cl = ClusterLogs.from_dict(doc)
+    assert cl.selector.matches("ns", "p1")
+    assert not cl.selector.matches("ns", "p2")
+    assert ClusterLogs.from_dict(cl.to_dict()) == cl
+
+
+def test_exec_types():
+    doc = {
+        "kind": "Exec",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "execs": [
+                {
+                    "containers": ["app"],
+                    "local": {
+                        "workDir": "/tmp",
+                        "envs": [{"name": "FOO", "value": "bar"}],
+                        "securityContext": {"runAsUser": 1000},
+                    },
+                }
+            ]
+        },
+    }
+    ex = Exec.from_dict(doc)
+    tgt = ex.find("app")
+    assert tgt.local.work_dir == "/tmp"
+    assert tgt.local.envs[0].name == "FOO"
+    assert tgt.local.security_context.run_as_user == 1000
+    assert ex.find("nope") is None
+    assert Exec.from_dict(ex.to_dict()) == ex
+    cx = ClusterExec.from_dict(
+        {"kind": "ClusterExec", "metadata": {"name": "c"}, "spec": {"execs": [{}]}}
+    )
+    assert cx.find("anything") is not None
+
+
+def test_attach_roundtrip():
+    doc = {
+        "kind": "Attach",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"attaches": [{"containers": ["c"], "logsFile": "/f"}]},
+    }
+    at = Attach.from_dict(doc)
+    assert at.find("c").logs_file == "/f"
+    assert Attach.from_dict(at.to_dict()) == at
+
+
+def test_port_forward_find():
+    doc = {
+        "kind": "PortForward",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "forwards": [
+                {"ports": [8080], "target": {"port": 80, "address": "127.0.0.1"}},
+                {"command": ["nc", "localhost", "9000"]},
+            ]
+        },
+    }
+    pf = PortForward.from_dict(doc)
+    assert pf.find(8080).target.port == 80
+    assert pf.find(1234).command == ["nc", "localhost", "9000"]
+    assert PortForward.from_dict(pf.to_dict()) == pf
+    cpf = ClusterPortForward.from_dict(
+        {"kind": "ClusterPortForward", "metadata": {"name": "c"}, "spec": {"forwards": []}}
+    )
+    assert cpf.find(80) is None
+
+
+def test_resource_patch():
+    doc = {
+        "apiVersion": "action.kwok.x-k8s.io/v1alpha1",
+        "kind": "ResourcePatch",
+        "resource": {"version": "v1", "resource": "pods"},
+        "target": {"name": "pod-0", "namespace": "default"},
+        "durationNanosecond": 1_500_000_000,
+        "method": "patch",
+        "template": {"status": {"phase": "Running"}},
+    }
+    rp = ResourcePatch.from_dict(doc)
+    assert rp.duration_ns == 1_500_000_000
+    assert rp.method == "patch"
+    assert rp.template == {"status": {"phase": "Running"}}
+    assert ResourcePatch.from_dict(rp.to_dict()) == rp
+    with pytest.raises(ValueError):
+        ResourcePatch.from_dict({**doc, "method": "upsert"})
+
+
+def test_from_document_dispatch():
+    m = from_document(METRIC_DOC)
+    assert isinstance(m, Metric)
+    with pytest.raises(ValueError):
+        from_document({"kind": "Nope"})
