@@ -334,12 +334,18 @@ class Log:
 
 
 def _match_container(entries: List[Any], container: str) -> Optional[Any]:
-    """First entry whose container list is empty or contains the name —
-    the reference's lookup rule (pkg/kwok/server/debugging_logs.go et al.)."""
+    """Exact container match wins; else the *first* entry with an empty
+    container list is the default — reference rule
+    (pkg/kwok/server/debugging_exec.go:131-143 findContainerInExecs)."""
+    default = None
     for e in entries:
-        if not e.containers or container in e.containers:
+        if not e.containers:
+            if default is None:
+                default = e
+            continue
+        if container in e.containers:
             return e
-    return None
+    return default
 
 
 @dataclass
@@ -695,10 +701,17 @@ class Forward:
 
 
 def _match_port(forwards: List[Forward], port: int) -> Optional[Forward]:
+    """Exact port match wins; else the first portless entry is the default —
+    same rule as container lookup (debugging_port_forword.go)."""
+    default = None
     for f in forwards:
-        if not f.ports or port in f.ports:
+        if not f.ports:
+            if default is None:
+                default = f
+            continue
+        if port in f.ports:
             return f
-    return None
+    return default
 
 
 @dataclass

@@ -1,0 +1,16 @@
+"""Synthetic metrics subsystem: settable collectors, Metric-CR evaluation,
+and ResourceUsage integration (reference: pkg/kwok/metrics, pkg/kwok/server/
+metrics_resource_usage.go)."""
+
+from kwok_tpu.metrics.collectors import Counter, Gauge, Histogram, Registry
+from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
+from kwok_tpu.metrics.usage import UsageEvaluator
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "MetricsUpdateHandler",
+    "UsageEvaluator",
+]

@@ -1,0 +1,233 @@
+"""Settable Prometheus-style collectors + text exposition.
+
+The reference wraps prometheus-client with *settable* collectors because the
+simulator computes metric values from CEL rather than observing real events:
+``Gauge.Set``/``Counter.Set`` (pkg/kwok/metrics/{gauge,counter}.go) and a
+histogram whose per-``le`` counts are set explicitly and folded into a
+cumulative distribution at write time (pkg/kwok/metrics/histogram.go:107-151,
+including the hidden-bucket fold into the next visible bucket).
+
+This module implements the same collector semantics standalone, exposing the
+Prometheus text format directly — no client library dependency.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Gauge", "Counter", "Histogram", "Registry", "escape_label_value"]
+
+_INF = math.inf
+
+
+def escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == _INF:
+        return "+Inf"
+    if v == -_INF:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(labels: Dict[str, str], extra: Optional[Tuple[str, str]] = None) -> str:
+    items = list(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{escape_label_value(str(v))}"' for k, v in items)
+    return "{" + body + "}"
+
+
+class _Collector:
+    def __init__(self, name: str, help: str, const_labels: Optional[Dict[str, str]]):
+        self.name = name
+        self.help = (help or "").strip()
+        self.const_labels = dict(const_labels or {})
+
+    def type_name(self) -> str:
+        raise NotImplementedError
+
+    def samples(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Gauge(_Collector):
+    """A gauge whose value is set directly (gauge.go ``Set``)."""
+
+    def __init__(self, name: str, help: str = "", const_labels=None):
+        super().__init__(name, help, const_labels)
+        self._value = 0.0
+
+    def type_name(self) -> str:
+        return "gauge"
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def get(self) -> float:
+        return self._value
+
+    def samples(self) -> List[str]:
+        return [f"{self.name}{_fmt_labels(self.const_labels)} {_fmt_value(self._value)}"]
+
+
+class Counter(_Collector):
+    """A counter that is *set* to its CEL-computed cumulative value
+    (counter.go ``Set`` — the simulator owns monotonicity)."""
+
+    def __init__(self, name: str, help: str = "", const_labels=None):
+        super().__init__(name, help, const_labels)
+        self._value = 0.0
+
+    def type_name(self) -> str:
+        return "counter"
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def add(self, v: float) -> None:
+        self._value += float(v)
+
+    def get(self) -> float:
+        return self._value
+
+    def samples(self) -> List[str]:
+        return [f"{self.name}{_fmt_labels(self.const_labels)} {_fmt_value(self._value)}"]
+
+
+class Histogram(_Collector):
+    """Explicit-bucket histogram: ``set(le, count)`` stores raw per-``le``
+    counts; exposition folds them into the visible cumulative buckets the way
+    histogram.go:107-151 does (a stored ``le`` between two visible bounds
+    lands in the next visible bucket — this is how ``hidden`` buckets merge).
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = (),
+        const_labels=None,
+    ):
+        super().__init__(name, help, const_labels)
+        self.buckets = sorted(float(b) for b in buckets)
+        self._stored: Dict[float, int] = {}
+
+    def type_name(self) -> str:
+        return "histogram"
+
+    def set(self, le: float, count: int) -> None:
+        self._stored[float(le)] = int(count)
+
+    def distribution(self) -> Tuple[List[Tuple[float, int]], int, float]:
+        """(visible cumulative buckets incl. +Inf, total count, sum)."""
+        bounds = list(self.buckets) + [_INF]
+        cumulative = [0] * len(bounds)
+        idx = 0
+        count = 0
+        total = 0.0
+        for le in sorted(self._stored):
+            while idx < len(bounds) - 1 and le > bounds[idx]:
+                idx += 1
+            val = self._stored[le]
+            cumulative[idx] += val
+            count += val
+            total += le * val
+        # make buckets cumulative
+        run = 0
+        out: List[Tuple[float, int]] = []
+        for b, c in zip(bounds, cumulative):
+            run += c
+            out.append((b, run))
+        return out, count, total
+
+    def samples(self) -> List[str]:
+        dist, count, total = self.distribution()
+        lines = []
+        for le, c in dist:
+            lines.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.const_labels, ('le', _fmt_value(le)))} {c}"
+            )
+        lines.append(f"{self.name}_sum{_fmt_labels(self.const_labels)} {_fmt_value(total)}")
+        lines.append(f"{self.name}_count{_fmt_labels(self.const_labels)} {count}")
+        return lines
+
+
+class Registry:
+    """Collector registry with Prometheus text-format exposition.
+
+    Collectors register under a unique key (name + label values, like the
+    reference's ``createKeyAndLabels`` keys) and can be unregistered when
+    their underlying object disappears.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._collectors: Dict[str, _Collector] = {}
+        self._order: List[str] = []
+
+    def register(self, key: str, collector: _Collector) -> None:
+        with self._lock:
+            if key in self._collectors:
+                raise ValueError(f"duplicate collector key: {key}")
+            self._collectors[key] = collector
+            self._order.append(key)
+
+    def get(self, key: str) -> Optional[_Collector]:
+        with self._lock:
+            return self._collectors.get(key)
+
+    def get_or_register(self, key: str, make) -> _Collector:
+        with self._lock:
+            c = self._collectors.get(key)
+            if c is None:
+                c = make()
+                self._collectors[key] = c
+                self._order.append(key)
+            return c
+
+    def unregister(self, key: str) -> bool:
+        with self._lock:
+            if key in self._collectors:
+                del self._collectors[key]
+                self._order.remove(key)
+                return True
+            return False
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return list(self._order)
+
+    def expose(self) -> str:
+        """Prometheus text format, HELP/TYPE emitted once per metric name."""
+        with self._lock:
+            collectors = [self._collectors[k] for k in self._order]
+        by_name: Dict[str, List[_Collector]] = {}
+        name_order: List[str] = []
+        for c in collectors:
+            if c.name not in by_name:
+                by_name[c.name] = []
+                name_order.append(c.name)
+            by_name[c.name].append(c)
+        lines: List[str] = []
+        for name in name_order:
+            group = by_name[name]
+            first = group[0]
+            if first.help:
+                esc = first.help.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {name} {esc}")
+            lines.append(f"# TYPE {name} {first.type_name()}")
+            for c in group:
+                lines.extend(c.samples())
+        return "\n".join(lines) + ("\n" if lines else "")

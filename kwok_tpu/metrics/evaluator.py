@@ -1,0 +1,145 @@
+"""Per-node Metric-CR evaluation → collectors.
+
+Reference: pkg/kwok/metrics/metrics.go ``UpdateHandler`` — one registry per
+node route, ``update*`` walks each MetricConfig by dimension (node → one
+sample; pod/container → one per pod/container on the node), evaluating label
+CEL to build the collector key and value CEL for the sample
+(``metrics.go:168-430``), and unregisters collectors whose key was not
+produced by the latest update (stale pods).  CEL evaluation errors on one
+metric do not abort the remaining metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from kwok_tpu.api.extra_types import (
+    DIMENSION_CONTAINER,
+    DIMENSION_NODE,
+    DIMENSION_POD,
+    KIND_COUNTER,
+    KIND_GAUGE,
+    KIND_HISTOGRAM,
+    Metric,
+    MetricConfig,
+)
+from kwok_tpu.metrics.collectors import Counter, Gauge, Histogram, Registry
+from kwok_tpu.utils.cel import CELError, Environment, as_float64
+
+__all__ = ["MetricsUpdateHandler"]
+
+
+class MetricsUpdateHandler:
+    """Evaluates one Metric CR's configs for one node into a Registry."""
+
+    def __init__(
+        self,
+        metric: Metric,
+        env: Environment,
+        node_getter: Callable[[str], Optional[dict]],
+        list_pods: Callable[[str], List[dict]],
+        on_error: Optional[Callable[[str, Exception], None]] = None,
+    ):
+        self.metric = metric
+        self.env = env
+        self._node_getter = node_getter
+        self._list_pods = list_pods
+        self.registry = Registry()
+        self._on_error = on_error or (lambda name, exc: None)
+
+    # -- bindings ----------------------------------------------------------
+    @staticmethod
+    def _bindings(node: dict, pod: Optional[dict] = None, container: Optional[dict] = None):
+        b = {"node": Environment.node_var(node)}
+        if pod is not None:
+            b["pod"] = Environment.pod_var(pod)
+        if container is not None:
+            b["container"] = Environment.container_var(container)
+        return b
+
+    def _eval_labels(self, mc: MetricConfig, bindings) -> Dict[str, str]:
+        labels: Dict[str, str] = {}
+        for lb in mc.labels:
+            v = self.env.compile(lb.value).eval(bindings)
+            if isinstance(v, bool):
+                labels[lb.name] = "true" if v else "false"
+            elif isinstance(v, float) and v.is_integer():
+                labels[lb.name] = str(int(v))
+            else:
+                labels[lb.name] = str(v)
+        return labels
+
+    @staticmethod
+    def _key(mc: MetricConfig, labels: Dict[str, str]) -> str:
+        # repr-escape values so a '|' or '=' inside a CEL-derived label value
+        # cannot collide two distinct label sets onto one collector
+        parts = [mc.kind, mc.name]
+        parts.extend(f"{k}={v!r}" for k, v in sorted(labels.items()))
+        return "|".join(parts)
+
+    # -- one (metric, binding) sample --------------------------------------
+    def _update_sample(self, mc: MetricConfig, bindings) -> Optional[str]:
+        labels = self._eval_labels(mc, bindings)
+        key = self._key(mc, labels)
+        if mc.kind == KIND_GAUGE:
+            g = self.registry.get_or_register(
+                key, lambda: Gauge(mc.name, mc.help, labels)
+            )
+            g.set(as_float64(self.env.compile(mc.value).eval(bindings)))
+        elif mc.kind == KIND_COUNTER:
+            c = self.registry.get_or_register(
+                key, lambda: Counter(mc.name, mc.help, labels)
+            )
+            c.set(as_float64(self.env.compile(mc.value).eval(bindings)))
+        elif mc.kind == KIND_HISTOGRAM:
+            visible = [b.le for b in mc.buckets if not b.hidden]
+            h = self.registry.get_or_register(
+                key, lambda: Histogram(mc.name, mc.help, visible, labels)
+            )
+            for b in mc.buckets:
+                val = as_float64(self.env.compile(b.value).eval(bindings))
+                h.set(b.le, int(val))
+        else:
+            raise CELError(f"unknown metric kind {mc.kind!r}")
+        return key
+
+    # -- update ------------------------------------------------------------
+    def update(self, node_name: str) -> None:
+        node = self._node_getter(node_name)
+        if node is None:
+            return
+        pods: Optional[List[dict]] = None
+        live_keys: Set[str] = set()
+        for mc in self.metric.metrics:
+            try:
+                if mc.dimension == DIMENSION_NODE:
+                    k = self._update_sample(mc, self._bindings(node))
+                    if k:
+                        live_keys.add(k)
+                    continue
+                if pods is None:
+                    pods = self._list_pods(node_name)
+                if mc.dimension == DIMENSION_POD:
+                    for pod in pods:
+                        k = self._update_sample(mc, self._bindings(node, pod))
+                        if k:
+                            live_keys.add(k)
+                elif mc.dimension == DIMENSION_CONTAINER:
+                    for pod in pods:
+                        for c in ((pod.get("spec") or {}).get("containers")) or []:
+                            k = self._update_sample(mc, self._bindings(node, pod, c))
+                            if k:
+                                live_keys.add(k)
+                else:
+                    raise CELError(f"unknown dimension {mc.dimension!r}")
+            except CELError as exc:
+                self._on_error(mc.name, exc)
+        # unregister stale collectors (pods that went away)
+        for key in self.registry.keys():
+            if key not in live_keys:
+                self.registry.unregister(key)
+
+    def expose(self, node_name: Optional[str] = None) -> str:
+        if node_name is not None:
+            self.update(node_name)
+        return self.registry.expose()

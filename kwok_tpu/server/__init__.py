@@ -1,0 +1,6 @@
+"""Fake-kubelet server surface (reference: pkg/kwok/server)."""
+
+from kwok_tpu.server.router import Router
+from kwok_tpu.server.server import Server, ServerConfig
+
+__all__ = ["Router", "Server", "ServerConfig"]

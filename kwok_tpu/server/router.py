@@ -1,0 +1,79 @@
+"""Path-template router for the fake-kubelet HTTP surface.
+
+Route patterns use ``{name}`` segments like the reference's go-restful
+routes (pkg/kwok/server/debugging.go:36-102):
+``/exec/{podNamespace}/{podID}/{containerName}``.  Longest-literal-prefix
+wins; a trailing ``/`` on a pattern makes it a subtree match.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Router"]
+
+Handler = Callable[..., Any]
+
+
+class _Route:
+    def __init__(self, method: str, pattern: str, handler: Handler):
+        self.method = method
+        self.pattern = pattern
+        self.handler = handler
+        self.subtree = pattern.endswith("/") and "{" not in pattern
+        parts = [p for p in pattern.strip("/").split("/") if p]
+        regex_parts: List[str] = []
+        self.n_literals = 0
+        for p in parts:
+            if p.startswith("{") and p.endswith("}"):
+                regex_parts.append(f"(?P<{p[1:-1]}>[^/]+)")
+            else:
+                regex_parts.append(re.escape(p))
+                self.n_literals += 1
+        body = "/".join(regex_parts)
+        if self.subtree:
+            self.regex = re.compile(f"^/{body}(?:/.*)?$" if body else "^/.*$")
+        else:
+            self.regex = re.compile(f"^/{body}/?$")
+
+    def match(self, path: str) -> Optional[Dict[str, str]]:
+        m = self.regex.match(path)
+        if not m:
+            return None
+        return m.groupdict()
+
+
+class Router:
+    def __init__(self):
+        self._routes: List[_Route] = []
+
+    def add(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.append(_Route(method.upper(), pattern, handler))
+
+    def remove(self, method: str, pattern: str) -> bool:
+        before = len(self._routes)
+        self._routes = [
+            r
+            for r in self._routes
+            if not (r.method == method.upper() and r.pattern == pattern)
+        ]
+        return len(self._routes) != before
+
+    def resolve(
+        self, method: str, path: str
+    ) -> Optional[Tuple[Handler, Dict[str, str]]]:
+        best: Optional[Tuple[_Route, Dict[str, str]]] = None
+        for r in self._routes:
+            if r.method != method.upper():
+                continue
+            params = r.match(path)
+            if params is None:
+                continue
+            if best is None or r.n_literals > best[0].n_literals or (
+                r.n_literals == best[0].n_literals and not r.subtree and best[0].subtree
+            ):
+                best = (r, params)
+        if best is None:
+            return None
+        return best[0].handler, best[1]

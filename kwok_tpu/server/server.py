@@ -1,0 +1,557 @@
+"""Fake-kubelet HTTP server.
+
+Re-implements the reference server surface (pkg/kwok/server/server.go:118
+``NewServer``, ``Run:446``) on ``http.server.ThreadingHTTPServer``:
+
+- ``/healthz`` ``/livez`` ``/readyz``           (healthz.go:25-38)
+- ``/metrics``  + per-Metric-CR dynamic routes  (metrics.go:59-150)
+- ``/discovery/prometheus`` HTTP SD             (service_discovery.go:26-79)
+- ``/containerLogs/{ns}/{pod}/{container}``     (debugging_logs.go:68-79)
+- ``/logs/…`` node-log subtree                  (debugging.go:38-44 — disabled
+  in the reference too; returns 405)
+- ``/exec/{ns}/{pod}/{container}``              (debugging_exec.go:40-145 —
+  local command execution with env/workdir/uid-gid)
+- ``/attach/{ns}/{pod}/{container}``            (debugging_attach.go — log
+  file streaming)
+- ``/portForward/{ns}/{pod}``                   (debugging_port_forword.go:39-85
+  — dial target address or run command piping stdin/stdout)
+- ``/debug/threads``                            (stand-in for Go pprof,
+  profiling.go:26 — dumps Python thread stacks)
+
+Transport note: the reference streams exec/attach/port-forward over
+SPDY/WebSocket upgrades to be kubectl-compatible; this server uses plain
+HTTP chunked bodies for the same operations (POST body → stdin/socket,
+response body ← stdout).  The simulation semantics — which command runs,
+which file is replayed, which target is dialed, per-pod config resolution —
+match the reference.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+import traceback
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from kwok_tpu.api.extra_types import (
+    Attach,
+    ClusterAttach,
+    ClusterExec,
+    ClusterLogs,
+    ClusterPortForward,
+    ClusterResourceUsage,
+    Exec,
+    Logs,
+    Metric,
+    PortForward,
+    ResourceUsage,
+)
+from kwok_tpu.metrics.collectors import Gauge, Registry
+from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
+from kwok_tpu.metrics.usage import UsageEvaluator
+from kwok_tpu.server.router import Router
+
+__all__ = ["Server", "ServerConfig"]
+
+
+class ServerConfig:
+    """Data source + config wiring (reference ``server.go:89-116``).
+
+    The data-source callables mirror the reference ``DataSource`` interface
+    plus the informer cache getters the server holds.
+    """
+
+    def __init__(
+        self,
+        get_node: Callable[[str], Optional[dict]],
+        get_pod: Callable[[str, str], Optional[dict]],
+        list_pods: Callable[[str], List[dict]],
+        list_nodes: Callable[[], List[str]],
+        now: Optional[Callable[[], float]] = None,
+    ):
+        self.get_node = get_node
+        self.get_pod = get_pod
+        self.list_pods = list_pods
+        self.list_nodes = list_nodes
+        self.now = now or time.time
+
+
+def _resolve_pod_config(rules, cluster_rules, namespace: str, name: str):
+    """Pod-specific config first, else first selector-matching cluster config
+    (reference lookup rule, e.g. debugging_exec.go:107-129)."""
+    for r in rules:
+        if r.name == name and r.namespace == namespace:
+            return r, True
+    for cr in cluster_rules:
+        if cr.selector.matches(namespace, name):
+            return cr, False
+    return None, False
+
+
+class Server:
+    def __init__(self, config: ServerConfig):
+        self.config = config
+        self.router = Router()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+        # config stores (static; a DynamicGetter can swap them live)
+        self.logs: List[Logs] = []
+        self.cluster_logs: List[ClusterLogs] = []
+        self.attaches: List[Attach] = []
+        self.cluster_attaches: List[ClusterAttach] = []
+        self.execs: List[Exec] = []
+        self.cluster_execs: List[ClusterExec] = []
+        self.port_forwards: List[PortForward] = []
+        self.cluster_port_forwards: List[ClusterPortForward] = []
+        self.metrics: List[Metric] = []
+
+        self.usage = UsageEvaluator(
+            pod_getter=config.get_pod,
+            node_getter=config.get_node,
+            list_pods=config.list_pods,
+            now=config.now,
+        )
+        self._metric_handlers: Dict[Tuple[str, str], MetricsUpdateHandler] = {}
+        self._metric_handlers_lock = threading.Lock()
+        self._started_containers: Dict[str, int] = {}
+        self.usage.env.conf.started_containers_total = (
+            lambda node: self._started_containers.get(node, 0)
+        )
+
+        self._self_registry = Registry()
+        up = Gauge("kwok_up", "1 if the server is serving.")
+        up.set(1)
+        self._self_registry.register("kwok_up", up)
+
+        self._install()
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def set_configs(self, docs: List[Any]) -> None:
+        """Install typed config objects (from api.extra_types) by type."""
+        for d in docs:
+            if isinstance(d, Logs):
+                self.logs.append(d)
+            elif isinstance(d, ClusterLogs):
+                self.cluster_logs.append(d)
+            elif isinstance(d, Attach):
+                self.attaches.append(d)
+            elif isinstance(d, ClusterAttach):
+                self.cluster_attaches.append(d)
+            elif isinstance(d, Exec):
+                self.execs.append(d)
+            elif isinstance(d, ClusterExec):
+                self.cluster_execs.append(d)
+            elif isinstance(d, PortForward):
+                self.port_forwards.append(d)
+            elif isinstance(d, ClusterPortForward):
+                self.cluster_port_forwards.append(d)
+            elif isinstance(d, Metric):
+                self._install_metric(d)  # validates path before it's advertised
+                self.metrics.append(d)
+            elif isinstance(d, ResourceUsage):
+                self.usage.add_usage(d)
+            elif isinstance(d, ClusterResourceUsage):
+                self.usage.add_cluster_usage(d)
+            else:
+                raise TypeError(f"unsupported config type: {type(d).__name__}")
+
+    def record_container_start(self, node_name: str, n: int = 1) -> None:
+        """Feed the StartedContainersTotal CEL hook."""
+        self._started_containers[node_name] = (
+            self._started_containers.get(node_name, 0) + n
+        )
+
+    # ------------------------------------------------------------------
+    # route installation
+    # ------------------------------------------------------------------
+    def _install(self) -> None:
+        r = self.router
+        for p in ("/healthz", "/livez", "/readyz"):
+            r.add("GET", p, self._healthz)
+        r.add("GET", "/metrics", self._self_metrics)
+        r.add("GET", "/discovery/prometheus", self._discovery)
+        r.add("GET", "/containerLogs/{podNamespace}/{podID}/{containerName}", self._container_logs)
+        for method in ("GET", "POST"):
+            r.add(method, "/exec/{podNamespace}/{podID}/{containerName}", self._exec)
+            r.add(method, "/exec/{podNamespace}/{podID}/{uid}/{containerName}", self._exec)
+            r.add(method, "/attach/{podNamespace}/{podID}/{containerName}", self._attach)
+            r.add(method, "/attach/{podNamespace}/{podID}/{uid}/{containerName}", self._attach)
+            r.add(method, "/portForward/{podNamespace}/{podID}", self._port_forward)
+            r.add(method, "/portForward/{podNamespace}/{podID}/{uid}", self._port_forward)
+        # disabled kubelet paths, mirroring InstallDebuggingDisabledHandlers
+        for p in ("/run/", "/runningpods/", "/logs/"):
+            r.add("GET", p, self._disabled)
+        r.add("GET", "/debug/threads", self._debug_threads)
+
+    def _install_metric(self, m: Metric) -> None:
+        if not m.path.startswith("/metrics"):
+            raise ValueError(f"metric path {m.path!r} does not start with /metrics")
+        self.router.add("GET", m.path, self._metric_endpoint(m))
+
+    def _metric_endpoint(self, m: Metric):
+        def handler(req: "_Request", **params):
+            node_name = params.get("nodeName", "")
+            key = (m.name, node_name)
+            with self._metric_handlers_lock:
+                h = self._metric_handlers.get(key)
+                if h is None:
+                    h = MetricsUpdateHandler(
+                        m,
+                        self.usage.env,
+                        self.config.get_node,
+                        self.config.list_pods,
+                    )
+                    self._metric_handlers[key] = h
+            text = h.expose(node_name) if node_name else h.expose(
+                node_name=(self.config.list_nodes() or [""])[0]
+            )
+            req.reply(200, text, content_type="text/plain; version=0.0.4")
+
+        return handler
+
+    # ------------------------------------------------------------------
+    # handlers
+    # ------------------------------------------------------------------
+    def _healthz(self, req: "_Request", **params) -> None:
+        req.reply(200, "ok")
+
+    def _disabled(self, req: "_Request", **params) -> None:
+        req.reply(405, "disabled")
+
+    def _self_metrics(self, req: "_Request", **params) -> None:
+        req.reply(200, self._self_registry.expose(), content_type="text/plain; version=0.0.4")
+
+    def _debug_threads(self, req: "_Request", **params) -> None:
+        buf = io.StringIO()
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            buf.write(f"--- thread {tid} ---\n")
+            buf.write("".join(traceback.format_stack(frame)))
+        req.reply(200, buf.getvalue())
+
+    def _discovery(self, req: "_Request", **params) -> None:
+        targets = []
+        host = req.headers.get("Host", "localhost")
+        for m in self.metrics:
+            if "{nodeName}" in m.path:
+                for node in self.config.list_nodes():
+                    targets.append(
+                        {
+                            "targets": [host],
+                            "labels": {
+                                "metrics_name": m.name,
+                                "__scheme__": "http",
+                                "__metrics_path__": m.path.replace("{nodeName}", node),
+                            },
+                        }
+                    )
+            else:
+                targets.append(
+                    {
+                        "targets": [host],
+                        "labels": {
+                            "metrics_name": m.name,
+                            "__scheme__": "http",
+                            "__metrics_path__": m.path,
+                        },
+                    }
+                )
+        req.reply(200, json.dumps(targets), content_type="application/json")
+
+    # -- logs ----------------------------------------------------------
+    def _container_logs(self, req: "_Request", **params) -> None:
+        ns, pod, container = (
+            params["podNamespace"],
+            params["podID"],
+            params["containerName"],
+        )
+        if self.config.get_pod(ns, pod) is None:
+            req.reply(404, f'pod "{ns}/{pod}" not found')
+            return
+        rule, _ = _resolve_pod_config(self.logs, self.cluster_logs, ns, pod)
+        entry = rule.find(container) if rule is not None else None
+        if entry is None or not entry.logs_file:
+            req.reply(404, f"no logs config for container {container!r}")
+            return
+        q = req.query
+        previous = (q.get("previous") or ["false"])[0].lower() in ("1", "true")
+        logs_file = entry.logs_file
+        if previous:
+            if not entry.previous_logs_file:
+                req.reply(404, f"no previous logs for container {container!r}")
+                return
+            logs_file = entry.previous_logs_file
+        if not os.path.exists(logs_file):
+            req.reply(404, f"log file not found: {logs_file}")
+            return
+        tail_lines = q.get("tailLines") or q.get("tail")
+        follow = (q.get("follow") or ["false"])[0].lower() in ("1", "true")
+        follow = follow or entry.follow
+        with open(logs_file, "rb") as f:
+            data = f.read()
+        if tail_lines:
+            n = int(tail_lines[0])
+            if n >= 0:
+                lines = data.splitlines(keepends=True)
+                data = b"".join(lines[-n:]) if n > 0 else b""
+        if not follow:
+            req.reply(200, data)
+            return
+        req.start_stream(200)
+        req.write(data)
+        offset = len(data)
+        # wall-clock deadline: the injectable config clock may be simulated/frozen
+        deadline = time.monotonic() + float((q.get("timeoutSeconds") or [30])[0])
+        while time.monotonic() < deadline:
+            try:
+                with open(logs_file, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except OSError:
+                break
+            if chunk:
+                if not req.write(chunk):
+                    break
+                offset += len(chunk)
+            time.sleep(0.05)
+        req.end_stream()
+
+    # -- attach --------------------------------------------------------
+    def _attach(self, req: "_Request", **params) -> None:
+        ns, pod, container = (
+            params["podNamespace"],
+            params["podID"],
+            params["containerName"],
+        )
+        if self.config.get_pod(ns, pod) is None:
+            req.reply(404, f'pod "{ns}/{pod}" not found')
+            return
+        rule, _ = _resolve_pod_config(self.attaches, self.cluster_attaches, ns, pod)
+        entry = rule.find(container) if rule is not None else None
+        if entry is None or not entry.logs_file:
+            req.reply(404, f"no attach config for container {container!r}")
+            return
+        if not os.path.exists(entry.logs_file):
+            req.reply(404, f"log file not found: {entry.logs_file}")
+            return
+        with open(entry.logs_file, "rb") as f:
+            req.reply(200, f.read())
+
+    # -- exec ----------------------------------------------------------
+    def _exec(self, req: "_Request", **params) -> None:
+        ns, pod, container = (
+            params["podNamespace"],
+            params["podID"],
+            params["containerName"],
+        )
+        if self.config.get_pod(ns, pod) is None:
+            req.reply(404, f'pod "{ns}/{pod}" not found')
+            return
+        rule, _ = _resolve_pod_config(self.execs, self.cluster_execs, ns, pod)
+        target = rule.find(container) if rule is not None else None
+        if target is None:
+            req.reply(404, f"no exec found for container {container!r}")
+            return
+        if target.local is None:
+            req.reply(400, "not set local exec")
+            return
+        cmd = req.query.get("command") or []
+        if not cmd:
+            req.reply(400, "missing command")
+            return
+        env = dict(os.environ)
+        for e in target.local.envs:
+            env[e.name] = e.value
+        kwargs: Dict[str, Any] = {
+            "env": env,
+            "stdout": subprocess.PIPE,
+            "stderr": subprocess.PIPE,
+        }
+        if target.local.work_dir:
+            kwargs["cwd"] = target.local.work_dir
+        sc = target.local.security_context
+        if sc is not None:
+            if sc.run_as_user is not None:
+                kwargs["user"] = sc.run_as_user
+            if sc.run_as_group is not None:
+                kwargs["group"] = sc.run_as_group
+        stdin_data = req.body if req.body else None
+        if stdin_data is not None:
+            kwargs["stdin"] = subprocess.PIPE
+        try:
+            proc = subprocess.Popen(cmd, **kwargs)
+            out, err = proc.communicate(input=stdin_data, timeout=60)
+        except (OSError, subprocess.TimeoutExpired, PermissionError) as exc:
+            req.reply(500, f"exec failed: {exc}")
+            return
+        if proc.returncode != 0 and not out:
+            req.reply(500, err or f"command exited {proc.returncode}")
+            return
+        req.reply(200, out + (err or b""))
+
+    # -- port forward --------------------------------------------------
+    def _port_forward(self, req: "_Request", **params) -> None:
+        ns, pod = params["podNamespace"], params["podID"]
+        if self.config.get_pod(ns, pod) is None:
+            req.reply(404, f'pod "{ns}/{pod}" not found')
+            return
+        rule, _ = _resolve_pod_config(
+            self.port_forwards, self.cluster_port_forwards, ns, pod
+        )
+        port_q = req.query.get("port")
+        port = int(port_q[0]) if port_q else 0
+        fwd = rule.find(port) if rule is not None else None
+        if fwd is None:
+            req.reply(404, f"no port forward found for port {port}")
+            return
+        payload = req.body or b""
+        if fwd.command:
+            try:
+                proc = subprocess.Popen(
+                    fwd.command,
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                )
+                out, _ = proc.communicate(input=payload, timeout=30)
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                req.reply(500, f"port-forward command failed: {exc}")
+                return
+            req.reply(200, out)
+            return
+        if fwd.target is None:
+            req.reply(400, "no target or command in port forward")
+            return
+        try:
+            with socket.create_connection(
+                (fwd.target.address, fwd.target.port), timeout=10
+            ) as sock:
+                if payload:
+                    sock.sendall(payload)
+                sock.shutdown(socket.SHUT_WR)
+                chunks = []
+                sock.settimeout(10)
+                try:
+                    while True:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            break
+                        chunks.append(chunk)
+                except socket.timeout:
+                    pass
+        except OSError as exc:
+            req.reply(502, f"dial failed: {exc}")
+            return
+        req.reply(200, b"".join(chunks))
+
+    # ------------------------------------------------------------------
+    # HTTP plumbing
+    # ------------------------------------------------------------------
+    def serve(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Start serving in a background thread; returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _dispatch(self):
+                parsed = urlsplit(self.path)
+                resolved = server.router.resolve(self.command, parsed.path)
+                req = _Request(self, parse_qs(parsed.query))
+                if resolved is None:
+                    req.reply(404, "404 page not found")
+                    return
+                handler, params = resolved
+                try:
+                    handler(req, **params)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # surface handler bugs as 500s
+                    if not req.started:
+                        req.reply(500, f"internal error: {exc}")
+
+            def do_GET(self):
+                self._dispatch()
+
+            def do_POST(self):
+                self._dispatch()
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class _Request:
+    """Thin wrapper over BaseHTTPRequestHandler for handlers."""
+
+    def __init__(self, handler: BaseHTTPRequestHandler, query: Dict[str, List[str]]):
+        self.handler = handler
+        self.query = query
+        self.headers = handler.headers
+        self.started = False
+        self._streaming = False
+        length = int(handler.headers.get("Content-Length") or 0)
+        self.body = handler.rfile.read(length) if length else b""
+
+    def reply(self, code: int, body, content_type: str = "text/plain") -> None:
+        data = body.encode() if isinstance(body, str) else bytes(body)
+        self.started = True
+        h = self.handler
+        h.send_response(code)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        try:
+            h.wfile.write(data)
+        except BrokenPipeError:
+            pass
+
+    def start_stream(self, code: int, content_type: str = "text/plain") -> None:
+        self.started = True
+        self._streaming = True
+        h = self.handler
+        h.send_response(code)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+    def write(self, data: bytes) -> bool:
+        if not data:
+            return True
+        h = self.handler
+        try:
+            h.wfile.write(f"{len(data):x}\r\n".encode())
+            h.wfile.write(data)
+            h.wfile.write(b"\r\n")
+            h.wfile.flush()
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
+
+    def end_stream(self) -> None:
+        try:
+            self.handler.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass

@@ -114,6 +114,23 @@ def test_object_selector_empty_matches_all():
     assert sel.matches("anything", "goes")
 
 
+def test_exact_container_match_beats_default():
+    doc = {
+        "kind": "Logs",
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {
+            "logs": [
+                {"logsFile": "/default.log"},
+                {"containers": ["web"], "logsFile": "/web.log"},
+            ]
+        },
+    }
+    lg = Logs.from_dict(doc)
+    # default listed first, but the exact match later must win
+    assert lg.find("web").logs_file == "/web.log"
+    assert lg.find("other").logs_file == "/default.log"
+
+
 def test_logs_find_container():
     doc = {
         "kind": "Logs",

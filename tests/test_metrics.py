@@ -1,0 +1,430 @@
+"""Metrics subsystem tests: collectors, Metric-CR evaluation, usage
+integration, and the vectorized bulk path (reference behaviors from
+pkg/kwok/metrics and pkg/kwok/server/metrics_resource_usage.go)."""
+
+import numpy as np
+import pytest
+import yaml
+
+from kwok_tpu.api.extra_types import ClusterResourceUsage, Metric, ResourceUsage
+from kwok_tpu.metrics.collectors import Counter, Gauge, Histogram, Registry
+from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
+from kwok_tpu.metrics.usage import UsageEvaluator, lower_usage_value
+from kwok_tpu.api.extra_types import ResourceUsageValue
+
+
+# -- collectors -------------------------------------------------------------
+
+
+def test_gauge_counter_expose():
+    r = Registry()
+    g = Gauge("node_cpu", "cpu help", {"node": "n0"})
+    g.set(1.5)
+    c = Counter("starts_total", "", {"node": "n0"})
+    c.set(7)
+    r.register("g", g)
+    r.register("c", c)
+    text = r.expose()
+    assert "# HELP node_cpu cpu help" in text
+    assert "# TYPE node_cpu gauge" in text
+    assert 'node_cpu{node="n0"} 1.5' in text
+    assert "# TYPE starts_total counter" in text
+    assert 'starts_total{node="n0"} 7' in text
+
+
+def test_histogram_distribution_and_hidden_fold():
+    h = Histogram("lat", buckets=[0.1, 1.0])
+    # raw per-le counts; 0.5 is a hidden bucket folded into le=1.0
+    h.set(0.1, 3)
+    h.set(0.5, 2)
+    h.set(1.0, 1)
+    dist, count, total = h.distribution()
+    assert dist == [(0.1, 3), (1.0, 6), (float("inf"), 6)]
+    assert count == 6
+    assert total == pytest.approx(0.1 * 3 + 0.5 * 2 + 1.0 * 1)
+    text = "\n".join(h.samples())
+    assert 'lat_bucket{le="0.1"} 3' in text
+    assert 'lat_bucket{le="1"} 6' in text
+    assert 'lat_bucket{le="+Inf"} 6' in text
+    assert "lat_count 6" in text
+
+
+def test_histogram_value_above_all_buckets():
+    h = Histogram("lat", buckets=[1.0])
+    h.set(5.0, 4)  # lands in +Inf
+    dist, count, _ = h.distribution()
+    assert dist == [(1.0, 0), (float("inf"), 4)]
+    assert count == 4
+
+
+def test_registry_duplicate_and_unregister():
+    r = Registry()
+    r.register("k", Gauge("g"))
+    with pytest.raises(ValueError):
+        r.register("k", Gauge("g"))
+    assert r.unregister("k") is True
+    assert r.unregister("k") is False
+
+
+def test_label_escaping():
+    g = Gauge("g", const_labels={"p": 'a"b\\c\nd'})
+    s = g.samples()[0]
+    assert '\\"' in s and "\\\\" in s and "\\n" in s
+
+
+# -- usage evaluator --------------------------------------------------------
+
+PODS = [
+    {
+        "metadata": {
+            "name": f"pod-{i}",
+            "namespace": "default",
+            "annotations": (
+                {"kwok.x-k8s.io/usage-cpu": "250m", "kwok.x-k8s.io/usage-memory": "64Mi"}
+                if i % 2 == 0
+                else {}
+            ),
+        },
+        "spec": {
+            "nodeName": f"node-{i % 2}",
+            "containers": [{"name": "app"}],
+        },
+        "status": {"phase": "Running"},
+    }
+    for i in range(6)
+]
+NODES = {
+    "node-0": {"metadata": {"name": "node-0"}},
+    "node-1": {"metadata": {"name": "node-1"}},
+}
+
+CRU = ClusterResourceUsage.from_dict(
+    {
+        "kind": "ClusterResourceUsage",
+        "metadata": {"name": "usage-from-annotation"},
+        "spec": {
+            "usages": [
+                {
+                    "usage": {
+                        "cpu": {
+                            "expression": '"kwok.x-k8s.io/usage-cpu" in pod.metadata.annotations ? Quantity(pod.metadata.annotations["kwok.x-k8s.io/usage-cpu"]) : Quantity("1m")'
+                        },
+                        "memory": {"value": "10Mi"},
+                    }
+                }
+            ]
+        },
+    }
+)
+
+
+def make_eval(now=None):
+    pods_by_key = {
+        (p["metadata"]["namespace"], p["metadata"]["name"]): p for p in PODS
+    }
+
+    clock = {"t": 100.0}
+
+    def get_pod(ns, name):
+        return pods_by_key.get((ns, name))
+
+    def get_node(name):
+        return NODES.get(name)
+
+    def list_pods(node_name):
+        return [p for p in PODS if p["spec"]["nodeName"] == node_name]
+
+    ev = UsageEvaluator(get_pod, get_node, list_pods, now=now or (lambda: clock["t"]))
+    ev.set_cluster_usages([CRU])
+    return ev, clock
+
+
+def test_container_usage_annotation_and_fallback():
+    ev, _ = make_eval()
+    assert ev.container_usage("cpu", "default", "pod-0", "app") == pytest.approx(0.25)
+    assert ev.container_usage("cpu", "default", "pod-1", "app") == pytest.approx(0.001)
+    # fixed value wins over nothing
+    assert ev.container_usage("memory", "default", "pod-1", "app") == 10 * 2**20
+    # unknown resource and unknown pod → 0
+    assert ev.container_usage("gpu", "default", "pod-0", "app") == 0.0
+    assert ev.container_usage("cpu", "default", "nope", "app") == 0.0
+
+
+def test_pod_specific_overrides_cluster():
+    ev, _ = make_eval()
+    ru = ResourceUsage.from_dict(
+        {
+            "kind": "ResourceUsage",
+            "metadata": {"name": "pod-1", "namespace": "default"},
+            "spec": {"usages": [{"usage": {"cpu": {"value": "2"}}}]},
+        }
+    )
+    ev.set_usages([ru])
+    assert ev.container_usage("cpu", "default", "pod-1", "app") == pytest.approx(2.0)
+    # pod-0 still resolves via cluster config
+    assert ev.container_usage("cpu", "default", "pod-0", "app") == pytest.approx(0.25)
+
+
+def test_node_usage_sums_pods():
+    ev, _ = make_eval()
+    # node-0 has pods 0,2,4 (annotated 250m); node-1 has 1,3,5 (default 1m)
+    assert ev.node_usage("cpu", "node-0") == pytest.approx(0.75)
+    assert ev.node_usage("cpu", "node-1") == pytest.approx(0.003)
+
+
+def test_cumulative_integration():
+    ev, clock = make_eval()
+    v0 = ev.container_cumulative_usage("cpu", "default", "pod-0", "app")
+    assert v0 == 0.0  # first observation initializes the clock
+    clock["t"] += 10
+    v1 = ev.container_cumulative_usage("cpu", "default", "pod-0", "app")
+    assert v1 == pytest.approx(0.25 * 10)
+    clock["t"] += 4
+    v2 = ev.container_cumulative_usage("cpu", "default", "pod-0", "app")
+    assert v2 == pytest.approx(0.25 * 14)
+
+
+def test_cel_env_usage_hooks():
+    ev, _ = make_eval()
+    b = {
+        "pod": ev.env.pod_var(PODS[0]),
+        "node": ev.env.node_var(NODES["node-0"]),
+        "container": ev.env.container_var({"name": "app"}),
+    }
+    out = ev.env.compile('pod.Usage("cpu", container.name)').eval(b)
+    assert out == pytest.approx(0.25)
+    out = ev.env.compile('node.Usage("cpu")').eval(b)
+    assert out == pytest.approx(0.75)
+
+
+# -- lowering / bulk path ---------------------------------------------------
+
+
+def test_lower_const_value():
+    low = lower_usage_value(ResourceUsageValue(value="100m"))
+    assert low.kind == "const" and low.constant == pytest.approx(0.1)
+    low = lower_usage_value(ResourceUsageValue(expression='Quantity("1Mi")'))
+    assert low.kind == "const" and low.constant == 2**20
+
+
+def test_lower_annotation_ternary():
+    expr = (
+        '"kwok.x-k8s.io/usage-cpu" in pod.metadata.annotations '
+        '? Quantity(pod.metadata.annotations["kwok.x-k8s.io/usage-cpu"]) '
+        ': Quantity("1m")'
+    )
+    low = lower_usage_value(ResourceUsageValue(expression=expr))
+    assert low is not None and low.kind == "annotation"
+    assert low.annotation_key == "kwok.x-k8s.io/usage-cpu"
+    assert low.default == pytest.approx(0.001)
+
+
+def test_lower_fallback_for_general_expression():
+    assert lower_usage_value(ResourceUsageValue(expression="Rand()")) is None
+
+
+def test_bulk_matches_scalar_path():
+    ev, _ = make_eval()
+    bulk = ev.bulk_pod_usage("cpu", PODS)
+    scalar = np.array(
+        [ev.pod_usage("cpu", "default", p["metadata"]["name"]) for p in PODS]
+    )
+    np.testing.assert_allclose(bulk, scalar)
+    by_node = ev.bulk_node_usage("cpu", PODS)
+    assert by_node["node-0"] == pytest.approx(ev.node_usage("cpu", "node-0"))
+    assert by_node["node-1"] == pytest.approx(ev.node_usage("cpu", "node-1"))
+
+
+def test_usage_exact_container_entry_beats_default():
+    ev, _ = make_eval()
+    ru = ResourceUsage.from_dict(
+        {
+            "kind": "ResourceUsage",
+            "metadata": {"name": "pod-0", "namespace": "default"},
+            "spec": {
+                "usages": [
+                    {"usage": {"cpu": {"value": "1"}}},  # default entry first
+                    {"containers": ["app"], "usage": {"cpu": {"value": "3"}}},
+                ]
+            },
+        }
+    )
+    ev.set_usages([ru])
+    assert ev.container_usage("cpu", "default", "pod-0", "app") == pytest.approx(3.0)
+    assert ev.container_usage("cpu", "default", "pod-0", "other") == pytest.approx(1.0)
+
+
+def test_lowered_unparsable_annotation_matches_interpreter():
+    ev, _ = make_eval()
+    bad_pod = {
+        "metadata": {
+            "name": "pod-bad",
+            "namespace": "default",
+            "annotations": {"kwok.x-k8s.io/usage-cpu": "bogus"},
+        },
+        "spec": {"nodeName": "node-0", "containers": [{"name": "app"}]},
+    }
+    bulk = ev.bulk_pod_usage("cpu", [bad_pod])
+    assert bulk[0] == 0.0  # interpreter parity: Quantity error → 0, not default
+
+
+def test_metric_key_escapes_separators():
+    from kwok_tpu.api.extra_types import MetricConfig
+    from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
+
+    mc = MetricConfig(name="m", kind="gauge")
+    k1 = MetricsUpdateHandler._key(mc, {"a": "x|b='y'"})
+    k2 = MetricsUpdateHandler._key(mc, {"a": "x", "b": "y"})
+    assert k1 != k2
+
+
+def test_bulk_with_fallback_rows():
+    ev, _ = make_eval()
+    cru2 = ClusterResourceUsage.from_dict(
+        {
+            "kind": "ClusterResourceUsage",
+            "metadata": {"name": "odd"},
+            "spec": {
+                "selector": {"matchNames": ["pod-1"]},
+                "usages": [{"usage": {"cpu": {"expression": "0.125 + 0.125"}}}],
+            },
+        }
+    )
+    ev.set_cluster_usages([cru2, CRU])
+    bulk = ev.bulk_pod_usage("cpu", PODS)
+    scalar = np.array(
+        [ev.pod_usage("cpu", "default", p["metadata"]["name"]) for p in PODS]
+    )
+    np.testing.assert_allclose(bulk, scalar)
+    assert bulk[1] == pytest.approx(0.25)  # interpreter fallback row
+
+
+# -- Metric CR update handler ----------------------------------------------
+
+METRIC_DOC = yaml.safe_load(
+    """
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Metric
+metadata:
+  name: m
+spec:
+  path: "/metrics/nodes/{nodeName}/metrics/resource"
+  metrics:
+  - name: scrape_error
+    dimension: node
+    kind: gauge
+    value: '0'
+  - name: pod_cpu_usage_seconds_total
+    dimension: pod
+    kind: counter
+    labels:
+    - name: namespace
+      value: 'pod.metadata.namespace'
+    - name: pod
+      value: 'pod.metadata.name'
+    value: 'pod.CumulativeUsage("cpu")'
+  - name: container_memory_working_set_bytes
+    dimension: container
+    kind: gauge
+    labels:
+    - name: container
+      value: 'container.name'
+    - name: pod
+      value: 'pod.metadata.name'
+    value: 'pod.Usage("memory", container.name)'
+"""
+)
+
+
+def make_handler():
+    ev, clock = make_eval()
+    metric = Metric.from_dict(METRIC_DOC)
+
+    def list_pods(node_name):
+        return [p for p in PODS if p["spec"]["nodeName"] == node_name]
+
+    h = MetricsUpdateHandler(metric, ev.env, lambda n: NODES.get(n), list_pods)
+    return h, clock
+
+
+def test_update_handler_expose():
+    h, clock = make_handler()
+    clock["t"] += 5
+    text = h.expose("node-0")
+    assert "scrape_error 0" in text
+    # 3 pods on node-0, each has a counter sample with labels
+    assert text.count("pod_cpu_usage_seconds_total{") == 3
+    assert 'pod="pod-0"' in text
+    assert text.count("container_memory_working_set_bytes{") == 3
+    assert 'container="app"' in text
+    # memory via fixed 10Mi value for un-annotated; annotated pods use 64Mi
+    assert f"{64 * 2**20}" in text or f"{10 * 2**20}" in text
+
+
+def test_update_handler_unregisters_stale():
+    h, _ = make_handler()
+    h.update("node-0")
+    n_before = len(h.registry.keys())
+    # shrink the pod list → stale collectors must be dropped
+    global PODS
+    removed = PODS[4]
+    try:
+        PODS.remove(removed)
+        h.update("node-0")
+        assert len(h.registry.keys()) == n_before - 2  # one counter + one gauge
+        assert all("pod-4" not in k for k in h.registry.keys())
+    finally:
+        PODS.append(removed)
+
+
+def test_update_handler_error_isolation():
+    ev, _ = make_eval()
+    doc = dict(METRIC_DOC, spec={
+        "path": "/m",
+        "metrics": [
+            {"name": "bad", "dimension": "node", "kind": "gauge", "value": "nope("},
+            {"name": "good", "dimension": "node", "kind": "gauge", "value": "1"},
+        ],
+    })
+    errors = []
+    h = MetricsUpdateHandler(
+        Metric.from_dict(doc),
+        ev.env,
+        lambda n: NODES.get(n),
+        lambda n: [],
+        on_error=lambda name, exc: errors.append(name),
+    )
+    text = h.expose("node-0")
+    assert "good 1" in text
+    assert errors == ["bad"]
+
+
+def test_histogram_metric_via_handler():
+    ev, _ = make_eval()
+    doc = {
+        "kind": "Metric",
+        "metadata": {"name": "m"},
+        "spec": {
+            "path": "/m",
+            "metrics": [
+                {
+                    "name": "lat",
+                    "dimension": "node",
+                    "kind": "histogram",
+                    "buckets": [
+                        {"le": 0.5, "value": "2"},
+                        {"le": 0.75, "value": "3", "hidden": True},
+                        {"le": 1.0, "value": "1"},
+                    ],
+                }
+            ],
+        },
+    }
+    h = MetricsUpdateHandler(
+        Metric.from_dict(doc), ev.env, lambda n: NODES.get(n), lambda n: []
+    )
+    text = h.expose("node-0")
+    assert 'lat_bucket{le="0.5"} 2' in text
+    # hidden 0.75 folds into le=1.0: 2+3+1 = 6 cumulative
+    assert 'lat_bucket{le="1"} 6' in text
+    assert 'le="0.75"' not in text
