@@ -1,0 +1,317 @@
+"""HTTP facade over :class:`ResourceStore` — the cluster's API server.
+
+In the reference, the communication backend *is* a real kube-apiserver:
+controllers watch over HTTP/2 streams and write back PATCH/DELETE
+(SURVEY §2.9; reference informer pkg/utils/informer/informer.go:33+,
+patch writers pkg/kwok/controllers/pod_controller.go:370-390).  The
+rebuild keeps that topology — components run as separate OS processes
+wired through an apiserver — but the apiserver itself is this thin HTTP
+layer over the in-process store (kwokctl's binary runtime launches it
+the way the reference launches etcd+kube-apiserver,
+reference runtime/binary/cluster.go:316-728).
+
+REST surface (kind-keyed rather than group/version-keyed; our
+``ResourceType`` carries the apiVersion):
+
+- ``GET  /healthz``                        liveness (components poll it
+  the way kwokctl polls a real apiserver's /healthz)
+- ``GET  /apis``                           type discovery
+- ``POST /apis``                           register a type (CRD create)
+- ``GET  /r/{plural}``                     list; query params
+  ``namespace`` ``labelSelector`` ``fieldSelector``
+- ``GET  /r/{plural}?watch=1&resourceVersion=N``  newline-delimited
+  JSON watch stream (``{"type","object","rv"}``, BOOKMARK heartbeats)
+- ``POST /r/{plural}``                     create
+- ``GET/PUT/PATCH/DELETE /r/{plural}/{name}``     single object; query
+  params ``namespace`` ``subresource``; PATCH type from Content-Type
+  (application/{merge-patch,json-patch,strategic-merge-patch}+json)
+- ``GET  /stats``                          resourceVersion + counts
+
+Impersonation rides the ``Impersonate-User`` header (reference
+stage_controller.go:341-378 patchResource w/ impersonation).
+
+Errors map NotFound→404, Conflict→409, Expired→410, bad input→400,
+each with a JSON body ``{"error", "reason"}``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from dataclasses import asdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from kwok_tpu.cluster.store import (
+    Conflict,
+    Expired,
+    NotFound,
+    ResourceStore,
+    ResourceType,
+)
+
+__all__ = ["APIServer", "PATCH_CONTENT_TYPES"]
+
+#: Content-Type → store patch_type (reference uses the same three k8s
+#: patch media types, controllers/utils.go:162-304)
+PATCH_CONTENT_TYPES = {
+    "application/merge-patch+json": "merge",
+    "application/json-patch+json": "json",
+    "application/strategic-merge-patch+json": "strategic",
+}
+
+#: watch heartbeat cadence; lets both ends detect dead peers
+_BOOKMARK_EVERY = 15.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kwok-tpu-apiserver"
+
+    # the server object stuffs the store onto the class
+    store: ResourceStore = None  # type: ignore[assignment]
+
+    def log_message(self, fmt, *args):  # quiet; audit lives in the store
+        pass
+
+    # ------------------------------------------------------------- plumbing
+
+    def _send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error(self, exc: Exception) -> None:
+        code, reason = 500, "Internal"
+        if isinstance(exc, NotFound):
+            code, reason = 404, "NotFound"
+        elif isinstance(exc, Conflict):
+            code, reason = 409, "Conflict"
+        elif isinstance(exc, Expired):
+            code, reason = 410, "Expired"
+        elif isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
+            code, reason = 400, "BadRequest"
+        self._send_json(code, {"error": str(exc), "reason": reason})
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        return json.loads(raw) if raw else None
+
+    def _route(self) -> Tuple[str, list, dict]:
+        u = urlsplit(self.path)
+        parts = [p for p in u.path.split("/") if p]
+        q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+        return (parts[0] if parts else ""), parts[1:], q
+
+    def _user(self) -> Optional[str]:
+        return self.headers.get("Impersonate-User") or None
+
+    @staticmethod
+    def _ns(q: dict) -> Optional[str]:
+        return q.get("namespace") or None
+
+    # --------------------------------------------------------------- verbs
+
+    def do_GET(self):
+        head, rest, q = self._route()
+        try:
+            if head == "healthz" or head == "readyz" or head == "livez":
+                self._send_json(200, {"status": "ok"})
+            elif head == "apis":
+                self._send_json(
+                    200, {"resources": [asdict(t) for t in self.store.kinds()]}
+                )
+            elif head == "stats":
+                counts = {
+                    t.plural: self.store.count(t.kind) for t in self.store.kinds()
+                }
+                self._send_json(
+                    200,
+                    {"resourceVersion": self.store.resource_version, "counts": counts},
+                )
+            elif head == "r" and len(rest) == 1:
+                if q.get("watch"):
+                    self._serve_watch(rest[0], q)
+                else:
+                    items, rv = self.store.list(
+                        rest[0],
+                        namespace=self._ns(q),
+                        label_selector=q.get("labelSelector"),
+                        field_selector=q.get("fieldSelector"),
+                    )
+                    self._send_json(200, {"items": items, "resourceVersion": str(rv)})
+            elif head == "r" and len(rest) == 2:
+                obj = self.store.get(rest[0], rest[1], namespace=self._ns(q))
+                self._send_json(200, obj)
+            else:
+                self._send_json(404, {"error": "no such route", "reason": "NotFound"})
+        except Exception as exc:  # noqa: BLE001 — translated to HTTP
+            try:
+                self._send_error(exc)
+            except (BrokenPipeError, ConnectionError):
+                pass
+
+    def do_POST(self):
+        head, rest, q = self._route()
+        try:
+            body = self._read_body()
+            if head == "apis":
+                self.store.register_type(
+                    ResourceType(
+                        api_version=body["api_version"],
+                        kind=body["kind"],
+                        plural=body["plural"],
+                        namespaced=bool(body.get("namespaced", True)),
+                    )
+                )
+                self._send_json(201, {"status": "registered"})
+            elif head == "r" and len(rest) == 1:
+                out = self.store.create(
+                    body, namespace=self._ns(q), as_user=self._user()
+                )
+                self._send_json(201, out)
+            else:
+                self._send_json(404, {"error": "no such route", "reason": "NotFound"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error(exc)
+
+    def do_PUT(self):
+        head, rest, q = self._route()
+        try:
+            body = self._read_body()
+            if head == "r" and len(rest) == 2:
+                out = self.store.update(
+                    body, subresource=q.get("subresource") or "", as_user=self._user()
+                )
+                self._send_json(200, out)
+            else:
+                self._send_json(404, {"error": "no such route", "reason": "NotFound"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error(exc)
+
+    def do_PATCH(self):
+        head, rest, q = self._route()
+        try:
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            patch_type = PATCH_CONTENT_TYPES.get(ctype, "merge")
+            body = self._read_body()
+            if head == "r" and len(rest) == 2:
+                out = self.store.patch(
+                    rest[0],
+                    rest[1],
+                    body,
+                    patch_type=patch_type,
+                    namespace=self._ns(q),
+                    subresource=q.get("subresource") or "",
+                    as_user=self._user(),
+                )
+                self._send_json(200, out)
+            else:
+                self._send_json(404, {"error": "no such route", "reason": "NotFound"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error(exc)
+
+    def do_DELETE(self):
+        head, rest, q = self._route()
+        try:
+            if head == "r" and len(rest) == 2:
+                out = self.store.delete(
+                    rest[0], rest[1], namespace=self._ns(q), as_user=self._user()
+                )
+                self._send_json(200, out if out is not None else {"status": "deleted"})
+            else:
+                self._send_json(404, {"error": "no such route", "reason": "NotFound"})
+        except Exception as exc:  # noqa: BLE001
+            self._send_error(exc)
+
+    # --------------------------------------------------------------- watch
+
+    def _serve_watch(self, plural: str, q: dict) -> None:
+        since = q.get("resourceVersion")
+        w = self.store.watch(
+            plural,
+            namespace=self._ns(q),
+            since_rv=int(since) if since else None,
+            label_selector=q.get("labelSelector"),
+            field_selector=q.get("fieldSelector"),
+        )
+        # Connection: close + unframed NDJSON until either side hangs up
+        # (one TCP connection per watch, like a real apiserver watch).
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json; stream=watch")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            idle = 0.0
+            while True:
+                ev = w.next(timeout=0.25)
+                if ev is None:
+                    idle += 0.25
+                    if idle >= _BOOKMARK_EVERY:
+                        idle = 0.0
+                        self._write_line(
+                            {"type": "BOOKMARK", "rv": self.store.resource_version}
+                        )
+                    continue
+                idle = 0.0
+                self._write_line({"type": ev.type, "object": ev.object, "rv": ev.rv})
+        except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            w.stop()
+
+    def _write_line(self, payload: dict) -> None:
+        self.wfile.write(json.dumps(payload).encode() + b"\n")
+        self.wfile.flush()
+
+
+class APIServer:
+    """Serve a :class:`ResourceStore` over HTTP.
+
+    The kwokctl binary runtime runs one of these per cluster (stand-in
+    for the reference's etcd + kube-apiserver pair) and points every
+    other component's ``--kubeconfig``-equivalent at it.
+    """
+
+    def __init__(self, store: ResourceStore, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        self.store = store
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1}, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # context-manager sugar for tests
+    def __enter__(self) -> "APIServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

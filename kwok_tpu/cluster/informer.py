@@ -163,6 +163,11 @@ class Informer:
                     while not done.is_set():
                         ev = w.next(timeout=0.2)
                         if ev is None:
+                            if w.stopped:
+                                # stream died underneath us (remote watch
+                                # connection lost): re-list and re-watch,
+                                # the reflector resume path
+                                break
                             continue
                         obj = ev.object
                         if opt.predicate is not None and not opt.predicate(obj):
@@ -178,7 +183,8 @@ class Informer:
                         if use_cache:
                             getter._apply(ev.type, obj)
                         events.add(InformerEvent(ev.type, obj))
-                    return
+                    # fall through: either done was set (outer loop exits)
+                    # or the stream died (outer loop re-lists + re-watches)
                 finally:
                     w.stop()
 

@@ -1,0 +1,305 @@
+"""apiserver facade + REST client: the cross-process cluster bus.
+
+Covers the client-go-equivalent surface (SURVEY §2.9): CRUD round-trip,
+patch media types, subresource scoping, impersonation, watch streams
+(with resourceVersion resume), type registration (CRDs), and an
+informer running unchanged against the remote client."""
+
+import threading
+import time
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.informer import Informer, WatchOptions
+from kwok_tpu.cluster.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    Conflict,
+    NotFound,
+    ResourceStore,
+    ResourceType,
+)
+from kwok_tpu.utils.queue import Queue
+
+
+@pytest.fixture()
+def cluster():
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        yield store, ClusterClient(srv.url)
+
+
+def make_pod(name, ns="default", node="node-1"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": {"app": name}},
+        "spec": {"nodeName": node, "containers": [{"name": "c", "image": "i"}]},
+        "status": {},
+    }
+
+
+def test_healthz_and_ready(cluster):
+    _, client = cluster
+    assert client.healthy()
+    assert client.wait_ready(timeout=2)
+
+
+def test_crud_roundtrip(cluster):
+    store, client = cluster
+    created = client.create(make_pod("a"))
+    assert created["metadata"]["uid"]
+    assert store.get("Pod", "a")["metadata"]["uid"] == created["metadata"]["uid"]
+
+    got = client.get("Pod", "a")
+    assert got["metadata"]["name"] == "a"
+
+    got["spec"]["nodeName"] = "node-2"
+    updated = client.update(got)
+    assert updated["spec"]["nodeName"] == "node-2"
+
+    assert client.delete("Pod", "a") is None
+    with pytest.raises(NotFound):
+        client.get("Pod", "a")
+
+
+def test_conflict_and_rv_mismatch(cluster):
+    _, client = cluster
+    client.create(make_pod("a"))
+    with pytest.raises(Conflict):
+        client.create(make_pod("a"))
+    stale = client.get("Pod", "a")
+    client.patch("Pod", "a", {"spec": {"nodeName": "n2"}})
+    stale["metadata"]["resourceVersion"] = "1"
+    with pytest.raises(Conflict):
+        client.update(stale)
+
+
+def test_list_with_selectors(cluster):
+    _, client = cluster
+    client.create(make_pod("a", node="n1"))
+    client.create(make_pod("b", node="n2"))
+    client.create(make_pod("c", ns="kube-system", node="n1"))
+
+    items, rv = client.list("Pod")
+    assert {i["metadata"]["name"] for i in items} == {"a", "b", "c"}
+    assert rv > 0
+
+    items, _ = client.list("Pod", namespace="default")
+    assert {i["metadata"]["name"] for i in items} == {"a", "b"}
+
+    items, _ = client.list("Pod", label_selector={"app": "a"})
+    assert [i["metadata"]["name"] for i in items] == ["a"]
+
+    items, _ = client.list("Pod", field_selector="spec.nodeName=n1")
+    assert {i["metadata"]["name"] for i in items} == {"a", "c"}
+
+
+def test_patch_types_and_subresource(cluster):
+    store, client = cluster
+    client.create(make_pod("a"))
+
+    out = client.patch("Pod", "a", {"status": {"phase": "Running"}}, patch_type="merge")
+    assert out["status"]["phase"] == "Running"
+
+    out = client.patch(
+        "Pod",
+        "a",
+        [{"op": "add", "path": "/metadata/finalizers", "value": ["kwok.x-k8s.io/f"]}],
+        patch_type="json",
+    )
+    assert out["metadata"]["finalizers"] == ["kwok.x-k8s.io/f"]
+
+    # subresource patch may only touch that subtree
+    out = client.patch(
+        "Pod",
+        "a",
+        {"status": {"phase": "Failed"}, "spec": {"nodeName": "EVIL"}},
+        patch_type="strategic",
+        subresource="status",
+    )
+    assert out["status"]["phase"] == "Failed"
+    assert out["spec"]["nodeName"] == "node-1"
+
+    # finalizer-graceful delete: object survives with deletionTimestamp
+    obj = client.delete("Pod", "a")
+    assert obj["metadata"]["deletionTimestamp"]
+    out = client.patch(
+        "Pod",
+        "a",
+        [{"op": "remove", "path": "/metadata/finalizers"}],
+        patch_type="json",
+    )
+    with pytest.raises(NotFound):
+        client.get("Pod", "a")
+
+
+def test_impersonation_rides_header(cluster):
+    store, client = cluster
+    client.create(make_pod("a"), as_user="system:fake-admin")
+    verbs = [(v, u) for v, k, u in store.audit_log() if v == "create" and "Pod" in k]
+    assert verbs[-1][1] == "system:fake-admin"
+
+
+def test_register_type_and_cr_crud(cluster):
+    _, client = cluster
+    client.register_type(
+        ResourceType("example.com/v1", "Widget", "widgets", namespaced=True)
+    )
+    client.create(
+        {
+            "apiVersion": "example.com/v1",
+            "kind": "Widget",
+            "metadata": {"name": "w", "namespace": "default"},
+            "spec": {"size": 3},
+        }
+    )
+    got = client.get("Widget", "w")
+    assert got["spec"]["size"] == 3
+    # second client discovers the type from /apis
+    c2 = ClusterClient(f"http://{client._hostport}")
+    assert c2.resource_type("widgets").kind == "Widget"
+
+
+def test_watch_stream_and_resume(cluster):
+    store, client = cluster
+    client.create(make_pod("a"))
+    rv_before = client.resource_version
+
+    w = client.watch("Pod", since_rv=0)
+    seen = []
+    deadline = time.monotonic() + 5
+    while len(seen) < 1 and time.monotonic() < deadline:
+        ev = w.next(timeout=0.2)
+        if ev:
+            seen.append(ev)
+    assert seen[0].type == ADDED and seen[0].object["metadata"]["name"] == "a"
+
+    client.patch("Pod", "a", {"status": {"phase": "Running"}})
+    client.delete("Pod", "a")
+    got = []
+    deadline = time.monotonic() + 5
+    while len(got) < 2 and time.monotonic() < deadline:
+        ev = w.next(timeout=0.2)
+        if ev:
+            got.append(ev)
+    assert [e.type for e in got] == [MODIFIED, DELETED]
+    w.stop()
+
+    # resume from a known rv only sees later events
+    client.create(make_pod("b"))
+    w2 = client.watch("Pod", since_rv=rv_before)
+    names = set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        ev = w2.next(timeout=0.2)
+        if ev is None:
+            if names:
+                break
+            continue
+        names.add((ev.type, ev.object["metadata"]["name"]))
+        if (ADDED, "b") in names:
+            break
+    assert (ADDED, "b") in names
+    assert all(not (t == ADDED and n == "a") for t, n in names)
+    w2.stop()
+
+
+def test_informer_over_remote_client(cluster):
+    """The informer runs byte-identical against store or client."""
+    store, client = cluster
+    client.create(make_pod("a"))
+
+    events = Queue()
+    done = threading.Event()
+    inf = Informer(client, "Pod")
+    cache = inf.watch_with_cache(WatchOptions(), events, done=done)
+
+    deadline = time.monotonic() + 5
+    while len(cache) < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cache.get("a", "default")["metadata"]["name"] == "a"
+
+    store.create(make_pod("b"))  # server-side write propagates
+    deadline = time.monotonic() + 5
+    while len(cache) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cache.get("b", "default") is not None
+
+    store.delete("Pod", "b")
+    deadline = time.monotonic() + 5
+    while len(cache) > 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert cache.get("b", "default") is None
+    done.set()
+
+
+def test_full_controller_over_remote_client(cluster):
+    """End-to-end, reference topology: controller process ↔ apiserver
+    over HTTP (SURVEY §3.2's hot path with a process boundary in the
+    middle).  Node initializes, pod reaches Running, delete completes."""
+    from kwok_tpu.api.config import KwokConfiguration
+    from kwok_tpu.controllers.controller import Controller
+    from kwok_tpu.stages import default_node_stages, default_pod_stages
+
+    store, client = cluster
+    ctr = Controller(
+        client,
+        KwokConfiguration(manage_all_nodes=True),
+        local_stages={
+            "Node": default_node_stages(lease=True),
+            "Pod": default_pod_stages(),
+        },
+        seed=0,
+    )
+    ctr.start()
+    try:
+        node = {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": "node-0"},
+            "spec": {},
+            "status": {},
+        }
+        client.create(node)
+        client.create(make_pod("p0", node="node-0"))
+
+        def pod_running():
+            try:
+                return store.get("Pod", "p0")["status"].get("phase") == "Running"
+            except KeyError:
+                return False
+
+        deadline = time.monotonic() + 20
+        while not pod_running() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pod_running(), store.get("Pod", "p0").get("status")
+
+        # node got initialized + a lease was acquired over the wire
+        conds = store.get("Node", "node-0")["status"].get("conditions", [])
+        assert any(c["type"] == "Ready" and c["status"] == "True" for c in conds)
+        assert store.count("Lease") >= 1
+
+        client.delete("Pod", "p0")
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                store.get("Pod", "p0")
+            except KeyError:
+                break
+            time.sleep(0.05)
+        with pytest.raises(NotFound):
+            store.get("Pod", "p0")
+    finally:
+        ctr.stop()
+
+
+def test_stats(cluster):
+    _, client = cluster
+    client.create(make_pod("a"))
+    client.create(make_pod("b"))
+    assert client.count("Pod") == 2
+    assert client.resource_version >= 2
