@@ -1,0 +1,72 @@
+"""action/v1alpha1 API: the record/replay wire format.
+
+Mirrors the reference's ``ResourcePatch`` action type
+(reference: pkg/apis/action/v1alpha1/resource_patch_types.go:35-77):
+one document per observed mutation, carrying the resource type, the
+target object, the time offset from the start of the recording, the
+method (create/patch/delete), and the raw object template.
+
+The reference keys resources by GVR (group/version/resource); this
+framework's store is kind-keyed with the apiVersion carried alongside
+(cluster/store.py ``ResourceType``), so ``resource`` here is
+``{apiVersion, kind}``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+ACTION_API_VERSION = "action.kwok.x-k8s.io/v1alpha1"
+
+#: ResourcePatch.method values (resource_patch_types.go:66-73)
+METHOD_CREATE = "create"
+METHOD_PATCH = "patch"
+METHOD_DELETE = "delete"
+
+
+@dataclass
+class ResourcePatch:
+    """One recorded mutation."""
+
+    #: {"apiVersion": ..., "kind": ...}
+    resource: Dict[str, str] = field(default_factory=dict)
+    #: {"name": ..., "namespace": ...} (namespace empty for cluster scope)
+    target: Dict[str, str] = field(default_factory=dict)
+    #: offset from recording start (reference DurationNanosecond)
+    duration_nanosecond: int = 0
+    method: str = METHOD_PATCH
+    #: full object for create/patch (merge-patch semantics on replay)
+    template: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "apiVersion": ACTION_API_VERSION,
+            "kind": "ResourcePatch",
+            "resource": dict(self.resource),
+            "target": dict(self.target),
+            "durationNanosecond": int(self.duration_nanosecond),
+            "method": self.method,
+        }
+        if self.template is not None:
+            d["template"] = self.template
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResourcePatch":
+        if d.get("kind") != "ResourcePatch":
+            raise ValueError(f"not a ResourcePatch document: kind={d.get('kind')!r}")
+        return cls(
+            resource=dict(d.get("resource") or {}),
+            target=dict(d.get("target") or {}),
+            duration_nanosecond=int(d.get("durationNanosecond") or 0),
+            method=d.get("method") or METHOD_PATCH,
+            template=d.get("template"),
+        )
+
+    @staticmethod
+    def is_resource_patch(doc: Dict[str, Any]) -> bool:
+        return (
+            doc.get("kind") == "ResourcePatch"
+            and doc.get("apiVersion", ACTION_API_VERSION) == ACTION_API_VERSION
+        )

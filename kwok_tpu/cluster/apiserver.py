@@ -42,7 +42,7 @@ import threading
 from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
-from urllib.parse import parse_qs, urlsplit
+from urllib.parse import parse_qs, unquote, urlsplit
 
 from kwok_tpu.cluster.store import (
     Conflict,
@@ -105,7 +105,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route(self) -> Tuple[str, list, dict]:
         u = urlsplit(self.path)
-        parts = [p for p in u.path.split("/") if p]
+        parts = [unquote(p) for p in u.path.split("/") if p]
         q = {k: v[-1] for k, v in parse_qs(u.query).items()}
         return (parts[0] if parts else ""), parts[1:], q
 
@@ -224,7 +224,15 @@ class _Handler(BaseHTTPRequestHandler):
                 out = self.store.delete(
                     rest[0], rest[1], namespace=self._ns(q), as_user=self._user()
                 )
-                self._send_json(200, out if out is not None else {"status": "deleted"})
+                if out is None:
+                    # fully gone → 204; graceful (finalizers pending) → 200
+                    # with the live object. Status code, not body sniffing,
+                    # distinguishes the two.
+                    self.send_response(204)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self._send_json(200, out)
             else:
                 self._send_json(404, {"error": "no such route", "reason": "NotFound"})
         except Exception as exc:  # noqa: BLE001
@@ -248,9 +256,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "close")
         self.end_headers()
         self.close_connection = True
+        shutdown = getattr(self.server, "shutting_down", None)
         try:
             idle = 0.0
-            while True:
+            while shutdown is None or not shutdown.is_set():
                 ev = w.next(timeout=0.25)
                 if ev is None:
                     idle += 0.25
@@ -284,6 +293,8 @@ class APIServer:
         handler = type("BoundHandler", (_Handler,), {"store": store})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        # watch handler loops poll this so stop() actually ends them
+        self._httpd.shutting_down = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.store = store
 
@@ -304,6 +315,7 @@ class APIServer:
         return self
 
     def stop(self) -> None:
+        self._httpd.shutting_down.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:

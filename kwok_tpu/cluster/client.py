@@ -204,6 +204,14 @@ class ClusterClient:
         return ("?" + urlencode(clean)) if clean else ""
 
     @staticmethod
+    def _esc(segment: str) -> str:
+        """Path-escape an object name; the in-process store accepts any
+        name, so the wire form must too."""
+        from urllib.parse import quote
+
+        return quote(segment, safe="")
+
+    @staticmethod
     def _sel(sel: Selector) -> Optional[str]:
         if sel is None:
             return None
@@ -285,7 +293,9 @@ class ClusterClient:
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> dict:
         plural = self.resource_type(kind).plural
-        return self._request("GET", f"/r/{plural}/{name}" + self._q(namespace=namespace))
+        return self._request(
+            "GET", f"/r/{plural}/{self._esc(name)}" + self._q(namespace=namespace)
+        )
 
     def list(
         self,
@@ -313,7 +323,7 @@ class ClusterClient:
         name = (obj.get("metadata") or {}).get("name") or ""
         return self._request(
             "PUT",
-            f"/r/{plural}/{name}" + self._q(subresource=subresource),
+            f"/r/{plural}/{self._esc(name)}" + self._q(subresource=subresource),
             body=obj,
             headers=self._user_hdr(as_user),
         )
@@ -335,7 +345,8 @@ class ClusterClient:
             headers.update(user)
         return self._request(
             "PATCH",
-            f"/r/{plural}/{name}" + self._q(namespace=namespace, subresource=subresource),
+            f"/r/{plural}/{self._esc(name)}"
+            + self._q(namespace=namespace, subresource=subresource),
             body=data,
             headers=headers,
         )
@@ -344,14 +355,11 @@ class ClusterClient:
         self, kind: str, name: str, namespace: Optional[str] = None, as_user: Optional[str] = None
     ) -> Optional[dict]:
         plural = self.resource_type(kind).plural
-        out = self._request(
+        return self._request(
             "DELETE",
-            f"/r/{plural}/{name}" + self._q(namespace=namespace),
+            f"/r/{plural}/{self._esc(name)}" + self._q(namespace=namespace),
             headers=self._user_hdr(as_user),
         )
-        if isinstance(out, dict) and out.get("status") == "deleted":
-            return None
-        return out
 
     # --------------------------------------------------------------- watch
 

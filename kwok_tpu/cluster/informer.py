@@ -119,8 +119,20 @@ class Informer:
         done = done or threading.Event()
 
         def loop():
+            backoff = 0.1
             while not done.is_set():
-                items, rv = self._list(opt)
+                try:
+                    items, rv = self._list(opt)
+                except Expired:
+                    continue
+                except Exception:  # noqa: BLE001 — transient apiserver outage
+                    # reflector retry-with-backoff: a dead apiserver must
+                    # not kill the watch thread (client-go reflectors
+                    # behave the same way)
+                    done.wait(backoff)
+                    backoff = min(backoff * 2, 5.0)
+                    continue
+                backoff = 0.1
                 if use_cache:
                     # reconcile: reflector "replace" semantics. Objects
                     # that vanished during a watch gap surface as DELETED;
@@ -158,6 +170,10 @@ class Informer:
                         field_selector=opt.field_selector,
                     )
                 except Expired:
+                    continue
+                except Exception:  # noqa: BLE001 — transient apiserver outage
+                    done.wait(backoff)
+                    backoff = min(backoff * 2, 5.0)
                     continue
                 try:
                     while not done.is_set():

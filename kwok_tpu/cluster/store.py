@@ -29,6 +29,7 @@ from __future__ import annotations
 import copy
 import datetime
 import threading
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
@@ -522,11 +523,19 @@ class EventRecorder:
         self._mut = threading.Lock()
         self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
 
+    @staticmethod
+    def _now_string() -> str:
+        """Event timestamps are client-side in k8s (the recording
+        component's clock), so no store round-trip here — this also
+        keeps the recorder store/client agnostic."""
+        t = datetime.datetime.now(datetime.timezone.utc)
+        return t.isoformat(timespec="seconds").replace("+00:00", "Z")
+
     def event(self, involved: dict, etype: str, reason: str, message: str) -> dict:
         meta = involved.get("metadata") or {}
         key = (meta.get("uid"), etype, reason, message)
         ns = meta.get("namespace") or "default"
-        now = self._store._now_string()
+        now = self._now_string()
         with self._mut:
             name = self._keys.get(key)
             if name is not None:
@@ -542,7 +551,7 @@ class EventRecorder:
                     )
                 except NotFound:
                     del self._keys[key]
-            name = f"{meta.get('name', 'unknown')}.{self._store.resource_version + 1:x}"
+            name = f"{meta.get('name', 'unknown')}.{time.monotonic_ns():x}"
             ev = {
                 "apiVersion": "v1",
                 "kind": "Event",

@@ -1,0 +1,19 @@
+"""Snapshot / record / replay (reference pkg/kwokctl/{snapshot,recording}).
+
+Three levels, mirroring SURVEY §5 "checkpoint/resume":
+
+- :func:`save` / :func:`load` — cluster-level YAML export/import with
+  owner-reference re-linking (reference snapshot/{save,load}.go).
+- :class:`Recorder` — watch every kind, append each mutation as a
+  time-offset :class:`ResourcePatch` document after the full dump
+  (reference snapshot/save.go:202-302 Record).
+- :func:`replay` + :class:`PlaybackHandle` — re-apply the patch stream
+  on its original timeline with pause/speed control
+  (reference replay + recording/{handle,speed}.go).
+"""
+
+from kwok_tpu.snapshot.snapshot import load, save, save_to
+from kwok_tpu.snapshot.record import Recorder
+from kwok_tpu.snapshot.replay import PlaybackHandle, replay
+
+__all__ = ["save", "save_to", "load", "Recorder", "replay", "PlaybackHandle"]

@@ -1,0 +1,93 @@
+"""Recording: watch all kinds, append mutations as ResourcePatch docs.
+
+Reference behavior (snapshot/save.go:202-302 ``Record``): after the
+full snapshot dump, every watch event becomes a ``ResourcePatch`` with
+a nanosecond offset from the recording's start; the stream is appended
+to the same file so one artifact replays the whole session.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import IO, Iterable, List, Optional
+
+import yaml
+
+from kwok_tpu.api.action import (
+    METHOD_CREATE,
+    METHOD_DELETE,
+    METHOD_PATCH,
+    ResourcePatch,
+)
+from kwok_tpu.cluster.store import ADDED, DELETED
+from kwok_tpu.snapshot.snapshot import DEFAULT_SKIP_KINDS, save
+
+
+class Recorder:
+    """Record a live cluster to a YAML stream."""
+
+    def __init__(self, store, kinds: Optional[Iterable[str]] = None):
+        self._store = store
+        if kinds is None:
+            kinds = [
+                t.kind for t in store.kinds() if t.kind not in DEFAULT_SKIP_KINDS
+            ]
+        self._kinds = list(kinds)
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._write_mut = threading.Lock()
+        self._t0 = 0.0
+
+    def start(self, sink: IO[str], snapshot: bool = True) -> "Recorder":
+        """Dump the current state (unless ``snapshot=False``), then
+        stream ResourcePatch docs for every subsequent mutation."""
+        if snapshot:
+            sink.write(save(self._store, self._kinds))
+        sink.flush()
+        self._t0 = time.monotonic()
+        for kind in self._kinds:
+            rv = self._store.list(kind)[1]
+            w = self._store.watch(kind, since_rv=rv)
+            t = threading.Thread(
+                target=self._pump, args=(kind, w, sink), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _pump(self, kind: str, watcher, sink: IO[str]) -> None:
+        rtype = self._store.resource_type(kind)
+        try:
+            while not self._stop.is_set():
+                ev = watcher.next(timeout=0.2)
+                if ev is None:
+                    if getattr(watcher, "stopped", False):
+                        return
+                    continue
+                obj = ev.object
+                meta = obj.get("metadata") or {}
+                method = {ADDED: METHOD_CREATE, DELETED: METHOD_DELETE}.get(
+                    ev.type, METHOD_PATCH
+                )
+                rp = ResourcePatch(
+                    resource={"apiVersion": rtype.api_version, "kind": rtype.kind},
+                    target={
+                        "name": meta.get("name") or "",
+                        "namespace": meta.get("namespace") or "",
+                    },
+                    duration_nanosecond=int((time.monotonic() - self._t0) * 1e9),
+                    method=method,
+                    template=None if method == METHOD_DELETE else obj,
+                )
+                with self._write_mut:
+                    sink.write("---\n")
+                    yaml.safe_dump(rp.to_dict(), sink, sort_keys=False)
+                    sink.flush()
+        finally:
+            watcher.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

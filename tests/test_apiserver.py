@@ -303,3 +303,45 @@ def test_stats(cluster):
     client.create(make_pod("b"))
     assert client.count("Pod") == 2
     assert client.resource_version >= 2
+
+
+def test_odd_object_names_roundtrip(cluster):
+    """The store accepts any name; the wire path must escape it."""
+    _, client = cluster
+    for name in ("a b", "x/y", "q?v", "h#f"):
+        client.create(make_pod(name))
+        assert client.get("Pod", name)["metadata"]["name"] == name
+        client.patch("Pod", name, {"status": {"phase": "Running"}})
+        assert client.get("Pod", name)["status"]["phase"] == "Running"
+        assert client.delete("Pod", name) is None
+
+
+def test_event_recorder_over_remote_client(cluster):
+    """EventRecorder (used by every controller) is store/client
+    agnostic: events record and aggregate over the wire."""
+    from kwok_tpu.cluster.store import EventRecorder
+
+    store, client = cluster
+    pod = client.create(make_pod("a"))
+    rec = EventRecorder(client, source="kwok")
+    rec.event(pod, "Normal", "Created", "Pod created")
+    rec.event(pod, "Normal", "Created", "Pod created")
+    events, _ = store.list("Event")
+    assert len(events) == 1
+    assert events[0]["count"] == 2
+    assert events[0]["involvedObject"]["name"] == "a"
+
+
+def test_watch_ends_when_server_stops():
+    """stop() must terminate active watch handler threads."""
+    store = ResourceStore()
+    srv = APIServer(store).start()
+    client = ClusterClient(srv.url)
+    w = client.watch("Pod")
+    time.sleep(0.2)
+    srv.stop()
+    deadline = time.monotonic() + 5
+    while not w.stopped and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert w.stopped
+    assert not store._state("Pod").watchers  # server-side watcher dropped

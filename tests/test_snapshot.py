@@ -1,0 +1,209 @@
+"""Snapshot save/load, record, replay (reference pkg/kwokctl/snapshot +
+recording; SURVEY §5 checkpoint/resume)."""
+
+import io
+import threading
+import time
+
+import yaml
+
+from kwok_tpu.api.action import ResourcePatch
+from kwok_tpu.cluster.store import NotFound, ResourceStore
+from kwok_tpu.snapshot import PlaybackHandle, Recorder, load, replay, save
+from kwok_tpu.snapshot.replay import parse_recording
+
+
+def make_node(name):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name},
+        "spec": {},
+        "status": {},
+    }
+
+
+def make_pod(name, node="n0", owner=None, ns="default"):
+    meta = {"name": name, "namespace": ns}
+    if owner is not None:
+        meta["ownerReferences"] = [
+            {
+                "apiVersion": owner["apiVersion"],
+                "kind": owner["kind"],
+                "name": owner["metadata"]["name"],
+                "uid": owner["metadata"]["uid"],
+            }
+        ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta,
+        "spec": {"nodeName": node, "containers": [{"name": "c", "image": "i"}]},
+        "status": {},
+    }
+
+
+def test_save_load_roundtrip_with_owner_relink():
+    src = ResourceStore()
+    node = src.create(make_node("n0"))
+    src.create(make_pod("p0", owner=node))
+    src.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {"name": "prod"},
+        }
+    )
+    src.patch("Pod", "p0", {"status": {"phase": "Running"}})
+
+    text = save(src)
+
+    dst = ResourceStore()
+    created = load(dst, text)
+    assert len(created) == 3
+
+    # pod's ownerReference was re-linked to the *new* node UID
+    new_node_uid = dst.get("Node", "n0")["metadata"]["uid"]
+    ref = dst.get("Pod", "p0")["metadata"]["ownerReferences"][0]
+    assert ref["uid"] == new_node_uid
+    assert ref["uid"] != node["metadata"]["uid"]
+    # status came across
+    assert dst.get("Pod", "p0")["status"]["phase"] == "Running"
+
+
+def test_load_owner_appears_later_in_stream():
+    """Owner documents after their dependents exercise the pending path."""
+    src = ResourceStore()
+    node = src.create(make_node("n0"))
+    src.create(make_pod("p0", owner=node))
+    docs = [d for d in yaml.safe_load_all(save(src)) if d]
+    # force dependent before owner
+    docs.sort(key=lambda d: 0 if d["kind"] == "Pod" else 1)
+    text = yaml.safe_dump_all(docs, sort_keys=False)
+
+    dst = ResourceStore()
+    load(dst, text)
+    new_node_uid = dst.get("Node", "n0")["metadata"]["uid"]
+    assert (
+        dst.get("Pod", "p0")["metadata"]["ownerReferences"][0]["uid"] == new_node_uid
+    )
+
+
+def test_save_skips_events_and_leases():
+    src = ResourceStore()
+    src.create(make_node("n0"))
+    src.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": "e", "namespace": "default"},
+            "reason": "x",
+        }
+    )
+    kinds = {d["kind"] for d in yaml.safe_load_all(save(src)) if d}
+    assert kinds == {"Node"}
+
+
+def test_record_then_replay_reaches_same_state():
+    src = ResourceStore()
+    src.create(make_node("n0"))
+
+    sink = io.StringIO()
+    rec = Recorder(src).start(sink)
+    src.create(make_pod("p0"))
+    src.patch("Pod", "p0", {"status": {"phase": "Running"}})
+    src.create(make_pod("p1"))
+    src.delete("Pod", "p1")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if sink.getvalue().count("ResourcePatch") >= 4:
+            break
+        time.sleep(0.02)
+    rec.stop()
+    text = sink.getvalue()
+
+    patches = parse_recording(text)
+    assert [p.method for p in patches] == ["create", "patch", "create", "delete"]
+    assert patches[0].resource == {"apiVersion": "v1", "kind": "Pod"}
+    # offsets are monotonic
+    offs = [p.duration_nanosecond for p in patches]
+    assert offs == sorted(offs)
+
+    dst = ResourceStore()
+    n = replay(dst, text, handle=PlaybackHandle(speed=1024))
+    assert n == 4
+    assert dst.get("Pod", "p0")["status"]["phase"] == "Running"
+    assert dst.get("Node", "n0")["metadata"]["name"] == "n0"
+    try:
+        dst.get("Pod", "p1")
+        raise AssertionError("p1 should have been deleted by replay")
+    except NotFound:
+        pass
+
+
+def test_replay_is_tolerant_of_drift():
+    """Deleting a missing object / creating an existing one is absorbed."""
+    dst = ResourceStore()
+    dst.create(make_node("n0"))
+    rp_del = ResourcePatch(
+        resource={"apiVersion": "v1", "kind": "Pod"},
+        target={"name": "ghost", "namespace": "default"},
+        method="delete",
+    )
+    rp_create = ResourcePatch(
+        resource={"apiVersion": "v1", "kind": "Node"},
+        target={"name": "n0", "namespace": ""},
+        method="create",
+        template=make_node("n0"),
+    )
+    from kwok_tpu.snapshot.replay import apply_patch
+
+    apply_patch(dst, rp_del)
+    apply_patch(dst, rp_create)
+    assert dst.get("Node", "n0")
+
+
+def test_playback_handle_pause_and_speed():
+    h = PlaybackHandle(speed=4)
+    assert h.faster() == 8
+    assert h.slower() == 4
+    h.set_speed(10 ** 9)
+    assert h.speed == PlaybackHandle.MAX_SPEED
+    h.set_speed(0)
+    assert h.speed == PlaybackHandle.MIN_SPEED
+
+    h = PlaybackHandle(speed=1024)
+    h.pause()
+    done = threading.Event()
+    t0 = time.monotonic()
+    waiter = threading.Thread(target=h.sleep, args=(5.0,), kwargs={"done": done})
+    waiter.start()
+    time.sleep(0.15)
+    assert waiter.is_alive()  # paused: no progress
+    h.resume()
+    waiter.join(timeout=5)
+    assert not waiter.is_alive()
+    assert time.monotonic() - t0 < 5  # sped up, not wall-clock 5s
+
+
+def test_record_replay_over_remote_client():
+    """Record from a live apiserver via the REST client (the kwokctl
+    snapshot-record path)."""
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import ClusterClient
+
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        client = ClusterClient(srv.url)
+        sink = io.StringIO()
+        rec = Recorder(client).start(sink)
+        client.create(make_node("n0"))
+        client.patch("Node", "n0", {"status": {"phase": "Ready"}})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if sink.getvalue().count("ResourcePatch") >= 2:
+                break
+            time.sleep(0.02)
+        rec.stop()
+    patches = parse_recording(sink.getvalue())
+    assert [p.method for p in patches] == ["create", "patch"]
