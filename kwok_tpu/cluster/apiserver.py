@@ -289,12 +289,32 @@ class APIServer:
     other component's ``--kubeconfig``-equivalent at it.
     """
 
-    def __init__(self, store: ResourceStore, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        store: ResourceStore,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+        client_ca: Optional[str] = None,
+    ):
         handler = type("BoundHandler", (_Handler,), {"store": store})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         # watch handler loops poll this so stop() actually ends them
         self._httpd.shutting_down = threading.Event()
+        self._tls = bool(tls_cert and tls_key)
+        if self._tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            if client_ca:
+                ctx.load_verify_locations(client_ca)
+                ctx.verify_mode = ssl.CERT_OPTIONAL
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket, server_side=True
+            )
         self._thread: Optional[threading.Thread] = None
         self.store = store
 
@@ -305,7 +325,8 @@ class APIServer:
     @property
     def url(self) -> str:
         host, port = self.address
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(

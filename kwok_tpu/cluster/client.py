@@ -128,7 +128,15 @@ class RemoteWatcher:
 class ClusterClient:
     """Store-compatible client for a remote :class:`APIServer`."""
 
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        url: str,
+        timeout: float = 30.0,
+        ca_cert: Optional[str] = None,
+        client_cert: Optional[str] = None,
+        client_key: Optional[str] = None,
+    ):
+        self._https = url.startswith("https://")
         if "://" in url:
             url = url.split("://", 1)[1]
         self._hostport = url.rstrip("/")
@@ -136,20 +144,35 @@ class ClusterClient:
         self._local = threading.local()
         self._types: Dict[str, ResourceType] = {}
         self._types_mut = threading.Lock()
+        self._ssl_ctx = None
+        if self._https:
+            import ssl
+
+            # full verification even against the private CA — the
+            # generated server certs carry localhost/127.0.0.1 SANs, so
+            # hostname checks pass and a leaked client cert cannot
+            # impersonate the apiserver
+            ctx = ssl.create_default_context(cafile=ca_cert)
+            if client_cert and client_key:
+                ctx.load_cert_chain(client_cert, client_key)
+            self._ssl_ctx = ctx
 
     # ---------------------------------------------------------- transport
 
     def _conn(self) -> http.client.HTTPConnection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = http.client.HTTPConnection(self._hostport, timeout=self._timeout)
+            c = self._fresh_conn()
             self._local.conn = c
         return c
 
     def _fresh_conn(self, timeout: Optional[float] = None) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(
-            self._hostport, timeout=timeout if timeout is not None else self._timeout
-        )
+        t = timeout if timeout is not None else self._timeout
+        if self._https:
+            return http.client.HTTPSConnection(
+                self._hostport, timeout=t, context=self._ssl_ctx
+            )
+        return http.client.HTTPConnection(self._hostport, timeout=t)
 
     def _drop_conn(self, conn: http.client.HTTPConnection) -> None:
         try:

@@ -492,6 +492,75 @@ class ResourceStore:
             st.watchers.append(w)
             return w
 
+    # -------------------------------------------------------------- persistence
+
+    def dump_state(self) -> dict:
+        """Raw state snapshot — the etcd-snapshot analog (reference
+        kwokctl saves etcd verbatim, pkg/kwokctl/etcd/{save,load}.go).
+        Captures the type registry, every object, and the rv/uid
+        counters so a restore is byte-identical."""
+        with self._mut:
+            types = []
+            objects = []
+            for rt in self.kinds():
+                types.append(
+                    {
+                        "api_version": rt.api_version,
+                        "kind": rt.kind,
+                        "plural": rt.plural,
+                        "namespaced": rt.namespaced,
+                    }
+                )
+                st = self._state(rt.kind)
+                objects.extend(copy.deepcopy(o) for o in st.objects.values())
+            return {
+                "resourceVersion": self._rv,
+                "uidCounter": self._uid,
+                "types": types,
+                "objects": objects,
+            }
+
+    def restore_state(self, state: dict) -> int:
+        """Load a :meth:`dump_state` snapshot over the current contents.
+        Watchers see ADDED events for every restored object (a restore
+        behaves like a fresh re-list)."""
+        with self._mut:
+            for t in state.get("types", []):
+                self.register_type(
+                    ResourceType(
+                        api_version=t["api_version"],
+                        kind=t["kind"],
+                        plural=t["plural"],
+                        namespaced=t["namespaced"],
+                    )
+                )
+            self._rv = max(self._rv, int(state.get("resourceVersion", 0)))
+            self._uid = max(self._uid, int(state.get("uidCounter", 0)))
+            n = 0
+            for obj in state.get("objects", []):
+                st = self._state(obj.get("kind") or "")
+                key = self._key(st, obj)
+                st.objects[key] = copy.deepcopy(obj)
+                self._emit(st, ADDED, obj, self._rv)
+                n += 1
+            return n
+
+    def save_file(self, path: str) -> None:
+        import json as _json
+
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            _json.dump(self.dump_state(), f)
+        import os as _os
+
+        _os.replace(tmp, path)
+
+    def load_file(self, path: str) -> int:
+        import json as _json
+
+        with open(path, "r", encoding="utf-8") as f:
+            return self.restore_state(_json.load(f))
+
     # -------------------------------------------------------------------- stats
 
     @property
@@ -517,18 +586,25 @@ class EventRecorder:
     #: event correlators use an LRU the same way)
     MAX_KEYS = 65536
 
-    def __init__(self, store: ResourceStore, source: str = "kwok"):
+    def __init__(
+        self,
+        store: ResourceStore,
+        source: str = "kwok",
+        clock: Optional[Clock] = None,
+    ):
         self._store = store
         self._source = source
+        self._clock = clock or RealClock()
         self._mut = threading.Lock()
         self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
 
-    @staticmethod
-    def _now_string() -> str:
+    def _now_string(self) -> str:
         """Event timestamps are client-side in k8s (the recording
-        component's clock), so no store round-trip here — this also
-        keeps the recorder store/client agnostic."""
-        t = datetime.datetime.now(datetime.timezone.utc)
+        component's clock) — injectable so simulated-time runs stamp
+        events on the simulation clock, store/client agnostic."""
+        t = datetime.datetime.fromtimestamp(
+            self._clock.now(), datetime.timezone.utc
+        )
         return t.isoformat(timespec="seconds").replace("+00:00", "Z")
 
     def event(self, involved: dict, etype: str, reason: str, message: str) -> dict:

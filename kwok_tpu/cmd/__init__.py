@@ -1,0 +1,2 @@
+"""CLI entry points: ``kwok`` controller daemon, apiserver daemon, and
+the ``kwokctl`` cluster tool (reference cmd/kwok, cmd/kwokctl)."""

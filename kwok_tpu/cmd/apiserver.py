@@ -1,0 +1,70 @@
+"""Apiserver daemon: ``python -m kwok_tpu.cmd.apiserver``.
+
+The binary runtime's stand-in for etcd + kube-apiserver (reference
+runtime/binary/cluster.go:316-420 starts both; our store folds the
+pair into one process).  State persists to ``--state-file`` as the
+etcd-snapshot analog: loaded on boot, written on SIGTERM and every
+``--save-interval`` seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.store import ResourceStore
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwok-tpu-apiserver", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2718)
+    p.add_argument("--state-file", default="", help="persist store state here")
+    p.add_argument("--save-interval", type=float, default=10.0)
+    p.add_argument("--tls-cert", default="")
+    p.add_argument("--tls-key", default="")
+    p.add_argument("--client-ca", default="")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    store = ResourceStore()
+    if args.state_file and os.path.exists(args.state_file):
+        n = store.load_file(args.state_file)
+        print(f"restored {n} objects from {args.state_file}", flush=True)
+
+    srv = APIServer(
+        store,
+        host=args.host,
+        port=args.port,
+        tls_cert=args.tls_cert or None,
+        tls_key=args.tls_key or None,
+        client_ca=args.client_ca or None,
+    )
+    srv.start()
+    print(f"apiserver listening on {srv.url}", flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+
+    while not done.wait(args.save_interval):
+        if args.state_file:
+            store.save_file(args.state_file)
+    if args.state_file:
+        store.save_file(args.state_file)
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

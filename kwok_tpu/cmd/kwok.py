@@ -1,0 +1,181 @@
+"""kwok controller daemon: ``python -m kwok_tpu.cmd.kwok``.
+
+Mirrors the reference's ``kwok`` binary startup (reference
+pkg/kwok/cmd/root.go:61 NewCommand, runE:121): load config docs, pick
+default stages when none are configured (root.go:463-490), build the
+cluster client, wait for the apiserver (root.go:434-460), start the
+controller facade, then serve the fake-kubelet HTTP surface
+(root.go:288-424).  Flags mirror root.go:79-102.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from kwok_tpu.api.config import KwokConfiguration
+from kwok_tpu.api.loader import load_documents
+from kwok_tpu.api.types import Stage
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.controllers.controller import Controller
+from kwok_tpu.server.server import Server, ServerConfig
+from kwok_tpu.stages import default_node_stages, default_pod_stages
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwok", description=__doc__)
+    p.add_argument("--server", default="http://127.0.0.1:2718", help="apiserver URL")
+    p.add_argument("--ca-cert", default="", help="CA bundle for https apiservers")
+    p.add_argument("--client-cert", default="")
+    p.add_argument("--client-key", default="")
+    p.add_argument(
+        "--config",
+        action="append",
+        default=[],
+        help="multi-doc YAML (Stages, KwokConfiguration, endpoint CRs); repeatable",
+    )
+    p.add_argument("--manage-all-nodes", action="store_true", default=None)
+    p.add_argument("--manage-nodes-with-annotation-selector", default=None)
+    p.add_argument("--manage-nodes-with-label-selector", default=None)
+    p.add_argument("--disregard-status-with-annotation-selector", default=None)
+    p.add_argument("--disregard-status-with-label-selector", default=None)
+    p.add_argument("--node-lease-duration-seconds", type=int, default=None)
+    p.add_argument(
+        "--enable-crds",
+        action="store_true",
+        default=None,
+        help="source Stages from cluster CRs instead of local config",
+    )
+    p.add_argument("--backend", choices=["host", "device"], default=None)
+    p.add_argument("--id", default=None, help="controller identity (lease holder)")
+    p.add_argument("--server-address", default="127.0.0.1:10247",
+                   help="fake-kubelet server host:port ('' disables)")
+    p.add_argument("--wait-timeout", type=float, default=60.0)
+    p.add_argument("--seed", type=int, default=None)
+    return p
+
+
+def load_config_docs(paths: List[str]) -> List[dict]:
+    docs: List[dict] = []
+    for path in paths:
+        with open(path, "r", encoding="utf-8") as f:
+            docs.extend(load_documents(f.read()))
+    return docs
+
+
+def config_from(docs: List[dict], args) -> KwokConfiguration:
+    """Config docs merge in order, CLI flags override (reference
+    config.go:194-252 merge + cobra flag precedence)."""
+    conf = KwokConfiguration()
+    merged: Dict = {}
+    for d in docs:
+        if d.get("kind") == "KwokConfiguration":
+            merged.update(d.get("options") or {})
+    if merged:
+        conf = KwokConfiguration.from_dict({"options": merged})
+    overrides = {
+        "manage_all_nodes": args.manage_all_nodes,
+        "manage_nodes_with_annotation_selector": args.manage_nodes_with_annotation_selector,
+        "manage_nodes_with_label_selector": args.manage_nodes_with_label_selector,
+        "disregard_status_with_annotation_selector": args.disregard_status_with_annotation_selector,
+        "disregard_status_with_label_selector": args.disregard_status_with_label_selector,
+        "node_lease_duration_seconds": args.node_lease_duration_seconds,
+        "enable_crds": args.enable_crds,
+        "backend": args.backend,
+        "id": args.id,
+    }
+    for key, val in overrides.items():
+        if val is not None:
+            setattr(conf, key, val)
+    if not (
+        conf.manage_all_nodes
+        or conf.manage_nodes_with_annotation_selector
+        or conf.manage_nodes_with_label_selector
+    ):
+        conf.manage_all_nodes = True
+    return conf
+
+
+def stages_from(docs: List[dict], enable_crds: bool) -> Optional[Dict[str, List[Stage]]]:
+    """Group configured stages by resourceRef kind; None → watch CRs.
+    Defaults when nothing is configured (root.go:463-490)."""
+    if enable_crds:
+        return None
+    grouped: Dict[str, List[Stage]] = {}
+    for d in docs:
+        if d.get("kind") == "Stage":
+            st = Stage.from_dict(d)
+            grouped.setdefault(st.resource_ref.kind, []).append(st)
+    if "Node" not in grouped:
+        grouped["Node"] = default_node_stages(lease=True)
+    if "Pod" not in grouped:
+        grouped["Pod"] = default_pod_stages()
+    return grouped
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    docs = load_config_docs(args.config)
+    conf = config_from(docs, args)
+    stages = stages_from(docs, bool(conf.enable_crds))
+
+    client = ClusterClient(
+        args.server,
+        ca_cert=args.ca_cert or None,
+        client_cert=args.client_cert or None,
+        client_key=args.client_key or None,
+    )
+    if not client.wait_ready(timeout=args.wait_timeout):
+        print(f"apiserver {args.server} not ready", file=sys.stderr)
+        return 1
+
+    ctr = Controller(client, conf, local_stages=stages, seed=args.seed)
+    ctr.start()
+    print(f"kwok controller started (backend={conf.backend})", flush=True)
+
+    srv = None
+    if args.server_address:
+        host, _, port = args.server_address.rpartition(":")
+        cfg = ServerConfig(
+            get_node=lambda name: _try(client.get, "Node", name),
+            get_pod=lambda ns, name: _try(client.get, "Pod", name, ns),
+            list_pods=lambda node: [
+                p
+                for p in client.list("Pod", field_selector=f"spec.nodeName={node}")[0]
+            ],
+            list_nodes=lambda: [
+                n["metadata"]["name"] for n in client.list("Node")[0]
+            ],
+        )
+        srv = Server(cfg)
+        srv.set_configs(docs)
+        bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
+        print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    done.wait()
+
+    if srv is not None:
+        srv.close()
+    ctr.stop()
+    return 0
+
+
+def _try(fn, *a):
+    try:
+        return fn(*a)
+    except KeyError:
+        return None
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,476 @@
+"""kwokctl: cluster lifecycle CLI — ``python -m kwok_tpu.cmd.kwokctl``.
+
+Command tree mirrors the reference (reference pkg/kwokctl/cmd/
+root.go:61-76): create/delete/start/stop cluster, get clusters/
+components/kubeconfig, scale, snapshot save/restore/export/record/
+replay, logs, hack get/put/del, config view, and a built-in kubectl
+subset (get/apply/delete) speaking to the cluster's apiserver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import yaml
+
+from kwok_tpu.ctl.dryrun import dry_run
+from kwok_tpu.ctl.runtime import BinaryRuntime, cluster_dir, list_clusters
+
+DEFAULT_CLUSTER = "kwok-tpu"
+
+
+# --------------------------------------------------------------------------- util
+
+
+def _runtime(args) -> BinaryRuntime:
+    return BinaryRuntime(getattr(args, "name", None) or DEFAULT_CLUSTER)
+
+
+def _require_cluster(args) -> BinaryRuntime:
+    rt = _runtime(args)
+    if not rt.exists():
+        raise SystemExit(f"cluster {rt.name!r} does not exist (kwokctl create cluster)")
+    return rt
+
+
+def _print_yaml(obj) -> None:
+    sys.stdout.write(yaml.safe_dump(obj, sort_keys=False))
+
+
+# ------------------------------------------------------------------- subcommands
+
+
+def cmd_create_cluster(args) -> int:
+    rt = _runtime(args)
+    if rt.exists() and not dry_run.enabled:
+        print(f"cluster {rt.name!r} already exists", file=sys.stderr)
+        return 1
+    rt.install(
+        secure=args.secure,
+        backend=args.backend,
+        config_paths=args.config,
+        controller_args=args.controller_arg,
+    )
+    rt.up(wait=args.wait)
+    if not dry_run.enabled:
+        if not rt.ready(timeout=args.wait):
+            print("cluster failed to become ready; see logs", file=sys.stderr)
+            return 1
+        print(f"cluster {rt.name!r} is ready at {rt.load_config()['serverURL']}")
+    return 0
+
+
+def cmd_delete_cluster(args) -> int:
+    rt = _runtime(args)
+    rt.down()
+    rt.uninstall()
+    if not dry_run.enabled:
+        print(f"cluster {rt.name!r} deleted")
+    return 0
+
+
+def cmd_start_cluster(args) -> int:
+    rt = _require_cluster(args)
+    rt.up(wait=args.wait)
+    return 0
+
+
+def cmd_stop_cluster(args) -> int:
+    rt = _require_cluster(args)
+    rt.down()
+    return 0
+
+
+def cmd_get_clusters(args) -> int:
+    for name in list_clusters():
+        print(name)
+    return 0
+
+
+def cmd_get_components(args) -> int:
+    rt = _require_cluster(args)
+    for name, alive in rt.running_components().items():
+        print(f"{name}\t{'Running' if alive else 'Stopped'}")
+    return 0
+
+
+def cmd_get_kubeconfig(args) -> int:
+    rt = _require_cluster(args)
+    conf = rt.load_config()
+    out = {
+        "server": conf["serverURL"],
+        "cluster": rt.name,
+    }
+    if conf.get("secure"):
+        pki = os.path.join(rt.workdir, "pki")
+        out.update(
+            {
+                "certificate-authority": os.path.join(pki, "ca.crt"),
+                "client-certificate": os.path.join(pki, "admin.crt"),
+                "client-key": os.path.join(pki, "admin.key"),
+            }
+        )
+    _print_yaml(out)
+    return 0
+
+
+def cmd_logs(args) -> int:
+    rt = _require_cluster(args)
+    sys.stdout.write(rt.logs(args.component))
+    return 0
+
+
+def cmd_scale(args) -> int:
+    from kwok_tpu.ctl.scale import parse_params, scale
+
+    rt = _require_cluster(args)
+    client = rt.client()
+    template = None
+    if args.template:
+        with open(args.template, "r", encoding="utf-8") as f:
+            template = f.read()
+
+    last = [0.0]
+
+    def progress(done: int, total: int) -> None:
+        now = time.monotonic()
+        if now - last[0] > 1 or done == total:
+            last[0] = now
+            print(f"\r{args.kind} {done}/{total}", end="", flush=True)
+
+    n = scale(
+        client,
+        args.kind,
+        args.replicas,
+        template=template,
+        name_prefix=args.name_prefix,
+        namespace=args.namespace,
+        params=parse_params(args.param),
+        start_index=args.start_index,
+        progress=progress,
+    )
+    print(f"\ncreated {n} {args.kind}s")
+    return 0
+
+
+def cmd_snapshot_export(args) -> int:
+    from kwok_tpu.snapshot import save_to
+
+    rt = _require_cluster(args)
+    n = save_to(rt.client(), args.path)
+    print(f"exported {n} objects to {args.path}")
+    return 0
+
+
+def cmd_snapshot_restore(args) -> int:
+    from kwok_tpu.snapshot import load
+
+    rt = _require_cluster(args)
+    created = load(rt.client(), args.path)
+    print(f"restored {len(created)} objects from {args.path}")
+    return 0
+
+
+def cmd_snapshot_record(args) -> int:
+    from kwok_tpu.snapshot import Recorder
+
+    rt = _require_cluster(args)
+    client = rt.client()
+    with open(args.path, "w", encoding="utf-8") as sink:
+        rec = Recorder(client).start(sink, snapshot=not args.no_snapshot)
+        print(f"recording to {args.path}; Ctrl-C to stop", flush=True)
+        try:
+            if args.duration > 0:
+                time.sleep(args.duration)
+            else:
+                while True:
+                    time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        rec.stop()
+    return 0
+
+
+def cmd_snapshot_replay(args) -> int:
+    from kwok_tpu.snapshot import PlaybackHandle, replay
+
+    rt = _require_cluster(args)
+    handle = PlaybackHandle(speed=args.speed)
+    done = threading.Event()
+
+    def progress(i: int, total: int) -> None:
+        print(f"\rreplay {i}/{total} (speed {handle.speed:g}x)", end="", flush=True)
+
+    n = replay(
+        rt.client(),
+        args.path,
+        handle=handle,
+        load_base=not args.no_snapshot,
+        done=done,
+        progress=progress,
+    )
+    print(f"\nreplayed {n} patches")
+    return 0
+
+
+def cmd_hack(args) -> int:
+    """Direct state-file access, the etcd-hack analog (reference
+    pkg/kwokctl/cmd/hack/{get,put,del} bypass the apiserver)."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    rt = _require_cluster(args)
+    state_path = os.path.join(rt.workdir, "state.json")
+    if args.hack_verb in ("put", "del") and rt.running_components().get("apiserver"):
+        # a live apiserver rewrites state.json every save-interval, so
+        # an offline edit would be silently lost — refuse, like etcd
+        # refuses a second writer on the same data dir
+        print(
+            "refusing to edit state while the apiserver is running; "
+            "run 'kwokctl stop cluster' first (or use kubectl apply/delete)",
+            file=sys.stderr,
+        )
+        return 1
+    store = ResourceStore()
+    if os.path.exists(state_path):
+        store.load_file(state_path)
+
+    if args.hack_verb == "get":
+        if args.object_name:
+            _print_yaml(store.get(args.kind, args.object_name, namespace=args.namespace))
+        else:
+            items, _ = store.list(args.kind)
+            _print_yaml({"items": items})
+        return 0
+    if args.hack_verb == "put":
+        with open(args.file, "r", encoding="utf-8") as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        for doc in docs:
+            try:
+                store.create(doc)
+            except Exception:  # noqa: BLE001 — overwrite on conflict
+                store.update(doc)
+        store.save_file(state_path)
+        print(f"put {len(docs)} objects")
+        return 0
+    if args.hack_verb == "del":
+        store.delete(args.kind, args.object_name, namespace=args.namespace)
+        store.save_file(state_path)
+        print(f"deleted {args.kind}/{args.object_name}")
+        return 0
+    return 1
+
+
+def cmd_config_view(args) -> int:
+    rt = _require_cluster(args)
+    _print_yaml(rt.load_config())
+    return 0
+
+
+def cmd_kubectl(args) -> int:
+    """Built-in kubectl subset (the reference shells out to a real
+    kubectl; ours speaks the REST client directly)."""
+    rt = _require_cluster(args)
+    client = rt.client()
+    verb = args.kubectl_verb
+    if verb == "get":
+        if args.object_name:
+            obj = client.get(args.kind, args.object_name, namespace=args.namespace)
+            if args.output in ("yaml", "json"):
+                out = yaml.safe_dump(obj, sort_keys=False) if args.output == "yaml" else json.dumps(obj, indent=2)
+                print(out)
+            else:
+                _print_table([obj])
+        else:
+            items, _ = client.list(
+                args.kind,
+                namespace=args.namespace if args.kind != "Node" else None,
+                label_selector=args.selector or None,
+            )
+            if args.output in ("yaml", "json"):
+                body = {"apiVersion": "v1", "kind": "List", "items": items}
+                print(
+                    yaml.safe_dump(body, sort_keys=False)
+                    if args.output == "yaml"
+                    else json.dumps(body, indent=2)
+                )
+            else:
+                _print_table(items)
+        return 0
+    if verb == "apply":
+        with open(args.file, "r", encoding="utf-8") as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        for doc in docs:
+            kind = doc.get("kind")
+            name = (doc.get("metadata") or {}).get("name")
+            ns = (doc.get("metadata") or {}).get("namespace")
+            try:
+                client.create(doc)
+                print(f"{kind}/{name} created")
+            except Exception:  # noqa: BLE001 — exists → patch
+                client.patch(kind, name, doc, patch_type="merge", namespace=ns)
+                print(f"{kind}/{name} configured")
+        return 0
+    if verb == "delete":
+        out = client.delete(args.kind, args.object_name, namespace=args.namespace)
+        state = "deleted" if out is None else "terminating (finalizers)"
+        print(f"{args.kind}/{args.object_name} {state}")
+        return 0
+    return 1
+
+
+def _print_table(items: List[dict]) -> None:
+    rows = []
+    for o in items:
+        meta = o.get("metadata") or {}
+        status = o.get("status") or {}
+        phase = status.get("phase") or ""
+        if not phase:
+            conds = status.get("conditions") or []
+            ready = next((c for c in conds if c.get("type") == "Ready"), None)
+            if ready is not None:
+                phase = "Ready" if ready.get("status") == "True" else "NotReady"
+        rows.append((meta.get("namespace") or "", meta.get("name") or "", phase))
+    if not rows:
+        print("No resources found")
+        return
+    w_ns = max(len("NAMESPACE"), *(len(r[0]) for r in rows))
+    w_nm = max(len("NAME"), *(len(r[1]) for r in rows))
+    print(f"{'NAMESPACE':<{w_ns}}  {'NAME':<{w_nm}}  STATUS")
+    for ns, name, phase in rows:
+        print(f"{ns:<{w_ns}}  {name:<{w_nm}}  {phase}")
+
+
+# ------------------------------------------------------------------------ parser
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwokctl", description=__doc__)
+    p.add_argument("--name", default=DEFAULT_CLUSTER, help="cluster name")
+    p.add_argument("--dry-run", action="store_true", help="print commands instead of executing")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pc = sub.add_parser("create", help="create a resource")
+    pcs = pc.add_subparsers(dest="what", required=True)
+    c = pcs.add_parser("cluster")
+    c.add_argument("--secure", action="store_true", help="TLS apiserver with generated PKI")
+    c.add_argument("--backend", choices=["host", "device"], default="host")
+    c.add_argument("--config", action="append", default=[])
+    c.add_argument("--controller-arg", action="append", default=[])
+    c.add_argument("--wait", type=float, default=60.0)
+    c.set_defaults(fn=cmd_create_cluster)
+
+    pd = sub.add_parser("delete", help="delete a resource")
+    pds = pd.add_subparsers(dest="what", required=True)
+    d = pds.add_parser("cluster")
+    d.set_defaults(fn=cmd_delete_cluster)
+
+    ps = sub.add_parser("start", help="start a stopped cluster")
+    pss = ps.add_subparsers(dest="what", required=True)
+    s = pss.add_parser("cluster")
+    s.add_argument("--wait", type=float, default=60.0)
+    s.set_defaults(fn=cmd_start_cluster)
+
+    pt = sub.add_parser("stop", help="stop a running cluster")
+    pts = pt.add_subparsers(dest="what", required=True)
+    t = pts.add_parser("cluster")
+    t.set_defaults(fn=cmd_stop_cluster)
+
+    pg = sub.add_parser("get", help="list clusters/components/kubeconfig")
+    pgs = pg.add_subparsers(dest="what", required=True)
+    pgs.add_parser("clusters").set_defaults(fn=cmd_get_clusters)
+    pgs.add_parser("components").set_defaults(fn=cmd_get_components)
+    pgs.add_parser("kubeconfig").set_defaults(fn=cmd_get_kubeconfig)
+
+    pl = sub.add_parser("logs", help="print a component's log")
+    pl.add_argument("component")
+    pl.set_defaults(fn=cmd_logs)
+
+    px = sub.add_parser("scale", help="create N rendered objects")
+    px.add_argument("kind", help="node | pod | any registered kind with --template")
+    px.add_argument("--replicas", type=int, required=True)
+    px.add_argument("--template", default="")
+    px.add_argument("--name-prefix", default="")
+    px.add_argument("--namespace", default="default")
+    px.add_argument("--param", action="append", default=[])
+    px.add_argument("--start-index", type=int, default=0)
+    px.set_defaults(fn=cmd_scale)
+
+    pn = sub.add_parser("snapshot", help="save/restore/record/replay")
+    pns = pn.add_subparsers(dest="snap_verb", required=True)
+    e = pns.add_parser("export")
+    e.add_argument("--path", required=True)
+    e.set_defaults(fn=cmd_snapshot_export)
+    r = pns.add_parser("restore")
+    r.add_argument("--path", required=True)
+    r.set_defaults(fn=cmd_snapshot_restore)
+    rec = pns.add_parser("record")
+    rec.add_argument("--path", required=True)
+    rec.add_argument("--duration", type=float, default=0.0)
+    rec.add_argument("--no-snapshot", action="store_true")
+    rec.set_defaults(fn=cmd_snapshot_record)
+    rep = pns.add_parser("replay")
+    rep.add_argument("--path", required=True)
+    rep.add_argument("--speed", type=float, default=1.0)
+    rep.add_argument("--no-snapshot", action="store_true")
+    rep.set_defaults(fn=cmd_snapshot_replay)
+
+    ph = sub.add_parser("hack", help="direct state-file access (cluster may be stopped)")
+    phs = ph.add_subparsers(dest="hack_verb", required=True)
+    hg = phs.add_parser("get")
+    hg.add_argument("kind")
+    hg.add_argument("object_name", nargs="?", default="")
+    hg.add_argument("-n", "--namespace", default=None)
+    hg.set_defaults(fn=cmd_hack)
+    hp = phs.add_parser("put")
+    hp.add_argument("--file", required=True)
+    hp.set_defaults(fn=cmd_hack)
+    hd = phs.add_parser("del")
+    hd.add_argument("kind")
+    hd.add_argument("object_name")
+    hd.add_argument("-n", "--namespace", default=None)
+    hd.set_defaults(fn=cmd_hack)
+
+    pv = sub.add_parser("config", help="view cluster config")
+    pvs = pv.add_subparsers(dest="what", required=True)
+    pvs.add_parser("view").set_defaults(fn=cmd_config_view)
+
+    pk = sub.add_parser("kubectl", help="built-in kubectl subset")
+    pks = pk.add_subparsers(dest="kubectl_verb", required=True)
+    kg = pks.add_parser("get")
+    kg.add_argument("kind")
+    kg.add_argument("object_name", nargs="?", default="")
+    kg.add_argument("-n", "--namespace", default=None)
+    kg.add_argument("-l", "--selector", default="")
+    kg.add_argument("-o", "--output", default="table")
+    kg.set_defaults(fn=cmd_kubectl)
+    ka = pks.add_parser("apply")
+    ka.add_argument("-f", "--file", required=True)
+    ka.set_defaults(fn=cmd_kubectl)
+    kd = pks.add_parser("delete")
+    kd.add_argument("kind")
+    kd.add_argument("object_name")
+    kd.add_argument("-n", "--namespace", default=None)
+    kd.set_defaults(fn=cmd_kubectl)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.dry_run:
+        dry_run.enable()
+    try:
+        return args.fn(args)
+    finally:
+        if args.dry_run:
+            dry_run.disable()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,7 +57,7 @@ class Controller:
         self._validate(self.conf)
         self.clock = clock or RealClock()
         self.rng = random.Random(seed)
-        self.recorder = EventRecorder(store, source="kwok")
+        self.recorder = EventRecorder(store, source="kwok", clock=self.clock)
         self._local_stages = local_stages
         self._started = False
         self._mut = threading.Lock()

@@ -1,0 +1,8 @@
+"""kwokctl-equivalent orchestration: cluster lifecycle, components,
+PKI, scale, snapshots, dryrun (reference pkg/kwokctl/*, SURVEY §2.6).
+
+The binary runtime launches this framework's own components as OS
+processes — apiserver daemon + kwok controller daemon — the way the
+reference's binary runtime forks etcd/kube-apiserver/kwok
+(reference runtime/binary/cluster.go:316-728).
+"""

@@ -1,0 +1,125 @@
+"""Declarative component specs + builders.
+
+Mirrors the reference's ``internalversion.Component`` (name, binary,
+args, ports, envs) and its per-component builders
+(reference pkg/kwokctl/components/*.go, e.g. kwok_controller.go:54,
+kube_apiserver.go:60).  Components here are Python daemon invocations
+of this framework's own binaries.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Component:
+    name: str
+    args: List[str]
+    env: Dict[str, str] = field(default_factory=dict)
+    ports: Dict[str, int] = field(default_factory=dict)
+    #: components started before this one (reference composes
+    #: etcd→apiserver→…→kwok in dependency order)
+    depends_on: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "args": list(self.args),
+            "env": dict(self.env),
+            "ports": dict(self.ports),
+            "dependsOn": list(self.depends_on),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Component":
+        return cls(
+            name=d["name"],
+            args=list(d["args"]),
+            env=dict(d.get("env") or {}),
+            ports=dict(d.get("ports") or {}),
+            depends_on=list(d.get("dependsOn") or []),
+        )
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_apiserver_component(
+    workdir: str,
+    port: int,
+    secure: bool = False,
+    pki_dir: Optional[str] = None,
+) -> Component:
+    """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
+    args = [
+        sys.executable,
+        "-m",
+        "kwok_tpu.cmd.apiserver",
+        "--host",
+        "127.0.0.1",
+        "--port",
+        str(port),
+        "--state-file",
+        os.path.join(workdir, "state.json"),
+    ]
+    if secure and pki_dir:
+        args += [
+            "--tls-cert",
+            os.path.join(pki_dir, "server.crt"),
+            "--tls-key",
+            os.path.join(pki_dir, "server.key"),
+            "--client-ca",
+            os.path.join(pki_dir, "ca.crt"),
+        ]
+    return Component(name="apiserver", args=args, ports={"http": port})
+
+
+def build_kwok_controller_component(
+    workdir: str,
+    server_url: str,
+    kubelet_port: int,
+    config_paths: Optional[List[str]] = None,
+    secure: bool = False,
+    pki_dir: Optional[str] = None,
+    backend: str = "host",
+    extra_args: Optional[List[str]] = None,
+) -> Component:
+    """(reference components/kwok_controller.go:54 BuildKwokControllerComponent)"""
+    args = [
+        sys.executable,
+        "-m",
+        "kwok_tpu.cmd.kwok",
+        "--server",
+        server_url,
+        "--manage-all-nodes",
+        "--server-address",
+        f"127.0.0.1:{kubelet_port}",
+        "--backend",
+        backend,
+    ]
+    if secure and pki_dir:
+        args += [
+            "--ca-cert",
+            os.path.join(pki_dir, "ca.crt"),
+            "--client-cert",
+            os.path.join(pki_dir, "admin.crt"),
+            "--client-key",
+            os.path.join(pki_dir, "admin.key"),
+        ]
+    for path in config_paths or []:
+        args += ["--config", path]
+    args += list(extra_args or [])
+    return Component(
+        name="kwok-controller",
+        args=args,
+        ports={"kubelet": kubelet_port},
+        depends_on=["apiserver"],
+    )

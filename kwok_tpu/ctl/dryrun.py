@@ -1,0 +1,45 @@
+"""Dry-run support (reference pkg/kwokctl/dryrun/dryrun.go:30-60).
+
+When enabled, runtimes print the equivalent shell command for every
+action instead of executing it; tests capture the stream and diff
+against goldens (reference test/e2e/dryrun.go:55-117).
+"""
+
+from __future__ import annotations
+
+import shlex
+import sys
+import threading
+from typing import IO, List, Optional
+
+
+class DryRun:
+    """Process-wide dry-run switch + captured writer."""
+
+    def __init__(self):
+        self._mut = threading.Lock()
+        self.enabled = False
+        self._sink: Optional[IO[str]] = None
+
+    def enable(self, sink: Optional[IO[str]] = None) -> None:
+        with self._mut:
+            self.enabled = True
+            self._sink = sink
+
+    def disable(self) -> None:
+        with self._mut:
+            self.enabled = False
+            self._sink = None
+
+    def emit(self, line: str) -> None:
+        with self._mut:
+            out = self._sink if self._sink is not None else sys.stdout
+            out.write(line + "\n")
+            out.flush()
+
+    def emit_cmd(self, argv: List[str]) -> None:
+        self.emit(" ".join(shlex.quote(a) for a in argv))
+
+
+#: module-level instance, mirroring the reference's global flag
+dry_run = DryRun()

@@ -1,0 +1,142 @@
+"""Cluster PKI: self-signed CA + serving/client certificates.
+
+Mirrors the reference's PKI generation for the binary runtime
+(reference pkg/kwokctl/pki/pki.go:49-91 GeneratePki: CA + admin cert
+with SANs for localhost), using the ``cryptography`` package.  The
+apiserver serves TLS with the serving cert; clients verify against the
+CA and may present the admin cert (the reference wires the same trio
+into each component's kubeconfig).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.x509.oid import NameOID
+
+__all__ = ["generate_pki", "PKIPaths"]
+
+_TEN_YEARS = datetime.timedelta(days=3650)
+
+
+class PKIPaths:
+    """File layout inside a cluster's pki/ directory."""
+
+    def __init__(self, base: str):
+        self.base = base
+        self.ca_crt = os.path.join(base, "ca.crt")
+        self.ca_key = os.path.join(base, "ca.key")
+        self.server_crt = os.path.join(base, "server.crt")
+        self.server_key = os.path.join(base, "server.key")
+        self.admin_crt = os.path.join(base, "admin.crt")
+        self.admin_key = os.path.join(base, "admin.key")
+
+    def exists(self) -> bool:
+        return all(
+            os.path.exists(p)
+            for p in (self.ca_crt, self.server_crt, self.server_key)
+        )
+
+
+def _new_key() -> rsa.RSAPrivateKey:
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _write_key(path: str, key: rsa.RSAPrivateKey) -> None:
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.TraditionalOpenSSL,
+        serialization.NoEncryption(),
+    )
+    with open(path, "wb") as f:
+        f.write(pem)
+    os.chmod(path, 0o600)
+
+
+def _write_cert(path: str, cert: x509.Certificate) -> None:
+    with open(path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+
+def _name(common: str, org: Optional[str] = None) -> x509.Name:
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common)]
+    if org:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+    return x509.Name(attrs)
+
+
+def _sans(hosts: List[str]) -> x509.SubjectAlternativeName:
+    alt = []
+    for h in hosts:
+        try:
+            alt.append(x509.IPAddress(ipaddress.ip_address(h)))
+        except ValueError:
+            alt.append(x509.DNSName(h))
+    return x509.SubjectAlternativeName(alt)
+
+
+def generate_pki(
+    base: str, extra_sans: Optional[List[str]] = None
+) -> PKIPaths:
+    """Generate CA + server + admin certs under ``base`` (idempotent)."""
+    paths = PKIPaths(base)
+    if paths.exists():
+        return paths
+    os.makedirs(base, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    not_after = now + _TEN_YEARS
+
+    ca_key = _new_key()
+    ca_name = _name("kwok-tpu-ca", "kwok-tpu")
+    ca = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    _write_key(paths.ca_key, ca_key)
+    _write_cert(paths.ca_crt, ca)
+
+    hosts = ["localhost", "127.0.0.1", "::1"] + list(extra_sans or [])
+
+    def issue(common: str, org: str, server: bool) -> Tuple[x509.Certificate, rsa.RSAPrivateKey]:
+        key = _new_key()
+        usage = (
+            x509.ExtendedKeyUsage([x509.ExtendedKeyUsageOID.SERVER_AUTH])
+            if server
+            else x509.ExtendedKeyUsage([x509.ExtendedKeyUsageOID.CLIENT_AUTH])
+        )
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(_name(common, org))
+            .issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now)
+            .not_valid_after(not_after)
+            .add_extension(usage, critical=False)
+        )
+        if server:
+            builder = builder.add_extension(_sans(hosts), critical=False)
+        return builder.sign(ca_key, hashes.SHA256()), key
+
+    server_cert, server_key = issue("kwok-tpu-apiserver", "kwok-tpu", server=True)
+    _write_cert(paths.server_crt, server_cert)
+    _write_key(paths.server_key, server_key)
+
+    # the admin identity matches the reference's kubernetes-admin cert
+    admin_cert, admin_key = issue("kubernetes-admin", "system:masters", server=False)
+    _write_cert(paths.admin_crt, admin_cert)
+    _write_key(paths.admin_key, admin_key)
+    return paths

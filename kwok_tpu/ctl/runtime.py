@@ -1,0 +1,332 @@
+"""Binary runtime: cluster lifecycle as local OS processes.
+
+The reference ``Runtime`` interface (runtime/config.go:30-147) has
+Install/Uninstall/Up/Down/Start/Stop/Ready plus per-component ops and
+snapshot hooks; the binary implementation forks real control-plane
+binaries (runtime/binary/cluster.go).  This runtime does the same with
+this framework's own daemons, one process per component, logs and
+pidfiles under the cluster workdir:
+
+    <workdir>/
+      kwok.yaml          cluster config (reference saves the same)
+      components.json    resolved component specs
+      pki/               CA + server/admin certs (secure mode)
+      logs/<name>.log    component stdout/stderr
+      pids/<name>.pid
+      state.json         apiserver persistence (etcd-snapshot analog)
+
+Dry-run prints every command instead of executing
+(reference dryrun.go:30-60 + golden tests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+import yaml
+
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.ctl.components import (
+    Component,
+    build_apiserver_component,
+    build_kwok_controller_component,
+    free_port,
+)
+from kwok_tpu.ctl.dryrun import dry_run
+from kwok_tpu.ctl.pki import generate_pki
+
+DEFAULT_HOME = os.path.join(os.path.expanduser("~"), ".kwok-tpu")
+
+
+def clusters_home() -> str:
+    return os.environ.get("KWOK_TPU_HOME", DEFAULT_HOME)
+
+
+def cluster_dir(name: str) -> str:
+    return os.path.join(clusters_home(), "clusters", name)
+
+
+def list_clusters() -> List[str]:
+    base = os.path.join(clusters_home(), "clusters")
+    if not os.path.isdir(base):
+        return []
+    return sorted(
+        d
+        for d in os.listdir(base)
+        if os.path.exists(os.path.join(base, d, "kwok.yaml"))
+    )
+
+
+class BinaryRuntime:
+    """One cluster's lifecycle (reference runtime/binary/cluster.go)."""
+
+    def __init__(self, name: str = "kwok-tpu"):
+        self.name = name
+        self.workdir = cluster_dir(name)
+        self._installed_components: Optional[List[Component]] = None
+
+    # ------------------------------------------------------------ layout
+
+    def _path(self, *parts: str) -> str:
+        return os.path.join(self.workdir, *parts)
+
+    @property
+    def config_path(self) -> str:
+        return self._path("kwok.yaml")
+
+    def exists(self) -> bool:
+        return os.path.exists(self.config_path)
+
+    def load_config(self) -> dict:
+        with open(self.config_path, "r", encoding="utf-8") as f:
+            return yaml.safe_load(f)
+
+    def load_components(self) -> List[Component]:
+        with open(self._path("components.json"), "r", encoding="utf-8") as f:
+            return [Component.from_dict(d) for d in json.load(f)]
+
+    # ----------------------------------------------------------- install
+
+    def install(
+        self,
+        secure: bool = False,
+        apiserver_port: int = 0,
+        kubelet_port: int = 0,
+        backend: str = "host",
+        config_paths: Optional[List[str]] = None,
+        controller_args: Optional[List[str]] = None,
+    ) -> dict:
+        """Generate pki/config/component specs (reference
+        binary/cluster.go:217-314 Install)."""
+        if dry_run.enabled:
+            dry_run.emit(f"mkdir -p {self.workdir}")
+        else:
+            os.makedirs(self._path("logs"), exist_ok=True)
+            os.makedirs(self._path("pids"), exist_ok=True)
+
+        pki_dir = self._path("pki")
+        if secure:
+            if dry_run.enabled:
+                dry_run.emit(f"generate-pki {pki_dir}")
+            else:
+                generate_pki(pki_dir)
+
+        apiserver_port = apiserver_port or free_port()
+        kubelet_port = kubelet_port or free_port()
+        scheme = "https" if secure else "http"
+        server_url = f"{scheme}://127.0.0.1:{apiserver_port}"
+
+        # copy user config files into the cluster dir so the cluster is
+        # self-contained (reference copies kwokctl config the same way)
+        stored_paths: List[str] = []
+        for i, src in enumerate(config_paths or []):
+            dst = self._path(f"config-{i}.yaml")
+            if dry_run.enabled:
+                dry_run.emit(f"cp {src} {dst}")
+            else:
+                shutil.copyfile(src, dst)
+            stored_paths.append(dst)
+
+        components = [
+            build_apiserver_component(
+                self.workdir, apiserver_port, secure=secure, pki_dir=pki_dir
+            ),
+            build_kwok_controller_component(
+                self.workdir,
+                server_url,
+                kubelet_port,
+                config_paths=stored_paths,
+                secure=secure,
+                pki_dir=pki_dir,
+                backend=backend,
+                extra_args=controller_args,
+            ),
+        ]
+        conf = {
+            "kind": "KwokctlConfiguration",
+            "name": self.name,
+            "serverURL": server_url,
+            "secure": secure,
+            "backend": backend,
+            "ports": {"apiserver": apiserver_port, "kubelet": kubelet_port},
+        }
+        self._installed_components = components
+        if dry_run.enabled:
+            dry_run.emit(f"write {self.config_path}")
+            dry_run.emit(f"write {self._path('components.json')}")
+        else:
+            with open(self.config_path, "w", encoding="utf-8") as f:
+                yaml.safe_dump(conf, f, sort_keys=False)
+            with open(self._path("components.json"), "w", encoding="utf-8") as f:
+                json.dump([c.to_dict() for c in components], f, indent=2)
+        return conf
+
+    def uninstall(self) -> None:
+        if dry_run.enabled:
+            dry_run.emit(f"rm -rf {self.workdir}")
+            return
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+    # ----------------------------------------------------------- process ops
+
+    def _pidfile(self, name: str) -> str:
+        return self._path("pids", f"{name}.pid")
+
+    def _pid(self, name: str) -> Optional[int]:
+        try:
+            with open(self._pidfile(name), "r", encoding="utf-8") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _alive(pid: Optional[int]) -> bool:
+        if not pid:
+            return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except OSError:
+            return False
+
+    def start_component(self, comp: Component) -> None:
+        """(reference binary runtime forks via os/exec, logging to files)"""
+        if dry_run.enabled:
+            dry_run.emit_cmd(comp.args)
+            return
+        if self._alive(self._pid(comp.name)):
+            return
+        log = open(self._path("logs", f"{comp.name}.log"), "ab")
+        env = dict(os.environ)
+        env.update(comp.env)
+        # daemons import kwok_tpu regardless of the caller's cwd
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pkg_root = os.path.dirname(pkg_parent)
+        env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        # the daemons only need CPU JAX unless the device backend is on
+        proc = subprocess.Popen(
+            comp.args,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+            env=env,
+            start_new_session=True,
+        )
+        log.close()
+        with open(self._pidfile(comp.name), "w", encoding="utf-8") as f:
+            f.write(str(proc.pid))
+
+    def stop_component(self, name: str, timeout: float = 10.0) -> None:
+        if dry_run.enabled:
+            dry_run.emit(f"kill {name}")
+            return
+        pid = self._pid(name)
+        if not self._alive(pid):
+            return
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._alive(pid):
+                break
+            time.sleep(0.05)
+        else:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        try:
+            os.remove(self._pidfile(name))
+        except OSError:
+            pass
+
+    # -------------------------------------------------------------- up/down
+
+    def up(self, wait: float = 30.0) -> None:
+        """Start all components in dependency order (reference Up)."""
+        components = (
+            self.load_components() if not dry_run.enabled else self._dry_components()
+        )
+        started: Dict[str, Component] = {}
+        pending = list(components)
+        while pending:
+            progressed = False
+            for comp in list(pending):
+                if all(d in started for d in comp.depends_on):
+                    self.start_component(comp)
+                    if comp.name == "apiserver" and not dry_run.enabled:
+                        if not self.ready(timeout=wait):
+                            raise RuntimeError(
+                                f"apiserver did not become ready within {wait}s "
+                                f"(see {self._path('logs', 'apiserver.log')})"
+                            )
+                    started[comp.name] = comp
+                    pending.remove(comp)
+                    progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    f"dependency cycle among components: {[c.name for c in pending]}"
+                )
+
+    def _dry_components(self) -> List[Component]:
+        if self._installed_components is not None:
+            return self._installed_components
+        if self.exists():
+            return self.load_components()
+        return []
+
+    def down(self) -> None:
+        if dry_run.enabled:
+            dry_run.emit(f"stop-cluster {self.name}")
+            return
+        if not os.path.isdir(self._path("pids")):
+            return
+        # reverse dependency order
+        comps = self.load_components() if self.exists() else []
+        for comp in reversed(comps):
+            self.stop_component(comp.name)
+
+    def running_components(self) -> Dict[str, bool]:
+        out = {}
+        for comp in self.load_components():
+            out[comp.name] = self._alive(self._pid(comp.name))
+        return out
+
+    # ------------------------------------------------------------- client
+
+    def client(self, timeout: float = 30.0) -> ClusterClient:
+        conf = self.load_config()
+        kwargs = {}
+        if conf.get("secure"):
+            pki_dir = self._path("pki")
+            kwargs = {
+                "ca_cert": os.path.join(pki_dir, "ca.crt"),
+                "client_cert": os.path.join(pki_dir, "admin.crt"),
+                "client_key": os.path.join(pki_dir, "admin.key"),
+            }
+        return ClusterClient(conf["serverURL"], timeout=timeout, **kwargs)
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        try:
+            return self.client().wait_ready(timeout=timeout)
+        except OSError:
+            return False
+
+    def logs(self, component: str, follow: bool = False) -> str:
+        path = self._path("logs", f"{component}.log")
+        if not os.path.exists(path):
+            return ""
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read()

@@ -21,7 +21,7 @@ from kwok_tpu.api.action import (
     ResourcePatch,
 )
 from kwok_tpu.cluster.store import ADDED, DELETED
-from kwok_tpu.snapshot.snapshot import DEFAULT_SKIP_KINDS, save
+from kwok_tpu.snapshot.snapshot import DEFAULT_SKIP_KINDS
 
 
 class Recorder:
@@ -41,13 +41,22 @@ class Recorder:
 
     def start(self, sink: IO[str], snapshot: bool = True) -> "Recorder":
         """Dump the current state (unless ``snapshot=False``), then
-        stream ResourcePatch docs for every subsequent mutation."""
+        stream ResourcePatch docs for every subsequent mutation.
+
+        The watch resumes from the SAME resourceVersion the dump's
+        list() returned, so mutations racing the dump land in the patch
+        stream instead of vanishing between snapshot and watch."""
+        kinds = sorted(self._kinds, key=lambda k: 0 if k == "Namespace" else 1)
+        per_kind = []
+        for kind in kinds:
+            items, rv = self._store.list(kind)
+            per_kind.append((kind, items, rv))
         if snapshot:
-            sink.write(save(self._store, self._kinds))
+            docs = [o for _, items, _ in per_kind for o in items]
+            sink.write(yaml.safe_dump_all(docs, sort_keys=False))
         sink.flush()
         self._t0 = time.monotonic()
-        for kind in self._kinds:
-            rv = self._store.list(kind)[1]
+        for kind, _, rv in per_kind:
             w = self._store.watch(kind, since_rv=rv)
             t = threading.Thread(
                 target=self._pump, args=(kind, w, sink), daemon=True

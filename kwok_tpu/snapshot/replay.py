@@ -28,6 +28,7 @@ from kwok_tpu.api.action import (
 )
 from kwok_tpu.cluster.store import Conflict, NotFound
 from kwok_tpu.snapshot.snapshot import load as load_snapshot
+from kwok_tpu.snapshot.snapshot import read_source
 
 
 class PlaybackHandle:
@@ -84,9 +85,10 @@ class PlaybackHandle:
         speed changes by chunking the wait."""
         remaining = seconds
         while remaining > 0 and not (done and done.is_set()):
-            self._resume.wait()
-            if done and done.is_set():
-                return
+            # bounded wait so a paused replay still honors abort
+            while not self._resume.wait(timeout=0.05):
+                if done and done.is_set():
+                    return
             step = min(remaining, 0.05 * self.speed)
             time.sleep(step / self.speed)
             remaining -= step
@@ -94,10 +96,7 @@ class PlaybackHandle:
 
 def parse_recording(source: str) -> List[ResourcePatch]:
     """Extract the ResourcePatch stream from a recording file/string."""
-    if "\n" not in source and source.endswith((".yaml", ".yml")):
-        with open(source, "r", encoding="utf-8") as f:
-            source = f.read()
-    docs = [d for d in yaml.safe_load_all(source) if d]
+    docs = [d for d in yaml.safe_load_all(read_source(source)) if d]
     patches = [
         ResourcePatch.from_dict(d) for d in docs if ResourcePatch.is_resource_patch(d)
     ]
@@ -156,9 +155,7 @@ def replay(
     record time).  ``handle`` supplies pause/speed control; ``done``
     aborts early; ``progress(i, total)`` fires after each patch.
     """
-    if "\n" not in source and source.endswith((".yaml", ".yml")):
-        with open(source, "r", encoding="utf-8") as f:
-            source = f.read()
+    source = read_source(source)
     handle = handle or PlaybackHandle()
     if load_base:
         load_snapshot(store, source)

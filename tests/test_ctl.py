@@ -1,0 +1,202 @@
+"""kwokctl orchestration: pki, persistence, scale, dryrun, and the
+full multi-process cluster lifecycle (reference pkg/kwokctl, SURVEY
+§2.6, §3.4)."""
+
+import io
+import json
+import os
+import time
+
+import pytest
+import yaml
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+from kwok_tpu.ctl.pki import generate_pki
+from kwok_tpu.ctl.scale import parse_params, scale
+
+
+@pytest.fixture()
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    return tmp_path
+
+
+def test_pki_and_tls_roundtrip(tmp_path):
+    paths = generate_pki(str(tmp_path / "pki"))
+    for p in (paths.ca_crt, paths.ca_key, paths.server_crt, paths.server_key,
+              paths.admin_crt, paths.admin_key):
+        assert os.path.exists(p)
+    # idempotent
+    again = generate_pki(str(tmp_path / "pki"))
+    assert again.ca_crt == paths.ca_crt
+
+    store = ResourceStore()
+    srv = APIServer(
+        store,
+        tls_cert=paths.server_crt,
+        tls_key=paths.server_key,
+        client_ca=paths.ca_crt,
+    ).start()
+    try:
+        assert srv.url.startswith("https://")
+        client = ClusterClient(
+            srv.url,
+            ca_cert=paths.ca_crt,
+            client_cert=paths.admin_crt,
+            client_key=paths.admin_key,
+        )
+        assert client.wait_ready(5)
+        client.create(
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"},
+             "spec": {}, "status": {}}
+        )
+        assert store.count("Node") == 1
+    finally:
+        srv.stop()
+
+
+def test_store_persistence_roundtrip(tmp_path):
+    from kwok_tpu.cluster.store import ResourceType
+
+    a = ResourceStore()
+    a.register_type(ResourceType("x.io/v1", "Gadget", "gadgets"))
+    a.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"},
+              "spec": {}, "status": {}})
+    a.create({"apiVersion": "x.io/v1", "kind": "Gadget",
+              "metadata": {"name": "g", "namespace": "default"}, "spec": {"v": 1}})
+    rv = a.resource_version
+    path = str(tmp_path / "state.json")
+    a.save_file(path)
+
+    b = ResourceStore()
+    n = b.load_file(path)
+    assert n == 2
+    assert b.get("Gadget", "g")["spec"]["v"] == 1
+    assert b.get("Node", "n0")["metadata"]["uid"] == a.get("Node", "n0")["metadata"]["uid"]
+    assert b.resource_version >= rv
+    # uid counter restored: no uid collisions after restore
+    c = b.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+                  "spec": {}, "status": {}})
+    uids = {o["metadata"]["uid"] for o in b.list("Node")[0]}
+    assert len(uids) == 2
+
+
+def test_scale_default_templates():
+    store = ResourceStore()
+    n = scale(store, "node", 5)
+    assert n == 5 and store.count("Node") == 5
+    node = store.get("Node", "node-3")
+    assert node["status"]["allocatable"]["pods"] == "110"
+    assert node["spec"]["taints"][0]["key"] == "kwok.x-k8s.io/node"
+
+    n = scale(store, "pod", 4, params={"nodeName": "node-1"})
+    assert n == 4
+    pod = store.get("Pod", "pod-2")
+    assert pod["spec"]["nodeName"] == "node-1"
+    assert pod["spec"]["tolerations"][0]["key"] == "kwok.x-k8s.io/node"
+
+
+def test_scale_custom_template_with_index_and_cidr():
+    store = ResourceStore()
+    tpl = (
+        "apiVersion: v1\n"
+        "kind: Node\n"
+        "metadata:\n"
+        "  name: {{ Name }}\n"
+        "  annotations:\n"
+        "    idx: \"{{ Index }}\"\n"
+        "    ip: {{ AddCIDR .cidr Index }}\n"
+        "spec: {}\n"
+    )
+    scale(store, "Node", 3, template=tpl, name_prefix="edge",
+          params={"cidr": "10.1.0.0/24"})
+    n2 = store.get("Node", "edge-2")
+    assert n2["metadata"]["annotations"]["idx"] == "2"
+    assert n2["metadata"]["annotations"]["ip"] == "10.1.0.2"
+
+
+def test_parse_params():
+    assert parse_params([".a=1", ".b=x", ".c=true"]) == {"a": 1, "b": "x", "c": True}
+    with pytest.raises(ValueError):
+        parse_params(["bad"])
+
+
+def test_dryrun_create_cluster(home, capsys):
+    rc = kwokctl_main(["--name", "dry", "--dry-run", "create", "cluster"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "kwok_tpu.cmd.apiserver" in out
+    assert "kwok_tpu.cmd.kwok" in out
+    assert "mkdir -p" in out
+    # nothing was actually created
+    assert not os.path.exists(os.path.join(str(home), "clusters", "dry", "kwok.yaml"))
+
+
+def test_cluster_lifecycle_end_to_end(home, capsys):
+    """create → scale → kubectl → snapshot → stop → start (state
+    persists) → hack → delete.  Real subprocess components."""
+    name = "e2e"
+    assert kwokctl_main(["--name", name, "create", "cluster", "--wait", "60"]) == 0
+
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    rt = BinaryRuntime(name)
+    client = rt.client()
+
+    try:
+        assert kwokctl_main(["--name", name, "scale", "node", "--replicas", "2"]) == 0
+        assert kwokctl_main(
+            ["--name", name, "scale", "pod", "--replicas", "3",
+             "--param", ".nodeName=node-0"]
+        ) == 0
+
+        def all_running():
+            pods, _ = client.list("Pod")
+            return len(pods) == 3 and all(
+                p.get("status", {}).get("phase") == "Running" for p in pods
+            )
+
+        deadline = time.monotonic() + 60
+        while not all_running() and time.monotonic() < deadline:
+            time.sleep(0.2)
+        assert all_running(), [p.get("status", {}) for p in client.list("Pod")[0]]
+
+        # nodes got initialized by the controller daemon
+        nodes, _ = client.list("Node")
+        assert all(
+            any(c["type"] == "Ready" and c["status"] == "True"
+                for c in n.get("status", {}).get("conditions", []))
+            for n in nodes
+        )
+
+        # kubectl table + yaml
+        capsys.readouterr()
+        assert kwokctl_main(["--name", name, "kubectl", "get", "pods"]) == 0
+        out = capsys.readouterr().out
+        assert "pod-0" in out and "Running" in out
+
+        # snapshot export
+        snap = os.path.join(str(home), "snap.yaml")
+        assert kwokctl_main(["--name", name, "snapshot", "export", "--path", snap]) == 0
+        kinds = [d["kind"] for d in yaml.safe_load_all(open(snap)) if d]
+        assert kinds.count("Pod") == 3 and kinds.count("Node") == 2
+
+        # stop → state persisted → hack sees it offline
+        assert kwokctl_main(["--name", name, "stop", "cluster"]) == 0
+        capsys.readouterr()
+        assert kwokctl_main(["--name", name, "hack", "get", "pods"]) == 0
+        hack_out = capsys.readouterr().out
+        assert "pod-0" in hack_out
+
+        # start again: objects survive the restart
+        assert kwokctl_main(["--name", name, "start", "cluster", "--wait", "60"]) == 0
+        client2 = rt.client()
+        assert client2.wait_ready(30)
+        pods, _ = client2.list("Pod")
+        assert len(pods) == 3
+    finally:
+        assert kwokctl_main(["--name", name, "delete", "cluster"]) == 0
+        assert not os.path.exists(rt.workdir)

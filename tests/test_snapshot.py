@@ -89,6 +89,38 @@ def test_load_owner_appears_later_in_stream():
     )
 
 
+def test_load_two_level_owner_chain_out_of_order():
+    """Pod→Node chain where both dependents precede their owners and
+    one object (ConfigMap) shares its old UID with a new-cluster UID:
+    multi-pass resolution must still re-link every level."""
+    src = ResourceStore()
+    node = src.create(make_node("n0"))
+    mid = src.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": "rs",
+                "namespace": "default",
+                "ownerReferences": [
+                    {"apiVersion": "v1", "kind": "Node", "name": "n0",
+                     "uid": node["metadata"]["uid"]}
+                ],
+            },
+        }
+    )
+    src.create(make_pod("p0", owner=mid))
+    docs = [d for d in yaml.safe_load_all(save(src)) if d]
+    order = {"Pod": 0, "ConfigMap": 1, "Node": 2}
+    docs.sort(key=lambda d: order[d["kind"]])
+    dst = ResourceStore()
+    load(dst, yaml.safe_dump_all(docs, sort_keys=False))
+    node_uid = dst.get("Node", "n0")["metadata"]["uid"]
+    mid_uid = dst.get("ConfigMap", "rs")["metadata"]["uid"]
+    assert dst.get("ConfigMap", "rs")["metadata"]["ownerReferences"][0]["uid"] == node_uid
+    assert dst.get("Pod", "p0")["metadata"]["ownerReferences"][0]["uid"] == mid_uid
+
+
 def test_save_skips_events_and_leases():
     src = ResourceStore()
     src.create(make_node("n0"))
