@@ -57,10 +57,12 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
+    saved_rv = -1
     while not done.wait(args.save_interval):
-        if args.state_file:
+        if args.state_file and store.resource_version != saved_rv:
+            saved_rv = store.resource_version
             store.save_file(args.state_file)
-    if args.state_file:
+    if args.state_file and store.resource_version != saved_rv:
         store.save_file(args.state_file)
     srv.stop()
     return 0

@@ -151,7 +151,17 @@ def main(argv=None) -> int:
             ],
         )
         srv = Server(cfg)
-        srv.set_configs(docs)
+        # only endpoint/metric config kinds feed the server; Stages and
+        # KwokConfiguration docs belong to the controller path above
+        from kwok_tpu.api.extra_types import CONFIG_KINDS, from_document
+
+        srv.set_configs(
+            [
+                from_document(d)
+                for d in docs
+                if d.get("kind") in CONFIG_KINDS and d.get("kind") != "ResourcePatch"
+            ]
+        )
         bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
         print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
 

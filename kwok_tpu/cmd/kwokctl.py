@@ -19,6 +19,7 @@ from typing import List, Optional
 
 import yaml
 
+from kwok_tpu.cluster.store import Conflict
 from kwok_tpu.ctl.dryrun import dry_run
 from kwok_tpu.ctl.runtime import BinaryRuntime, cluster_dir, list_clusters
 
@@ -207,14 +208,19 @@ def cmd_snapshot_replay(args) -> int:
     def progress(i: int, total: int) -> None:
         print(f"\rreplay {i}/{total} (speed {handle.speed:g}x)", end="", flush=True)
 
-    n = replay(
-        rt.client(),
-        args.path,
-        handle=handle,
-        load_base=not args.no_snapshot,
-        done=done,
-        progress=progress,
-    )
+    try:
+        n = replay(
+            rt.client(),
+            args.path,
+            handle=handle,
+            load_base=not args.no_snapshot,
+            done=done,
+            progress=progress,
+        )
+    except KeyboardInterrupt:
+        done.set()
+        print("\nreplay interrupted")
+        return 130
     print(f"\nreplayed {n} patches")
     return 0
 
@@ -244,7 +250,7 @@ def cmd_hack(args) -> int:
         if args.object_name:
             _print_yaml(store.get(args.kind, args.object_name, namespace=args.namespace))
         else:
-            items, _ = store.list(args.kind)
+            items, _ = store.list(args.kind, namespace=args.namespace)
             _print_yaml({"items": items})
         return 0
     if args.hack_verb == "put":
@@ -253,7 +259,7 @@ def cmd_hack(args) -> int:
         for doc in docs:
             try:
                 store.create(doc)
-            except Exception:  # noqa: BLE001 — overwrite on conflict
+            except Conflict:
                 store.update(doc)
         store.save_file(state_path)
         print(f"put {len(docs)} objects")
@@ -312,7 +318,7 @@ def cmd_kubectl(args) -> int:
             try:
                 client.create(doc)
                 print(f"{kind}/{name} created")
-            except Exception:  # noqa: BLE001 — exists → patch
+            except Conflict:
                 client.patch(kind, name, doc, patch_type="merge", namespace=ns)
                 print(f"{kind}/{name} configured")
         return 0

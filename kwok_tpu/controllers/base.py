@@ -30,7 +30,11 @@ from kwok_tpu.controllers.utils import Backoff, StageJob, should_retry
 from kwok_tpu.engine.lifecycle import CompiledStage, Lifecycle, to_json_standard
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.patch import is_noop_patch
-from kwok_tpu.utils.queue import Queue, WeightDelayingQueue
+from kwok_tpu.utils.queue import (
+    Queue,
+    WeightDelayingQueue,
+    new_weight_delaying_queue,
+)
 
 
 class StagePlayer:
@@ -62,7 +66,7 @@ class StagePlayer:
 
         self.events: Queue = Queue()
         self.preprocess_q: Queue = Queue()
-        self.delay_queue: WeightDelayingQueue = WeightDelayingQueue(self.clock)
+        self.delay_queue: WeightDelayingQueue = new_weight_delaying_queue(self.clock)
         #: key -> (rv, job): dedup + cancellation of superseded jobs
         #: (reference pod_controller.go:205-214 delayQueueMapping)
         self.delay_queue_mapping: Dict[str, StageJob] = {}

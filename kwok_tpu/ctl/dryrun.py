@@ -24,7 +24,8 @@ class DryRun:
     def enable(self, sink: Optional[IO[str]] = None) -> None:
         with self._mut:
             self.enabled = True
-            self._sink = sink
+            if sink is not None or self._sink is None:
+                self._sink = sink
 
     def disable(self) -> None:
         with self._mut:

@@ -225,6 +225,26 @@ class DelayingQueue(Queue[T]):
         self._hsignal.set()
 
 
+def new_weight_delaying_queue(clock: Optional[Clock] = None) -> "WeightDelayingQueue":
+    """Preferred constructor: the C++-backed queue when the native
+    library is available (KWOK_TPU_NATIVE=0 forces pure Python), else
+    the pure-Python implementation. Both present the same surface."""
+    import os
+
+    if os.environ.get("KWOK_TPU_NATIVE", "1") != "0":
+        try:
+            from kwok_tpu.native.queue import (
+                NativeWeightDelayingQueue,
+                native_available,
+            )
+
+            if native_available():
+                return NativeWeightDelayingQueue(clock)  # type: ignore[return-value]
+        except Exception:  # noqa: BLE001 — toolchain missing: fall back
+            pass
+    return WeightDelayingQueue(clock)
+
+
 class WeightDelayingQueue(WeightQueue[T]):
     """add_weight_after: the controllers' retry/delay scheduler.
 

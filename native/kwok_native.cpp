@@ -1,0 +1,194 @@
+// kwok_native: C++ runtime core for kwok-tpu's host-side scheduler.
+//
+// Implements the framework's delay/weight scheduling structure as a
+// native binary-heap pair keyed by (deadline, seq) with weight-bucket
+// ready queues — the C++ counterpart of the reference's
+// WeightDelayingQueue (reference pkg/utils/queue/
+// weight_delaying_queue.go:29-163: time-ordered heap feeding per-weight
+// buckets, lower weight served first).  Python drives it through a flat
+// C ABI via ctypes; items are opaque int64 handles mapped back to
+// Python objects by the binding layer.
+//
+// Also exports a batched FNV-1a 64 hash for string interning.
+//
+// Build: g++ -O3 -shared -fPIC -o libkwok_native.so kwok_native.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    double deadline;
+    uint64_t seq;  // FIFO tiebreak within one deadline
+    int64_t id;
+    int32_t weight;
+};
+
+struct EntryCmp {
+    bool operator()(const Entry& a, const Entry& b) const {
+        if (a.deadline != b.deadline) return a.deadline > b.deadline;
+        return a.seq > b.seq;  // min-heap: earlier seq first
+    }
+};
+
+struct ReadyItem {
+    uint64_t seq;
+    int64_t id;
+};
+
+class DelayHeap {
+   public:
+    // Schedule id to become ready at `deadline` with `weight`.
+    // Re-adding an id reschedules it (cancels the previous entry).
+    void add(int64_t id, int32_t weight, double deadline) {
+        uint64_t seq = next_seq_++;
+        live_[id] = {deadline, weight, seq};
+        heap_.push(Entry{deadline, seq, id, weight});
+    }
+
+    // Remove id wherever it lives (pending heap or ready bucket).
+    // Returns 1 if it was scheduled/ready, 0 otherwise.
+    int cancel(int64_t id) {
+        auto it = live_.find(id);
+        if (it == live_.end()) return 0;
+        live_.erase(it);  // heap/bucket entries become stale; skipped on pop
+        return 1;
+    }
+
+    // Move everything due at `now` into the weight buckets.
+    void promote(double now) {
+        while (!heap_.empty() && heap_.top().deadline <= now) {
+            Entry e = heap_.top();
+            heap_.pop();
+            auto it = live_.find(e.id);
+            // stale if cancelled or rescheduled since
+            if (it == live_.end() || it->second.seq != e.seq) continue;
+            ready_[e.weight].push_back(ReadyItem{e.seq, e.id});
+            it->second.ready = true;
+        }
+    }
+
+    // Pop up to `max_out` ready ids, lowest weight bucket first, FIFO
+    // within a bucket.  Returns the count written to out.
+    int pop_ready(int64_t* out, int max_out) {
+        int n = 0;
+        auto bucket = ready_.begin();
+        while (bucket != ready_.end() && n < max_out) {
+            auto& vec = bucket->second;
+            while (cursor_[bucket->first] < vec.size() && n < max_out) {
+                ReadyItem item = vec[cursor_[bucket->first]++];
+                auto it = live_.find(item.id);
+                if (it == live_.end() || it->second.seq != item.seq) continue;
+                live_.erase(it);
+                out[n++] = item.id;
+            }
+            if (cursor_[bucket->first] >= vec.size()) {
+                cursor_.erase(bucket->first);
+                bucket = ready_.erase(bucket);
+            } else {
+                ++bucket;
+            }
+        }
+        return n;
+    }
+
+    // Next pending deadline, or -1 when the heap is empty (after
+    // skipping stale entries).
+    double next_deadline() {
+        while (!heap_.empty()) {
+            const Entry& e = heap_.top();
+            auto it = live_.find(e.id);
+            if (it == live_.end() || it->second.seq != e.seq ||
+                it->second.ready) {
+                heap_.pop();
+                continue;
+            }
+            return e.deadline;
+        }
+        return -1.0;
+    }
+
+    int ready_count() const {
+        int n = 0;
+        for (const auto& kv : ready_) {
+            auto cur = cursor_.find(kv.first);
+            size_t skip = cur == cursor_.end() ? 0 : cur->second;
+            for (size_t i = skip; i < kv.second.size(); ++i) {
+                auto it = live_.find(kv.second[i].id);
+                if (it != live_.end() && it->second.seq == kv.second[i].seq)
+                    ++n;
+            }
+        }
+        return n;
+    }
+
+    int size() const { return static_cast<int>(live_.size()); }
+
+   private:
+    struct Live {
+        double deadline;
+        int32_t weight;
+        uint64_t seq;
+        bool ready = false;
+    };
+    std::priority_queue<Entry, std::vector<Entry>, EntryCmp> heap_;
+    std::map<int32_t, std::vector<ReadyItem>> ready_;  // weight-ordered
+    std::map<int32_t, size_t> cursor_;  // consumed prefix per bucket
+    std::unordered_map<int64_t, Live> live_;
+    uint64_t next_seq_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kn_heap_new() { return new DelayHeap(); }
+
+void kn_heap_free(void* h) { delete static_cast<DelayHeap*>(h); }
+
+void kn_heap_add(void* h, int64_t id, int32_t weight, double deadline) {
+    static_cast<DelayHeap*>(h)->add(id, weight, deadline);
+}
+
+int kn_heap_cancel(void* h, int64_t id) {
+    return static_cast<DelayHeap*>(h)->cancel(id);
+}
+
+void kn_heap_promote(void* h, double now) {
+    static_cast<DelayHeap*>(h)->promote(now);
+}
+
+int kn_heap_pop_ready(void* h, int64_t* out, int max_out) {
+    return static_cast<DelayHeap*>(h)->pop_ready(out, max_out);
+}
+
+double kn_heap_next_deadline(void* h) {
+    return static_cast<DelayHeap*>(h)->next_deadline();
+}
+
+int kn_heap_ready_count(void* h) {
+    return static_cast<DelayHeap*>(h)->ready_count();
+}
+
+int kn_heap_size(void* h) { return static_cast<DelayHeap*>(h)->size(); }
+
+// Batched FNV-1a 64: hash n strings packed into buf at offs/lens.
+void kn_fnv1a64_batch(const char* buf, const int64_t* offs,
+                      const int64_t* lens, int n, uint64_t* out) {
+    for (int i = 0; i < n; ++i) {
+        uint64_t hash = 14695981039346656037ull;
+        const char* p = buf + offs[i];
+        for (int64_t j = 0; j < lens[i]; ++j) {
+            hash ^= static_cast<unsigned char>(p[j]);
+            hash *= 1099511628211ull;
+        }
+        out[i] = hash;
+    }
+}
+
+}  // extern "C"

@@ -135,6 +135,55 @@ def test_dryrun_create_cluster(home, capsys):
     assert not os.path.exists(os.path.join(str(home), "clusters", "dry", "kwok.yaml"))
 
 
+def test_kwok_daemon_accepts_config_docs(home, tmp_path):
+    """--config files mix Stages, KwokConfiguration, and endpoint CRs;
+    the daemon must route each kind to its consumer and come up."""
+    import subprocess
+    import sys
+
+    from kwok_tpu.stages import default_pod_stages
+
+    cfg = tmp_path / "config.yaml"
+    stage_doc = default_pod_stages()[0].to_dict()
+    docs = [
+        stage_doc,
+        {"apiVersion": "config.kwok.x-k8s.io/v1alpha1", "kind": "KwokConfiguration",
+         "options": {"nodeLeaseDurationSeconds": 0}},
+        {"apiVersion": "kwok.x-k8s.io/v1alpha1", "kind": "ClusterLogs",
+         "metadata": {"name": "logs"}, "spec": {"logs": []}},
+    ]
+    cfg.write_text(yaml.safe_dump_all(docs, sort_keys=False))
+
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "kwok_tpu.cmd.kwok",
+             "--server", srv.url, "--config", str(cfg),
+             "--server-address", "127.0.0.1:0"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env={**os.environ, "PYTHONPATH": os.path.dirname(os.path.dirname(__file__))},
+        )
+        try:
+            lines = []
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if not line:
+                    break
+                lines.append(line)
+                if "fake-kubelet server on" in line:
+                    break
+            joined = "".join(lines)
+            assert "kwok controller started" in joined, joined
+            assert "fake-kubelet server on" in joined, joined
+            assert proc.poll() is None, joined
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
 def test_cluster_lifecycle_end_to_end(home, capsys):
     """create → scale → kubectl → snapshot → stop → start (state
     persists) → hack → delete.  Real subprocess components."""

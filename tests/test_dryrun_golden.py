@@ -1,0 +1,78 @@
+"""Dry-run golden tests (reference test/e2e/dryrun.go:55-117 diffs
+``kwokctl --dry-run`` output against checked-in goldens; ``-update``
+regenerates — here: ``pytest --update-goldens`` via env var).
+
+Volatile tokens (ports, home dir, python path) normalize to
+placeholders so goldens are machine-independent, the same trick the
+reference plays with its <ROOT_DIR> substitutions."""
+
+import io
+import os
+import re
+import sys
+
+import pytest
+
+from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+from kwok_tpu.ctl.dryrun import dry_run
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "testdata", "dryrun")
+
+
+def normalize(text: str, home: str) -> str:
+    text = text.replace(home, "<HOME>")
+    text = text.replace(sys.executable, "<PYTHON>")
+    text = re.sub(r"--port \d+", "--port <PORT>", text)
+    text = re.sub(r"127\.0\.0\.1:\d+", "127.0.0.1:<PORT>", text)
+    return text
+
+
+def run_dry(home: str, argv) -> str:
+    sink = io.StringIO()
+    dry_run.enable(sink)
+    try:
+        kwokctl_main(argv)
+    finally:
+        dry_run.disable()
+    return normalize(sink.getvalue(), home)
+
+
+def check_golden(name: str, got: str) -> None:
+    path = os.path.join(GOLDEN_DIR, name)
+    if os.environ.get("UPDATE_GOLDENS"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(got)
+        return
+    if not os.path.exists(path):
+        pytest.fail(
+            f"golden {path} missing; run with UPDATE_GOLDENS=1 to create"
+        )
+    with open(path, "r", encoding="utf-8") as f:
+        want = f.read()
+    assert got == want, f"dry-run output drifted from {name}"
+
+
+@pytest.fixture()
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    return str(tmp_path)
+
+
+def test_create_cluster_golden(home):
+    got = run_dry(home, ["--name", "golden", "--dry-run", "create", "cluster"])
+    check_golden("create_cluster.txt", got)
+
+
+def test_create_cluster_secure_device_golden(home):
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "cluster",
+         "--secure", "--backend", "device"],
+    )
+    check_golden("create_cluster_secure_device.txt", got)
+
+
+def test_delete_cluster_golden(home):
+    got = run_dry(home, ["--name", "golden", "--dry-run", "delete", "cluster"])
+    check_golden("delete_cluster.txt", got)

@@ -1,0 +1,173 @@
+"""Native C++ runtime core: build, heap semantics, queue parity with
+the pure-Python WeightDelayingQueue, and a throughput sanity check."""
+
+import random
+import time
+
+import pytest
+
+from kwok_tpu.native import NativeDelayHeap, available, fnv1a64
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="g++ toolchain unavailable to build kwok_native"
+)
+
+
+def test_heap_orders_by_deadline_then_weight():
+    h = NativeDelayHeap()
+    h.add(1, 0, 10.0)
+    h.add(2, 1, 5.0)
+    h.add(3, 0, 5.0)
+    assert len(h) == 3
+    assert h.next_deadline() == 5.0
+
+    h.promote(6.0)
+    # both id2 and id3 are due; weight 0 pops before weight 1
+    assert h.pop_ready() == [3, 2]
+    h.promote(11.0)
+    assert h.pop_ready() == [1]
+    assert len(h) == 0
+    assert h.next_deadline() is None
+
+
+def test_heap_fifo_within_weight():
+    h = NativeDelayHeap()
+    for i in range(10):
+        h.add(i, 0, 1.0)
+    h.promote(2.0)
+    assert h.pop_ready() == list(range(10))
+
+
+def test_heap_cancel_and_reschedule():
+    h = NativeDelayHeap()
+    h.add(1, 0, 5.0)
+    h.add(2, 0, 5.0)
+    assert h.cancel(1)
+    assert not h.cancel(99)
+    h.promote(6.0)
+    assert h.pop_ready() == [2]
+
+    # re-adding an id reschedules (old entry goes stale)
+    h.add(7, 0, 100.0)
+    h.add(7, 0, 1.0)
+    assert h.next_deadline() == 1.0
+    h.promote(2.0)
+    assert h.pop_ready() == [7]
+    assert len(h) == 0
+
+
+def test_heap_pop_respects_max():
+    h = NativeDelayHeap()
+    for i in range(100):
+        h.add(i, 0, 1.0)
+    h.promote(2.0)
+    first = h.pop_ready(max_items=30)
+    rest = h.pop_ready()
+    assert first == list(range(30))
+    assert rest == list(range(30, 100))
+
+
+def test_fnv1a64_matches_reference_vectors():
+    # well-known FNV-1a 64 test vectors
+    out = fnv1a64(["", "a", "foobar"])
+    assert out[0] == 0xCBF29CE484222325
+    assert out[1] == 0xAF63DC4C8601EC8C
+    assert out[2] == 0x85944171F73967E8
+
+
+def test_native_queue_parity_with_python():
+    """Randomized schedule/cancel trace produces the same served
+    multiset and weight-class ordering in both implementations."""
+    from kwok_tpu.native.queue import NativeWeightDelayingQueue
+    from kwok_tpu.utils.clock import Clock
+    from kwok_tpu.utils.queue import WeightDelayingQueue
+
+    class ManualClock(Clock):
+        def __init__(self):
+            self.t = 0.0
+            self._subs = []
+
+        def now(self):
+            return self.t
+
+        def advance(self, dt):
+            self.t += dt
+            for s in self._subs:
+                s.set()
+
+        def subscribe(self, signal):
+            self._subs.append(signal)
+
+        def wait_signal(self, signal, timeout):
+            signal.wait(0.005)
+
+    rng = random.Random(7)
+    trace = []
+    for i in range(200):
+        trace.append(("add", f"item-{i}", rng.choice([0, 0, 0, 1]), rng.uniform(0.0, 5.0)))
+    cancelled = set()
+    for i in rng.sample(range(200), 40):
+        trace.append(("cancel", f"item-{i}"))
+        cancelled.add(f"item-{i}")
+
+    def run(queue_cls):
+        clock = ManualClock()
+        q = queue_cls(clock)
+        for op in trace:
+            if op[0] == "add":
+                q.add_weight_after(op[1], op[2], op[3])
+            else:
+                q.cancel(op[1])
+        served = []
+        deadline = time.monotonic() + 10
+        clock.advance(10.0)
+        while len(served) < 160 and time.monotonic() < deadline:
+            item, ok = q.get_or_wait(timeout=0.05)
+            if ok:
+                served.append(item)
+            else:
+                clock.advance(1.0)
+        q.stop()
+        return served
+
+    native = run(NativeWeightDelayingQueue)
+    python = run(WeightDelayingQueue)
+    assert len(native) == len(python) == 160
+    assert set(native) == set(python)
+    assert not (set(native) & cancelled)
+
+
+def test_native_queue_throughput():
+    """100k timers schedule + drain through the native heap fast."""
+    h = NativeDelayHeap()
+    t0 = time.perf_counter()
+    for i in range(100_000):
+        h.add(i, i % 3, float(i % 1000))
+    h.promote(1000.0)
+    total = 0
+    while True:
+        got = h.pop_ready(max_items=4096)
+        if not got:
+            break
+        total += len(got)
+    dt = time.perf_counter() - t0
+    assert total == 100_000
+    assert dt < 2.0, f"native heap too slow: {dt:.2f}s for 100k timers"
+
+
+def test_controllers_use_native_queue_when_available(monkeypatch):
+    from kwok_tpu.native.queue import NativeWeightDelayingQueue
+    from kwok_tpu.utils.queue import WeightDelayingQueue, new_weight_delaying_queue
+
+    q = new_weight_delaying_queue()
+    try:
+        assert isinstance(q, NativeWeightDelayingQueue)
+    finally:
+        q.stop()
+    monkeypatch.setenv("KWOK_TPU_NATIVE", "0")
+    q2 = new_weight_delaying_queue()
+    try:
+        assert isinstance(q2, WeightDelayingQueue)
+        assert not isinstance(q2, NativeWeightDelayingQueue)
+    finally:
+        q2.stop()
