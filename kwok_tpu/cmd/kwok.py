@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="source Stages from cluster CRs instead of local config",
     )
     p.add_argument("--backend", choices=["host", "device"], default=None)
+    p.add_argument(
+        "--enable-metrics-usage",
+        action="store_true",
+        help="install the builtin metrics-usage asset (kubelet "
+        "/metrics/resource emulation + annotation-driven usage)",
+    )
     p.add_argument("--id", default=None, help="controller identity (lease holder)")
     p.add_argument("--server-address", default="127.0.0.1:10247",
                    help="fake-kubelet server host:port ('' disables)")
@@ -116,9 +122,62 @@ def stages_from(docs: List[dict], enable_crds: bool) -> Optional[Dict[str, List[
     return grouped
 
 
+def _config_cr_kinds() -> List[str]:
+    """Config CR kinds the server consumes when --enable-crds is on
+    (reference server.go:154-419 switches each to a DynamicGetter) —
+    derived from the typed-config registry so a new kind is watched
+    automatically; ResourcePatch is the record/replay wire format, not
+    server config."""
+    from kwok_tpu.api.extra_types import CONFIG_KINDS
+
+    return [k for k in CONFIG_KINDS if k != "ResourcePatch"]
+
+
+def start_config_watcher(client, srv, done: threading.Event) -> None:
+    """Watch config CRs and swap the server's config set on change."""
+    import time
+    import traceback
+
+    from kwok_tpu.api.extra_types import from_document
+    from kwok_tpu.cluster.informer import Informer, WatchOptions
+    from kwok_tpu.utils.queue import Queue
+
+    kinds = _config_cr_kinds()
+    events: Queue = Queue()
+    for kind in kinds:
+        Informer(client, kind).watch(WatchOptions(), events, done=done)
+
+    def loop():
+        while not done.is_set():
+            _, ok = events.get_or_wait(timeout=0.5)
+            if not ok:
+                continue
+            time.sleep(0.2)  # debounce a burst of CR changes
+            while events.get()[1]:
+                pass
+            docs = []
+            for kind in kinds:
+                try:
+                    docs.extend(client.list(kind)[0])
+                except Exception:  # noqa: BLE001 — kind may be unregistered
+                    continue
+            try:
+                srv.replace_configs(
+                    [from_document(d) for d in docs if d.get("kind") in kinds]
+                )
+            except Exception:  # noqa: BLE001 — a bad CR must not kill the loop
+                traceback.print_exc()
+
+    threading.Thread(target=loop, daemon=True).start()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     docs = load_config_docs(args.config)
+    if args.enable_metrics_usage:
+        from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs
+
+        docs.extend(load_builtin_docs(METRICS_USAGE))
     conf = config_from(docs, args)
     stages = stages_from(docs, bool(conf.enable_crds))
 
@@ -136,6 +195,7 @@ def main(argv=None) -> int:
     ctr.start()
     print(f"kwok controller started (backend={conf.backend})", flush=True)
 
+    done = threading.Event()
     srv = None
     if args.server_address:
         host, _, port = args.server_address.rpartition(":")
@@ -153,19 +213,16 @@ def main(argv=None) -> int:
         srv = Server(cfg)
         # only endpoint/metric config kinds feed the server; Stages and
         # KwokConfiguration docs belong to the controller path above
-        from kwok_tpu.api.extra_types import CONFIG_KINDS, from_document
+        from kwok_tpu.api.extra_types import from_document
 
+        server_kinds = set(_config_cr_kinds())
         srv.set_configs(
-            [
-                from_document(d)
-                for d in docs
-                if d.get("kind") in CONFIG_KINDS and d.get("kind") != "ResourcePatch"
-            ]
+            [from_document(d) for d in docs if d.get("kind") in server_kinds]
         )
         bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
         print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
-
-    done = threading.Event()
+        if conf.enable_crds:
+            start_config_watcher(client, srv, done)
 
     def _stop(signum, frame):
         done.set()

@@ -30,7 +30,23 @@ DEFAULT_CLUSTER = "kwok-tpu"
 
 
 def _runtime(args) -> BinaryRuntime:
-    return BinaryRuntime(getattr(args, "name", None) or DEFAULT_CLUSTER)
+    """Pick the runtime: --runtime at create time, else whatever the
+    cluster was created with (reference runtime registry + autodetect,
+    kwokctl_configuration_types.go:96-103)."""
+    name = getattr(args, "name", None) or DEFAULT_CLUSTER
+    choice = getattr(args, "runtime", None)
+    if choice is None:
+        probe = BinaryRuntime(name)
+        if probe.exists():
+            choice = probe.load_config().get("runtime", "binary")
+        else:
+            choice = "binary"
+    if choice.startswith("compose"):
+        from kwok_tpu.ctl.compose import ComposeRuntime
+
+        engine = choice.split("/", 1)[1] if "/" in choice else "docker"
+        return ComposeRuntime(name, engine=engine)
+    return BinaryRuntime(name)
 
 
 def _require_cluster(args) -> BinaryRuntime:
@@ -198,12 +214,50 @@ def cmd_snapshot_record(args) -> int:
     return 0
 
 
+def _attach_keyboard(handle, done):
+    """Interactive playback control when stdin is a tty (reference
+    recording/handle.go:48-128): space pauses/resumes, +/- steps the
+    speed ladder, q aborts.  Returns a restore() callable the caller
+    MUST run on every exit path — the daemon reader thread stays
+    blocked in read(1), so only the main thread can reliably put the
+    terminal back into canonical mode."""
+    if not sys.stdin.isatty():
+        return lambda: None
+
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    tty.setcbreak(fd)
+
+    def restore() -> None:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    def reader():
+        while not done.is_set():
+            ch = sys.stdin.read(1)
+            if ch == " ":
+                handle.toggle()
+            elif ch in ("+", "="):
+                handle.faster()
+            elif ch == "-":
+                handle.slower()
+            elif ch in ("q", "\x03"):
+                done.set()
+
+    threading.Thread(target=reader, daemon=True).start()
+    print("playback keys: [space] pause/resume  [+/-] speed  [q] quit", flush=True)
+    return restore
+
+
 def cmd_snapshot_replay(args) -> int:
     from kwok_tpu.snapshot import PlaybackHandle, replay
 
     rt = _require_cluster(args)
     handle = PlaybackHandle(speed=args.speed)
     done = threading.Event()
+    restore_tty = _attach_keyboard(handle, done)
 
     def progress(i: int, total: int) -> None:
         print(f"\rreplay {i}/{total} (speed {handle.speed:g}x)", end="", flush=True)
@@ -218,9 +272,11 @@ def cmd_snapshot_replay(args) -> int:
             progress=progress,
         )
     except KeyboardInterrupt:
-        done.set()
         print("\nreplay interrupted")
         return 130
+    finally:
+        done.set()
+        restore_tty()
     print(f"\nreplayed {n} patches")
     return 0
 
@@ -366,6 +422,12 @@ def build_parser() -> argparse.ArgumentParser:
     c = pcs.add_parser("cluster")
     c.add_argument("--secure", action="store_true", help="TLS apiserver with generated PKI")
     c.add_argument("--backend", choices=["host", "device"], default="host")
+    c.add_argument(
+        "--runtime",
+        choices=["binary", "compose", "compose/docker", "compose/podman", "compose/nerdctl"],
+        default=None,
+        help="component runtime (default: binary = host processes)",
+    )
     c.add_argument("--config", action="append", default=[])
     c.add_argument("--controller-arg", action="append", default=[])
     c.add_argument("--wait", type=float, default=60.0)

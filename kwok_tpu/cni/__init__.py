@@ -1,0 +1,148 @@
+"""CNI integration for simulated pod networking.
+
+The reference optionally allocates *real* IPs for fake pods through
+go-cni + a network namespace when ``--experimental-enable-cni`` is on
+(reference pkg/kwok/cni/cni_linux.go:26+, gated linux-only); the
+default path is the in-process per-node CIDR pool
+(pod_controller.go:481-535).
+
+This module mirrors that split, speaking the standard CNI *plugin
+protocol* directly (CNI_COMMAND/CNI_CONTAINERID/CNI_NETNS env + network
+config JSON on stdin, IPAM result JSON on stdout) rather than binding
+to a Go library:
+
+- :class:`SimulatedCNI` — the default: wraps the same IPPool allocator
+  the pod controller uses; no privileges, works everywhere.
+- :class:`HostCNI` — EXPERIMENTAL: invokes a real CNI plugin binary
+  (e.g. host-local) per ADD/DEL.  Needs a plugin on disk; no netns is
+  created (kwok pods have no processes), so CNI_NETNS is passed as the
+  placeholder the plugin tolerates for pure-IPAM plugins.
+
+Both expose ``add(pod) -> ip`` / ``delete(pod)``, the two verbs the
+pod controller needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from kwok_tpu.controllers.utils import IPPool
+
+__all__ = ["SimulatedCNI", "HostCNI", "CNIError"]
+
+
+class CNIError(RuntimeError):
+    pass
+
+
+class SimulatedCNI:
+    """IPPool-backed CNI: the default simulated network.
+
+    Mirrors the pool path's invariants (pod_controller.py pod_ip_for):
+    allocation is serialized so concurrent plays for one pod cannot
+    double-allocate, and an IP already present in ``status.podIP`` is
+    re-reserved rather than re-issued (controller-restart safety)."""
+
+    def __init__(self, cidr: str = "10.0.0.1/24"):
+        self._pool = IPPool(cidr)
+        self._ips: Dict[str, str] = {}
+        self._mut = threading.Lock()
+
+    def add(self, pod: dict) -> str:
+        uid = (pod.get("metadata") or {}).get("uid") or ""
+        existing = (pod.get("status") or {}).get("podIP")
+        with self._mut:
+            ip = self._ips.get(uid)
+            if ip is None:
+                if existing:
+                    self._pool.use(existing)
+                    ip = existing
+                else:
+                    ip = self._pool.get()
+                self._ips[uid] = ip
+            return ip
+
+    def delete(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid") or ""
+        with self._mut:
+            ip = self._ips.pop(uid, None)
+            if ip is not None:
+                self._pool.put(ip)
+
+
+class HostCNI:
+    """Drive a real CNI plugin binary per the CNI spec (ADD/DEL).
+
+    ``plugin_path`` must point at a CNI plugin executable (the
+    canonical pure-IPAM choice is ``host-local``).  The network config
+    is the standard conflist member document."""
+
+    def __init__(
+        self,
+        plugin_path: str,
+        cidr: str = "10.244.0.0/16",
+        ifname: str = "eth0",
+        netns: str = "/var/run/netns/kwok-placeholder",
+        extra_conf: Optional[dict] = None,
+    ):
+        if not os.path.exists(plugin_path):
+            raise CNIError(f"CNI plugin not found: {plugin_path}")
+        self.plugin_path = plugin_path
+        self.ifname = ifname
+        self.netns = netns
+        self.conf = {
+            "cniVersion": "0.4.0",
+            "name": "kwok-net",
+            "type": os.path.basename(plugin_path),
+            "ipam": {
+                "type": os.path.basename(plugin_path),
+                "subnet": cidr,
+            },
+        }
+        if extra_conf:
+            self.conf.update(extra_conf)
+
+    def _invoke(self, command: str, pod: dict) -> dict:
+        uid = (pod.get("metadata") or {}).get("uid") or "no-uid"
+        env = dict(os.environ)
+        env.update(
+            {
+                "CNI_COMMAND": command,
+                "CNI_CONTAINERID": uid,
+                "CNI_NETNS": self.netns,
+                "CNI_IFNAME": self.ifname,
+                "CNI_PATH": os.path.dirname(self.plugin_path),
+            }
+        )
+        try:
+            proc = subprocess.run(
+                [self.plugin_path],
+                input=json.dumps(self.conf).encode(),
+                capture_output=True,
+                env=env,
+                timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError) as exc:
+            raise CNIError(f"CNI {command} failed to execute: {exc}") from exc
+        if proc.returncode != 0:
+            raise CNIError(
+                f"CNI {command} exited {proc.returncode}: "
+                f"{proc.stdout.decode(errors='replace')[:500]}"
+            )
+        out = proc.stdout.decode(errors="replace")
+        return json.loads(out) if out.strip() else {}
+
+    def add(self, pod: dict) -> str:
+        result = self._invoke("ADD", pod)
+        for ip_entry in result.get("ips") or []:
+            addr = (ip_entry.get("address") or "").split("/")[0]
+            if addr:
+                return addr
+        raise CNIError(f"CNI ADD returned no IP: {result}")
+
+    def delete(self, pod: dict) -> None:
+        self._invoke("DEL", pod)

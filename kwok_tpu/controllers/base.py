@@ -22,6 +22,7 @@ from __future__ import annotations
 import datetime
 import random
 import threading
+import traceback
 from typing import Callable, Dict, List, Optional
 
 from kwok_tpu.cluster.informer import InformerEvent
@@ -113,7 +114,12 @@ class StagePlayer:
             ev, ok = self.events.get_or_wait(timeout=0.2)
             if not ok:
                 continue
-            self.handle_event(ev)
+            try:
+                self.handle_event(ev)
+            except Exception:  # noqa: BLE001 — one bad event (e.g. a CNI
+                # release failure) must not kill the event loop; the
+                # preprocess/play workers guard the same way
+                traceback.print_exc()
 
     def handle_event(self, ev: InformerEvent) -> None:
         obj = ev.object

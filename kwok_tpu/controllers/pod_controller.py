@@ -28,10 +28,15 @@ class PodEnv:
         cidr: str = "10.0.0.1/24",
         node_ip: str = "10.0.0.1",
         node_getter: Optional[CacheGetter] = None,
+        cni=None,
     ):
         self.default_cidr = cidr
         self.node_ip = node_ip
         self.node_getter = node_getter
+        #: optional CNI backend (kwok_tpu.cni) replacing the pool path —
+        #: the reference's --experimental-enable-cni seam
+        #: (reference pkg/kwok/cni/cni_linux.go)
+        self.cni = cni
         self._pools: Dict[str, IPPool] = {}
         self._pool_mut = threading.Lock()
         #: uid -> (ip, owning pool); the pool is recorded at allocation
@@ -56,6 +61,8 @@ class PodEnv:
         pool IP keyed by uid (reference pod_controller.go:481-535)."""
         if (pod.get("spec") or {}).get("hostNetwork"):
             return self.node_ip_for((pod.get("spec") or {}).get("nodeName") or "")
+        if self.cni is not None:
+            return self.cni.add(pod)
         uid = (pod.get("metadata") or {}).get("uid") or ""
         existing = (pod.get("status") or {}).get("podIP")
         node = (pod.get("spec") or {}).get("nodeName") or ""
@@ -84,6 +91,9 @@ class PodEnv:
         return self.node_ip
 
     def release(self, pod: dict) -> None:
+        if self.cni is not None:
+            self.cni.delete(pod)
+            return
         uid = (pod.get("metadata") or {}).get("uid") or ""
         with self._pool_mut:
             hit = self._pod_ips.pop(uid, None)

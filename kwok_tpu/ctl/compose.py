@@ -1,0 +1,160 @@
+"""Compose runtime: the cluster as a docker-compose project.
+
+Mirrors the reference's compose runtime (reference
+pkg/kwokctl/runtime/compose/: per-component containers generated from
+the same Component specs the binary runtime forks).  Component argv
+lists translate into services on a python base image with the
+framework bind-mounted; ``up``/``down`` shell out to ``docker compose``
+(podman/nerdctl work identically via ``engine=``), and dry-run prints
+the commands instead, which is how the golden tests pin the topology
+(reference test/e2e/kwokctl/dryrun/testdata/docker/).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List
+
+import yaml
+
+from kwok_tpu.ctl.components import Component
+from kwok_tpu.ctl.dryrun import dry_run
+from kwok_tpu.ctl.runtime import BinaryRuntime
+
+#: image tag for component containers; any python>=3.10 works since the
+#: framework rides a bind mount
+DEFAULT_IMAGE = "python:3.12-slim"
+
+
+class ComposeRuntime(BinaryRuntime):
+    """Same install/list surface as BinaryRuntime; containers for up."""
+
+    def __init__(self, name: str = "kwok-tpu", engine: str = "docker"):
+        super().__init__(name)
+        self.engine = engine
+        self.runtime_label = f"compose/{engine}"
+
+    @property
+    def compose_path(self) -> str:
+        return self._path("docker-compose.yaml")
+
+    # ------------------------------------------------------------- install
+
+    def install(self, **kwargs) -> dict:
+        conf = super().install(**kwargs)
+        compose = self._compose_document()
+        if dry_run.enabled:
+            dry_run.emit(f"write {self.compose_path}")
+        else:
+            with open(self.compose_path, "w", encoding="utf-8") as f:
+                yaml.safe_dump(compose, f, sort_keys=False)
+        return conf
+
+    def _compose_document(self) -> dict:
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        components = (
+            self._installed_components
+            if self._installed_components is not None
+            else (self.load_components() if self.exists() else [])
+        )
+        services = {}
+        for comp in components:
+            services[comp.name] = self._service_for(comp, pkg_root)
+        return {"name": f"kwok-tpu-{self.name}", "services": services}
+
+    def _service_for(self, comp: Component, pkg_root: str) -> dict:
+        # rewrite the host python + host paths into container terms
+        args = ["python"] + [
+            a.replace(self.workdir, "/cluster") if isinstance(a, str) else a
+            for a in comp.args[1:]
+        ]
+        svc = {
+            "image": DEFAULT_IMAGE,
+            "command": args,
+            "working_dir": "/app",
+            "volumes": [
+                f"{pkg_root}:/app:ro",
+                f"{self.workdir}:/cluster",
+            ],
+            "environment": {"PYTHONPATH": "/app", **comp.env},
+            "network_mode": "host",
+            "restart": "unless-stopped",
+        }
+        if comp.depends_on:
+            svc["depends_on"] = list(comp.depends_on)
+        return svc
+
+    # ------------------------------------------------------------- up/down
+
+    def _compose_cmd(self, *verb: str) -> List[str]:
+        return [
+            self.engine,
+            "compose",
+            "-f",
+            self.compose_path,
+            *verb,
+        ]
+
+    def up(self, wait: float = 30.0) -> None:
+        cmd = self._compose_cmd("up", "-d")
+        if dry_run.enabled:
+            dry_run.emit_cmd(cmd)
+            return
+        subprocess.run(cmd, check=True)
+        if not self.ready(timeout=wait):
+            raise RuntimeError(
+                f"apiserver did not become ready within {wait}s (compose)"
+            )
+
+    def down(self) -> None:
+        cmd = self._compose_cmd("down")
+        if dry_run.enabled:
+            dry_run.emit_cmd(cmd)
+            return
+        if os.path.exists(self.compose_path):
+            subprocess.run(cmd, check=False)
+
+    def start_component(self, comp: Component) -> None:
+        cmd = self._compose_cmd("start", comp.name)
+        if dry_run.enabled:
+            dry_run.emit_cmd(cmd)
+            return
+        subprocess.run(cmd, check=True)
+
+    def stop_component(self, name: str, timeout: float = 10.0) -> None:
+        cmd = self._compose_cmd("stop", name)
+        if dry_run.enabled:
+            dry_run.emit_cmd(cmd)
+            return
+        subprocess.run(cmd, check=False)
+
+    def running_components(self) -> dict:
+        out = {}
+        try:
+            res = subprocess.run(
+                self._compose_cmd("ps", "--services", "--status", "running"),
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+            running = set(res.stdout.split())
+        except (OSError, subprocess.SubprocessError):
+            running = set()
+        for comp in self.load_components():
+            out[comp.name] = comp.name in running
+        return out
+
+    @staticmethod
+    def engine_available(engine: str = "docker") -> bool:
+        try:
+            subprocess.run(
+                [engine, "version"],
+                capture_output=True,
+                timeout=10,
+            )
+            return True
+        except (OSError, subprocess.SubprocessError):
+            return False

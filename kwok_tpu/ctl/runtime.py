@@ -67,6 +67,9 @@ def list_clusters() -> List[str]:
 class BinaryRuntime:
     """One cluster's lifecycle (reference runtime/binary/cluster.go)."""
 
+    #: recorded in kwok.yaml so later commands re-select the runtime
+    runtime_label = "binary"
+
     def __init__(self, name: str = "kwok-tpu"):
         self.name = name
         self.workdir = cluster_dir(name)
@@ -152,6 +155,7 @@ class BinaryRuntime:
         conf = {
             "kind": "KwokctlConfiguration",
             "name": self.name,
+            "runtime": self.runtime_label,
             "serverURL": server_url,
             "secure": secure,
             "backend": backend,

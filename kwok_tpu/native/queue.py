@@ -85,22 +85,6 @@ class NativeWeightDelayingQueue(WeightQueue[T]):
                     removed = True
         return self.remove(item) or removed
 
-    def remove(self, item: T) -> bool:
-        """Remove from the promoted FIFO or any weight bucket."""
-        with self._mut:
-            try:
-                self._items.remove(item)
-                return True
-            except ValueError:
-                pass
-            for bucket in self._buckets.values():
-                try:
-                    bucket.remove(item)
-                    return True
-                except ValueError:
-                    continue
-        return False
-
     # --------------------------------------------------------------- worker
 
     def _drop_entry(self, eid: int) -> Optional[Tuple[T, int]]:
@@ -141,9 +125,12 @@ class NativeWeightDelayingQueue(WeightQueue[T]):
         self._stopped = True
         self._hsignal.set()
 
-    def __len__(self) -> int:
-        with self._mut:
-            n = len(self._items) + sum(len(b) for b in self._buckets.values())
+    # __len__ deliberately inherits WeightQueue's (promoted items only,
+    # excluding not-yet-due delayed entries) to match the pure-Python
+    # WeightDelayingQueue exactly; pending_count exposes the rest.
+
+    @property
+    def pending_count(self) -> int:
+        """Scheduled-but-not-yet-due entries (native heap residents)."""
         with self._hmut:
-            n += len(self._entries)
-        return n
+            return len(self._entries)

@@ -194,6 +194,57 @@ class Server:
             r.add("GET", p, self._disabled)
         r.add("GET", "/debug/threads", self._debug_threads)
 
+    #: types set_configs accepts, for pre-validation in replace_configs
+    _CONFIG_TYPES = (
+        Logs,
+        ClusterLogs,
+        Attach,
+        ClusterAttach,
+        Exec,
+        ClusterExec,
+        PortForward,
+        ClusterPortForward,
+        Metric,
+        ResourceUsage,
+        ClusterResourceUsage,
+    )
+
+    def replace_configs(self, docs: List[Any]) -> None:
+        """Swap the whole config set live (the --enable-crds path: the
+        reference switches each config kind to a CRD-watch-backed
+        DynamicGetter, server.go:154-419; here the watcher calls this
+        with the current CR set on every change).
+
+        Validates the full set BEFORE tearing down the old one, so one
+        bad CR rejects the swap instead of leaving the server stripped
+        of its previously working configs."""
+        for d in docs:
+            if not isinstance(d, self._CONFIG_TYPES):
+                raise TypeError(f"unsupported config type: {type(d).__name__}")
+            if isinstance(d, Metric) and not d.path.startswith("/metrics"):
+                raise ValueError(
+                    f"metric path {d.path!r} does not start with /metrics"
+                )
+        for m in self.metrics:
+            self.router.remove("GET", m.path)
+        for lst in (
+            self.logs,
+            self.cluster_logs,
+            self.attaches,
+            self.cluster_attaches,
+            self.execs,
+            self.cluster_execs,
+            self.port_forwards,
+            self.cluster_port_forwards,
+            self.metrics,
+        ):
+            lst.clear()
+        with self._metric_handlers_lock:
+            self._metric_handlers.clear()
+        self.usage.set_usages([])
+        self.usage.set_cluster_usages([])
+        self.set_configs(docs)
+
     def _install_metric(self, m: Metric) -> None:
         if not m.path.startswith("/metrics"):
             raise ValueError(f"metric path {m.path!r} does not start with /metrics")

@@ -25,11 +25,29 @@ NODE_CHAOS = "node-chaos"
 ALL_SETS = [POD_FAST, POD_GENERAL, POD_CHAOS, NODE_FAST, NODE_HEARTBEAT, NODE_CHAOS]
 
 
-def load_builtin(name: str) -> List[Stage]:
+#: non-Stage builtin asset: Metric + ClusterResourceUsage emulating the
+#: kubelet /metrics/resource endpoint (the reference's metrics-usage
+#: chart, charts/metrics-usage/templates/)
+METRICS_USAGE = "metrics-usage"
+
+
+def builtin_asset_path(name: str) -> str:
     path = os.path.join(_DIR, f"{name}.yaml")
     if not os.path.exists(path):
-        raise ValueError(f"unknown builtin stage set {name!r}; have {ALL_SETS}")
-    return load_stages(path)
+        raise ValueError(f"unknown builtin asset {name!r}; have {ALL_SETS + [METRICS_USAGE]}")
+    return path
+
+
+def load_builtin(name: str) -> List[Stage]:
+    return load_stages(builtin_asset_path(name))
+
+
+def load_builtin_docs(name: str) -> List[dict]:
+    """Raw YAML documents of a builtin asset (for non-Stage kinds like
+    the metrics-usage Metric/ClusterResourceUsage pair)."""
+    from kwok_tpu.api.loader import load_documents
+
+    return load_documents(builtin_asset_path(name))
 
 
 def default_node_stages(lease: bool = False) -> List[Stage]:

@@ -134,6 +134,37 @@ class _ZeroRandom:
         return 0
 
 
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI driver (reference hack/test_stage/main.go:46-80): apply
+    stage files to one resource YAML, print the outcome structure.
+
+    usage: python -m kwok_tpu.tools.stage_tester OBJECT.yaml STAGE.yaml...
+    """
+    import argparse
+    import sys
+
+    import yaml
+
+    from kwok_tpu.api.loader import load_stages
+
+    p = argparse.ArgumentParser(
+        prog="stage-tester",
+        description="apply Stages to one object offline, no cluster needed",
+    )
+    p.add_argument("object", help="YAML file with the target object")
+    p.add_argument("stages", nargs="+", help="Stage YAML files")
+    args = p.parse_args(argv)
+
+    with open(args.object, "r", encoding="utf-8") as f:
+        target = yaml.safe_load(f)
+    stages: List[Stage] = []
+    for path in args.stages:
+        stages.extend(load_stages(path))
+    out = testing_stages(target, stages)
+    yaml.safe_dump(out, sys.stdout, sort_keys=False)
+    return 0
+
+
 def _format_patch(patch) -> Dict[str, Any]:
     out: Dict[str, Any] = {"kind": "patch", "type": patch.content_type}
     if patch.subresource:
@@ -142,3 +173,9 @@ def _format_patch(patch) -> Dict[str, Any]:
     if patch.impersonation:
         out["impersonation"] = patch.impersonation
     return out
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via CLI test
+    import sys
+
+    sys.exit(main())

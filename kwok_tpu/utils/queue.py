@@ -103,6 +103,22 @@ class WeightQueue(Queue[T]):
             self._buckets.setdefault(weight, deque()).append(item)
         self._signal.set()
 
+    def remove(self, item: T) -> bool:
+        """Remove from the main FIFO or any weight bucket."""
+        with self._mut:
+            try:
+                self._items.remove(item)
+                return True
+            except ValueError:
+                pass
+            for bucket in self._buckets.values():
+                try:
+                    bucket.remove(item)
+                    return True
+                except ValueError:
+                    continue
+        return False
+
     def _step(self) -> bool:
         """Drain buckets into the main queue; returns True if anything moved."""
         added = False
@@ -297,22 +313,6 @@ class WeightDelayingQueue(WeightQueue[T]):
 
     def add_after(self, item: T, delay: float) -> None:
         self.add_weight_after(item, 0, delay)
-
-    def remove(self, item: T) -> bool:
-        """Remove from the main FIFO or any weight bucket."""
-        with self._mut:
-            try:
-                self._items.remove(item)
-                return True
-            except ValueError:
-                pass
-            for bucket in self._buckets.values():
-                try:
-                    bucket.remove(item)
-                    return True
-                except ValueError:
-                    continue
-        return False
 
     def cancel(self, item: T) -> bool:
         """Remove an item whether still delayed or already promoted."""

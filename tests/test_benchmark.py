@@ -1,0 +1,105 @@
+"""Throughput gates, the CI-benchmark analog (reference
+test/kwokctl/kwokctl_benchmark_test.sh:100-124: 2000 nodes ≤120s,
+5000 pods ≤240s create, 5000 pods ≤240s delete).  Run in-process
+against the host backend — the reference numbers are its ceiling; the
+device backend's throughput is bench.py's headline metric."""
+
+import time
+
+from kwok_tpu.api.config import KwokConfiguration
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.controllers.controller import Controller
+from kwok_tpu.ctl.scale import scale
+from kwok_tpu.stages import default_node_stages, default_pod_stages
+
+N_NODES = 500
+N_PODS = 1500
+CREATE_NODES_BUDGET_S = 30.0  # reference: 2000 ≤ 120s → 60 s at this scale
+CREATE_PODS_BUDGET_S = 72.0  # reference: 5000 ≤ 240s → 72 s at this scale
+DELETE_PODS_BUDGET_S = 72.0
+
+
+def wait_until(cond, budget):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return cond()
+
+
+def test_benchmark_create_and_delete_rates():
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(manage_all_nodes=True, node_lease_duration_seconds=0),
+        local_stages={
+            "Node": default_node_stages(),
+            "Pod": default_pod_stages(),
+        },
+        seed=0,
+    )
+    ctr.start()
+    try:
+        t0 = time.monotonic()
+        scale(store, "node", N_NODES)
+
+        def nodes_ready():
+            nodes, _ = store.list("Node")
+            return len(nodes) == N_NODES and all(
+                any(
+                    c.get("type") == "Ready" and c.get("status") == "True"
+                    for c in (n.get("status") or {}).get("conditions", [])
+                )
+                for n in nodes
+            )
+
+        assert wait_until(nodes_ready, CREATE_NODES_BUDGET_S), (
+            f"nodes not Ready within {CREATE_NODES_BUDGET_S}s"
+        )
+        node_secs = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        # spread pods across nodes like the reference benchmark
+        for shard in range(5):
+            scale(
+                store,
+                "pod",
+                N_PODS // 5,
+                name_prefix=f"pod-{shard}",
+                params={"nodeName": f"node-{shard}"},
+            )
+
+        def pods_running():
+            pods, _ = store.list("Pod")
+            return len(pods) == N_PODS and all(
+                (p.get("status") or {}).get("phase") == "Running" for p in pods
+            )
+
+        assert wait_until(pods_running, CREATE_PODS_BUDGET_S), (
+            f"pods not Running within {CREATE_PODS_BUDGET_S}s"
+        )
+        pod_secs = time.monotonic() - t0
+
+        t0 = time.monotonic()
+        for pp in store.list("Pod")[0]:
+            try:
+                store.delete("Pod", pp["metadata"]["name"])
+            except KeyError:
+                pass
+
+        def pods_gone():
+            return store.count("Pod") == 0
+
+        assert wait_until(pods_gone, DELETE_PODS_BUDGET_S), (
+            f"pods not deleted within {DELETE_PODS_BUDGET_S}s "
+            f"({store.count('Pod')} left)"
+        )
+        del_secs = time.monotonic() - t0
+
+        # reference-equivalent rates: ≥16.6 nodes/s, ≥20.8 pods/s
+        assert N_NODES / node_secs > 16.6
+        assert N_PODS / pod_secs > 20.8
+        assert N_PODS / del_secs > 20.8
+    finally:
+        ctr.stop()

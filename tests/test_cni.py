@@ -1,0 +1,86 @@
+"""CNI seam: simulated backend, real plugin-protocol invocation
+against a stub plugin, and PodEnv wiring (reference
+pkg/kwok/cni/cni_linux.go + --experimental-enable-cni)."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from kwok_tpu.cni import CNIError, HostCNI, SimulatedCNI
+from kwok_tpu.controllers.pod_controller import PodEnv
+
+
+def make_pod(uid, host_network=False):
+    return {
+        "metadata": {"name": f"p-{uid}", "namespace": "default", "uid": uid},
+        "spec": {"nodeName": "n0", "hostNetwork": host_network},
+        "status": {},
+    }
+
+
+def test_simulated_cni_allocates_and_recycles():
+    cni = SimulatedCNI("10.5.0.1/24")
+    a = cni.add(make_pod("u1"))
+    b = cni.add(make_pod("u2"))
+    assert a != b and a.startswith("10.5.0.")
+    assert cni.add(make_pod("u1")) == a  # stable per uid
+    cni.delete(make_pod("u1"))
+    c = cni.add(make_pod("u3"))
+    assert c == a  # recycled
+
+
+def test_host_cni_speaks_plugin_protocol(tmp_path):
+    """A stub plugin validates the CNI env/stdin contract and returns a
+    spec-shaped IPAM result."""
+    plugin = tmp_path / "host-local"
+    plugin.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, os, sys\n"
+        "conf = json.load(sys.stdin)\n"
+        "assert conf['ipam']['subnet'] == '10.9.0.0/24', conf\n"
+        "cmd = os.environ['CNI_COMMAND']\n"
+        "cid = os.environ['CNI_CONTAINERID']\n"
+        "assert os.environ['CNI_IFNAME'] == 'eth0'\n"
+        "if cmd == 'ADD':\n"
+        "    last = int(cid[-1]) if cid[-1].isdigit() else 9\n"
+        "    json.dump({'cniVersion': '0.4.0',\n"
+        "               'ips': [{'address': f'10.9.0.{last}/24'}]}, sys.stdout)\n"
+        "elif cmd == 'DEL':\n"
+        "    pass\n"
+        "else:\n"
+        "    sys.exit(1)\n"
+    )
+    plugin.chmod(plugin.stat().st_mode | stat.S_IEXEC)
+
+    cni = HostCNI(str(plugin), cidr="10.9.0.0/24")
+    assert cni.add(make_pod("u1")) == "10.9.0.1"
+    assert cni.add(make_pod("u7")) == "10.9.0.7"
+    cni.delete(make_pod("u1"))
+
+
+def test_host_cni_missing_plugin():
+    with pytest.raises(CNIError):
+        HostCNI("/nonexistent/plugin")
+
+
+def test_host_cni_plugin_failure(tmp_path):
+    plugin = tmp_path / "broken"
+    plugin.write_text("#!/bin/sh\nexit 3\n")
+    plugin.chmod(plugin.stat().st_mode | stat.S_IEXEC)
+    cni = HostCNI(str(plugin))
+    with pytest.raises(CNIError, match="exited 3"):
+        cni.add(make_pod("u1"))
+
+
+def test_pod_env_uses_cni_backend():
+    cni = SimulatedCNI("10.7.0.1/24")
+    env = PodEnv(cni=cni)
+    pod = make_pod("u1")
+    ip = env.pod_ip_for(pod)
+    assert ip.startswith("10.7.0.")
+    # hostNetwork still bypasses CNI
+    assert env.pod_ip_for(make_pod("u2", host_network=True)) == env.node_ip
+    env.release(pod)
+    assert env.pod_ip_for(make_pod("u3")) == ip  # recycled through CNI

@@ -1,0 +1,69 @@
+"""Compose runtime: generated docker-compose topology + dryrun goldens
+(reference pkg/kwokctl/runtime/compose + dryrun testdata/docker)."""
+
+import os
+
+import pytest
+import yaml
+
+from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+from kwok_tpu.ctl.compose import ComposeRuntime
+
+
+@pytest.fixture()
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    return str(tmp_path)
+
+
+def test_compose_document_topology(home):
+    rt = ComposeRuntime("c1")
+    conf = rt.install(secure=True, backend="device")
+    assert conf["runtime"] == "compose/docker"
+    assert rt.load_config()["runtime"] == "compose/docker"
+
+    doc = yaml.safe_load(open(rt.compose_path))
+    services = doc["services"]
+    assert set(services) == {"apiserver", "kwok-controller"}
+
+    api = services["apiserver"]
+    assert api["command"][0] == "python"
+    assert "-m" in api["command"] and "kwok_tpu.cmd.apiserver" in api["command"]
+    # host cluster paths rewritten to the /cluster mount
+    assert any(a.startswith("/cluster/") for a in api["command"] if isinstance(a, str))
+    assert api["network_mode"] == "host"
+    assert any(v.endswith(":/app:ro") for v in api["volumes"])
+
+    ctl = services["kwok-controller"]
+    assert ctl["depends_on"] == ["apiserver"]
+    assert "--backend" in ctl["command"] and "device" in ctl["command"]
+    # TLS material rides the /cluster mount too
+    assert any("/cluster/pki" in a for a in ctl["command"] if isinstance(a, str))
+
+
+def test_compose_dryrun_commands(home, capsys):
+    rc = kwokctl_main(
+        ["--name", "c2", "--dry-run", "create", "cluster", "--runtime", "compose"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "docker compose" in out and "up -d" in out
+    assert "docker-compose.yaml" in out
+    # nothing touched disk
+    assert not os.path.exists(
+        os.path.join(home, "clusters", "c2", "docker-compose.yaml")
+    )
+
+
+def test_runtime_selection_persists(home):
+    rt = ComposeRuntime("c3", engine="podman")
+    rt.install()
+    from kwok_tpu.cmd.kwokctl import _runtime
+
+    class Args:
+        name = "c3"
+        runtime = None
+
+    picked = _runtime(Args())
+    assert isinstance(picked, ComposeRuntime)
+    assert picked.engine == "podman"

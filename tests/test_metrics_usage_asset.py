@@ -1,0 +1,82 @@
+"""The builtin metrics-usage asset: kubelet /metrics/resource emulation
+(reference charts/metrics-usage — Metric CR + annotation-driven
+ClusterResourceUsage; SURVEY §2.8)."""
+
+import json
+import urllib.request
+
+from kwok_tpu.api.extra_types import from_document
+from kwok_tpu.server.server import Server, ServerConfig
+from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs
+
+NODES = {"node-0": {"metadata": {"name": "node-0"}, "status": {}}}
+PODS = [
+    {
+        "metadata": {
+            "name": "pod-0",
+            "namespace": "default",
+            "annotations": {
+                "kwok.x-k8s.io/usage-cpu": "250m",
+                "kwok.x-k8s.io/usage-memory": "64Mi",
+            },
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+        },
+        "spec": {"nodeName": "node-0", "containers": [{"name": "app"}]},
+        "status": {"phase": "Running", "startTime": "2026-01-01T00:00:00Z"},
+    },
+    {
+        "metadata": {
+            "name": "pod-1",
+            "namespace": "default",
+            "annotations": {},
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+        },
+        "spec": {"nodeName": "node-0", "containers": [{"name": "app"}]},
+        "status": {"phase": "Running", "startTime": "2026-01-01T00:00:00Z"},
+    },
+]
+
+
+def test_docs_load_and_install():
+    docs = load_builtin_docs(METRICS_USAGE)
+    kinds = [d["kind"] for d in docs]
+    assert kinds == ["Metric", "ClusterResourceUsage"]
+
+    cfg = ServerConfig(
+        get_node=NODES.get,
+        get_pod=lambda ns, n: next(
+            (p for p in PODS if p["metadata"]["name"] == n), None
+        ),
+        list_pods=lambda node: [p for p in PODS if p["spec"]["nodeName"] == node],
+        list_nodes=lambda: list(NODES),
+    )
+    srv = Server(cfg)
+    srv.set_configs([from_document(d) for d in docs])
+    port = srv.serve(port=0)
+    try:
+        url = f"http://127.0.0.1:{port}/metrics/nodes/node-0/metrics/resource"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        # kubelet resource-metrics names are all present
+        for name in (
+            "scrape_error",
+            "container_start_time_seconds",
+            "container_cpu_usage_seconds_total",
+            "container_memory_working_set_bytes",
+            "pod_cpu_usage_seconds_total",
+            "pod_memory_working_set_bytes",
+            "node_cpu_usage_seconds_total",
+            "node_memory_working_set_bytes",
+        ):
+            assert name in body, f"{name} missing from:\n{body}"
+        # annotation-driven usage: pod-0 memory 64Mi, pod-1 default 1Mi
+        mem = {}
+        for line in body.splitlines():
+            if line.startswith("pod_memory_working_set_bytes{"):
+                labels, val = line.rsplit(" ", 1)
+                mem["pod-0" if 'pod="pod-0"' in labels else "pod-1"] = float(val)
+        assert mem["pod-0"] == 64 * 1024 * 1024
+        assert mem["pod-1"] == 1024 * 1024
+        # per-pod labels on container dimension
+        assert 'container="app"' in body and 'namespace="default"' in body
+    finally:
+        srv.close()
