@@ -28,11 +28,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tls-cert", default="")
     p.add_argument("--tls-key", default="")
     p.add_argument("--client-ca", default="")
+    p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kwok_tpu.utils.log import setup as log_setup
+
+    log_setup(args.verbosity)
     store = ResourceStore()
     if args.state_file and os.path.exists(args.state_file):
         n = store.load_file(args.state_file)

@@ -61,6 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fake-kubelet server host:port ('' disables)")
     p.add_argument("--wait-timeout", type=float, default=60.0)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
 
@@ -133,8 +134,12 @@ def _config_cr_kinds() -> List[str]:
     return [k for k in CONFIG_KINDS if k != "ResourcePatch"]
 
 
-def start_config_watcher(client, srv, done: threading.Event) -> None:
-    """Watch config CRs and swap the server's config set on change."""
+def start_config_watcher(client, srv, done: threading.Event, base_configs=None) -> None:
+    """Watch config CRs and swap the server's config set on change.
+
+    ``base_configs`` are locally configured typed docs (e.g. the
+    --enable-metrics-usage asset); every swap re-installs them alongside
+    the cluster CRs so a CR event cannot wipe local configuration."""
     import time
     import traceback
 
@@ -142,6 +147,7 @@ def start_config_watcher(client, srv, done: threading.Event) -> None:
     from kwok_tpu.cluster.informer import Informer, WatchOptions
     from kwok_tpu.utils.queue import Queue
 
+    base_configs = list(base_configs or [])
     kinds = _config_cr_kinds()
     events: Queue = Queue()
     for kind in kinds:
@@ -163,7 +169,8 @@ def start_config_watcher(client, srv, done: threading.Event) -> None:
                     continue
             try:
                 srv.replace_configs(
-                    [from_document(d) for d in docs if d.get("kind") in kinds]
+                    base_configs
+                    + [from_document(d) for d in docs if d.get("kind") in kinds]
                 )
             except Exception:  # noqa: BLE001 — a bad CR must not kill the loop
                 traceback.print_exc()
@@ -173,6 +180,9 @@ def start_config_watcher(client, srv, done: threading.Event) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    from kwok_tpu.utils.log import setup as log_setup
+
+    log_setup(args.verbosity)
     docs = load_config_docs(args.config)
     if args.enable_metrics_usage:
         from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs
@@ -216,13 +226,14 @@ def main(argv=None) -> int:
         from kwok_tpu.api.extra_types import from_document
 
         server_kinds = set(_config_cr_kinds())
-        srv.set_configs(
-            [from_document(d) for d in docs if d.get("kind") in server_kinds]
-        )
+        local_configs = [
+            from_document(d) for d in docs if d.get("kind") in server_kinds
+        ]
+        srv.set_configs(local_configs)
         bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
         print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
         if conf.enable_crds:
-            start_config_watcher(client, srv, done)
+            start_config_watcher(client, srv, done, base_configs=local_configs)
 
     def _stop(signum, frame):
         done.set()

@@ -143,6 +143,97 @@ def cmd_logs(args) -> int:
     return 0
 
 
+def cmd_export_logs(args) -> int:
+    rt = _require_cluster(args)
+    collected = rt.collect_logs(args.dest)
+    print(f"exported {len(collected)} files to {args.dest}")
+    return 0
+
+
+def _scrape_resource_metrics(rt, nodes):
+    """One scrape of every node's /metrics/resource → per-pod and
+    per-node samples {key: (cpu_seconds, memory_bytes)}."""
+    import urllib.request
+
+    conf = rt.load_config()
+    port = conf["ports"]["kubelet"]
+    pods = {}
+    node_samples = {}
+    for node in nodes:
+        url = f"http://127.0.0.1:{port}/metrics/nodes/{node}/metrics/resource"
+        try:
+            body = urllib.request.urlopen(url, timeout=10).read().decode()
+        except OSError:
+            continue
+        for line in body.splitlines():
+            if line.startswith("#") or " " not in line:
+                continue
+            series, val = line.rsplit(" ", 1)
+            labels = {}
+            if "{" in series:
+                name, lbl = series.split("{", 1)
+                for part in lbl.rstrip("}").split(","):
+                    if "=" in part:
+                        k, v = part.split("=", 1)
+                        labels[k] = v.strip('"')
+            else:
+                name = series
+            if name == "pod_cpu_usage_seconds_total":
+                key = (labels.get("namespace", ""), labels.get("pod", ""))
+                pods.setdefault(key, [0.0, 0.0])[0] = float(val)
+            elif name == "pod_memory_working_set_bytes":
+                key = (labels.get("namespace", ""), labels.get("pod", ""))
+                pods.setdefault(key, [0.0, 0.0])[1] = float(val)
+            elif name == "node_cpu_usage_seconds_total":
+                node_samples.setdefault(node, [0.0, 0.0])[0] = float(val)
+            elif name == "node_memory_working_set_bytes":
+                node_samples.setdefault(node, [0.0, 0.0])[1] = float(val)
+    return pods, node_samples
+
+
+def cmd_kubectl_top(args) -> int:
+    """``kubectl top pods|nodes`` — the metrics-server equivalent: CPU
+    from the cumulative counter's rate over a short window, memory from
+    the working-set gauge, both served by the metrics-usage asset."""
+    if args.window <= 0:
+        print("--window must be positive", file=sys.stderr)
+        return 2
+    rt = _require_cluster(args)
+    client = rt.client()
+    nodes = [n["metadata"]["name"] for n in client.list("Node")[0]]
+    before_pods, before_nodes = _scrape_resource_metrics(rt, nodes)
+    if not before_pods and not before_nodes:
+        print(
+            "no resource metrics; create the cluster with "
+            "--controller-arg=--enable-metrics-usage",
+            file=sys.stderr,
+        )
+        return 1
+    window = args.window
+    time.sleep(window)
+    after_pods, after_nodes = _scrape_resource_metrics(rt, nodes)
+
+    def fmt_cpu(delta):
+        return f"{max(delta, 0) / window * 1000:.0f}m"
+
+    def fmt_mem(b):
+        return f"{b / (1024 * 1024):.0f}Mi"
+
+    if args.top_what == "pods":
+        print(f"{'NAMESPACE':<16} {'NAME':<24} {'CPU(cores)':<12} MEMORY(bytes)")
+        for key in sorted(after_pods):
+            cpu1, mem = after_pods[key]
+            cpu0 = before_pods.get(key, [cpu1, 0])[0]
+            print(f"{key[0]:<16} {key[1]:<24} {fmt_cpu(cpu1 - cpu0):<12} {fmt_mem(mem)}")
+    else:
+        print(f"{'NAME':<24} {'CPU(cores)':<12} MEMORY(bytes)")
+        for node in sorted(after_nodes):
+            cpu1, mem = after_nodes[node]
+            cpu0 = before_nodes.get(node, [cpu1, 0])[0]
+            print(f"{node:<24} {fmt_cpu(cpu1 - cpu0):<12} {fmt_mem(mem)}")
+    return 0
+
+
 def cmd_scale(args) -> int:
     from kwok_tpu.ctl.scale import parse_params, scale
 
@@ -459,6 +550,12 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("component")
     pl.set_defaults(fn=cmd_logs)
 
+    pe = sub.add_parser("export", help="export cluster artifacts")
+    pes = pe.add_subparsers(dest="what", required=True)
+    el = pes.add_parser("logs")
+    el.add_argument("dest", help="destination directory")
+    el.set_defaults(fn=cmd_export_logs)
+
     px = sub.add_parser("scale", help="create N rendered objects")
     px.add_argument("kind", help="node | pod | any registered kind with --template")
     px.add_argument("--replicas", type=int, required=True)
@@ -525,6 +622,11 @@ def build_parser() -> argparse.ArgumentParser:
     kd.add_argument("object_name")
     kd.add_argument("-n", "--namespace", default=None)
     kd.set_defaults(fn=cmd_kubectl)
+    kt = pks.add_parser("top")
+    kt.add_argument("top_what", choices=["pods", "nodes"])
+    kt.add_argument("--window", type=float, default=1.0,
+                    help="rate window in seconds for CPU")
+    kt.set_defaults(fn=cmd_kubectl_top)
 
     return p
 

@@ -91,6 +91,8 @@ class PodEnv:
         return self.node_ip
 
     def release(self, pod: dict) -> None:
+        if (pod.get("spec") or {}).get("hostNetwork"):
+            return  # never allocated: both paths bypass hostNetwork pods
         if self.cni is not None:
             self.cni.delete(pod)
             return

@@ -99,15 +99,13 @@ class ComposeRuntime(BinaryRuntime):
         ]
 
     def up(self, wait: float = 30.0) -> None:
+        # readiness is the caller's concern (cmd_create_cluster polls
+        # ready() and prints the friendly failure), same as BinaryRuntime
         cmd = self._compose_cmd("up", "-d")
         if dry_run.enabled:
             dry_run.emit_cmd(cmd)
             return
         subprocess.run(cmd, check=True)
-        if not self.ready(timeout=wait):
-            raise RuntimeError(
-                f"apiserver did not become ready within {wait}s (compose)"
-            )
 
     def down(self) -> None:
         cmd = self._compose_cmd("down")
@@ -147,14 +145,28 @@ class ComposeRuntime(BinaryRuntime):
             out[comp.name] = comp.name in running
         return out
 
-    @staticmethod
-    def engine_available(engine: str = "docker") -> bool:
+    # ---------------------------------------------------------------- logs
+
+    def logs(self, component: str, follow: bool = False) -> str:
+        """Component stdout lives with the engine, not in workdir/logs."""
         try:
-            subprocess.run(
-                [engine, "version"],
+            res = subprocess.run(
+                self._compose_cmd("logs", "--no-color", component),
                 capture_output=True,
-                timeout=10,
+                text=True,
+                timeout=60,
             )
-            return True
+            return res.stdout
         except (OSError, subprocess.SubprocessError):
-            return False
+            return ""
+
+    def collect_logs(self, dest: str) -> List[str]:
+        collected = super().collect_logs(dest)
+        for comp in self.load_components() if self.exists() else []:
+            text = self.logs(comp.name)
+            if text:
+                fn = f"{comp.name}.log"
+                with open(os.path.join(dest, fn), "w", encoding="utf-8") as f:
+                    f.write(text)
+                collected.append(fn)
+        return collected

@@ -161,6 +161,7 @@ class BinaryRuntime:
             "backend": backend,
             "ports": {"apiserver": apiserver_port, "kubelet": kubelet_port},
         }
+        self.write_prometheus_config(kubelet_port)
         self._installed_components = components
         if dry_run.enabled:
             dry_run.emit(f"write {self.config_path}")
@@ -327,6 +328,56 @@ class BinaryRuntime:
             return self.client().wait_ready(timeout=timeout)
         except OSError:
             return False
+
+    def collect_logs(self, dest: str) -> List[str]:
+        """Export logs + cluster config into ``dest`` (reference
+        Runtime.CollectLogs: logs, audit, components yaml)."""
+        os.makedirs(dest, exist_ok=True)
+        collected: List[str] = []
+        for rel in ("kwok.yaml", "components.json", "prometheus.yaml"):
+            src = self._path(rel)
+            if os.path.exists(src):
+                shutil.copyfile(src, os.path.join(dest, rel))
+                collected.append(rel)
+        logdir = self._path("logs")
+        if os.path.isdir(logdir):
+            for fn in sorted(os.listdir(logdir)):
+                shutil.copyfile(
+                    os.path.join(logdir, fn), os.path.join(dest, fn)
+                )
+                collected.append(fn)
+        return collected
+
+    def write_prometheus_config(self, kubelet_port: int) -> str:
+        """Generate a scrape config for the cluster (reference
+        components/prometheus_config.go + prometheus_config.yaml.tpl:
+        static kwok-controller target + HTTP SD for Metric CR routes)."""
+        path = self._path("prometheus.yaml")
+        doc = {
+            "global": {"scrape_interval": "15s"},
+            "scrape_configs": [
+                {
+                    "job_name": "kwok-controller",
+                    "static_configs": [
+                        {"targets": [f"127.0.0.1:{kubelet_port}"]}
+                    ],
+                },
+                {
+                    "job_name": "kwok-metric-crs",
+                    "http_sd_configs": [
+                        {
+                            "url": f"http://127.0.0.1:{kubelet_port}/discovery/prometheus"
+                        }
+                    ],
+                },
+            ],
+        }
+        if dry_run.enabled:
+            dry_run.emit(f"write {path}")
+        else:
+            with open(path, "w", encoding="utf-8") as f:
+                yaml.safe_dump(doc, f, sort_keys=False)
+        return path
 
     def logs(self, component: str, follow: bool = False) -> str:
         path = self._path("logs", f"{component}.log")

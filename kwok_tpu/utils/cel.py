@@ -718,6 +718,12 @@ class Environment:
         if op == "in":
             if isinstance(right, _Obj):
                 right = right.obj
+            if right is None:
+                # absent map/list field: cel-go over typed k8s objects
+                # yields an empty map there (e.g. `"k" in
+                # pod.metadata.annotations` on a pod with no
+                # annotations), so membership is simply false
+                return False
             if isinstance(right, dict):
                 return left in right
             if isinstance(right, (list, str)):

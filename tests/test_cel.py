@@ -275,6 +275,22 @@ def test_quantity_string_operand_raises_celerror():
         ev('Quantity("1") / "2"')
 
 
+def test_in_on_absent_field_is_false():
+    """`"k" in pod.metadata.annotations` with no annotations field:
+    cel-go over typed k8s objects sees an empty map, so membership is
+    false, and the usage-from-annotation default branch fires
+    (charts/metrics-usage usage-from-annotation.yaml)."""
+    env = Environment()
+    pod = {"metadata": {"name": "p"}, "spec": {}, "status": {}}
+    expr = (
+        '"kwok.x-k8s.io/usage-cpu" in pod.metadata.annotations '
+        '? Quantity(pod.metadata.annotations["kwok.x-k8s.io/usage-cpu"]) '
+        ': Quantity("5m")'
+    )
+    out = env.compile(expr).eval({"pod": pod})
+    assert out.as_float() == 0.005
+
+
 def test_builtin_type_errors_are_celerror():
     with pytest.raises(CELError):
         ev('ceil("abc")')

@@ -188,7 +188,10 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
     """create → scale → kubectl → snapshot → stop → start (state
     persists) → hack → delete.  Real subprocess components."""
     name = "e2e"
-    assert kwokctl_main(["--name", name, "create", "cluster", "--wait", "60"]) == 0
+    assert kwokctl_main(
+        ["--name", name, "create", "cluster", "--wait", "60",
+         "--controller-arg=--enable-metrics-usage"]
+    ) == 0
 
     from kwok_tpu.ctl.runtime import BinaryRuntime
 
@@ -226,6 +229,25 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
         assert kwokctl_main(["--name", name, "kubectl", "get", "pods"]) == 0
         out = capsys.readouterr().out
         assert "pod-0" in out and "Running" in out
+
+        # kubectl top (metrics-server equivalent over the kubelet
+        # resource-metrics endpoint)
+        capsys.readouterr()
+        assert kwokctl_main(
+            ["--name", name, "kubectl", "top", "pods", "--window", "0.5"]
+        ) == 0
+        top_out = capsys.readouterr().out
+        assert "pod-0" in top_out
+        # default usage from the metrics-usage asset is 1Mi per pod —
+        # zeros would mean the CEL eval silently failed
+        assert "1Mi" in top_out, top_out
+
+        # export logs collects component logs + cluster config
+        exp = os.path.join(str(home), "exported")
+        assert kwokctl_main(["--name", name, "export", "logs", exp]) == 0
+        assert os.path.exists(os.path.join(exp, "kwok.yaml"))
+        assert os.path.exists(os.path.join(exp, "apiserver.log"))
+        assert os.path.exists(os.path.join(exp, "prometheus.yaml"))
 
         # snapshot export
         snap = os.path.join(str(home), "snap.yaml")

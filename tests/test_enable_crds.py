@@ -46,9 +46,21 @@ def test_config_crs_flow_into_server(tmp_path):
                 list_nodes=lambda: list(nodes),
             )
         )
+        # a locally configured doc (the --enable-metrics-usage /
+        # --config path) must survive every CR-triggered swap
+        from kwok_tpu.api.extra_types import from_document
+
+        local = from_document(
+            {
+                "kind": "ClusterAttach",
+                "metadata": {"name": "local"},
+                "spec": {"attaches": [{"logsFile": str(logf)}]},
+            }
+        )
+        srv.set_configs([local])
         port = srv.serve(port=0)
         done = threading.Event()
-        start_config_watcher(client, srv, done)
+        start_config_watcher(client, srv, done, base_configs=[local])
         try:
             # no config yet: containerLogs has nothing to serve
             client.create(
@@ -60,6 +72,8 @@ def test_config_crs_flow_into_server(tmp_path):
                 }
             )
             assert wait_for(lambda: len(srv.cluster_logs) == 1)
+            # the local base config survived the swap
+            assert len(srv.cluster_attaches) == 1
             url = f"http://127.0.0.1:{port}/containerLogs/default/pod-0/app"
             body = urllib.request.urlopen(url, timeout=10).read().decode()
             assert "hello from CR-configured logs" in body
