@@ -40,6 +40,9 @@ class KwokConfiguration:
     backend: str = "host"
     device_capacity: int = 4096
     device_tick_ms: int = 100
+    #: 0 = single device; N>1 = shard SoA rows over an N-device mesh
+    #: (SURVEY §2.9 scale-out; needs N visible jax devices)
+    device_mesh_devices: int = 0
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "KwokConfiguration":
@@ -67,4 +70,5 @@ class KwokConfiguration:
             backend=g("backend", "host"),
             device_capacity=int(g("deviceCapacity", 4096)),
             device_tick_ms=int(g("deviceTickMilliseconds", 100)),
+            device_mesh_devices=int(g("deviceMeshDevices", 0)),
         )

@@ -299,6 +299,11 @@ class Controller:
             predicate = self._node_predicate
             nf = node_funcs(self.conf.node_ip, self.conf.node_name, self.conf.node_port)
             funcs_for = lambda obj: nf  # noqa: E731
+        mesh = None
+        if self.conf.device_mesh_devices > 1:
+            from kwok_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(self.conf.device_mesh_devices)
         try:
             player = DeviceStagePlayer(
                 self.store,
@@ -313,6 +318,7 @@ class Controller:
                 funcs_for=funcs_for,
                 on_delete=on_delete,
                 seed=self.rng.randrange(2**31),
+                mesh=mesh,
             )
         except StageCompileError:
             return False

@@ -48,6 +48,7 @@ class DeviceStagePlayer:
         funcs_for: Optional[Callable[[dict], Dict[str, Callable]]] = None,
         on_delete: Optional[Callable[[dict], None]] = None,
         seed: int = 0,
+        mesh=None,
     ):
         self.store = store
         self.kind = kind
@@ -58,7 +59,7 @@ class DeviceStagePlayer:
         self.funcs_for = funcs_for or (lambda obj: {})
         self.on_delete = on_delete
         self.tick_ms = tick_ms
-        self.sim = DeviceSimulator(stages, capacity=capacity, seed=seed)
+        self.sim = DeviceSimulator(stages, capacity=capacity, seed=seed, mesh=mesh)
         self._informer = Informer(store, kind)
         self.events: Queue = Queue()
         #: (namespace, name) -> row

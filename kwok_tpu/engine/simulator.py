@@ -93,8 +93,20 @@ class DeviceSimulator:
         epoch: datetime.datetime = DEFAULT_EPOCH,
         seed: int = 0,
         env_funcs: Optional[Dict[str, Callable]] = None,
+        mesh=None,
     ):
         self.cset = CompiledStageSet(stages)
+        #: optional jax.sharding.Mesh: rows sharded across its devices,
+        #: stage tensors replicated (SURVEY §2.9/§7 step 7 scale-out).
+        #: The tick is row-parallel, so the only collective is the
+        #: fired-count psum XLA inserts under the out-shardings.
+        self.mesh = mesh
+        self._n_shards = 1 if mesh is None else int(mesh.size)
+        self._sharded_ticks: Dict[int, Callable] = {}
+        if mesh is not None:
+            from kwok_tpu.parallel.mesh import pad_rows
+
+            capacity = pad_rows(capacity, self._n_shards)
         self.capacity = capacity
         self.epoch = epoch
         self.env_funcs = dict(env_funcs) if env_funcs is not None else default_env_funcs()
@@ -232,6 +244,10 @@ class DeviceSimulator:
         if n <= self.capacity:
             return
         new_cap = max(self.capacity * 2, n, 64)
+        if self.mesh is not None:
+            from kwok_tpu.parallel.mesh import pad_rows
+
+            new_cap = pad_rows(new_cap, self._n_shards)
         self._invalidate_device()
         grow = new_cap - self.capacity
 
@@ -296,12 +312,26 @@ class DeviceSimulator:
                 ),
             )
             self._rematch_pending = bool(self.rematch.any())
+            if self.mesh is not None:
+                from kwok_tpu.parallel.mesh import place
+
+                self._params, self._soa = place(self._params, self._soa, self.mesh)
         return self._params, self._soa
+
+    def _tick_fn(self, dt_ms: int):
+        if self.mesh is None:
+            return lambda p, s: tick(p, s, dt_ms)
+        fn = self._sharded_ticks.get(dt_ms)
+        if fn is None:
+            from kwok_tpu.parallel.mesh import sharded_tick
+
+            fn = self._sharded_ticks[dt_ms] = sharded_tick(self.mesh, dt_ms)
+        return fn
 
     def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
         """One tick; drains and (optionally) materializes transitions."""
         params, soa = self.to_device()
-        new_soa, out = tick(params, soa, dt_ms)
+        new_soa, out = self._tick_fn(dt_ms)(params, soa)
         self._soa = new_soa
 
         transitions: List[Transition] = []

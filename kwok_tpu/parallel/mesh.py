@@ -32,10 +32,17 @@ ROWS_AXIS = "rows"
 
 
 def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
-    """1-D mesh over the row axis."""
+    """1-D mesh over the row axis.  Asking for more devices than jax
+    exposes is an error, not a silent truncation — an operator who
+    configured an 8-chip mesh must not unknowingly run on one chip."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"mesh wants {n_devices} devices but jax exposes "
+                    f"{len(devices)}"
+                )
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (ROWS_AXIS,))
 
@@ -102,6 +109,7 @@ def sharded_tick(mesh: Mesh, dt_ms: int = 100):
         lambda params, soa: _tick_impl(params, soa, dt_ms),
         in_shardings=(par_s, soa_s),
         out_shardings=out_s,
+        donate_argnums=(1,),  # reuse the SoA buffers like the 1-chip tick
     )
 
 
@@ -123,4 +131,5 @@ def sharded_run_ticks(mesh: Mesh, dt_ms: int = 100, num_ticks: int = 100):
         run,
         in_shardings=(par_s, soa_s),
         out_shardings=((soa_s, rep)),
+        donate_argnums=(1,),
     )

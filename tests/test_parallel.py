@@ -84,3 +84,122 @@ class TestMesh:
         assert len(params.w_static.sharding.device_set) == 8
         shard_rows = {s.data.shape[0] for s in soa.features.addressable_shards}
         assert shard_rows == {n // 8}
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestSimulatorMesh:
+    """DeviceSimulator with an integrated mesh (the device backend's
+    multi-chip mode, conf.device_mesh_devices)."""
+
+    def test_simulator_mesh_trajectory_matches_single(self):
+        mesh = make_mesh(8)
+        sharded = DeviceSimulator(
+            load_builtin(POD_FAST), capacity=64, seed=0, mesh=mesh
+        )
+        for i in range(64):
+            sharded.admit(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "d", "uid": f"u{i}"},
+                    "spec": {
+                        "nodeName": f"n{i % 4}",
+                        "containers": [{"name": "c", "image": "i"}],
+                    },
+                    "status": {},
+                }
+            )
+        # matching admit population for the single sim
+        single2 = DeviceSimulator(load_builtin(POD_FAST), capacity=64, seed=0)
+        for i in range(64):
+            single2.admit(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"p{i}", "namespace": "d", "uid": f"u{i}"},
+                    "spec": {
+                        "nodeName": f"n{i % 4}",
+                        "containers": [{"name": "c", "image": "i"}],
+                    },
+                    "status": {},
+                }
+            )
+        for _ in range(40):
+            a = sharded.step(dt_ms=100, materialize=False)
+            b = single2.step(dt_ms=100, materialize=False)
+            assert [(t.row, t.stage_name) for t in a] == [
+                (t.row, t.stage_name) for t in b
+            ]
+        np.testing.assert_array_equal(
+            np.asarray(sharded._soa.stage), np.asarray(single2._soa.stage)
+        )
+
+    def test_simulator_mesh_capacity_rounds_to_shards(self):
+        mesh = make_mesh(8)
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=10, seed=0, mesh=mesh)
+        assert sim.capacity % 8 == 0
+        # growth keeps divisibility
+        sim.ensure_capacity(sim.capacity + 1)
+        assert sim.capacity % 8 == 0
+
+    def test_controller_device_backend_on_mesh(self):
+        """Full controller with the device backend sharded over the
+        8-device CPU mesh: pods reach Running through sharded ticks."""
+        import time
+
+        from kwok_tpu.api.config import KwokConfiguration
+        from kwok_tpu.cluster.store import ResourceStore
+        from kwok_tpu.controllers.controller import Controller
+        from kwok_tpu.stages import default_node_stages, default_pod_stages
+
+        store = ResourceStore()
+        ctr = Controller(
+            store,
+            KwokConfiguration(
+                manage_all_nodes=True,
+                backend="device",
+                device_mesh_devices=8,
+                device_tick_ms=20,
+                node_lease_duration_seconds=0,
+            ),
+            local_stages={
+                "Node": default_node_stages(),
+                "Pod": default_pod_stages(),
+            },
+            seed=0,
+        )
+        ctr.start()
+        try:
+            store.create(
+                {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"},
+                 "spec": {}, "status": {}}
+            )
+            for i in range(16):
+                store.create(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {"name": f"p{i}", "namespace": "default"},
+                        "spec": {"nodeName": "n0",
+                                 "containers": [{"name": "c", "image": "i"}]},
+                        "status": {},
+                    }
+                )
+            assert ctr.device_players, "device backend should be active"
+            assert ctr.device_players["Pod"].sim.mesh is not None
+
+            def all_running():
+                pods, _ = store.list("Pod")
+                return len(pods) == 16 and all(
+                    (p.get("status") or {}).get("phase") == "Running" for p in pods
+                )
+
+            deadline = time.monotonic() + 60
+            while not all_running() and time.monotonic() < deadline:
+                time.sleep(0.2)
+            assert all_running(), [
+                (p["metadata"]["name"], p.get("status", {}).get("phase"))
+                for p in store.list("Pod")[0]
+            ]
+        finally:
+            ctr.stop()
