@@ -384,6 +384,14 @@ class ClusterClient:
             headers=self._user_hdr(as_user),
         )
 
+    # ---------------------------------------------------------------- bulk
+
+    def bulk(self, ops) -> list:
+        """One round-trip for many mutations (the device backend's
+        dirty-row drain; see ResourceStore.bulk for the op format)."""
+        data = self._request("POST", "/bulk", body={"ops": list(ops)})
+        return data.get("results", [])
+
     # --------------------------------------------------------------- watch
 
     def watch(

@@ -492,6 +492,65 @@ class ResourceStore:
             st.watchers.append(w)
             return w
 
+    # --------------------------------------------------------------------- bulk
+
+    def bulk(self, ops: List[dict]) -> List[dict]:
+        """Apply many mutations in one call — the device backend's
+        dirty-row drain (SURVEY §2.9: only dirty rows cross the
+        device↔apiserver boundary; batching amortizes the per-op HTTP
+        round-trip when the store is remote).  Each op:
+
+        ``{"verb": "patch"|"delete", "kind", "name", "namespace"?,
+           "data"?, "patch_type"?, "subresource"?, "as_user"?}``
+
+        Per-op failures do not abort the batch; results align with ops:
+        ``{"status": "ok", "object": ...}`` (object None for a
+        completed delete) or ``{"status": "error", "reason", "error"}``.
+        """
+        results: List[dict] = []
+        for op in ops:
+            try:
+                verb = op.get("verb")
+                if verb == "patch":
+                    out = self.patch(
+                        op["kind"],
+                        op["name"],
+                        op.get("data"),
+                        patch_type=op.get("patch_type", "merge"),
+                        namespace=op.get("namespace"),
+                        subresource=op.get("subresource", ""),
+                        as_user=op.get("as_user"),
+                    )
+                elif verb == "delete":
+                    out = self.delete(
+                        op["kind"],
+                        op["name"],
+                        namespace=op.get("namespace"),
+                        as_user=op.get("as_user"),
+                    )
+                elif verb == "create":
+                    out = self.create(
+                        op["data"],
+                        namespace=op.get("namespace"),
+                        as_user=op.get("as_user"),
+                    )
+                else:
+                    raise ValueError(f"unknown bulk verb {verb!r}")
+                results.append({"status": "ok", "object": out})
+            except NotFound as exc:
+                results.append(
+                    {"status": "error", "reason": "NotFound", "error": str(exc)}
+                )
+            except Conflict as exc:
+                results.append(
+                    {"status": "error", "reason": "Conflict", "error": str(exc)}
+                )
+            except Exception as exc:  # noqa: BLE001 — per-op isolation
+                results.append(
+                    {"status": "error", "reason": "Invalid", "error": str(exc)}
+                )
+        return results
+
     # -------------------------------------------------------------- persistence
 
     def dump_state(self) -> dict:

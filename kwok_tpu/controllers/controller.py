@@ -70,6 +70,15 @@ class Controller:
         self._node_events: Queue = Queue()
         self.node_cache = None
 
+        #: shared device mesh for every device player; built and
+        #: validated ONCE here so an oversubscribed mesh fails loudly at
+        #: startup instead of killing the Stage-CR manage thread later
+        self._device_mesh = None
+        if self.conf.backend == "device" and self.conf.device_mesh_devices > 1:
+            from kwok_tpu.parallel.mesh import make_mesh
+
+            self._device_mesh = make_mesh(self.conf.device_mesh_devices)
+
         self.nodes: Optional[NodeController] = None
         self.pods: Optional[PodController] = None
         self.node_leases: Optional[NodeLeaseController] = None
@@ -299,11 +308,6 @@ class Controller:
             predicate = self._node_predicate
             nf = node_funcs(self.conf.node_ip, self.conf.node_name, self.conf.node_port)
             funcs_for = lambda obj: nf  # noqa: E731
-        mesh = None
-        if self.conf.device_mesh_devices > 1:
-            from kwok_tpu.parallel.mesh import make_mesh
-
-            mesh = make_mesh(self.conf.device_mesh_devices)
         try:
             player = DeviceStagePlayer(
                 self.store,
@@ -318,7 +322,7 @@ class Controller:
                 funcs_for=funcs_for,
                 on_delete=on_delete,
                 seed=self.rng.randrange(2**31),
-                mesh=mesh,
+                mesh=self._device_mesh,
             )
         except StageCompileError:
             return False

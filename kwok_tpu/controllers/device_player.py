@@ -18,6 +18,7 @@ facade falls back to the host backend for that kind.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -171,18 +172,126 @@ class DeviceStagePlayer:
                 next_tick = self.clock.now()  # fell behind; don't spiral
 
     def step(self, dt_ms: Optional[int] = None) -> List[Transition]:
-        """One device tick + host drain of dirty rows."""
+        """One device tick + host drain of dirty rows.
+
+        The common transition shape — event? + one rendered status
+        patch, no finalizers/delete — batches into a single
+        ``store.bulk`` call, so a remote apiserver costs one round-trip
+        per tick instead of one per dirty row (SURVEY §2.9: dirty rows
+        stream across the boundary).  Finalizer/delete transitions keep
+        the exact sequential path."""
         transitions = self.sim.step(
             dt_ms if dt_ms is not None else self.tick_ms, materialize=False
         )
+        can_bulk = hasattr(self.store, "bulk")
+        batch_ops: List[dict] = []
+        batch_keys: List[Tuple[str, str]] = []
         for tr in transitions:
             try:
-                self._play_transition(tr)
+                op = self._collect_simple(tr) if can_bulk else None
+                if op is not None:
+                    key, bulk_op = op
+                    if bulk_op is not None:
+                        batch_ops.append(bulk_op)
+                        batch_keys.append(key)
+                else:
+                    self._play_transition(tr)
             except Exception:  # noqa: BLE001 — one bad row must not stop the drain
                 import traceback
 
                 traceback.print_exc()
+        if batch_ops:
+            try:
+                results = self.store.bulk(batch_ops)
+            except Exception:  # noqa: BLE001 — drop to per-op on bulk failure
+                results = None
+            if results is None:
+                for key, op in zip(batch_keys, batch_ops):
+                    try:
+                        obj = self.store.patch(
+                            op["kind"],
+                            op["name"],
+                            op["data"],
+                            op.get("patch_type", "merge"),
+                            namespace=op.get("namespace"),
+                            subresource=op.get("subresource") or "",
+                            as_user=op.get("as_user"),
+                        )
+                        self.patches += 1
+                        self.transitions += 1
+                        self._refresh(key, obj)
+                    except NotFound:
+                        self._release(key)
+                    except Exception:  # noqa: BLE001 — per-op isolation,
+                        # matching the sequential path's guard
+                        import traceback
+
+                        traceback.print_exc()
+            else:
+                for key, res in zip(batch_keys, results):
+                    if res.get("status") == "ok":
+                        self.patches += 1
+                        self.transitions += 1
+                        obj = res.get("object")
+                        if obj is not None:
+                            self._refresh(key, obj)
+                    elif res.get("reason") == "NotFound":
+                        self._release(key)
+                    else:
+                        # Conflict/Invalid: surface it like the
+                        # sequential path's per-transition traceback did
+                        print(
+                            f"device bulk op failed for {key}: "
+                            f"{res.get('reason')}: {res.get('error')}",
+                            file=sys.stderr,
+                        )
         return transitions
+
+    def _collect_simple(self, tr: Transition):
+        """If the transition is the batchable shape, emit its bulk op:
+        returns (key, op_or_None) — op None means a no-op patch (counted
+        as a transition, nothing to send); returns None for complex
+        transitions needing the sequential path."""
+        with self._mut:
+            obj = self.sim.objects[tr.row]
+        if obj is None:
+            return ("", ""), None
+        meta = obj.get("metadata") or {}
+        cs = self.sim.cset.compiled[tr.stage_idx]
+        effects = self.sim.cset.lifecycle.effects(cs)
+        if effects is None:
+            return (self._key(obj), None)
+        if effects.delete or effects.finalizers_patch(meta.get("finalizers") or []):
+            return None
+        funcs = dict(self.funcs_for(obj))
+        funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
+        patches = list(effects.patches(obj, funcs))
+        if len(patches) > 1:
+            return None
+        if tr.event is not None and self.recorder is not None:
+            self.recorder.event(
+                obj, tr.event.type or "Normal", tr.event.reason, tr.event.message
+            )
+        if not patches or is_noop_patch(obj, patches[0].data, patches[0].type):
+            # nothing to send — the transition is complete here; ops
+            # that DO ship count only once their patch lands (parity
+            # with the sequential path's post-success increment)
+            self.transitions += 1
+            return (self._key(obj), None)
+        p = patches[0]
+        return (
+            self._key(obj),
+            {
+                "verb": "patch",
+                "kind": self.kind,
+                "name": meta.get("name") or "",
+                "namespace": meta.get("namespace"),
+                "data": p.data,
+                "patch_type": p.type,
+                "subresource": p.subresource,
+                "as_user": p.impersonation,
+            },
+        )
 
     # ----------------------------------------------------------- store effects
 

@@ -305,6 +305,31 @@ def test_stats(cluster):
     assert client.resource_version >= 2
 
 
+def test_bulk_mutations_roundtrip(cluster):
+    """One round-trip applies many mutations; per-op errors isolate."""
+    store, client = cluster
+    client.create(make_pod("a"))
+    client.create(make_pod("b"))
+    results = client.bulk(
+        [
+            {"verb": "patch", "kind": "Pod", "name": "a",
+             "namespace": "default", "data": {"status": {"phase": "Running"}}},
+            {"verb": "patch", "kind": "Pod", "name": "ghost",
+             "namespace": "default", "data": {"status": {"phase": "Running"}}},
+            {"verb": "delete", "kind": "Pod", "name": "b", "namespace": "default"},
+            {"verb": "create", "kind": "Pod",
+             "data": make_pod("c"), "namespace": "default"},
+        ]
+    )
+    assert [r["status"] for r in results] == ["ok", "error", "ok", "ok"]
+    assert results[1]["reason"] == "NotFound"
+    assert results[0]["object"]["status"]["phase"] == "Running"
+    assert store.get("Pod", "a")["status"]["phase"] == "Running"
+    assert store.count("Pod") == 2  # b deleted, c created
+    with pytest.raises(NotFound):
+        store.get("Pod", "b")
+
+
 def test_odd_object_names_roundtrip(cluster):
     """The store accepts any name; the wire path must escape it."""
     _, client = cluster
