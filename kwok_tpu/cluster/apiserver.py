@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -75,6 +76,37 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, fmt, *args):  # quiet; audit lives in the store
         pass
+
+    def log_request(self, code="-", size="-"):
+        """Append mutations to the audit sink as JSON lines (the
+        kube-apiserver audit-log analog; reference kwokctl AuditLogs,
+        runtime/config.go).  The sink is an unbuffered O_APPEND binary
+        file, so each line lands as one atomic write even with many
+        handler threads."""
+        sink = getattr(self.server, "audit_sink", None)
+        if sink is None or self.command == "GET":
+            return
+        try:
+            status = int(code)  # handles both int and HTTPStatus
+        except (TypeError, ValueError):
+            status = 0
+        try:
+            sink.write(
+                (
+                    json.dumps(
+                        {
+                            "ts": time.time(),
+                            "verb": self.command,
+                            "path": self.path,
+                            "user": self.headers.get("Impersonate-User") or "",
+                            "code": status,
+                        }
+                    )
+                    + "\n"
+                ).encode()
+            )
+        except (OSError, ValueError):
+            pass
 
     # ------------------------------------------------------------- plumbing
 
@@ -300,24 +332,40 @@ class APIServer:
         tls_cert: Optional[str] = None,
         tls_key: Optional[str] = None,
         client_ca: Optional[str] = None,
+        audit_path: Optional[str] = None,
     ):
+        # acquire the audit file before binding the port so a bad path
+        # fails without leaking a listening socket; unbuffered O_APPEND
+        # binary mode makes each line one atomic write across threads
+        self._audit_file = None
+        if audit_path:
+            self._audit_file = open(audit_path, "ab", buffering=0)
         handler = type("BoundHandler", (_Handler,), {"store": store})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
-        self._httpd.daemon_threads = True
-        # watch handler loops poll this so stop() actually ends them
-        self._httpd.shutting_down = threading.Event()
-        self._tls = bool(tls_cert and tls_key)
-        if self._tls:
-            import ssl
+        try:
+            self._httpd = ThreadingHTTPServer((host, port), handler)
+            self._httpd.daemon_threads = True
+            # watch handler loops poll this so stop() actually ends them
+            self._httpd.shutting_down = threading.Event()
+            self._httpd.audit_sink = self._audit_file
+            self._tls = bool(tls_cert and tls_key)
+            if self._tls:
+                import ssl
 
-            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.load_cert_chain(tls_cert, tls_key)
-            if client_ca:
-                ctx.load_verify_locations(client_ca)
-                ctx.verify_mode = ssl.CERT_OPTIONAL
-            self._httpd.socket = ctx.wrap_socket(
-                self._httpd.socket, server_side=True
-            )
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(tls_cert, tls_key)
+                if client_ca:
+                    ctx.load_verify_locations(client_ca)
+                    ctx.verify_mode = ssl.CERT_OPTIONAL
+                self._httpd.socket = ctx.wrap_socket(
+                    self._httpd.socket, server_side=True
+                )
+        except Exception:
+            if self._audit_file is not None:
+                self._audit_file.close()
+            httpd = getattr(self, "_httpd", None)
+            if httpd is not None:
+                httpd.server_close()
+            raise
         self._thread: Optional[threading.Thread] = None
         self.store = store
 
@@ -344,6 +392,11 @@ class APIServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        if self._audit_file is not None:
+            try:
+                self._audit_file.close()
+            except OSError:
+                pass
 
     # context-manager sugar for tests
     def __enter__(self) -> "APIServer":

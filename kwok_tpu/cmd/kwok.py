@@ -178,6 +178,75 @@ def start_config_watcher(client, srv, done: threading.Event, base_configs=None) 
     threading.Thread(target=loop, daemon=True).start()
 
 
+def _controller_self_metrics(ctr):
+    """Self-metrics updater: stage transitions/patches per kind (host
+    and device paths) and device tick-lag quantiles (the p99
+    heartbeat-lag signal, SURVEY §7 step 5)."""
+
+    def update(registry) -> None:
+        from kwok_tpu.metrics.collectors import Gauge
+
+        def gauge(name, help_, value, **labels):
+            key = name + "".join(f"|{k}={v}" for k, v in sorted(labels.items()))
+            g = registry.get_or_register(
+                key, lambda: Gauge(name, help_, const_labels=labels or None)
+            )
+            g.set(value)
+
+        players = []
+        for kind, host in (("Node", ctr.nodes), ("Pod", ctr.pods)):
+            if host is not None:
+                players.append((kind, "host", host))
+        # snapshot the dicts: the controller mutates them on CR changes
+        for kind, host in dict(ctr.stage_controllers or {}).items():
+            players.append((kind, "host", host))
+        for kind, dev in dict(ctr.device_players or {}).items():
+            players.append((kind, "device", dev))
+        for kind, backend, p in players:
+            gauge(
+                "kwok_stage_transitions_total",
+                "Stage transitions played.",
+                getattr(p, "transitions", 0),
+                kind=kind,
+                backend=backend,
+            )
+            gauge(
+                "kwok_patches_total",
+                "Patches written to the cluster.",
+                getattr(p, "patches", 0),
+                kind=kind,
+                backend=backend,
+            )
+            raw = getattr(p, "tick_lags", None)
+            lags = []
+            if raw:
+                # the tick thread appends concurrently; a mid-copy
+                # mutation raises RuntimeError — retry once, else skip
+                for _ in range(2):
+                    try:
+                        lags = sorted(raw)
+                        break
+                    except RuntimeError:
+                        continue
+            if lags:
+                for q in (0.5, 0.99):
+                    gauge(
+                        "kwok_tick_lag_seconds",
+                        "Device tick-loop lag behind schedule.",
+                        lags[min(len(lags) - 1, int(q * len(lags)))],
+                        kind=kind,
+                        quantile=str(q),
+                    )
+                gauge(
+                    "kwok_tick_lag_seconds_max",
+                    "Max recent device tick-loop lag.",
+                    lags[-1],
+                    kind=kind,
+                )
+
+    return update
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from kwok_tpu.utils.log import setup as log_setup
@@ -230,6 +299,7 @@ def main(argv=None) -> int:
             from_document(d) for d in docs if d.get("kind") in server_kinds
         ]
         srv.set_configs(local_configs)
+        srv.add_self_updater(_controller_self_metrics(ctr))
         bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
         print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
         if conf.enable_crds:

@@ -72,6 +72,12 @@ class DeviceStagePlayer:
         self._threads: List[threading.Thread] = []
         self.transitions = 0
         self.patches = 0
+        #: recent tick-lag samples in seconds (how far the real-time
+        #: loop fell behind its schedule) — the p99 heartbeat-lag
+        #: signal from SURVEY §7 step 5
+        from collections import deque
+
+        self.tick_lags = deque(maxlen=1024)
         # virtual-time anchor: device ms 0 == clock.now() at start
         self._t0: Optional[float] = None
         self.cache = None
@@ -167,8 +173,10 @@ class DeviceStagePlayer:
             next_tick += self.tick_ms / 1000.0
             sleep = next_tick - self.clock.now()
             if sleep > 0:
+                self.tick_lags.append(0.0)
                 time.sleep(min(sleep, self.tick_ms / 1000.0))
             else:
+                self.tick_lags.append(-sleep)
                 next_tick = self.clock.now()  # fell behind; don't spiral
 
     def step(self, dt_ms: Optional[int] = None) -> List[Transition]:

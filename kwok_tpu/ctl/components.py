@@ -69,6 +69,8 @@ def build_apiserver_component(
         str(port),
         "--state-file",
         os.path.join(workdir, "state.json"),
+        "--audit-file",
+        os.path.join(workdir, "logs", "audit.log"),
     ]
     if secure and pki_dir:
         args += [

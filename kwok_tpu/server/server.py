@@ -131,6 +131,9 @@ class Server:
         up = Gauge("kwok_up", "1 if the server is serving.")
         up.set(1)
         self._self_registry.register("kwok_up", up)
+        #: callables run before each /metrics scrape to refresh
+        #: self-metrics (controller stats, tick lag, …)
+        self._self_updaters: List[Callable[[Registry], None]] = []
 
         self._install()
 
@@ -280,7 +283,19 @@ class Server:
     def _disabled(self, req: "_Request", **params) -> None:
         req.reply(405, "disabled")
 
+    def add_self_updater(self, fn: Callable[[Registry], None]) -> None:
+        """Register a per-scrape refresher for self-metrics (the
+        reference exposes controller prometheus self-metrics the same
+        way, metrics.go:65-75)."""
+        self._self_updaters.append(fn)
+
     def _self_metrics(self, req: "_Request", **params) -> None:
+        for fn in self._self_updaters:
+            try:
+                fn(self._self_registry)
+            except Exception:  # noqa: BLE001 — a broken updater must not
+                # take down the scrape endpoint
+                traceback.print_exc()
         req.reply(200, self._self_registry.expose(), content_type="text/plain; version=0.0.4")
 
     def _debug_threads(self, req: "_Request", **params) -> None:

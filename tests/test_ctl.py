@@ -249,6 +249,23 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
         assert os.path.exists(os.path.join(exp, "apiserver.log"))
         assert os.path.exists(os.path.join(exp, "prometheus.yaml"))
 
+        # the apiserver audit log recorded the mutations as JSON lines
+        audit_path = os.path.join(exp, "audit.log")
+        assert os.path.exists(audit_path)
+        lines = [json.loads(l) for l in open(audit_path) if l.strip()]
+        assert any(e["verb"] == "POST" and "/r/pods" in e["path"] for e in lines)
+        assert any(e["verb"] == "PATCH" for e in lines)
+
+        # controller self-metrics expose transition counters
+        import urllib.request
+
+        kubelet_port = rt.load_config()["ports"]["kubelet"]
+        metrics_body = urllib.request.urlopen(
+            f"http://127.0.0.1:{kubelet_port}/metrics", timeout=10
+        ).read().decode()
+        assert "kwok_stage_transitions_total" in metrics_body
+        assert 'kind="Pod"' in metrics_body
+
         # snapshot export
         snap = os.path.join(str(home), "snap.yaml")
         assert kwokctl_main(["--name", name, "snapshot", "export", "--path", snap]) == 0

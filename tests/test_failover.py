@@ -1,0 +1,106 @@
+"""Elastic recovery across processes: when a kwok daemon dies, a
+second instance takes over its nodes after lease expiry (SURVEY §5
+failure injection / §3.3 lease ownership; reference
+node_lease_controller.go:293-306 tryAcquireOrRenew)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.store import ResourceStore
+
+NAMESPACE_NODE_LEASE = "kube-node-lease"
+
+
+def spawn_kwok(server_url, ident, lease_s=4):
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "kwok_tpu.cmd.kwok",
+            "--server",
+            server_url,
+            "--id",
+            ident,
+            "--node-lease-duration-seconds",
+            str(lease_s),
+            "--server-address",
+            "",  # no kubelet server needed
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={
+            **os.environ,
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+            "JAX_PLATFORMS": "cpu",
+        },
+        start_new_session=True,
+    )
+
+
+def wait_for(cond, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.2)
+    return cond()
+
+
+def test_second_instance_takes_over_after_crash():
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        a = spawn_kwok(srv.url, "kwok-a")
+        b = None
+        try:
+            store.create(
+                {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n0"},
+                 "spec": {}, "status": {}}
+            )
+
+            def holder():
+                try:
+                    lease = store.get("Lease", "n0", namespace=NAMESPACE_NODE_LEASE)
+                    return (lease.get("spec") or {}).get("holderIdentity")
+                except KeyError:
+                    return None
+
+            assert wait_for(lambda: holder() == "kwok-a", 30), holder()
+
+            b = spawn_kwok(srv.url, "kwok-b")
+            time.sleep(2)
+            # b defers while a renews
+            assert holder() == "kwok-a"
+
+            # kill a hard (no graceful lease release)
+            os.killpg(os.getpgid(a.pid), signal.SIGKILL)
+            a.wait(timeout=10)
+
+            # b acquires after the 4s lease expires
+            assert wait_for(lambda: holder() == "kwok-b", 30), holder()
+
+            # and b actually manages the node now: pods still converge
+            store.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": "p0", "namespace": "default"},
+                    "spec": {"nodeName": "n0",
+                             "containers": [{"name": "c", "image": "i"}]},
+                    "status": {},
+                }
+            )
+            assert wait_for(
+                lambda: (store.get("Pod", "p0").get("status") or {}).get("phase")
+                == "Running",
+                30,
+            )
+        finally:
+            for proc in (a, b):
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+                    proc.wait(timeout=10)
