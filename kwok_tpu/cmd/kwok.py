@@ -184,14 +184,22 @@ def _controller_self_metrics(ctr):
     heartbeat-lag signal, SURVEY §7 step 5)."""
 
     def update(registry) -> None:
-        from kwok_tpu.metrics.collectors import Gauge
+        from kwok_tpu.metrics.collectors import Counter, Gauge
+
+        def _set(cls, name, help_, value, **labels):
+            key = name + "".join(f"|{k}={v}" for k, v in sorted(labels.items()))
+            c = registry.get_or_register(
+                key, lambda: cls(name, help_, const_labels=labels or None)
+            )
+            c.set(value)
 
         def gauge(name, help_, value, **labels):
-            key = name + "".join(f"|{k}={v}" for k, v in sorted(labels.items()))
-            g = registry.get_or_register(
-                key, lambda: Gauge(name, help_, const_labels=labels or None)
-            )
-            g.set(value)
+            _set(Gauge, name, help_, value, **labels)
+
+        def counter(name, help_, value, **labels):
+            # _total series must expose TYPE counter so rate()/increase()
+            # treat restarts (player rebuilds) as counter resets
+            _set(Counter, name, help_, value, **labels)
 
         players = []
         for kind, host in (("Node", ctr.nodes), ("Pod", ctr.pods)):
@@ -203,14 +211,14 @@ def _controller_self_metrics(ctr):
         for kind, dev in dict(ctr.device_players or {}).items():
             players.append((kind, "device", dev))
         for kind, backend, p in players:
-            gauge(
+            counter(
                 "kwok_stage_transitions_total",
                 "Stage transitions played.",
                 getattr(p, "transitions", 0),
                 kind=kind,
                 backend=backend,
             )
-            gauge(
+            counter(
                 "kwok_patches_total",
                 "Patches written to the cluster.",
                 getattr(p, "patches", 0),

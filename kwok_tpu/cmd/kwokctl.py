@@ -425,6 +425,41 @@ def cmd_config_view(args) -> int:
     return 0
 
 
+def cmd_config_tidy(args) -> int:
+    """Re-normalize kwok.yaml (reference `kwokctl config tidy` rewrites
+    the saved config in canonical form)."""
+    rt = _require_cluster(args)
+    conf = rt.load_config()
+    if dry_run.enabled:
+        dry_run.emit(f"write {rt.config_path}")
+        return 0
+    with open(rt.config_path, "w", encoding="utf-8") as f:
+        yaml.safe_dump(conf, f, sort_keys=False)
+    print(f"tidied {rt.config_path}")
+    return 0
+
+
+def cmd_config_reset(args) -> int:
+    """Wipe cluster state but keep the cluster definition (reference
+    `kwokctl config reset` restores defaults): stops components,
+    removes the persisted store, restarts if it was running."""
+    rt = _require_cluster(args)
+    state = os.path.join(rt.workdir, "state.json")
+    if dry_run.enabled:
+        dry_run.emit(f"stop-cluster {rt.name}")
+        dry_run.emit(f"rm -f {state}")
+        dry_run.emit(f"start-cluster {rt.name}")
+        return 0
+    was_running = any(rt.running_components().values())
+    rt.down()
+    if os.path.exists(state):
+        os.remove(state)
+    if was_running:
+        rt.up(wait=60)
+    print(f"reset cluster {rt.name!r} state")
+    return 0
+
+
 def cmd_kubectl(args) -> int:
     """Built-in kubectl subset (the reference shells out to a real
     kubectl; ours speaks the REST client directly)."""
@@ -601,9 +636,11 @@ def build_parser() -> argparse.ArgumentParser:
     hd.add_argument("-n", "--namespace", default=None)
     hd.set_defaults(fn=cmd_hack)
 
-    pv = sub.add_parser("config", help="view cluster config")
+    pv = sub.add_parser("config", help="view/tidy/reset cluster config")
     pvs = pv.add_subparsers(dest="what", required=True)
     pvs.add_parser("view").set_defaults(fn=cmd_config_view)
+    pvs.add_parser("tidy").set_defaults(fn=cmd_config_tidy)
+    pvs.add_parser("reset").set_defaults(fn=cmd_config_reset)
 
     pk = sub.add_parser("kubectl", help="built-in kubectl subset")
     pks = pk.add_subparsers(dest="kubectl_verb", required=True)

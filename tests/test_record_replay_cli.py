@@ -1,0 +1,74 @@
+"""Record/replay through the CLI on live clusters: record a session on
+one cluster, replay it onto a fresh one, end with the same state
+(reference kwokctl snapshot record/replay, SURVEY §3.5)."""
+
+import os
+import threading
+import time
+
+import pytest
+import yaml
+
+from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+from kwok_tpu.ctl.runtime import BinaryRuntime
+
+
+@pytest.fixture()
+def home(tmp_path, monkeypatch):
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    return str(tmp_path)
+
+
+def test_record_then_replay_across_clusters(home):
+    rec_path = os.path.join(home, "session.yaml")
+    assert kwokctl_main(["--name", "src", "create", "cluster", "--wait", "60"]) == 0
+    try:
+        # record in a thread while we drive the cluster
+        rec_thread = threading.Thread(
+            target=kwokctl_main,
+            args=(
+                ["--name", "src", "snapshot", "record", "--path", rec_path,
+                 "--duration", "6"],
+            ),
+        )
+        rec_thread.start()
+        time.sleep(0.5)
+        assert kwokctl_main(["--name", "src", "scale", "node", "--replicas", "2"]) == 0
+        assert kwokctl_main(
+            ["--name", "src", "scale", "pod", "--replicas", "3",
+             "--param", ".nodeName=node-0"]
+        ) == 0
+        rec_thread.join(timeout=30)
+        assert not rec_thread.is_alive()
+
+        docs = [d for d in yaml.safe_load_all(open(rec_path)) if d]
+        assert any(d.get("kind") == "ResourcePatch" for d in docs)
+
+        # replay onto a fresh cluster at 64x
+        assert kwokctl_main(["--name", "dst", "create", "cluster", "--wait", "60"]) == 0
+        try:
+            assert kwokctl_main(
+                ["--name", "dst", "snapshot", "replay", "--path", rec_path,
+                 "--speed", "64"]
+            ) == 0
+            client = BinaryRuntime("dst").client()
+            nodes, _ = client.list("Node")
+            pods, _ = client.list("Pod")
+            assert len(nodes) == 2 and len(pods) == 3
+            # dst's own controller picks the replayed pods up and they
+            # converge to Running there too
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                pods, _ = client.list("Pod")
+                if all(
+                    (p.get("status") or {}).get("phase") == "Running" for p in pods
+                ):
+                    break
+                time.sleep(0.3)
+            assert all(
+                (p.get("status") or {}).get("phase") == "Running" for p in pods
+            )
+        finally:
+            kwokctl_main(["--name", "dst", "delete", "cluster"])
+    finally:
+        kwokctl_main(["--name", "src", "delete", "cluster"])
