@@ -67,6 +67,23 @@ PATCH_CONTENT_TYPES = {
 _BOOKMARK_EVERY = 15.0
 
 
+def _encode_continue(token) -> str:
+    """Opaque continue token: base64(json([ns, name])) — object names
+    may contain any character, so no separator scheme is safe."""
+    import base64
+
+    return base64.urlsafe_b64encode(json.dumps(list(token)).encode()).decode()
+
+
+def _decode_continue(raw):
+    if not raw:
+        return None
+    import base64
+
+    ns, name = json.loads(base64.urlsafe_b64decode(raw.encode()))
+    return (ns, name)
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kwok-tpu-apiserver"
@@ -170,6 +187,19 @@ class _Handler(BaseHTTPRequestHandler):
             elif head == "r" and len(rest) == 1:
                 if q.get("watch"):
                     self._serve_watch(rest[0], q)
+                elif q.get("limit") or q.get("continue"):
+                    items, rv, nxt = self.store.list_page(
+                        rest[0],
+                        namespace=self._ns(q),
+                        label_selector=q.get("labelSelector"),
+                        field_selector=q.get("fieldSelector"),
+                        limit=int(q.get("limit") or 0),
+                        continue_from=_decode_continue(q.get("continue")),
+                    )
+                    body = {"items": items, "resourceVersion": str(rv)}
+                    if nxt is not None:
+                        body["continue"] = _encode_continue(nxt)
+                    self._send_json(200, body)
                 else:
                     items, rv = self.store.list(
                         rest[0],

@@ -320,6 +320,10 @@ class ClusterClient:
             "GET", f"/r/{plural}/{self._esc(name)}" + self._q(namespace=namespace)
         )
 
+    #: default page size for list_paged (the reference's snapshot pager
+    #: bounds responses the same way)
+    LIST_PAGE_SIZE = 5000
+
     def list(
         self,
         kind: str,
@@ -327,6 +331,11 @@ class ClusterClient:
         label_selector: Selector = None,
         field_selector: Selector = None,
     ) -> Tuple[List[dict], int]:
+        """Single-request list: one consistent snapshot under the store
+        lock, which informers REQUIRE (the returned resourceVersion
+        must cover every item, or watch-from-rv misses events).  Use
+        :meth:`list_paged` for bulk exports where bounded response
+        sizes matter more than snapshot consistency."""
         plural = self.resource_type(kind).plural
         data = self._request(
             "GET",
@@ -338,6 +347,40 @@ class ClusterClient:
             ),
         )
         return data.get("items", []), int(data.get("resourceVersion", 0))
+
+    def list_paged(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Selector = None,
+        field_selector: Selector = None,
+        page_size: Optional[int] = None,
+    ) -> Tuple[List[dict], int]:
+        """Paged list via limit/continue: bounds each response, but the
+        pages are independent reads — mutations between pages can skip
+        or duplicate items (see ResourceStore.list_page)."""
+        plural = self.resource_type(kind).plural
+        items: List[dict] = []
+        rv = 0
+        cont: Optional[str] = None
+        size = page_size or self.LIST_PAGE_SIZE
+        while True:
+            data = self._request(
+                "GET",
+                f"/r/{plural}"
+                + self._q(
+                    namespace=namespace,
+                    labelSelector=self._sel(label_selector),
+                    fieldSelector=self._sel(field_selector),
+                    limit=str(size),
+                    **({"continue": cont} if cont else {}),
+                ),
+            )
+            items.extend(data.get("items", []))
+            rv = int(data.get("resourceVersion", 0))
+            cont = data.get("continue")
+            if not cont:
+                return items, rv
 
     def update(
         self, obj: dict, subresource: str = "", as_user: Optional[str] = None

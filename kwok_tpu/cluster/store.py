@@ -215,6 +215,9 @@ class _TypeState:
     history: deque
     objects: Dict[Tuple[str, str], dict] = field(default_factory=dict)
     watchers: List[Watcher] = field(default_factory=list)
+    #: field-path -> value -> keys (the informer-cache index analog:
+    #: client-go indexes pods by spec.nodeName the same way)
+    indexes: Dict[str, Dict[str, set]] = field(default_factory=dict)
 
 
 class ResourceStore:
@@ -231,6 +234,9 @@ class ResourceStore:
         self._audit: List[Tuple[str, str, Optional[str]]] = []  # (verb, key, as_user)
         for t in BUILTIN_TYPES:
             self.register_type(t)
+        # the hottest field-selector in the system: the kubelet server
+        # and pod controller list pods by node on every scrape/sync
+        self.register_index("Pod", "spec.nodeName")
 
     # ------------------------------------------------------------------ registry
 
@@ -242,6 +248,37 @@ class ResourceStore:
                     rtype=rtype, history=deque(maxlen=self.HISTORY)
                 )
             self._types[rtype.plural.lower()] = self._types[key]
+
+    def register_index(self, kind: str, path: str) -> None:
+        """Index a scalar field path for O(matches) field-selector
+        lists (client-go informer indexers do the same for
+        spec.nodeName)."""
+        with self._mut:
+            st = self._state(kind)
+            if path in st.indexes:
+                return
+            idx: Dict[str, set] = {}
+            st.indexes[path] = idx
+            for key, obj in st.objects.items():
+                v = _dotted_get(obj, path)
+                if isinstance(v, str):
+                    idx.setdefault(v, set()).add(key)
+
+    @staticmethod
+    def _index_update(st: _TypeState, key: Tuple[str, str], old: Optional[dict], new: Optional[dict]) -> None:
+        for path, idx in st.indexes.items():
+            ov = _dotted_get(old, path) if old is not None else None
+            nv = _dotted_get(new, path) if new is not None else None
+            if ov == nv:
+                continue
+            if isinstance(ov, str):
+                bucket = idx.get(ov)
+                if bucket is not None:
+                    bucket.discard(key)
+                    if not bucket:
+                        del idx[ov]
+            if isinstance(nv, str):
+                idx.setdefault(nv, set()).add(key)
 
     def resource_type(self, kind: str) -> ResourceType:
         return self._state(kind).rtype
@@ -314,6 +351,7 @@ class ResourceStore:
             self._audit.append(("create", f"{kind}:{key}", as_user))
             rv = self._bump(obj)
             st.objects[key] = obj
+            self._index_update(st, key, None, obj)
             self._emit(st, ADDED, obj, rv)
             return copy.deepcopy(obj)
 
@@ -326,6 +364,25 @@ class ResourceStore:
                 raise NotFound(f"{kind} {ns}/{name} not found")
             return copy.deepcopy(obj)
 
+    @staticmethod
+    def _index_candidates(st: _TypeState, field_selector: Selector):
+        """Sorted key subset from an index when the field selector is a
+        single equality on an indexed path; None → full scan."""
+        if not st.indexes or field_selector is None:
+            return None
+        reqs = _parse_selector(field_selector)
+        if len(reqs) != 1 or reqs[0][1] != "=":
+            return None
+        path, _, value = reqs[0]
+        if value == "":
+            # match_field_selector treats missing fields as "" — unset
+            # values are not indexed, so serve that query by full scan
+            return None
+        idx = st.indexes.get(path)
+        if idx is None:
+            return None
+        return sorted(idx.get(value, ()))
+
     def list(
         self,
         kind: str,
@@ -335,6 +392,20 @@ class ResourceStore:
     ) -> Tuple[List[dict], int]:
         with self._mut:
             st = self._state(kind)
+            cand = self._index_candidates(st, field_selector)
+            if cand is not None:
+                items = []
+                for key in cand:
+                    obj = st.objects.get(key)
+                    if obj is None:
+                        continue
+                    ns = key[0]
+                    if st.rtype.namespaced and namespace is not None and ns != namespace:
+                        continue
+                    if not match_label_selector(obj, label_selector):
+                        continue
+                    items.append(copy.deepcopy(obj))
+                return items, self._rv
             items = []
             for (ns, _), obj in sorted(st.objects.items()):
                 if st.rtype.namespaced and namespace is not None and ns != namespace:
@@ -345,6 +416,58 @@ class ResourceStore:
                     continue
                 items.append(copy.deepcopy(obj))
             return items, self._rv
+
+    def list_page(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Selector = None,
+        field_selector: Selector = None,
+        limit: int = 0,
+        continue_from: Optional[Tuple[str, str]] = None,
+    ) -> Tuple[List[dict], int, Optional[Tuple[str, str]]]:
+        """Paged list (the apiserver's limit/continue semantics; the
+        reference's snapshot pager consumes the same, snapshot/save.go).
+        Returns (items, rv, next_token): next_token is the last key of
+        a full page, None when exhausted.  Filtering applies after
+        pagination-by-key like k8s (a page can be shorter than limit
+        even when more items remain).
+
+        Consistency caveat: pages are independent reads, not one
+        snapshot — mutations between pages can skip or duplicate
+        objects (k8s pins continue tokens to an etcd snapshot; this
+        store does not).  Informers therefore use the single-request
+        :meth:`list`; paging is for bulk export paths."""
+        import bisect
+
+        with self._mut:
+            st = self._state(kind)
+            items: List[dict] = []
+            next_token: Optional[Tuple[str, str]] = None
+            scanned = 0
+            keys = sorted(st.objects)
+            start = (
+                bisect.bisect_right(keys, continue_from)
+                if continue_from is not None
+                else 0
+            )
+            for key in keys[start:]:
+                if limit and scanned >= limit:
+                    break
+                scanned += 1
+                next_token = key
+                ns, _ = key
+                obj = st.objects[key]
+                if st.rtype.namespaced and namespace is not None and ns != namespace:
+                    continue
+                if not match_label_selector(obj, label_selector):
+                    continue
+                if not match_field_selector(obj, field_selector):
+                    continue
+                items.append(copy.deepcopy(obj))
+            if not limit or scanned < limit:
+                next_token = None
+            return items, self._rv, next_token
 
     def update(
         self,
@@ -421,13 +544,16 @@ class ResourceStore:
         """Commit an updated object; reap it if it is terminating with no
         finalizers left (the apiserver's finalizer GC)."""
         meta = new.setdefault("metadata", {})
+        old = st.objects.get(key)
         if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
             rv = self._bump(new)
             del st.objects[key]
+            self._index_update(st, key, old, None)
             self._emit(st, DELETED, new, rv)
             return copy.deepcopy(new)
         rv = self._bump(new)
         st.objects[key] = new
+        self._index_update(st, key, old, new)
         self._emit(st, MODIFIED, new, rv)
         return copy.deepcopy(new)
 
@@ -457,6 +583,7 @@ class ResourceStore:
                 return copy.deepcopy(cur)
             rv = self._bump(cur)
             del st.objects[key]
+            self._index_update(st, key, cur, None)
             self._emit(st, DELETED, cur, rv)
             return None
 
@@ -599,7 +726,9 @@ class ResourceStore:
             for obj in state.get("objects", []):
                 st = self._state(obj.get("kind") or "")
                 key = self._key(st, obj)
+                old = st.objects.get(key)
                 st.objects[key] = copy.deepcopy(obj)
+                self._index_update(st, key, old, obj)
                 self._emit(st, ADDED, obj, self._rv)
                 n += 1
             return n

@@ -177,3 +177,64 @@ def test_event_recorder_aggregates():
     rec.event(p, "Warning", "Failed", "boom")
     events, _ = s.list("Event")
     assert len(events) == 2
+
+
+def test_field_index_matches_full_scan():
+    """The spec.nodeName index returns exactly what a full scan does,
+    through create/update/delete churn."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+
+    def pod(name, node):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"nodeName": node, "containers": [{"name": "c"}]},
+            "status": {},
+        }
+
+    for i in range(30):
+        store.create(pod(f"p{i}", f"n{i % 3}"))
+    # move a pod between nodes via patch
+    store.patch("Pod", "p0", {"spec": {"nodeName": "n9"}})
+    store.delete("Pod", "p3")
+
+    for node in ("n0", "n1", "n2", "n9", "missing"):
+        indexed, _ = store.list("Pod", field_selector=f"spec.nodeName={node}")
+        full = [
+            o
+            for o in store.list("Pod")[0]
+            if o["spec"].get("nodeName") == node
+        ]
+        assert {o["metadata"]["name"] for o in indexed} == {
+            o["metadata"]["name"] for o in full
+        }, node
+
+    # restore path keeps the index in sync too
+    snap = store.dump_state()
+    fresh = ResourceStore()
+    fresh.restore_state(snap)
+    indexed, _ = fresh.list("Pod", field_selector="spec.nodeName=n9")
+    assert [o["metadata"]["name"] for o in indexed] == ["p0"]
+
+    # non-equality / multi-requirement selectors fall back to scanning
+    items, _ = store.list("Pod", field_selector="spec.nodeName!=n0")
+    assert all(o["spec"]["nodeName"] != "n0" for o in items)
+
+
+def test_index_empty_value_falls_back_to_scan():
+    """spec.nodeName= (unscheduled pods) must match missing fields,
+    which the index never holds — full-scan fallback required."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "scheduled", "namespace": "default"},
+                  "spec": {"nodeName": "n1"}, "status": {}})
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "pending", "namespace": "default"},
+                  "spec": {}, "status": {}})
+    items, _ = store.list("Pod", field_selector="spec.nodeName=")
+    assert [o["metadata"]["name"] for o in items] == ["pending"]
