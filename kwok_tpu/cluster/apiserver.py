@@ -176,6 +176,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(
                     200, {"resources": [asdict(t) for t in self.store.kinds()]}
                 )
+            elif head == "state":
+                # raw store dump — the etcd-snapshot analog (reference
+                # kwokctl snapshot save, etcd/save.go)
+                self._send_json(200, self.store.dump_state())
             elif head == "stats":
                 counts = {
                     t.plural: self.store.count(t.kind) for t in self.store.kinds()
@@ -250,7 +254,10 @@ class _Handler(BaseHTTPRequestHandler):
         head, rest, q = self._route()
         try:
             body = self._read_body()
-            if head == "r" and len(rest) == 2:
+            if head == "state":
+                n = self.store.restore_state(body or {})
+                self._send_json(200, {"restored": n})
+            elif head == "r" and len(rest) == 2:
                 out = self.store.update(
                     body, subresource=q.get("subresource") or "", as_user=self._user()
                 )

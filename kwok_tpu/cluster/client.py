@@ -427,6 +427,17 @@ class ClusterClient:
             headers=self._user_hdr(as_user),
         )
 
+    # ---------------------------------------------------------- raw state
+
+    def dump_state(self) -> dict:
+        """Raw store snapshot from a live cluster (etcd-save analog)."""
+        return self._request("GET", "/state")
+
+    def restore_state(self, state: dict) -> int:
+        """Load a raw snapshot into a live cluster (etcd-restore
+        analog); watchers see ADDED for every restored object."""
+        return int(self._request("PUT", "/state", body=state)["restored"])
+
     # ---------------------------------------------------------------- bulk
 
     def bulk(self, ops) -> list:

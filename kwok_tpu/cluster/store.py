@@ -128,6 +128,15 @@ def match_label_selector(obj: dict, sel: Selector) -> bool:
     return True
 
 
+def _index_value(v: Any) -> Optional[str]:
+    """Stringify a scalar for indexing exactly like the field selector
+    compares (match_field_selector does str(raw)); composites and
+    missing values are unindexed."""
+    if v is None or isinstance(v, (dict, list)):
+        return None
+    return str(v)
+
+
 def _dotted_get(obj: Any, path: str) -> Any:
     cur = obj
     for p in path.split("."):
@@ -218,6 +227,9 @@ class _TypeState:
     #: field-path -> value -> keys (the informer-cache index analog:
     #: client-go indexes pods by spec.nodeName the same way)
     indexes: Dict[str, Dict[str, set]] = field(default_factory=dict)
+    #: lazily maintained sorted key list; invalidated on add/remove so
+    #: paged walks don't re-sort the keyspace per page
+    sorted_keys: Optional[List[Tuple[str, str]]] = None
 
 
 class ResourceStore:
@@ -260,24 +272,26 @@ class ResourceStore:
             idx: Dict[str, set] = {}
             st.indexes[path] = idx
             for key, obj in st.objects.items():
-                v = _dotted_get(obj, path)
-                if isinstance(v, str):
+                v = _index_value(_dotted_get(obj, path))
+                if v is not None:
                     idx.setdefault(v, set()).add(key)
 
     @staticmethod
     def _index_update(st: _TypeState, key: Tuple[str, str], old: Optional[dict], new: Optional[dict]) -> None:
+        if old is None or new is None:  # key added or removed
+            st.sorted_keys = None
         for path, idx in st.indexes.items():
-            ov = _dotted_get(old, path) if old is not None else None
-            nv = _dotted_get(new, path) if new is not None else None
+            ov = _index_value(_dotted_get(old, path) if old is not None else None)
+            nv = _index_value(_dotted_get(new, path) if new is not None else None)
             if ov == nv:
                 continue
-            if isinstance(ov, str):
+            if ov is not None:
                 bucket = idx.get(ov)
                 if bucket is not None:
                     bucket.discard(key)
                     if not bucket:
                         del idx[ov]
-            if isinstance(nv, str):
+            if nv is not None:
                 idx.setdefault(nv, set()).add(key)
 
     def resource_type(self, kind: str) -> ResourceType:
@@ -417,6 +431,24 @@ class ResourceStore:
                 items.append(copy.deepcopy(obj))
             return items, self._rv
 
+    def list_paged(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Selector = None,
+        field_selector: Selector = None,
+        page_size: Optional[int] = None,
+    ) -> Tuple[List[dict], int]:
+        """Duck-type twin of ClusterClient.list_paged.  In-process there
+        is no response-size concern, so one consistent snapshot read is
+        strictly better — delegate to :meth:`list`."""
+        return self.list(
+            kind,
+            namespace=namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+        )
+
     def list_page(
         self,
         kind: str,
@@ -445,7 +477,9 @@ class ResourceStore:
             items: List[dict] = []
             next_token: Optional[Tuple[str, str]] = None
             scanned = 0
-            keys = sorted(st.objects)
+            if st.sorted_keys is None:
+                st.sorted_keys = sorted(st.objects)
+            keys = st.sorted_keys
             start = (
                 bisect.bisect_right(keys, continue_from)
                 if continue_from is not None

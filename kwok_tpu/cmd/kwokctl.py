@@ -276,10 +276,40 @@ def cmd_snapshot_export(args) -> int:
     return 0
 
 
+def cmd_snapshot_save(args) -> int:
+    """Raw store snapshot — the etcd-level save (reference
+    kwokctl snapshot save, pkg/kwokctl/etcd/save.go)."""
+    rt = _require_cluster(args)
+    state = rt.client().dump_state()
+    tmp = args.path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(state, f)
+    os.replace(tmp, args.path)
+    print(f"saved {len(state.get('objects', []))} objects (raw) to {args.path}")
+    return 0
+
+
 def cmd_snapshot_restore(args) -> int:
+    """Restore a snapshot: raw JSON state (etcd-level) or YAML export
+    (k8s-level with owner-ref re-link), detected by content."""
     from kwok_tpu.snapshot import load
 
     rt = _require_cluster(args)
+    # a raw dump is a JSON object with the dump_state shape; anything
+    # else (including JSON-format k8s manifests, which are valid YAML)
+    # goes through the k8s-level loader
+    state = None
+    try:
+        with open(args.path, "r", encoding="utf-8") as f:
+            parsed = json.load(f)
+        if isinstance(parsed, dict) and "objects" in parsed and "types" in parsed:
+            state = parsed
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        pass
+    if state is not None:
+        n = rt.client().restore_state(state)
+        print(f"restored {n} objects (raw) from {args.path}")
+        return 0
     created = load(rt.client(), args.path)
     print(f"restored {len(created)} objects from {args.path}")
     return 0
@@ -606,6 +636,9 @@ def build_parser() -> argparse.ArgumentParser:
     e = pns.add_parser("export")
     e.add_argument("--path", required=True)
     e.set_defaults(fn=cmd_snapshot_export)
+    sv = pns.add_parser("save")
+    sv.add_argument("--path", required=True)
+    sv.set_defaults(fn=cmd_snapshot_save)
     r = pns.add_parser("restore")
     r.add_argument("--path", required=True)
     r.set_defaults(fn=cmd_snapshot_restore)

@@ -305,6 +305,26 @@ def test_stats(cluster):
     assert client.resource_version >= 2
 
 
+def test_raw_state_roundtrip_over_http(cluster):
+    """dump_state/restore_state over the wire: the etcd-level
+    save/restore path (kwokctl snapshot save)."""
+    store, client = cluster
+    client.create(make_pod("a"))
+    client.patch("Pod", "a", {"status": {"phase": "Running"}})
+    state = client.dump_state()
+    assert any(o["metadata"]["name"] == "a" for o in state["objects"])
+
+    fresh_store = ResourceStore()
+    with APIServer(fresh_store) as srv2:
+        c2 = ClusterClient(srv2.url)
+        n = c2.restore_state(state)
+        assert n >= 1
+        obj = fresh_store.get("Pod", "a")
+        assert obj["status"]["phase"] == "Running"
+        # uid preserved exactly (raw restore, unlike YAML load)
+        assert obj["metadata"]["uid"] == store.get("Pod", "a")["metadata"]["uid"]
+
+
 def test_list_paging(cluster):
     """limit/continue pages bound response sizes; the client pages
     transparently and returns the full set."""

@@ -238,3 +238,16 @@ def test_index_empty_value_falls_back_to_scan():
                   "spec": {}, "status": {}})
     items, _ = store.list("Pod", field_selector="spec.nodeName=")
     assert [o["metadata"]["name"] for o in items] == ["pending"]
+
+
+def test_index_on_non_string_field():
+    """Indexed non-string scalars stringify like the field selector."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    store.register_index("Node", "status.capacity.pods")
+    store.create({"apiVersion": "v1", "kind": "Node",
+                  "metadata": {"name": "n0"},
+                  "spec": {}, "status": {"capacity": {"pods": 110}}})
+    items, _ = store.list("Node", field_selector="status.capacity.pods=110")
+    assert [o["metadata"]["name"] for o in items] == ["n0"]
