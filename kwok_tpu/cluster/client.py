@@ -128,6 +128,10 @@ class RemoteWatcher:
 class ClusterClient:
     """Store-compatible client for a remote :class:`APIServer`."""
 
+    #: default page size for list_paged (the reference's snapshot pager
+    #: bounds responses the same way)
+    LIST_PAGE_SIZE = 5000
+
     def __init__(
         self,
         url: str,
@@ -319,10 +323,6 @@ class ClusterClient:
         return self._request(
             "GET", f"/r/{plural}/{self._esc(name)}" + self._q(namespace=namespace)
         )
-
-    #: default page size for list_paged (the reference's snapshot pager
-    #: bounds responses the same way)
-    LIST_PAGE_SIZE = 5000
 
     def list(
         self,

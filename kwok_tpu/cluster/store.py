@@ -128,6 +128,18 @@ def match_label_selector(obj: dict, sel: Selector) -> bool:
     return True
 
 
+def atomic_write_json(path: str, data: Any) -> None:
+    """Write JSON via tmp-then-replace so a crash never leaves a
+    truncated file over a previous good one."""
+    import json as _json
+    import os as _os
+
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        _json.dump(data, f)
+    _os.replace(tmp, path)
+
+
 def _index_value(v: Any) -> Optional[str]:
     """Stringify a scalar for indexing exactly like the field selector
     compares (match_field_selector does str(raw)); composites and
@@ -485,7 +497,8 @@ class ResourceStore:
                 if continue_from is not None
                 else 0
             )
-            for key in keys[start:]:
+            for i in range(start, len(keys)):  # no tail copy per page
+                key = keys[i]
                 if limit and scanned >= limit:
                     break
                 scanned += 1
@@ -768,14 +781,7 @@ class ResourceStore:
             return n
 
     def save_file(self, path: str) -> None:
-        import json as _json
-
-        tmp = f"{path}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            _json.dump(self.dump_state(), f)
-        import os as _os
-
-        _os.replace(tmp, path)
+        atomic_write_json(path, self.dump_state())
 
     def load_file(self, path: str) -> int:
         import json as _json

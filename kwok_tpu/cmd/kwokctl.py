@@ -279,12 +279,11 @@ def cmd_snapshot_export(args) -> int:
 def cmd_snapshot_save(args) -> int:
     """Raw store snapshot — the etcd-level save (reference
     kwokctl snapshot save, pkg/kwokctl/etcd/save.go)."""
+    from kwok_tpu.cluster.store import atomic_write_json
+
     rt = _require_cluster(args)
     state = rt.client().dump_state()
-    tmp = args.path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(state, f)
-    os.replace(tmp, args.path)
+    atomic_write_json(args.path, state)
     print(f"saved {len(state.get('objects', []))} objects (raw) to {args.path}")
     return 0
 
