@@ -342,14 +342,31 @@ class _Handler(BaseHTTPRequestHandler):
                         )
                     continue
                 idle = 0.0
-                self._write_line({"type": ev.type, "object": ev.object, "rv": ev.rv})
+                # drain the burst (e.g. a bulk tick's worth of MODIFIED
+                # events) into one buffered write + single flush
+                buf = [self._encode_line({"type": ev.type, "object": ev.object, "rv": ev.rv})]
+                while len(buf) < 512:
+                    ev = w.next(timeout=0)
+                    if ev is None:
+                        break
+                    buf.append(
+                        self._encode_line(
+                            {"type": ev.type, "object": ev.object, "rv": ev.rv}
+                        )
+                    )
+                self.wfile.write(b"".join(buf))
+                self.wfile.flush()
         except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
             pass
         finally:
             w.stop()
 
+    @staticmethod
+    def _encode_line(payload: dict) -> bytes:
+        return json.dumps(payload).encode() + b"\n"
+
     def _write_line(self, payload: dict) -> None:
-        self.wfile.write(json.dumps(payload).encode() + b"\n")
+        self.wfile.write(self._encode_line(payload))
         self.wfile.flush()
 
 
