@@ -216,18 +216,7 @@ class DeviceStagePlayer:
             if results is None:
                 for key, op in zip(batch_keys, batch_ops):
                     try:
-                        obj = self.store.patch(
-                            op["kind"],
-                            op["name"],
-                            op["data"],
-                            op.get("patch_type", "merge"),
-                            namespace=op.get("namespace"),
-                            subresource=op.get("subresource") or "",
-                            as_user=op.get("as_user"),
-                        )
-                        self.patches += 1
-                        self.transitions += 1
-                        self._refresh(key, obj)
+                        self._apply_op_sequential(key, op)
                     except NotFound:
                         self._release(key)
                     except Exception:  # noqa: BLE001 — per-op isolation,
@@ -236,15 +225,23 @@ class DeviceStagePlayer:
 
                         traceback.print_exc()
             else:
-                for key, res in zip(batch_keys, results):
+                for (key, op), res in zip(zip(batch_keys, batch_ops), results):
                     if res.get("status") == "ok":
-                        self.patches += 1
-                        self.transitions += 1
-                        obj = res.get("object")
-                        if obj is not None:
-                            self._refresh(key, obj)
+                        if op["verb"] == "delete":
+                            self._finish_delete(key, res.get("object"))
+                        else:
+                            self.patches += 1
+                            self.transitions += 1
+                            obj = res.get("object")
+                            if obj is not None:
+                                self._refresh(key, obj)
                     elif res.get("reason") == "NotFound":
-                        self._release(key)
+                        if op["verb"] == "delete":
+                            # already gone counts as a completed delete
+                            # transition (sequential-path parity)
+                            self._finish_delete(key, None)
+                        else:
+                            self._release(key)
                     else:
                         # Conflict/Invalid: surface it like the
                         # sequential path's per-transition traceback did
@@ -254,6 +251,40 @@ class DeviceStagePlayer:
                             file=sys.stderr,
                         )
         return transitions
+
+    def _finish_delete(self, key: Tuple[str, str], out: Optional[dict]) -> None:
+        """Complete a stage-driven delete: fully gone → release the
+        row; terminating (finalizers pending) → refresh from the
+        store's result.  Counts the transition either way."""
+        self.transitions += 1
+        if out is None:
+            self._release(key)
+        else:
+            self._refresh(key, out)
+
+    def _apply_op_sequential(self, key: Tuple[str, str], op: dict) -> None:
+        """Per-op fallback when the bulk round-trip itself failed."""
+        if op["verb"] == "delete":
+            try:
+                out = self.store.delete(
+                    op["kind"], op["name"], namespace=op.get("namespace")
+                )
+            except NotFound:
+                out = None
+            self._finish_delete(key, out)
+            return
+        obj = self.store.patch(
+            op["kind"],
+            op["name"],
+            op["data"],
+            op.get("patch_type", "merge"),
+            namespace=op.get("namespace"),
+            subresource=op.get("subresource") or "",
+            as_user=op.get("as_user"),
+        )
+        self.patches += 1
+        self.transitions += 1
+        self._refresh(key, obj)
 
     def _collect_simple(self, tr: Transition):
         """If the transition is the batchable shape, emit its bulk op:
@@ -269,8 +300,23 @@ class DeviceStagePlayer:
         effects = self.sim.cset.lifecycle.effects(cs)
         if effects is None:
             return (self._key(obj), None)
-        if effects.delete or effects.finalizers_patch(meta.get("finalizers") or []):
+        if effects.finalizers_patch(meta.get("finalizers") or []):
             return None
+        if effects.delete:
+            # no finalizer change → the delete is a single op; batch it
+            if tr.event is not None and self.recorder is not None:
+                self.recorder.event(
+                    obj, tr.event.type or "Normal", tr.event.reason, tr.event.message
+                )
+            return (
+                self._key(obj),
+                {
+                    "verb": "delete",
+                    "kind": self.kind,
+                    "name": meta.get("name") or "",
+                    "namespace": meta.get("namespace"),
+                },
+            )
         funcs = dict(self.funcs_for(obj))
         funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
         patches = list(effects.patches(obj, funcs))
@@ -339,11 +385,7 @@ class DeviceStagePlayer:
                 out = self.store.delete(self.kind, name, namespace=ns)
             except NotFound:
                 out = None
-            if out is None:
-                self._release(key)
-            else:
-                self._refresh(key, out)  # terminating (finalizers pending)
-            self.transitions += 1
+            self._finish_delete(key, out)
             return
 
         funcs = dict(self.funcs_for(obj))
