@@ -570,7 +570,37 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="kwokctl", description=__doc__)
     p.add_argument("--name", default=DEFAULT_CLUSTER, help="cluster name")
     p.add_argument("--dry-run", action="store_true", help="print commands instead of executing")
+    # accept the globals after the subcommand too (`kwokctl create
+    # cluster --name x`, like the reference's persistent flags);
+    # SUPPRESS keeps an unprovided leaf flag from clobbering the
+    # main parser's value
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--name", default=argparse.SUPPRESS)
+    common.add_argument("--dry-run", action="store_true", default=argparse.SUPPRESS)
+
+    def _propagate(action):
+        """Give every parser in the tree (recursively) the common
+        flags, without touching each add_parser call site."""
+        orig_add = action.add_parser
+
+        def add_parser(name, **kw):
+            parents = list(kw.pop("parents", []))
+            parents.append(common)
+            child = orig_add(name, parents=parents, **kw)
+            orig_subs = child.add_subparsers
+
+            def add_subparsers(**skw):
+                sp = orig_subs(**skw)
+                _propagate(sp)
+                return sp
+
+            child.add_subparsers = add_subparsers
+            return child
+
+        action.add_parser = add_parser
+
     sub = p.add_subparsers(dest="cmd", required=True)
+    _propagate(sub)
 
     pc = sub.add_parser("create", help="create a resource")
     pcs = pc.add_subparsers(dest="what", required=True)

@@ -182,12 +182,12 @@ class DeviceStagePlayer:
     def step(self, dt_ms: Optional[int] = None) -> List[Transition]:
         """One device tick + host drain of dirty rows.
 
-        The common transition shape — event? + one rendered status
-        patch, no finalizers/delete — batches into a single
+        The common transition shapes — event? + one rendered status
+        patch, or a finalizer-free delete — batch into a single
         ``store.bulk`` call, so a remote apiserver costs one round-trip
         per tick instead of one per dirty row (SURVEY §2.9: dirty rows
-        stream across the boundary).  Finalizer/delete transitions keep
-        the exact sequential path."""
+        stream across the boundary).  Transitions that touch finalizers
+        or need multiple dependent patches keep the sequential path."""
         transitions = self.sim.step(
             dt_ms if dt_ms is not None else self.tick_ms, materialize=False
         )
