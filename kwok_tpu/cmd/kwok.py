@@ -11,6 +11,7 @@ controller facade, then serve the fake-kubelet HTTP surface
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -260,6 +261,17 @@ def main(argv=None) -> int:
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
+    # honor JAX_PLATFORMS even under TPU plugins that preset
+    # jax_platforms (e.g. "axon,cpu"), so operators/tests can pin the
+    # device backend to CPU; must run before any jax computation
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # noqa: BLE001 — backend already initialized
+            pass
     docs = load_config_docs(args.config)
     if args.enable_metrics_usage:
         from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs

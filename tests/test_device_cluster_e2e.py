@@ -49,6 +49,18 @@ def test_device_backend_cluster(home):
             for p in client.list("Pod")[0]
         ]
 
+        # self-metrics expose the device backend's counters + tick lag
+        # (the p99 heartbeat-lag signal, SURVEY §7 step 5)
+        import urllib.request
+
+        kubelet_port = rt.load_config()["ports"]["kubelet"]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{kubelet_port}/metrics", timeout=10
+        ).read().decode()
+        assert "kwok_stage_transitions_total" in body, body
+        assert 'backend="device"' in body, body
+        assert "kwok_tick_lag_seconds" in body, body
+
         # delete flows back through the device player's delete path
         client.delete("Pod", "pod-0")
         deadline = time.monotonic() + 60
