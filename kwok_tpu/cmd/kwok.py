@@ -270,8 +270,14 @@ def main(argv=None) -> int:
             import jax
 
             jax.config.update("jax_platforms", plat)
-        except Exception:  # noqa: BLE001 — backend already initialized
-            pass
+        except Exception as exc:  # noqa: BLE001 — backend already initialized
+            from kwok_tpu.utils.log import get_logger
+
+            get_logger("kwok").warn(
+                "JAX_PLATFORMS pin ignored (backend already initialized)",
+                platforms=plat,
+                error=str(exc),
+            )
     docs = load_config_docs(args.config)
     if args.enable_metrics_usage:
         from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs

@@ -519,6 +519,36 @@ def cmd_kubectl(args) -> int:
             else:
                 _print_table(items)
         return 0
+    if verb == "logs":
+        import urllib.error
+        import urllib.request
+
+        pod = client.get("Pod", args.object_name, namespace=args.namespace)
+        ns = (pod.get("metadata") or {}).get("namespace") or "default"
+        container = args.container or (
+            (pod.get("spec") or {}).get("containers") or [{}]
+        )[0].get("name", "")
+        port = rt.load_config()["ports"]["kubelet"]
+        url = (
+            f"http://127.0.0.1:{port}/containerLogs/{ns}/"
+            f"{args.object_name}/{container}"
+        )
+        try:
+            body = urllib.request.urlopen(url, timeout=30).read().decode(
+                errors="replace"
+            )
+        except urllib.error.HTTPError as e:
+            print(
+                f"no logs for {args.object_name}/{container}: HTTP {e.code} "
+                "(configure a Logs/ClusterLogs CR)",
+                file=sys.stderr,
+            )
+            return 1
+        except OSError as e:  # kubelet unreachable / stream timeout
+            print(f"cannot reach the kubelet endpoint: {e}", file=sys.stderr)
+            return 1
+        sys.stdout.write(body)
+        return 0
     if verb == "apply":
         with open(args.file, "r", encoding="utf-8") as f:
             docs = [d for d in yaml.safe_load_all(f) if d]
@@ -721,6 +751,11 @@ def build_parser() -> argparse.ArgumentParser:
     kd.add_argument("object_name")
     kd.add_argument("-n", "--namespace", default=None)
     kd.set_defaults(fn=cmd_kubectl)
+    klg = pks.add_parser("logs")
+    klg.add_argument("object_name")
+    klg.add_argument("-n", "--namespace", default=None)
+    klg.add_argument("-c", "--container", default="")
+    klg.set_defaults(fn=cmd_kubectl, kind="Pod")
     kt = pks.add_parser("top")
     kt.add_argument("top_what", choices=["pods", "nodes"])
     kt.add_argument("--window", type=float, default=1.0,

@@ -188,9 +188,20 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
     """create → scale → kubectl → snapshot → stop → start (state
     persists) → hack → delete.  Real subprocess components."""
     name = "e2e"
+    logf = os.path.join(str(home), "container.log")
+    with open(logf, "w", encoding="utf-8") as f:
+        f.write("fake container says hi\n")
+    cfg = os.path.join(str(home), "logs-config.yaml")
+    with open(cfg, "w", encoding="utf-8") as f:
+        yaml.safe_dump(
+            {"apiVersion": "kwok.x-k8s.io/v1alpha1", "kind": "ClusterLogs",
+             "metadata": {"name": "all"},
+             "spec": {"logs": [{"logsFile": logf}]}},
+            f,
+        )
     assert kwokctl_main(
         ["--name", name, "create", "cluster", "--wait", "60",
-         "--controller-arg=--enable-metrics-usage"]
+         "--controller-arg=--enable-metrics-usage", "--config", cfg]
     ) == 0
 
     from kwok_tpu.ctl.runtime import BinaryRuntime
@@ -229,6 +240,11 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
         assert kwokctl_main(["--name", name, "kubectl", "get", "pods"]) == 0
         out = capsys.readouterr().out
         assert "pod-0" in out and "Running" in out
+
+        # kubectl logs streams the configured fake-kubelet log replay
+        capsys.readouterr()
+        assert kwokctl_main(["--name", name, "kubectl", "logs", "pod-0"]) == 0
+        assert "fake container says hi" in capsys.readouterr().out
 
         # kubectl top (metrics-server equivalent over the kubelet
         # resource-metrics endpoint)
