@@ -1,16 +1,31 @@
 """Headline benchmark: sustained pod stage-transitions/sec.
 
-Config (BASELINE.json): 1M simulated pods across 10k fake nodes on a
-single chip, chaos churn (pod-container-running-failed) keeping every
-pod in a CrashLoopBackOff-style transition cycle, node heartbeats
-running concurrently in a second simulator.
+Two measurements, one JSON line:
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+1. **Kernel** (the headline `value`): 1M simulated pods across 10k fake
+   nodes on a single chip (BASELINE.json north star), chaos churn
+   (pod-container-running-failed) keeping every pod in a
+   CrashLoopBackOff-style transition cycle, node heartbeats ticking in
+   a second simulator. Measures the device tick loop alone.
+2. **End-to-end** (`e2e` field): the full pipeline at 100k pods —
+   device tick -> dirty-row drain -> template render -> `store.bulk`
+   against a live in-process ResourceStore, watch echoes fed back
+   through the informer (SURVEY §7 "hard parts": the dirty-row rate is
+   the real constraint). Reports sustained transitions/s, dirty-row
+   (patch) rate, and which pipeline component is the bottleneck.
+
 vs_baseline is against the north-star target of 100k transitions/sec
 (BASELINE.md); the reference CPU controller's measured ceiling is ~20
 object transitions/sec/worker x 4 workers (README.md:26-27, default
 parallelism) — this kernel replaces that loop wholesale.
+
+Resilience (the round-1 bench lost to a flaky tunnel TPU): backend
+init is retried with bounded backoff; JAX_PLATFORMS is honored by
+updating jax.config after import (the axon plugin presets
+jax_platforms, so the env var alone is not enough — tests/conftest.py
+documents the same gotcha); on terminal backend failure the bench
+falls back to CPU and says so; any crash still emits one structured
+JSON line instead of a bare traceback.
 """
 
 from __future__ import annotations
@@ -24,20 +39,66 @@ N_PODS = int(os.environ.get("BENCH_PODS", 1_000_000))
 N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
 TICKS = int(os.environ.get("BENCH_TICKS", 600))
 DT_MS = int(os.environ.get("BENCH_DT_MS", 100))
+E2E_PODS = int(os.environ.get("BENCH_E2E_PODS", 100_000))
+E2E_TICKS = int(os.environ.get("BENCH_E2E_TICKS", 100))
+E2E_WARM_TICKS = int(os.environ.get("BENCH_E2E_WARM_TICKS", 150))
+INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", 5))
+INIT_RETRY_DELAY = float(os.environ.get("BENCH_INIT_RETRY_DELAY", 60))
 TARGET_TPS = 100_000.0
 
 
-def build_pod_sim():
-    from kwok_tpu.engine.simulator import DeviceSimulator
-    from kwok_tpu.stages import load_builtin
+def _clear_backends() -> None:
+    try:
+        import jax.extend.backend
 
-    stages = load_builtin("pod-general") + load_builtin("pod-chaos")
-    sim = DeviceSimulator(stages, capacity=N_PODS, seed=0)
-    pod = {
+        jax.extend.backend.clear_backends()
+    except Exception:  # noqa: BLE001 — best effort between retries
+        pass
+
+
+def init_backend():
+    """Initialize the JAX backend, surviving shared-tunnel-TPU
+    flakiness (bounded retries), honoring JAX_PLATFORMS, and falling
+    back to CPU so a number exists even when the TPU is down.
+
+    Returns (platform, note_or_None)."""
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    last = None
+    for attempt in range(INIT_RETRIES):
+        if attempt:
+            print(
+                f"bench: backend init failed ({last}); retry "
+                f"{attempt}/{INIT_RETRIES - 1} in {INIT_RETRY_DELAY:.0f}s",
+                file=sys.stderr,
+            )
+            time.sleep(INIT_RETRY_DELAY)
+            _clear_backends()
+        try:
+            dev = jax.devices()[0]
+            jax.device_put(0).block_until_ready()
+            return dev.platform, None
+        except RuntimeError as e:  # backend init is the only RuntimeError here
+            last = e
+    _clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    jax.device_put(0).block_until_ready()
+    return dev.platform, (
+        f"primary backend unavailable after {INIT_RETRIES} attempts, "
+        f"fell back to cpu: {last}"
+    )
+
+
+def make_pod(name: str = "pod") -> dict:
+    return {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {
-            "name": "pod",
+            "name": name,
             "namespace": "default",
             "uid": "uid",
             "labels": {"pod-container-running-failed.stage.kwok.x-k8s.io": "true"},
@@ -48,8 +109,15 @@ def build_pod_sim():
         },
         "status": {},
     }
-    for _ in range(N_PODS):
-        sim.admit(pod)
+
+
+def build_pod_sim():
+    from kwok_tpu.engine.simulator import DeviceSimulator
+    from kwok_tpu.stages import load_builtin
+
+    stages = load_builtin("pod-general") + load_builtin("pod-chaos")
+    sim = DeviceSimulator(stages, capacity=N_PODS, seed=0)
+    sim.admit_bulk(make_pod(), N_PODS)
     return sim
 
 
@@ -64,12 +132,12 @@ def build_node_sim():
         "metadata": {"name": "node", "creationTimestamp": "2026-01-01T00:00:00Z"},
         "status": {},
     }
-    for _ in range(N_NODES):
-        sim.admit(node)
+    sim.admit_bulk(node, N_NODES)
     return sim
 
 
-def main() -> None:
+def run_kernel_bench() -> float:
+    """Device tick loop at 1M pods / 10k nodes; returns best-window tps."""
     from kwok_tpu.ops.tick import run_ticks
 
     pod_sim = build_pod_sim()
@@ -96,16 +164,111 @@ def main() -> None:
     # node heartbeats tick alongside (cheap at 10k rows)
     node_soa, node_count = run_ticks(node_params, node_soa, DT_MS, TICKS)
     node_count.block_until_ready()
-    print(
-        json.dumps(
-            {
-                "metric": f"pod_stage_transitions_per_sec_{N_PODS}_pods_{N_NODES}_nodes",
-                "value": round(tps),
-                "unit": "transitions/s",
-                "vs_baseline": round(tps / TARGET_TPS, 2),
-            }
-        )
+    return tps
+
+
+def run_e2e_bench() -> dict:
+    """Full-pipeline bench: tick + drain + store.bulk against a live
+    in-process store, informer echoes included. Back-to-back ticks (no
+    real-time pacing) measure sustained capacity, not cadence."""
+    from kwok_tpu.cluster.informer import WatchOptions
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.controllers.device_player import DeviceStagePlayer, _epoch_from
+    from kwok_tpu.controllers.pod_controller import PodEnv
+    from kwok_tpu.stages import load_builtin
+
+    store = ResourceStore()
+    stages = load_builtin("pod-general") + load_builtin("pod-chaos")
+    env = PodEnv()
+    player = DeviceStagePlayer(
+        store,
+        "Pod",
+        stages,
+        capacity=E2E_PODS,
+        tick_ms=DT_MS,
+        funcs_for=env.funcs,
+        on_delete=env.release,
+        seed=2,
     )
+
+    t_setup0 = time.time()
+    ops = [{"verb": "create", "data": make_pod(f"pod-{i}")} for i in range(E2E_PODS)]
+    for i in range(0, len(ops), 10_000):
+        store.bulk(ops[i : i + 10_000])
+
+    # wire the informer by hand (player.start() would add wall-clock
+    # pacing); the initial list admits every pod into the SoA
+    player._t0 = time.time()
+    player.sim.epoch = _epoch_from(player._t0)
+    player.cache = player._informer.watch_with_cache(
+        WatchOptions(), player.events, done=player._done
+    )
+    player._drain_events()
+    setup_s = time.time() - t_setup0
+
+    for _ in range(E2E_WARM_TICKS):
+        player._drain_events()
+        player.step(DT_MS)
+
+    tr0, p0 = player.transitions, player.patches
+    d0, s0, h0 = player.t_device, player.t_store, player.t_host
+    t0 = time.time()
+    for _ in range(E2E_TICKS):
+        player._drain_events()
+        player.step(DT_MS)
+    wall = time.time() - t0
+    player._done.set()
+
+    breakdown = {
+        "device_tick_s": round(player.t_device - d0, 2),
+        "store_bulk_s": round(player.t_store - s0, 2),
+        "host_drain_s": round(player.t_host - h0, 2),
+    }
+    bottleneck = max(breakdown, key=breakdown.get).removesuffix("_s")
+    return {
+        "pods": E2E_PODS,
+        "transitions_per_sec": round((player.transitions - tr0) / wall),
+        "dirty_rows_per_sec": round((player.patches - p0) / wall),
+        "setup_s": round(setup_s, 1),
+        "bottleneck": bottleneck,
+        "breakdown_s": breakdown,
+    }
+
+
+def main() -> int:
+    out = {
+        "metric": f"pod_stage_transitions_per_sec_{N_PODS}_pods_{N_NODES}_nodes",
+        "value": 0,
+        "unit": "transitions/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        platform, note = init_backend()
+        out["platform"] = platform
+        if note:
+            out["note"] = note
+
+        t0 = time.time()
+        tps = run_kernel_bench()
+        out["value"] = round(tps)
+        out["vs_baseline"] = round(tps / TARGET_TPS, 2)
+        out["kernel_wall_s"] = round(time.time() - t0, 1)
+
+        if E2E_PODS > 0:
+            try:
+                out["e2e"] = run_e2e_bench()
+            except Exception as e:  # noqa: BLE001 — e2e must not kill the headline
+                import traceback
+
+                traceback.print_exc()
+                out["e2e"] = {"error": f"{type(e).__name__}: {e}"}
+    except Exception as e:  # noqa: BLE001 — always emit the one JSON line
+        import traceback
+
+        traceback.print_exc()
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
+    return 1 if "error" in out else 0
 
 
 if __name__ == "__main__":

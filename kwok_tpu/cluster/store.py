@@ -754,8 +754,11 @@ class ResourceStore:
             }
 
     def restore_state(self, state: dict) -> int:
-        """Load a :meth:`dump_state` snapshot over the current contents.
-        Watchers see ADDED events for every restored object (a restore
+        """Load a :meth:`dump_state` snapshot, *replacing* the current
+        contents — objects created after the save are deleted, matching
+        the reference's etcd-level restore which swaps the whole DB
+        (pkg/kwokctl/etcd save/restore). Watchers see DELETED for the
+        removed state and ADDED for every restored object (a restore
         behaves like a fresh re-list)."""
         with self._mut:
             for t in state.get("types", []):
@@ -769,6 +772,12 @@ class ResourceStore:
                 )
             self._rv = max(self._rv, int(state.get("resourceVersion", 0)))
             self._uid = max(self._uid, int(state.get("uidCounter", 0)))
+            for rt in self.kinds():
+                st = self._state(rt.kind)
+                for key, old in list(st.objects.items()):
+                    del st.objects[key]
+                    self._index_update(st, key, old, None)
+                    self._emit(st, DELETED, old, self._rv)
             n = 0
             for obj in state.get("objects", []):
                 st = self._state(obj.get("kind") or "")

@@ -496,8 +496,20 @@ def cmd_kubectl(args) -> int:
     client = rt.client()
     verb = args.kubectl_verb
     if verb == "get":
+        # kubectl's namespace defaulting: namespaced kinds read from
+        # "default" unless -n or --all-namespaces says otherwise
+        # (cluster-scoped kinds ignore the namespace entirely)
+        try:
+            namespaced = client.resource_type(args.kind).namespaced
+        except Exception:  # noqa: BLE001 — unknown kind: let get/list 404
+            namespaced = True
+        ns = args.namespace
+        if namespaced and ns is None and not getattr(args, "all_namespaces", False):
+            ns = "default"
+        if not namespaced:
+            ns = None
         if args.object_name:
-            obj = client.get(args.kind, args.object_name, namespace=args.namespace)
+            obj = client.get(args.kind, args.object_name, namespace=ns)
             if args.output in ("yaml", "json"):
                 out = yaml.safe_dump(obj, sort_keys=False) if args.output == "yaml" else json.dumps(obj, indent=2)
                 print(out)
@@ -506,7 +518,7 @@ def cmd_kubectl(args) -> int:
         else:
             items, _ = client.list(
                 args.kind,
-                namespace=args.namespace if args.kind != "Node" else None,
+                namespace=ns,
                 label_selector=args.selector or None,
             )
             if args.output in ("yaml", "json"):
@@ -740,6 +752,7 @@ def build_parser() -> argparse.ArgumentParser:
     kg.add_argument("kind")
     kg.add_argument("object_name", nargs="?", default="")
     kg.add_argument("-n", "--namespace", default=None)
+    kg.add_argument("-A", "--all-namespaces", action="store_true")
     kg.add_argument("-l", "--selector", default="")
     kg.add_argument("-o", "--output", default="table")
     kg.set_defaults(fn=cmd_kubectl)

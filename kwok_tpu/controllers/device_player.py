@@ -72,6 +72,13 @@ class DeviceStagePlayer:
         self._threads: List[threading.Thread] = []
         self.transitions = 0
         self.patches = 0
+        #: cumulative step() time split (seconds): device tick kernel,
+        #: store round-trips (bulk), and host drain (materialize/render
+        #: + any sequential-path store calls) — the e2e bench reads
+        #: these to name the pipeline bottleneck (VERDICT r01 #2)
+        self.t_device = 0.0
+        self.t_store = 0.0
+        self.t_host = 0.0
         #: recent tick-lag samples in seconds (how far the real-time
         #: loop fell behind its schedule) — the p99 heartbeat-lag
         #: signal from SURVEY §7 step 5
@@ -137,8 +144,12 @@ class DeviceStagePlayer:
                 row = self.sim.admit(obj)
                 self._rows[key] = row
             else:
-                if self._written_rv.get(row) == rv:
-                    return  # echo of our own patch; row is already current
+                if _rv_stale(rv, self._written_rv.get(row)):
+                    # echo of one of our own patches (possibly an
+                    # intermediate state of a multi-patch transition —
+                    # finalizer patch then status patch); the row
+                    # already reflects the final write
+                    return
                 self.sim.objects[row] = obj
                 self.sim.refresh_row(row)
 
@@ -188,9 +199,13 @@ class DeviceStagePlayer:
         per tick instead of one per dirty row (SURVEY §2.9: dirty rows
         stream across the boundary).  Transitions that touch finalizers
         or need multiple dependent patches keep the sequential path."""
+        t0 = time.perf_counter()
         transitions = self.sim.step(
             dt_ms if dt_ms is not None else self.tick_ms, materialize=False
         )
+        t_dev = time.perf_counter()
+        self.t_device += t_dev - t0
+        t_store_this = 0.0
         can_bulk = hasattr(self.store, "bulk")
         batch_ops: List[dict] = []
         batch_keys: List[Tuple[str, str]] = []
@@ -209,10 +224,12 @@ class DeviceStagePlayer:
 
                 traceback.print_exc()
         if batch_ops:
+            tb = time.perf_counter()
             try:
                 results = self.store.bulk(batch_ops)
             except Exception:  # noqa: BLE001 — drop to per-op on bulk failure
                 results = None
+            t_store_this = time.perf_counter() - tb
             if results is None:
                 for key, op in zip(batch_keys, batch_ops):
                     try:
@@ -250,6 +267,8 @@ class DeviceStagePlayer:
                             f"{res.get('reason')}: {res.get('error')}",
                             file=sys.stderr,
                         )
+        self.t_store += t_store_this
+        self.t_host += (time.perf_counter() - t_dev) - t_store_this
         return transitions
 
     def _finish_delete(self, key: Tuple[str, str], out: Optional[dict]) -> None:
@@ -430,6 +449,21 @@ class DeviceStagePlayer:
             self._written_rv[row] = mm.get("resourceVersion")
             self.sim.objects[row] = obj
             self.sim.refresh_row(row)
+
+
+def _rv_stale(rv, last) -> bool:
+    """True when a watch event's resourceVersion is at or before our
+    last write for the row. The store's resourceVersions are a
+    monotonic counter, so numeric comparison suppresses stale
+    intermediate echoes; opaque rvs fall back to exact match."""
+    if last is None:
+        return False
+    if rv == last:
+        return True
+    try:
+        return int(rv) <= int(last)
+    except (TypeError, ValueError):
+        return False
 
 
 def _epoch_from(t: float):

@@ -95,13 +95,18 @@ def build_kwok_controller_component(
     extra_args: Optional[List[str]] = None,
 ) -> Component:
     """(reference components/kwok_controller.go:54 BuildKwokControllerComponent)"""
+    # no --manage-all-nodes here: the daemon defaults to manage-all when
+    # neither it nor a manage-nodes-with-*-selector is configured
+    # (cmd/kwok.py config_from), and passing it unconditionally would
+    # make a selector in extra_args/--config fail validation at startup
+    # (reference components/kwok_controller.go:56-65 passes it only
+    # when no selector is configured)
     args = [
         sys.executable,
         "-m",
         "kwok_tpu.cmd.kwok",
         "--server",
         server_url,
-        "--manage-all-nodes",
         "--server-address",
         f"127.0.0.1:{kubelet_port}",
         "--backend",

@@ -251,6 +251,14 @@ class CompiledStageSet:
         self._sig_effects: List[np.ndarray] = []  # per sig: [S, C] mode
         self._sig_effect_vals: List[np.ndarray] = []  # per sig: [S, C] val
         self._sig_effect_known: List[np.ndarray] = []  # per sig: [S] bool
+        # column-wise effect-merge evidence across explored pre-states
+        # (a stage lowers iff every column is keep-consistent OR
+        # set-consistent — e.g. "add finalizer" is keep from a state
+        # that already has it and set(1) from one that doesn't, which
+        # merges to set(1)):
+        self._sig_keep_ok: List[np.ndarray] = []  # per sig: [S, C] bool
+        self._sig_set_ok: List[np.ndarray] = []  # per sig: [S, C] bool
+        self._sig_set_val: List[np.ndarray] = []  # per sig: [S, C] int32
         self._ov_ids: Dict[str, int] = {}
         self._ov_rows: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         # per-sig set of exploration-state keys already explored (BFS cache)
@@ -315,6 +323,9 @@ class CompiledStageSet:
             self._sig_effects.append(np.zeros((self.num_stages, self.C), np.int32))
             self._sig_effect_vals.append(np.zeros((self.num_stages, self.C), np.int32))
             self._sig_effect_known.append(np.zeros(self.num_stages, np.bool_))
+            self._sig_keep_ok.append(np.ones((self.num_stages, self.C), np.bool_))
+            self._sig_set_ok.append(np.ones((self.num_stages, self.C), np.bool_))
+            self._sig_set_val.append(np.zeros((self.num_stages, self.C), np.int32))
             self.version += 1
         self._explore(sig, obj)
         return sig
@@ -405,23 +416,43 @@ class CompiledStageSet:
             matched = self.lifecycle.match(
                 meta.get("labels") or {}, meta.get("annotations") or {}, obj
             )
+            pre_row = np.array(self.schema.extract_row(obj), np.int32)
             for cs in matched:
                 idx = self.compiled.index(cs)
                 new_obj, mode, val, deleted = self._apply_stage(obj, cs)
+                post = np.where(mode == MODE_SET, val, pre_row)
                 known = self._sig_effect_known[sig]
-                if known[idx]:
-                    if not (
-                        np.array_equal(self._sig_effects[sig][idx], mode)
-                        and np.array_equal(self._sig_effect_vals[sig][idx], val)
-                    ):
-                        raise StageCompileError(
-                            f"stage {cs.name!r}: effect depends on pre-state; "
-                            "not device-compilable"
-                        )
-                else:
-                    self._sig_effects[sig][idx] = mode
-                    self._sig_effect_vals[sig][idx] = val
+                keep_ok = self._sig_keep_ok[sig][idx]
+                set_ok = self._sig_set_ok[sig][idx]
+                if not known[idx]:
+                    keep_ok[:] = post == pre_row
+                    set_ok[:] = True
+                    self._sig_set_val[sig][idx] = post
                     known[idx] = True
+                else:
+                    keep_ok &= post == pre_row
+                    set_ok &= post == self._sig_set_val[sig][idx]
+                    if not np.all(keep_ok | set_ok):
+                        bad = [
+                            self.schema.columns[c].key
+                            for c in np.nonzero(~(keep_ok | set_ok))[0]
+                        ]
+                        raise StageCompileError(
+                            f"stage {cs.name!r}: effect depends on pre-state "
+                            f"(columns {bad}); not device-compilable"
+                        )
+                # lowering: keep where keep-consistent, else set to the
+                # (proven-common) post value
+                new_mode = np.where(keep_ok, MODE_KEEP, MODE_SET).astype(np.int32)
+                new_val = np.where(
+                    new_mode == MODE_SET, self._sig_set_val[sig][idx], 0
+                ).astype(np.int32)
+                if not (
+                    np.array_equal(new_mode, self._sig_effects[sig][idx])
+                    and np.array_equal(new_val, self._sig_effect_vals[sig][idx])
+                ):
+                    self._sig_effects[sig][idx] = new_mode
+                    self._sig_effect_vals[sig][idx] = new_val
                     self.version += 1
                 if not deleted:
                     worklist.append(new_obj)

@@ -104,7 +104,31 @@ def parse_recording(source: str) -> List[ResourcePatch]:
     return patches
 
 
-def apply_patch(store, rp: ResourcePatch) -> None:
+def _scrub_for_replay(template: dict, uid_map: Optional[Dict[str, str]]) -> dict:
+    """Drop server-owned metadata from a recorded object so the
+    destination store assigns its own (the recorded uid belongs to the
+    source cluster — keeping it collides with destination-minted uids,
+    which key EventRecorder aggregation and PodEnv IP bookkeeping), and
+    re-link ownerReferences through the load/replay uid map, like
+    snapshot.load does."""
+    clean = dict(template)
+    meta = dict(clean.get("metadata") or {})
+    meta.pop("resourceVersion", None)
+    meta.pop("uid", None)
+    refs = meta.get("ownerReferences")
+    if refs and uid_map:
+        refs = [dict(r) for r in refs]
+        for r in refs:
+            if r.get("uid") in uid_map:
+                r["uid"] = uid_map[r["uid"]]
+        meta["ownerReferences"] = refs
+    clean["metadata"] = meta
+    return clean
+
+
+def apply_patch(
+    store, rp: ResourcePatch, uid_map: Optional[Dict[str, str]] = None
+) -> None:
     """Apply one recorded mutation, tolerating drift (the target may
     already exist / already be gone — replay is best-effort, like the
     reference's apply loop)."""
@@ -118,27 +142,27 @@ def apply_patch(store, rp: ResourcePatch) -> None:
             pass
         return
     template = rp.template or {}
+    old_uid = (template.get("metadata") or {}).get("uid")
+
+    def record_uid(out: dict) -> None:
+        if uid_map is not None and old_uid:
+            uid_map[old_uid] = (out.get("metadata") or {}).get("uid", "")
+
+    clean = _scrub_for_replay(template, uid_map)
     if rp.method == METHOD_CREATE:
-        clean = dict(template)
-        meta = dict(clean.get("metadata") or {})
-        meta.pop("resourceVersion", None)
-        clean["metadata"] = meta
         try:
-            store.create(clean)
+            record_uid(store.create(clean))
         except Conflict:
-            store.patch(kind, name, template, patch_type="merge", namespace=ns)
+            # the destination's existing object stands in for the
+            # recorded one; its uid must still enter the map so later
+            # recorded children re-link their ownerReferences
+            record_uid(store.patch(kind, name, clean, patch_type="merge", namespace=ns))
         return
     # METHOD_PATCH: full-object merge patch
     try:
-        body = dict(template)
-        (body.get("metadata") or {}).pop("resourceVersion", None)
-        store.patch(kind, name, body, patch_type="merge", namespace=ns)
+        record_uid(store.patch(kind, name, clean, patch_type="merge", namespace=ns))
     except NotFound:
-        clean = dict(template)
-        meta = dict(clean.get("metadata") or {})
-        meta.pop("resourceVersion", None)
-        clean["metadata"] = meta
-        store.create(clean)
+        record_uid(store.create(clean))
 
 
 def replay(
@@ -157,8 +181,9 @@ def replay(
     """
     source = read_source(source)
     handle = handle or PlaybackHandle()
+    uid_map: dict = {}
     if load_base:
-        load_snapshot(store, source)
+        load_snapshot(store, source, uid_map=uid_map)
     patches = parse_recording(source)
     applied = 0
     elapsed_ns = 0
@@ -170,7 +195,7 @@ def replay(
         if done and done.is_set():
             break
         elapsed_ns = rp.duration_nanosecond
-        apply_patch(store, rp)
+        apply_patch(store, rp, uid_map)
         applied += 1
         if progress:
             progress(i + 1, len(patches))

@@ -831,4 +831,9 @@ class Renderer:
         """Render, then parse the YAML output to a JSON-standard value
         (reference renderer.go:110 ToJSON)."""
         text = self.render(template, data, extra_funcs)
-        return yaml.safe_load(text)
+        return yaml.load(text, Loader=_YAML_LOADER)
+
+
+# the rendered-patch parse is the drain hot path: libyaml's C loader is
+# ~20x faster than the pure-Python scanner (bench e2e profile)
+_YAML_LOADER = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
