@@ -10,6 +10,13 @@ layer over the in-process store (kwokctl's binary runtime launches it
 the way the reference launches etcd+kube-apiserver,
 reference runtime/binary/cluster.go:316-728).
 
+Two dialects on one port:
+
+1. the **Kubernetes wire protocol** (``/api``, ``/apis``, ``/version``,
+   ``/openapi`` — see :mod:`kwok_tpu.cluster.k8s_api`), which stock
+   kubectl/client-go tooling speaks, and
+2. a compact legacy REST surface used by in-repo components, below.
+
 REST surface (kind-keyed rather than group/version-keyed; our
 ``ResourceType`` carries the apiVersion):
 
@@ -40,48 +47,30 @@ import json
 import socket
 import threading
 import time
-from dataclasses import asdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
+from kwok_tpu.cluster.k8s_api import (
+    PATCH_CONTENT_TYPES,
+    K8sFacade,
+    decode_continue as _decode_continue,
+    encode_continue as _encode_continue,
+    error_code_reason,
+)
 from kwok_tpu.cluster.store import (
-    Conflict,
-    Expired,
-    NotFound,
     ResourceStore,
     ResourceType,
 )
 
 __all__ = ["APIServer", "PATCH_CONTENT_TYPES"]
 
-#: Content-Type → store patch_type (reference uses the same three k8s
-#: patch media types, controllers/utils.go:162-304)
-PATCH_CONTENT_TYPES = {
-    "application/merge-patch+json": "merge",
-    "application/json-patch+json": "json",
-    "application/strategic-merge-patch+json": "strategic",
-}
+#: Paths owned by the Kubernetes wire-protocol facade (k8s_api.py);
+#: everything else stays on the legacy custom REST surface.
+_K8S_HEADS = {"api", "apis", "version", "openapi"}
 
 #: watch heartbeat cadence; lets both ends detect dead peers
 _BOOKMARK_EVERY = 15.0
-
-
-def _encode_continue(token) -> str:
-    """Opaque continue token: base64(json([ns, name])) — object names
-    may contain any character, so no separator scheme is safe."""
-    import base64
-
-    return base64.urlsafe_b64encode(json.dumps(list(token)).encode()).decode()
-
-
-def _decode_continue(raw):
-    if not raw:
-        return None
-    import base64
-
-    ns, name = json.loads(base64.urlsafe_b64decode(raw.encode()))
-    return (ns, name)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -136,15 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def _send_error(self, exc: Exception) -> None:
-        code, reason = 500, "Internal"
-        if isinstance(exc, NotFound):
-            code, reason = 404, "NotFound"
-        elif isinstance(exc, Conflict):
-            code, reason = 409, "Conflict"
-        elif isinstance(exc, Expired):
-            code, reason = 410, "Expired"
-        elif isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
-            code, reason = 400, "BadRequest"
+        # same exception→code mapping as the k8s Status path, rendered
+        # in the legacy body shape clients of this dialect expect
+        code, reason = error_code_reason(exc)
         self._send_json(code, {"error": str(exc), "reason": reason})
 
     def _read_body(self):
@@ -169,13 +152,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         head, rest, q = self._route()
+        if head in _K8S_HEADS and self.server.k8s.handle(self, "GET", head, rest, q):
+            return
         try:
             if head == "healthz" or head == "readyz" or head == "livez":
                 self._send_json(200, {"status": "ok"})
-            elif head == "apis":
-                self._send_json(
-                    200, {"resources": [asdict(t) for t in self.store.kinds()]}
-                )
             elif head == "state":
                 # raw store dump — the etcd-snapshot analog (reference
                 # kwokctl snapshot save, etcd/save.go)
@@ -225,6 +206,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         head, rest, q = self._route()
+        if head in _K8S_HEADS and self.server.k8s.handle(self, "POST", head, rest, q):
+            return
         try:
             body = self._read_body()
             if head == "apis":
@@ -252,6 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         head, rest, q = self._route()
+        if head in _K8S_HEADS and self.server.k8s.handle(self, "PUT", head, rest, q):
+            return
         try:
             body = self._read_body()
             if head == "state":
@@ -269,6 +254,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_PATCH(self):
         head, rest, q = self._route()
+        if head in _K8S_HEADS and self.server.k8s.handle(self, "PATCH", head, rest, q):
+            return
         try:
             ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
             patch_type = PATCH_CONTENT_TYPES.get(ctype, "merge")
@@ -291,6 +278,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         head, rest, q = self._route()
+        if head in _K8S_HEADS and self.server.k8s.handle(self, "DELETE", head, rest, q):
+            return
         try:
             if head == "r" and len(rest) == 2:
                 out = self.store.delete(
@@ -387,6 +376,7 @@ class APIServer:
         tls_key: Optional[str] = None,
         client_ca: Optional[str] = None,
         audit_path: Optional[str] = None,
+        kubelet_url: Optional[str] = None,
     ):
         # acquire the audit file before binding the port so a bad path
         # fails without leaking a listening socket; unbuffered O_APPEND
@@ -401,6 +391,9 @@ class APIServer:
             # watch handler loops poll this so stop() actually ends them
             self._httpd.shutting_down = threading.Event()
             self._httpd.audit_sink = self._audit_file
+            # Kubernetes wire-protocol facade (k8s_api.py): /api, /apis,
+            # /version, /openapi — what stock kubectl/client-go speak
+            self._httpd.k8s = K8sFacade(store, kubelet_url=kubelet_url)
             self._tls = bool(tls_cert and tls_key)
             if self._tls:
                 import ssl

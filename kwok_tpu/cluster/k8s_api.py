@@ -1,0 +1,785 @@
+"""Kubernetes wire-protocol facade over :class:`ResourceStore`.
+
+The reference's entire ecosystem value is that it speaks the *real*
+Kubernetes API: it launches a genuine kube-apiserver
+(reference runtime/binary/cluster.go:316-728) and its informers use the
+standard list/watch protocol (reference
+pkg/utils/informer/informer.go:33-319).  This module gives the rebuild
+the same wire surface on top of the existing store, so stock ecosystem
+clients — kubectl, client-go tooling, schedulers, prometheus kubernetes
+service discovery — can connect to a kwok-tpu cluster:
+
+- ``GET /version``                         version info
+- ``GET /api`` / ``GET /api/v1``           core discovery
+- ``GET /apis`` / ``/apis/{g}`` / ``/apis/{g}/{v}``  group discovery
+- ``GET /openapi/v2`` / ``/openapi/v3``    minimal documents
+- resource routes under ``/api/v1`` and ``/apis/{group}/{version}``:
+  ``/{plural}``, ``/{plural}/{name}[/{subresource}]``,
+  ``/namespaces/{ns}/{plural}[/{name}[/{subresource}]]`` with k8s verbs
+  (GET list/get, POST create, PUT update, PATCH with the three k8s
+  patch content types, DELETE object + deletecollection),
+  ``?watch=true`` chunk-streamed ``{"type","object"}`` frames with
+  optional BOOKMARK events, ``limit``/``continue`` paging, and
+  ``labelSelector``/``fieldSelector``/``resourceVersion`` params
+- ``POST .../pods/{name}/binding``         scheduler binding subresource
+- ``POST /apis/apiextensions.k8s.io/v1/customresourcedefinitions``
+  registers new resource types from a CRD manifest
+
+Errors are returned as ``kind: Status`` objects with the reference's
+reason/code mapping (NotFound→404, AlreadyExists/Conflict→409,
+Expired→410, BadRequest→400).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import time
+from typing import List, Optional, Tuple
+
+from kwok_tpu.cluster.store import (
+    Conflict,
+    Expired,
+    NotFound,
+    ResourceStore,
+    ResourceType,
+)
+
+__all__ = ["K8sFacade", "encode_continue", "decode_continue", "status_body"]
+
+#: Content-Type → store patch_type.  ``apply-patch+yaml`` (server-side
+#: apply) is accepted and degraded to a merge patch — the store has no
+#: field-manager tracking.
+PATCH_CONTENT_TYPES = {
+    "application/merge-patch+json": "merge",
+    "application/json-patch+json": "json",
+    "application/strategic-merge-patch+json": "strategic",
+    "application/apply-patch+yaml": "merge",
+}
+
+_BOOKMARK_EVERY = 15.0
+
+
+def encode_continue(token) -> str:
+    """Opaque continue token: base64(json([ns, name])) — object names
+    may contain any character, so no separator scheme is safe."""
+    return base64.urlsafe_b64encode(json.dumps(list(token)).encode()).decode()
+
+
+def decode_continue(raw):
+    if not raw:
+        return None
+    ns, name = json.loads(base64.urlsafe_b64decode(raw.encode()))
+    return (ns, name)
+
+
+def group_version(rtype: ResourceType) -> Tuple[str, str]:
+    """Split apiVersion into (group, version); core group is ""."""
+    if "/" in rtype.api_version:
+        g, v = rtype.api_version.split("/", 1)
+        return g, v
+    return "", rtype.api_version
+
+
+def status_body(
+    code: int, reason: str, message: str, details: Optional[dict] = None
+) -> dict:
+    body = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "metadata": {},
+        "status": "Failure" if code >= 400 else "Success",
+        "message": message,
+        "reason": reason,
+        "code": code,
+    }
+    if details:
+        body["details"] = details
+    return body
+
+
+def error_code_reason(exc: Exception) -> Tuple[int, str]:
+    """Store exception → (HTTP code, k8s reason); the one mapping both
+    the legacy dialect and the k8s Status path share."""
+    if isinstance(exc, NotFound):
+        return 404, "NotFound"
+    if isinstance(exc, Conflict):
+        return 409, "AlreadyExists"
+    if isinstance(exc, Expired):
+        return 410, "Expired"
+    if isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
+        return 400, "BadRequest"
+    return 500, "InternalError"
+
+
+def status_for(exc: Exception) -> dict:
+    code, reason = error_code_reason(exc)
+    return status_body(code, reason, str(exc))
+
+
+class _Route:
+    """Parsed resource route below a group/version prefix."""
+
+    __slots__ = ("rtype", "namespace", "name", "subresource", "all_namespaces")
+
+    def __init__(self, rtype, namespace, name, subresource, all_namespaces):
+        self.rtype = rtype
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+        self.all_namespaces = all_namespaces
+
+
+class K8sFacade:
+    """Handle k8s-protocol requests for an apiserver handler.
+
+    ``handle`` returns True when it owned the route; the legacy custom
+    REST surface (``/r/{plural}``, ``/bulk``, …) remains available for
+    in-repo clients.
+    """
+
+    def __init__(self, store: ResourceStore, kubelet_url: Optional[str] = None):
+        self.store = store
+        self.kubelet_url = kubelet_url
+        self._ensure_namespaces()
+
+    def _ensure_namespaces(self) -> None:
+        """A fresh cluster exposes the conventional namespaces, like a
+        real control plane after bootstrap."""
+        try:
+            self.store.resource_type("Namespace")
+        except (KeyError, NotFound):
+            return
+        for name in ("default", "kube-system", "kube-public"):
+            try:
+                self.store.create(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Namespace",
+                        "metadata": {"name": name},
+                        "spec": {"finalizers": ["kubernetes"]},
+                        "status": {"phase": "Active"},
+                    }
+                )
+            except Conflict:
+                pass
+
+    # ------------------------------------------------------------ discovery
+
+    def _groups(self) -> dict:
+        """group name → sorted set of versions, from registered types."""
+        groups: dict = {}
+        for rt in self.store.kinds():
+            g, v = group_version(rt)
+            groups.setdefault(g, set()).add(v)
+        return groups
+
+    def _api_versions(self) -> dict:
+        return {
+            "kind": "APIVersions",
+            "versions": ["v1"],
+            "serverAddressByClientCIDRs": [
+                {"clientCIDR": "0.0.0.0/0", "serverAddress": ""}
+            ],
+        }
+
+    def _api_group(self, g: str, versions) -> dict:
+        vs = sorted(versions)
+        return {
+            "name": g,
+            "versions": [
+                {"groupVersion": f"{g}/{v}", "version": v} for v in vs
+            ],
+            "preferredVersion": {"groupVersion": f"{g}/{vs[-1]}", "version": vs[-1]},
+        }
+
+    def _api_group_list(self) -> dict:
+        return {
+            "kind": "APIGroupList",
+            "apiVersion": "v1",
+            "groups": [
+                self._api_group(g, vs)
+                for g, vs in sorted(self._groups().items())
+                if g  # core group lives under /api
+            ],
+        }
+
+    def _api_resource_list(self, group: str, version: str) -> dict:
+        gv = f"{group}/{version}" if group else version
+        resources = []
+        for rt in self.store.kinds():
+            if rt.api_version != gv:
+                continue
+            resources.append(
+                {
+                    "name": rt.plural,
+                    "singularName": rt.kind.lower(),
+                    "namespaced": rt.namespaced,
+                    "kind": rt.kind,
+                    "verbs": [
+                        "create",
+                        "delete",
+                        "deletecollection",
+                        "get",
+                        "list",
+                        "patch",
+                        "update",
+                        "watch",
+                    ],
+                }
+            )
+            resources.append(
+                {
+                    "name": f"{rt.plural}/status",
+                    "singularName": "",
+                    "namespaced": rt.namespaced,
+                    "kind": rt.kind,
+                    "verbs": ["get", "patch", "update"],
+                }
+            )
+        return {
+            "kind": "APIResourceList",
+            "apiVersion": "v1",
+            "groupVersion": gv,
+            "resources": resources,
+        }
+
+    # -------------------------------------------------------------- routing
+
+    def _resolve(self, gv: str, parts: List[str]) -> _Route:
+        """Parse the resource path below a group/version prefix."""
+        namespace: Optional[str] = None
+        all_namespaces = False
+        if parts and parts[0] == "namespaces" and len(parts) >= 3:
+            namespace = parts[1]
+            parts = parts[2:]
+        plural, name, subresource = (
+            parts[0],
+            parts[1] if len(parts) > 1 else None,
+            parts[2] if len(parts) > 2 else None,
+        )
+        try:
+            rtype = self.store.resource_type(plural)
+        except (KeyError, NotFound):
+            raise NotFound(f"the server could not find the requested resource {plural!r}")
+        if rtype.api_version != gv:
+            raise NotFound(
+                f"resource {plural!r} is not in group/version {gv!r}"
+            )
+        if rtype.namespaced and namespace is None and name is None:
+            all_namespaces = True
+        return _Route(rtype, namespace, name, subresource, all_namespaces)
+
+    # ------------------------------------------------------------- the verb
+
+    def handle(self, handler, method: str, head: str, rest: List[str], q: dict) -> bool:
+        """Route a request.  ``handler`` is the BaseHTTPRequestHandler;
+        returns False when the path is not a k8s-protocol route."""
+        try:
+            return self._handle(handler, method, head, rest, q)
+        except Exception as exc:  # noqa: BLE001 — becomes a Status
+            st = status_for(exc)
+            self._send(handler, st["code"], st)
+            return True
+
+    def _handle(self, handler, method, head, rest, q) -> bool:
+        if head == "version" and method == "GET":
+            self._send(
+                handler,
+                200,
+                {
+                    "major": "1",
+                    "minor": "29",
+                    "gitVersion": "v1.29.0-kwok-tpu",
+                    "gitCommit": "",
+                    "gitTreeState": "clean",
+                    "goVersion": "n/a",
+                    "compiler": "n/a",
+                    "platform": "tpu/jax",
+                },
+            )
+            return True
+        if head == "openapi" and method == "GET":
+            if rest and rest[0] == "v2":
+                self._send(
+                    handler,
+                    200,
+                    {
+                        "swagger": "2.0",
+                        "info": {"title": "kwok-tpu", "version": "v1.29.0"},
+                        "paths": {},
+                        "definitions": {},
+                    },
+                )
+            else:
+                self._send(handler, 200, {"openapi": "3.0.0", "paths": {}})
+            return True
+        if head == "api":
+            if not rest:
+                if method != "GET":
+                    return self._method_not_allowed(handler, method)
+                self._send(handler, 200, self._api_versions())
+                return True
+            version, parts = rest[0], rest[1:]
+            if not parts:
+                if method != "GET":
+                    return self._method_not_allowed(handler, method)
+                self._send(handler, 200, self._api_resource_list("", version))
+                return True
+            return self._resource(handler, method, version, parts, q)
+        if head == "apis":
+            if not rest:
+                if method != "GET":
+                    return False  # legacy POST /apis registers a type
+                # merged payload: k8s APIGroupList plus the legacy
+                # "resources" field consumed by ClusterClient discovery
+                body = self._api_group_list()
+                from dataclasses import asdict
+
+                body["resources"] = [asdict(t) for t in self.store.kinds()]
+                self._send(handler, 200, body)
+                return True
+            if len(rest) == 1:
+                if method != "GET":
+                    return self._method_not_allowed(handler, method)
+                groups = self._groups()
+                if rest[0] not in groups:
+                    raise NotFound(f"no API group {rest[0]!r}")
+                self._send(handler, 200, self._api_group(rest[0], groups[rest[0]]))
+                return True
+            group, version, parts = rest[0], rest[1], rest[2:]
+            if (
+                group == "apiextensions.k8s.io"
+                and parts
+                and parts[0] == "customresourcedefinitions"
+            ):
+                return self._crd(handler, method, parts, q)
+            if not parts:
+                if method != "GET":
+                    return self._method_not_allowed(handler, method)
+                self._send(
+                    handler, 200, self._api_resource_list(group, version)
+                )
+                return True
+            return self._resource(
+                handler, method, f"{group}/{version}", parts, q
+            )
+        return False
+
+    def _method_not_allowed(self, handler, method) -> bool:
+        self._send(
+            handler,
+            405,
+            status_body(405, "MethodNotAllowed", f"method {method} not allowed"),
+        )
+        return True
+
+    # ---------------------------------------------------------------- CRDs
+
+    def _crd(self, handler, method, parts, q) -> bool:
+        """Minimal CustomResourceDefinition support: registering a CRD
+        creates a live resource type (the reference reaches the same
+        state via kwokctl InitCRDs, reference runtime/config.go)."""
+        if method == "POST":
+            body = self._read_body(handler)
+            spec = (body or {}).get("spec") or {}
+            names = spec.get("names") or {}
+            versions = spec.get("versions") or []
+            version = next(
+                (v["name"] for v in versions if v.get("served", True)),
+                versions[0]["name"] if versions else "v1",
+            )
+            rtype = ResourceType(
+                api_version=f"{spec['group']}/{version}",
+                kind=names["kind"],
+                plural=names["plural"],
+                namespaced=(spec.get("scope", "Namespaced") == "Namespaced"),
+            )
+            self.store.register_type(rtype)
+            body.setdefault("metadata", {}).setdefault(
+                "name", f"{names['plural']}.{spec['group']}"
+            )
+            body["status"] = {
+                "acceptedNames": names,
+                "conditions": [
+                    {"type": "Established", "status": "True"},
+                    {"type": "NamesAccepted", "status": "True"},
+                ],
+            }
+            self._send(handler, 201, body)
+            return True
+        if method == "GET":
+            # synthesize the CRD list from registered non-builtin types
+            items = []
+            for rt in self.store.kinds():
+                g, v = group_version(rt)
+                if g in ("", "coordination.k8s.io"):
+                    continue
+                items.append(
+                    {
+                        "apiVersion": "apiextensions.k8s.io/v1",
+                        "kind": "CustomResourceDefinition",
+                        "metadata": {"name": f"{rt.plural}.{g}"},
+                        "spec": {
+                            "group": g,
+                            "names": {"kind": rt.kind, "plural": rt.plural},
+                            "scope": "Namespaced" if rt.namespaced else "Cluster",
+                            "versions": [{"name": v, "served": True, "storage": True}],
+                        },
+                    }
+                )
+            if len(parts) > 1:
+                for it in items:
+                    if it["metadata"]["name"] == parts[1]:
+                        self._send(handler, 200, it)
+                        return True
+                raise NotFound(f"CRD {parts[1]!r} not found")
+            self._send(
+                handler,
+                200,
+                {
+                    "kind": "CustomResourceDefinitionList",
+                    "apiVersion": "apiextensions.k8s.io/v1",
+                    "metadata": {"resourceVersion": str(self.store.resource_version)},
+                    "items": items,
+                },
+            )
+            return True
+        return self._method_not_allowed(handler, method)
+
+    # ----------------------------------------------------------- resources
+
+    def _resource(self, handler, method, gv, parts, q) -> bool:
+        r = self._resolve(gv, parts)
+        ns = r.namespace if r.rtype.namespaced else None
+        if r.rtype.namespaced and not r.all_namespaces and ns is None and r.name:
+            # cluster path to a namespaced type without /namespaces/{ns}
+            ns = "default"
+        if method == "GET":
+            if r.name is None:
+                if q.get("watch") in ("true", "1"):
+                    self._serve_watch(handler, r, q)
+                else:
+                    self._serve_list(handler, r, q)
+                return True
+            if r.subresource == "log":
+                return self._proxy_log(handler, r, q)
+            obj = self.store.get(r.rtype.kind, r.name, namespace=ns)
+            self._stamp(r.rtype, obj)
+            self._send(handler, 200, obj)
+            return True
+        if method == "POST":
+            body = self._read_body(handler)
+            if r.name and r.subresource == "binding":
+                target = ((body or {}).get("target") or {}).get("name") or ""
+                self.store.patch(
+                    r.rtype.kind,
+                    r.name,
+                    {"spec": {"nodeName": target}},
+                    patch_type="merge",
+                    namespace=ns,
+                    as_user=self._user(handler),
+                )
+                self._send(
+                    handler, 201, status_body(201, "", "binding created")
+                )
+                return True
+            if r.name and r.subresource == "eviction":
+                # eviction == graceful delete (reference pods are
+                # evictable like real ones)
+                self.store.delete(
+                    r.rtype.kind, r.name, namespace=ns, as_user=self._user(handler)
+                )
+                self._send(handler, 201, status_body(201, "", "eviction created"))
+                return True
+            body = body or {}
+            body.setdefault("kind", r.rtype.kind)
+            body.setdefault("apiVersion", r.rtype.api_version)
+            out = self.store.create(
+                body, namespace=ns, as_user=self._user(handler)
+            )
+            self._send(handler, 201, self._stamp(r.rtype, out))
+            return True
+        if method == "PUT":
+            body = self._read_body(handler) or {}
+            body.setdefault("kind", r.rtype.kind)
+            body.setdefault("apiVersion", r.rtype.api_version)
+            if r.rtype.namespaced and ns and not (body.get("metadata") or {}).get(
+                "namespace"
+            ):
+                body.setdefault("metadata", {})["namespace"] = ns
+            out = self.store.update(
+                body,
+                subresource=r.subresource or "",
+                as_user=self._user(handler),
+            )
+            self._send(handler, 200, self._stamp(r.rtype, out))
+            return True
+        if method == "PATCH":
+            ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+            patch_type = PATCH_CONTENT_TYPES.get(ctype, "merge")
+            body = self._read_body(handler)
+            out = self.store.patch(
+                r.rtype.kind,
+                r.name,
+                body,
+                patch_type=patch_type,
+                namespace=ns,
+                subresource=r.subresource or "",
+                as_user=self._user(handler),
+            )
+            self._send(handler, 200, self._stamp(r.rtype, out))
+            return True
+        if method == "DELETE":
+            self._read_body(handler)  # DeleteOptions — accepted, unused
+            if r.name is None:
+                return self._delete_collection(handler, r, q)
+            out = self.store.delete(
+                r.rtype.kind, r.name, namespace=ns, as_user=self._user(handler)
+            )
+            if out is None:
+                self._send(handler, 200, status_body(200, "", "deleted"))
+            else:
+                self._send(handler, 200, self._stamp(r.rtype, out))
+            return True
+        return self._method_not_allowed(handler, method)
+
+    def _delete_collection(self, handler, r: _Route, q) -> bool:
+        ns = None if r.all_namespaces else r.namespace
+        items, rv = self.store.list(
+            r.rtype.kind,
+            namespace=ns,
+            label_selector=q.get("labelSelector"),
+            field_selector=q.get("fieldSelector"),
+        )
+        deleted = []
+        for obj in items:
+            meta = obj.get("metadata") or {}
+            try:
+                self.store.delete(
+                    r.rtype.kind,
+                    meta.get("name") or "",
+                    namespace=meta.get("namespace"),
+                    as_user=self._user(handler),
+                )
+                deleted.append(self._stamp(r.rtype, obj))
+            except NotFound:
+                pass
+        self._send(
+            handler,
+            200,
+            {
+                "kind": f"{r.rtype.kind}List",
+                "apiVersion": r.rtype.api_version,
+                "metadata": {"resourceVersion": str(rv)},
+                "items": deleted,
+            },
+        )
+        return True
+
+    def _serve_list(self, handler, r: _Route, q) -> None:
+        ns = None if r.all_namespaces else r.namespace
+        limit = int(q.get("limit") or 0)
+        body = {
+            "kind": f"{r.rtype.kind}List",
+            "apiVersion": r.rtype.api_version,
+        }
+        if limit or q.get("continue"):
+            items, rv, nxt = self.store.list_page(
+                r.rtype.kind,
+                namespace=ns,
+                label_selector=q.get("labelSelector"),
+                field_selector=q.get("fieldSelector"),
+                limit=limit,
+                continue_from=decode_continue(q.get("continue")),
+            )
+            body["metadata"] = {"resourceVersion": str(rv)}
+            if nxt is not None:
+                body["metadata"]["continue"] = encode_continue(nxt)
+        else:
+            items, rv = self.store.list(
+                r.rtype.kind,
+                namespace=ns,
+                label_selector=q.get("labelSelector"),
+                field_selector=q.get("fieldSelector"),
+            )
+            body["metadata"] = {"resourceVersion": str(rv)}
+        body["items"] = [self._stamp(r.rtype, o) for o in items]
+        self._send(handler, 200, body)
+
+    # ---------------------------------------------------------------- watch
+
+    def _serve_watch(self, handler, r: _Route, q) -> None:
+        ns = None if r.all_namespaces else r.namespace
+        since = q.get("resourceVersion")
+        bookmarks = q.get("allowWatchBookmarks") in ("true", "1")
+        timeout_s = float(q.get("timeoutSeconds") or 0) or None
+        # k8s "Get State and Start at Most Recent" semantics: a watch
+        # without a resourceVersion (or rv=0) first streams synthetic
+        # ADDED events for all existing objects, then goes live — plain
+        # curl-style watchers must not see an empty cluster
+        initial: list = []
+        if not since or since == "0":
+            initial, rv0 = self.store.list(
+                r.rtype.kind,
+                namespace=ns,
+                label_selector=q.get("labelSelector"),
+                field_selector=q.get("fieldSelector"),
+            )
+            since = str(rv0)
+        try:
+            w = self.store.watch(
+                r.rtype.kind,
+                namespace=ns,
+                since_rv=int(since),
+                label_selector=q.get("labelSelector"),
+                field_selector=q.get("fieldSelector"),
+            )
+        except Expired as exc:
+            # k8s semantics: 200 stream whose single frame is an ERROR
+            # event carrying a 410 Status — clients re-list on seeing it
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            handler.close_connection = True
+            frame = json.dumps(
+                {"type": "ERROR", "object": status_body(410, "Expired", str(exc))}
+            ).encode() + b"\n"
+            handler.wfile.write(frame)
+            return
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json; stream=watch")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        handler.close_connection = True
+        shutdown = getattr(handler.server, "shutting_down", None)
+        deadline = time.monotonic() + timeout_s if timeout_s else None
+        try:
+            if initial:
+                handler.wfile.write(
+                    b"".join(
+                        json.dumps(
+                            {"type": "ADDED", "object": self._stamp(r.rtype, o)}
+                        ).encode()
+                        + b"\n"
+                        for o in initial
+                    )
+                )
+                handler.wfile.flush()
+            idle = 0.0
+            while shutdown is None or not shutdown.is_set():
+                if deadline and time.monotonic() >= deadline:
+                    break
+                ev = w.next(timeout=0.25)
+                if ev is None:
+                    idle += 0.25
+                    if bookmarks and idle >= _BOOKMARK_EVERY:
+                        idle = 0.0
+                        self._write_frame(
+                            handler,
+                            {
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "kind": r.rtype.kind,
+                                    "apiVersion": r.rtype.api_version,
+                                    "metadata": {
+                                        "resourceVersion": str(
+                                            self.store.resource_version
+                                        )
+                                    },
+                                },
+                            },
+                        )
+                    continue
+                idle = 0.0
+                buf = [self._encode_event(r.rtype, ev)]
+                while len(buf) < 512:
+                    ev = w.next(timeout=0)
+                    if ev is None:
+                        break
+                    buf.append(self._encode_event(r.rtype, ev))
+                handler.wfile.write(b"".join(buf))
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
+            pass
+        finally:
+            w.stop()
+
+    def _encode_event(self, rtype, ev) -> bytes:
+        obj = self._stamp(rtype, ev.object)
+        return json.dumps({"type": ev.type, "object": obj}).encode() + b"\n"
+
+    @staticmethod
+    def _write_frame(handler, payload: dict) -> None:
+        handler.wfile.write(json.dumps(payload).encode() + b"\n")
+        handler.wfile.flush()
+
+    # ------------------------------------------------------------ log proxy
+
+    def _proxy_log(self, handler, r: _Route, q) -> bool:
+        """Proxy ``GET .../pods/{name}/log`` to the fake kubelet (the
+        real apiserver proxies to the node's kubelet the same way;
+        reference server debugging_logs.go:68-79)."""
+        if not self.kubelet_url:
+            raise NotFound("no kubelet registered for log proxying")
+        import urllib.request
+
+        ns = r.namespace or "default"
+        container = q.get("container") or ""
+        url = f"{self.kubelet_url}/containerLogs/{ns}/{r.name}/{container}"
+        if q.get("follow") in ("true", "1"):
+            url += "?follow=true"
+        try:
+            resp = urllib.request.urlopen(url, timeout=30)
+        except Exception as exc:  # noqa: BLE001
+            raise NotFound(f"kubelet log fetch failed: {exc}")
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        handler.close_connection = True
+        try:
+            while True:
+                chunk = resp.read(8192)
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass
+        return True
+
+    # ------------------------------------------------------------- plumbing
+
+    def _stamp(self, rtype: ResourceType, obj: dict) -> dict:
+        obj.setdefault("kind", rtype.kind)
+        obj.setdefault("apiVersion", rtype.api_version)
+        return obj
+
+    @staticmethod
+    def _user(handler) -> Optional[str]:
+        return handler.headers.get("Impersonate-User") or None
+
+    @staticmethod
+    def _read_body(handler):
+        length = int(handler.headers.get("Content-Length") or 0)
+        raw = handler.rfile.read(length) if length else b""
+        if not raw:
+            return None
+        ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype.endswith("+yaml") or ctype == "application/yaml":
+            import yaml
+
+            return yaml.safe_load(raw)
+        return json.loads(raw)
+
+    @staticmethod
+    def _send(handler, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)

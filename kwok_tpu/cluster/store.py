@@ -94,26 +94,59 @@ BUILTIN_TYPES = [
 Selector = Union[None, str, Dict[str, str]]
 
 
+def _split_requirements(sel: str) -> List[str]:
+    """Split on requirement-separating commas, not the commas inside a
+    set-based value list like ``app in (a,b)``."""
+    parts, cur, depth = [], [], 0
+    for ch in sel:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
 def _parse_selector(sel: Selector) -> List[Tuple[str, str, str]]:
-    """Parse 'k=v,k!=v,k' into (key, op, value) requirements."""
+    """Parse the full k8s selector grammar — 'k=v', 'k!=v', 'k', '!k',
+    'k in (a,b)', 'k notin (a,b)' — into (key, op, value) requirements
+    (set values stay as the raw '(a,b)' text; match splits them)."""
     if sel is None:
         return []
     if isinstance(sel, dict):
         return [(k, "=", v) for k, v in sel.items()]
     reqs: List[Tuple[str, str, str]] = []
-    for part in str(sel).split(","):
+    for part in _split_requirements(str(sel)):
         part = part.strip()
         if not part:
             continue
-        if "!=" in part:
+        low = f" {part} "
+        if " notin " in low:
+            k, v = low.split(" notin ", 1)
+            reqs.append((k.strip(), "notin", v.strip()))
+        elif " in " in low:
+            k, v = low.split(" in ", 1)
+            reqs.append((k.strip(), "in", v.strip()))
+        elif "!=" in part:
             k, v = part.split("!=", 1)
             reqs.append((k.strip(), "!=", v.strip()))
         elif "=" in part:
             k, v = part.split("==", 1) if "==" in part else part.split("=", 1)
             reqs.append((k.strip(), "=", v.strip()))
+        elif part.startswith("!"):
+            reqs.append((part[1:].strip(), "notexists", ""))
         else:
             reqs.append((part, "exists", ""))
     return reqs
+
+
+def _set_values(raw: str) -> List[str]:
+    return [v.strip() for v in raw.strip().strip("()").split(",") if v.strip()]
 
 
 def match_label_selector(obj: dict, sel: Selector) -> bool:
@@ -124,6 +157,12 @@ def match_label_selector(obj: dict, sel: Selector) -> bool:
         if op == "!=" and labels.get(k) == v:
             return False
         if op == "exists" and k not in labels:
+            return False
+        if op == "notexists" and k in labels:
+            return False
+        if op == "in" and (k not in labels or labels[k] not in _set_values(v)):
+            return False
+        if op == "notin" and labels.get(k) in _set_values(v):
             return False
     return True
 

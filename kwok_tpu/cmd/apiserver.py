@@ -29,6 +29,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tls-key", default="")
     p.add_argument("--client-ca", default="")
     p.add_argument("--audit-file", default="", help="append mutation audit JSONL here")
+    p.add_argument(
+        "--kubelet-url",
+        default="",
+        help="fake-kubelet base URL for pod log/exec subresource proxying",
+    )
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
@@ -51,6 +56,7 @@ def main(argv=None) -> int:
         tls_key=args.tls_key or None,
         client_ca=args.client_ca or None,
         audit_path=args.audit_file or None,
+        kubelet_url=args.kubelet_url or None,
     )
     srv.start()
     print(f"apiserver listening on {srv.url}", flush=True)

@@ -118,21 +118,31 @@ def cmd_get_components(args) -> int:
 
 
 def cmd_get_kubeconfig(args) -> int:
+    """Emit a standard kubeconfig (``kind: Config``) so stock kubectl
+    and client-go tooling can point at the cluster's k8s-protocol
+    facade (reference kwokctl writes the same artifact via
+    AddContext, pkg/kwokctl/cmd/create/cluster)."""
     rt = _require_cluster(args)
     conf = rt.load_config()
-    out = {
-        "server": conf["serverURL"],
-        "cluster": rt.name,
-    }
+    ctx = f"kwok-{rt.name}"
+    cluster: dict = {"server": conf["serverURL"]}
+    user: dict = {}
     if conf.get("secure"):
         pki = os.path.join(rt.workdir, "pki")
-        out.update(
-            {
-                "certificate-authority": os.path.join(pki, "ca.crt"),
-                "client-certificate": os.path.join(pki, "admin.crt"),
-                "client-key": os.path.join(pki, "admin.key"),
-            }
-        )
+        cluster["certificate-authority"] = os.path.join(pki, "ca.crt")
+        user["client-certificate"] = os.path.join(pki, "admin.crt")
+        user["client-key"] = os.path.join(pki, "admin.key")
+    out = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "clusters": [{"name": ctx, "cluster": cluster}],
+        "users": [{"name": ctx, "user": user}],
+        "contexts": [
+            {"name": ctx, "context": {"cluster": ctx, "user": ctx}}
+        ],
+        "current-context": ctx,
+        "preferences": {},
+    }
     _print_yaml(out)
     return 0
 

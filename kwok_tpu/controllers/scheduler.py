@@ -1,0 +1,239 @@
+"""Pod scheduler: binds unbound pods to simulated nodes.
+
+Reference clusters run a real kube-scheduler as a component
+(reference pkg/kwokctl/components/kube_scheduler.go:51;
+runtime/binary/cluster.go:316-728 composes it after the apiserver), so
+a pod created without ``spec.nodeName`` still reaches Running.  This is
+the rebuild's equivalent: round-robin placement with a
+resource-capacity fit (requests vs allocatable cpu/memory/pods), which
+covers the scheduling semantics simulated clusters exercise — the full
+predicate/priority framework of kube-scheduler is out of scope since
+nodes here are data, not machines.
+
+Like every controller in this package it is store-duck-typed: give it a
+:class:`ResourceStore` or a :class:`ClusterClient` (the separate-daemon
+topology, ``python -m kwok_tpu.cmd.scheduler``).  Binds go through the
+merge-patch path the facade's ``pods/{name}/binding`` subresource uses
+(cluster/k8s_api.py), so both entrances converge on the same write.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from kwok_tpu.cluster.informer import CacheGetter, Informer, WatchOptions
+from kwok_tpu.cluster.store import DELETED, EventRecorder
+from kwok_tpu.utils.cel import parse_quantity
+from kwok_tpu.utils.log import get_logger
+from kwok_tpu.utils.queue import Queue
+
+__all__ = ["Scheduler"]
+
+logger = get_logger("scheduler")
+
+#: default per-node pod cap when the node declares none (k8s default)
+_DEFAULT_PODS = 110.0
+
+
+def _requests(pod: dict) -> Tuple[float, float]:
+    """Total (cpu_cores, memory_bytes) requested by a pod's containers."""
+    cpu = mem = 0.0
+    spec = pod.get("spec") or {}
+    for c in spec.get("containers") or []:
+        reqs = ((c.get("resources") or {}).get("requests")) or {}
+        if "cpu" in reqs:
+            cpu += parse_quantity(str(reqs["cpu"]))
+        if "memory" in reqs:
+            mem += parse_quantity(str(reqs["memory"]))
+    return cpu, mem
+
+
+def _allocatable(node: dict) -> Tuple[float, float, float]:
+    """(cpu, memory, pods) a node offers — allocatable, else capacity."""
+    status = node.get("status") or {}
+    res = status.get("allocatable") or status.get("capacity") or {}
+
+    def q(key: str, default: float) -> float:
+        try:
+            return parse_quantity(str(res[key])) if key in res else default
+        except (ValueError, TypeError):
+            return default
+
+    return q("cpu", float("inf")), q("memory", float("inf")), q("pods", _DEFAULT_PODS)
+
+
+def _ready(node: dict) -> bool:
+    if (node.get("spec") or {}).get("unschedulable"):
+        return False
+    if (node.get("metadata") or {}).get("deletionTimestamp"):
+        return False
+    for c in (node.get("status") or {}).get("conditions") or []:
+        if c.get("type") == "Ready":
+            return c.get("status") == "True"
+    # nodes fresh out of create have no conditions yet; schedule onto
+    # them anyway — their initialize stage is about to run
+    return True
+
+
+class Scheduler:
+    """Round-robin + capacity-fit pod binder."""
+
+    def __init__(
+        self,
+        store,
+        recorder: Optional[EventRecorder] = None,
+        name: str = "kwok-scheduler",
+    ):
+        self.store = store
+        self.name = name
+        self.recorder = recorder or EventRecorder(store, source=name)
+        self._done = threading.Event()
+        self._events: Queue = Queue()
+        self._nodes: CacheGetter = CacheGetter()
+        #: uid → (node, cpu, mem): usage of every live bound pod, built
+        #: incrementally from bind results and watch events — the
+        #: kube-scheduler cache equivalent (no per-bind re-list; uid
+        #: keying makes the bind-then-watch-echo sequence idempotent)
+        self._pod_usage: Dict[str, Tuple[str, float, float]] = {}
+        self._used_agg: Dict[str, Tuple[float, float, int]] = {}
+        self._rr = 0  # round-robin cursor
+        self._threads = []
+        self._mut = threading.Lock()
+
+    # ----------------------------------------------------------- usage cache
+
+    def _track(self, pod: dict, node: str) -> None:
+        uid = (pod.get("metadata") or {}).get("uid") or ""
+        cpu, mem = _requests(pod)
+        with self._mut:
+            if uid in self._pod_usage:
+                return
+            self._pod_usage[uid] = (node, cpu, mem)
+            c0, m0, n0 = self._used_agg.get(node, (0.0, 0.0, 0))
+            self._used_agg[node] = (c0 + cpu, m0 + mem, n0 + 1)
+
+    def _untrack(self, pod: dict) -> None:
+        uid = (pod.get("metadata") or {}).get("uid") or ""
+        with self._mut:
+            entry = self._pod_usage.pop(uid, None)
+            if entry is None:
+                return
+            node, cpu, mem = entry
+            c0, m0, n0 = self._used_agg.get(node, (0.0, 0.0, 0))
+            if n0 <= 1:
+                self._used_agg.pop(node, None)
+            else:
+                self._used_agg[node] = (c0 - cpu, m0 - mem, n0 - 1)
+
+    # --------------------------------------------------------------- fitting
+
+    def _pick_node(self, pod: dict) -> Optional[str]:
+        nodes = sorted(self._nodes.list(), key=lambda n: n["metadata"]["name"])
+        if not nodes:
+            return None
+        cpu, mem = _requests(pod)
+        with self._mut:
+            used = dict(self._used_agg)
+        n = len(nodes)
+        for i in range(n):
+            node = nodes[(self._rr + i) % n]
+            if not _ready(node):
+                continue
+            name = node["metadata"]["name"]
+            a_cpu, a_mem, a_pods = _allocatable(node)
+            u_cpu, u_mem, u_pods = used.get(name, (0.0, 0.0, 0))
+            if u_cpu + cpu <= a_cpu and u_mem + mem <= a_mem and u_pods + 1 <= a_pods:
+                self._rr = (self._rr + i + 1) % n
+                return name
+        return None
+
+    # --------------------------------------------------------------- binding
+
+    def _bind(self, pod: dict) -> None:
+        meta = pod.get("metadata") or {}
+        name, ns = meta.get("name") or "", meta.get("namespace") or "default"
+        target = self._pick_node(pod)
+        if target is None:
+            self.recorder.event(
+                pod,
+                "Warning",
+                "FailedScheduling",
+                "0/%d nodes are available" % len(self._nodes),
+            )
+            return
+        try:
+            self.store.patch(
+                "Pod",
+                pod["metadata"]["name"],
+                {"spec": {"nodeName": target}},
+                patch_type="merge",
+                namespace=ns,
+            )
+            self._track(pod, target)
+            self.recorder.event(
+                pod,
+                "Normal",
+                "Scheduled",
+                f"Successfully assigned {ns}/{name} to {target}",
+            )
+        except Exception as exc:  # noqa: BLE001 — pod may be gone
+            logger.info("bind failed", pod=f"{ns}/{name}", err=str(exc))
+
+    # ------------------------------------------------------------------ loop
+
+    def _loop(self) -> None:
+        pending_retry = 0.0
+        while not self._done.is_set():
+            ev, _ok = self._events.get_or_wait(timeout=0.25, done=self._done)
+            if ev is None:
+                # nodes may have appeared/recovered; re-list unschedulable
+                # pods at a gentle cadence
+                pending_retry += 0.25
+                if pending_retry >= 2.0:
+                    pending_retry = 0.0
+                    self._retry_pending()
+                continue
+            obj = ev.object
+            if obj.get("kind") == "Node":
+                continue  # cache updated by the informer; retry path covers it
+            if ev.type == DELETED:
+                self._untrack(obj)
+                continue
+            node = (obj.get("spec") or {}).get("nodeName")
+            if node:
+                if (obj.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                    self._untrack(obj)  # terminal pods free their slot
+                else:
+                    self._track(obj, node)
+                continue
+            if (obj.get("metadata") or {}).get("deletionTimestamp"):
+                continue
+            self._bind(obj)
+
+    def _retry_pending(self) -> None:
+        try:
+            pods, _ = self.store.list("Pod", field_selector="spec.nodeName=")
+        except Exception:  # noqa: BLE001 — apiserver outage; informer retries
+            return
+        for pod in pods:
+            if (pod.get("metadata") or {}).get("deletionTimestamp"):
+                continue
+            self._bind(pod)
+
+    def start(self) -> "Scheduler":
+        node_informer = Informer(self.store, "Node")
+        node_informer.watch(
+            WatchOptions(), self._events, done=self._done, cache=self._nodes
+        )
+        pod_informer = Informer(self.store, "Pod")
+        pod_informer.watch(WatchOptions(), self._events, done=self._done)
+        t = threading.Thread(target=self._loop, daemon=True, name="scheduler")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._done.set()
+        for t in self._threads:
+            t.join(timeout=5)

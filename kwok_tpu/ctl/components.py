@@ -57,6 +57,7 @@ def build_apiserver_component(
     port: int,
     secure: bool = False,
     pki_dir: Optional[str] = None,
+    kubelet_port: Optional[int] = None,
 ) -> Component:
     """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
     args = [
@@ -72,6 +73,10 @@ def build_apiserver_component(
         "--audit-file",
         os.path.join(workdir, "logs", "audit.log"),
     ]
+    if kubelet_port:
+        # pod log/exec subresources proxy to the fake kubelet, like a
+        # real apiserver proxies to the node (server debugging.go:36-102)
+        args += ["--kubelet-url", f"http://127.0.0.1:{kubelet_port}"]
     if secure and pki_dir:
         args += [
             "--tls-cert",
@@ -82,6 +87,31 @@ def build_apiserver_component(
             os.path.join(pki_dir, "ca.crt"),
         ]
     return Component(name="apiserver", args=args, ports={"http": port})
+
+
+def build_scheduler_component(
+    server_url: str,
+    secure: bool = False,
+    pki_dir: Optional[str] = None,
+) -> Component:
+    """(reference components/kube_scheduler.go:51 BuildKubeSchedulerComponent)"""
+    args = [
+        sys.executable,
+        "-m",
+        "kwok_tpu.cmd.scheduler",
+        "--server",
+        server_url,
+    ]
+    if secure and pki_dir:
+        args += [
+            "--ca-cert",
+            os.path.join(pki_dir, "ca.crt"),
+            "--client-cert",
+            os.path.join(pki_dir, "admin.crt"),
+            "--client-key",
+            os.path.join(pki_dir, "admin.key"),
+        ]
+    return Component(name="scheduler", args=args, depends_on=["apiserver"])
 
 
 def build_kwok_controller_component(

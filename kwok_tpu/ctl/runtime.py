@@ -37,6 +37,7 @@ from kwok_tpu.ctl.components import (
     Component,
     build_apiserver_component,
     build_kwok_controller_component,
+    build_scheduler_component,
     free_port,
 )
 from kwok_tpu.ctl.dryrun import dry_run
@@ -139,7 +140,14 @@ class BinaryRuntime:
 
         components = [
             build_apiserver_component(
-                self.workdir, apiserver_port, secure=secure, pki_dir=pki_dir
+                self.workdir,
+                apiserver_port,
+                secure=secure,
+                pki_dir=pki_dir,
+                kubelet_port=kubelet_port,
+            ),
+            build_scheduler_component(
+                server_url, secure=secure, pki_dir=pki_dir
             ),
             build_kwok_controller_component(
                 self.workdir,

@@ -28,9 +28,11 @@ def test_device_backend_cluster(home):
     client = rt.client()
     try:
         assert kwokctl_main(["--name", name, "scale", "node", "--replicas", "1"]) == 0
+        # no .nodeName param: the scheduler component binds the pods
+        # (reference clusters run a real kube-scheduler for this,
+        # components/kube_scheduler.go:51)
         assert kwokctl_main(
-            ["--name", name, "scale", "pod", "--replicas", "3",
-             "--param", ".nodeName=node-0"]
+            ["--name", name, "scale", "pod", "--replicas", "3"]
         ) == 0
 
         def all_running():
