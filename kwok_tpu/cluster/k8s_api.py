@@ -456,6 +456,11 @@ class K8sFacade:
         if r.rtype.namespaced and not r.all_namespaces and ns is None and r.name:
             # cluster path to a namespaced type without /namespaces/{ns}
             ns = "default"
+        if r.name and r.subresource in ("exec", "attach", "portforward") and method in (
+            "GET",
+            "POST",
+        ):
+            return self._proxy_streaming(handler, r)
         if method == "GET":
             if r.name is None:
                 if q.get("watch") in ("true", "1"):
@@ -750,6 +755,99 @@ class K8sFacade:
         except (BrokenPipeError, ConnectionError, OSError):
             pass
         return True
+
+    # --------------------------------------------------------- stream proxy
+
+    def _proxy_streaming(self, handler, r: _Route) -> bool:
+        """Tunnel pod exec/attach/portforward subresources to the fake
+        kubelet as a raw byte pipe, preserving WebSocket upgrades — the
+        apiserver role for `kubectl exec/attach/port-forward` (a real
+        apiserver proxies the upgraded connection to the kubelet the
+        same way; reference server debugging.go:36-102 is the far end)."""
+        if not self.kubelet_url:
+            raise NotFound("no kubelet registered for streaming subresources")
+        import socket as _socket
+        from urllib.parse import parse_qs, urlsplit
+
+        u = urlsplit(handler.path)
+        q = parse_qs(u.query)
+        ns = r.namespace or "default"
+        if r.subresource == "portforward":
+            path = f"/portForward/{ns}/{r.name}"
+        else:
+            container = (q.get("container") or [""])[0]
+            if not container:
+                # default to the first container name kubectl would pick;
+                # the kubelet handler resolves per-container config
+                try:
+                    pod = self.store.get("Pod", r.name, namespace=ns)
+                    containers = (pod.get("spec") or {}).get("containers") or []
+                    container = (containers[0].get("name") if containers else "") or ""
+                except NotFound:
+                    container = ""
+            sub = "exec" if r.subresource == "exec" else "attach"
+            path = f"/{sub}/{ns}/{r.name}/{container}"
+        if u.query:
+            path += f"?{u.query}"
+
+        ku = urlsplit(self.kubelet_url)
+        upstream = _socket.create_connection(
+            (ku.hostname, ku.port or 80), timeout=30
+        )
+        upgrading = "upgrade" in (handler.headers.get("Connection") or "").lower()
+        try:
+            lines = [f"{handler.command} {path} HTTP/1.1"]
+            lines.append(f"Host: {ku.netloc}")
+            for k, v in handler.headers.items():
+                if k.lower() in ("host", "content-length"):
+                    continue
+                if not upgrading and k.lower() == "connection":
+                    continue
+                lines.append(f"{k}: {v}")
+            length = int(handler.headers.get("Content-Length") or 0)
+            body = handler.rfile.read(length) if length else b""
+            if body:
+                lines.append(f"Content-Length: {len(body)}")
+            if not upgrading:
+                lines.append("Connection: close")
+            upstream.sendall("\r\n".join(lines).encode() + b"\r\n\r\n" + body)
+
+            handler.close_connection = True
+
+            def client_to_upstream():
+                try:
+                    while True:
+                        chunk = handler.rfile.read1(65536)
+                        if not chunk:
+                            break
+                        upstream.sendall(chunk)
+                except (OSError, ValueError):
+                    pass
+                finally:
+                    try:
+                        upstream.shutdown(_socket.SHUT_WR)
+                    except OSError:
+                        pass
+
+            import threading
+
+            t = threading.Thread(target=client_to_upstream, daemon=True)
+            t.start()
+            try:
+                while True:
+                    chunk = upstream.recv(65536)
+                    if not chunk:
+                        break
+                    handler.wfile.write(chunk)
+                    handler.wfile.flush()
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass
+            return True
+        finally:
+            try:
+                upstream.close()
+            except OSError:
+                pass
 
     # ------------------------------------------------------------- plumbing
 

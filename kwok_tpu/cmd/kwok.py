@@ -253,6 +253,44 @@ def _controller_self_metrics(ctr):
                     kind=kind,
                 )
 
+        # lease heartbeat health (SURVEY §7 step 5): renewals + p99 lag,
+        # covering both the host syncWorker path and the device lane
+        nl = ctr.node_leases
+        if nl is not None:
+            lane = getattr(nl, "_lane", None)
+            counter(
+                "kwok_lease_renewals_total",
+                "Node lease renewals written.",
+                nl.renew_count,
+            )
+            lag_samples = []
+            raw_lags = getattr(lane, "renew_lags", None)
+            if raw_lags:
+                # the lane tick thread appends concurrently; a mid-copy
+                # mutation raises RuntimeError — retry once, else skip
+                for _ in range(2):
+                    try:
+                        lag_samples = list(raw_lags)
+                        break
+                    except RuntimeError:
+                        continue
+            if not lag_samples:
+                for _ in range(2):
+                    try:
+                        lag_samples = list(nl.renew_lag.values())
+                        break
+                    except RuntimeError:
+                        continue
+            if lag_samples:
+                lag_samples.sort()
+                for q in (0.5, 0.99):
+                    gauge(
+                        "kwok_lease_renew_lag_seconds",
+                        "Lease renewal lag past its scheduled time.",
+                        lag_samples[min(len(lag_samples) - 1, int(q * len(lag_samples)))],
+                        quantile=str(q),
+                    )
+
     return update
 
 

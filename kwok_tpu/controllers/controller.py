@@ -223,6 +223,11 @@ class Controller:
             player = self.device_players.pop(kind, None)
             if player is not None:
                 player.stop()
+                if kind == "Node" and self.node_leases is not None:
+                    # the old player's lease lane dies with it; renewals
+                    # fall back to the host workers until (and unless) a
+                    # new device player re-attaches a lane
+                    self.node_leases.detach_device_lane()
             self._start_controller_for(kind)
 
     def _start_controller_for(self, kind: str) -> None:
@@ -326,6 +331,21 @@ class Controller:
             )
         except StageCompileError:
             return False
+        if kind == "Node" and self.node_leases is not None:
+            # lease renewals ride the node player's device tick
+            # (SURVEY §7 step 5): held leases register on a vectorized
+            # fire-time lane; due rows drain as one bulk write-back.
+            # Nodes already cycling through the host path migrate on
+            # their next requeue pop.
+            from kwok_tpu.controllers.device_lease import DeviceLeaseLane
+
+            lane = DeviceLeaseLane(
+                self.node_leases,
+                capacity=self.conf.device_capacity,
+                seed=self.rng.randrange(2**31),
+            )
+            self.node_leases.attach_device_lane(lane)
+            player.post_tick = lane.tick
         self.device_players[kind] = player
         player.start()
         return True

@@ -88,6 +88,9 @@ class DeviceStagePlayer:
         # virtual-time anchor: device ms 0 == clock.now() at start
         self._t0: Optional[float] = None
         self.cache = None
+        #: optional per-tick hook fed the post-tick virtual now (ms);
+        #: carries the device lease lane (controllers/device_lease.py)
+        self.post_tick: Optional[Callable[[int], None]] = None
 
     # ------------------------------------------------------------------- wiring
 
@@ -269,6 +272,22 @@ class DeviceStagePlayer:
                         )
         self.t_store += t_store_this
         self.t_host += (time.perf_counter() - t_dev) - t_store_this
+        if self.post_tick is not None:
+            # wall-anchored ms, not the sim's virtual clock: lease
+            # renewal is a real-time contract (expiry is judged on wall
+            # time by peers), so a tick loop running behind schedule
+            # must not slow the heartbeat cadence
+            if self._t0 is not None:
+                lane_now = int((self.clock.now() - self._t0) * 1000)
+            else:
+                lane_now = self.sim.now_ms
+            try:
+                self.post_tick(lane_now)
+            except Exception:  # noqa: BLE001 — lane trouble must not
+                # stall the stage loop
+                import traceback
+
+                traceback.print_exc()
         return transitions
 
     def _finish_delete(self, key: Tuple[str, str], out: Optional[dict]) -> None:

@@ -70,6 +70,33 @@ class NodeLeaseController:
         #: per-node last renew lag (seconds past due) — feeds the p99
         #: heartbeat-lag metric in BASELINE.json
         self.renew_lag: Dict[str, float] = {}
+        #: optional DeviceLeaseLane: once a lease is held, its renewal
+        #: cadence moves onto the device tick (SURVEY §7 step 5); this
+        #: controller keeps acquisition/takeover/multi-instance logic
+        self._lane = None
+
+    def attach_device_lane(self, lane) -> None:
+        """Move renewal cadence for held leases onto a device lane.
+        Re-attaching (player rebuild on a Stage-CR change) re-registers
+        everything currently held so no lease strands on a dead lane."""
+        self._lane = lane
+        for name in self.held_nodes():
+            lane.register(name)
+
+    def detach_device_lane(self) -> None:
+        """Tear down lane delegation (e.g. the Node kind demoted to the
+        host backend): every held node's renewal cadence returns to the
+        host workers so no lease strands on a lane whose tick stopped."""
+        self._lane = None
+        with self._mut:
+            resume = [
+                n
+                for n in self._holding
+                if n in self._wanted and n not in self._queued
+            ]
+            self._queued.update(resume)
+        for name in resume:
+            self._queue.add(name)
 
     def start(self) -> None:
         for _ in range(self._parallelism):
@@ -102,6 +129,18 @@ class NodeLeaseController:
             if self._queue.cancel(name):
                 self._queued.discard(name)
             # else: the worker holds it; it will drop it on next pop
+        if self._lane is not None:
+            self._lane.unregister(name)
+
+    def reacquire(self, name: str) -> None:
+        """Re-enter the host acquisition path for a node whose lane
+        renewal failed (lease gone or taken)."""
+        with self._mut:
+            self._holding.discard(name)
+            if name not in self._wanted or name in self._queued:
+                return
+            self._queued.add(name)
+        self._queue.add(name)
 
     def held(self, name: str) -> bool:
         """(node_lease_controller.go:164-171)"""
@@ -130,6 +169,13 @@ class NodeLeaseController:
 
                 traceback.print_exc()
                 next_try = self.renew_interval
+            if self._lane is not None and self.held(name):
+                # renewal cadence moves to the device lane; this worker
+                # is done with the node unless the lane hands it back
+                self._lane.register(name)
+                with self._mut:
+                    self._queued.discard(name)
+                continue
             self._queue.add_after(name, next_try)
 
     def _now(self) -> datetime.datetime:
@@ -208,3 +254,60 @@ class NodeLeaseController:
 
         # renewInterval + one-sided jitter in [iv, iv*(1+0.04)]
         return self.renew_interval * (1.0 + self.renew_jitter * self.rng.random())
+
+    # ------------------------------------------------------------ lane renewals
+
+    def renew_batch(self, names: List[str]) -> List[str]:
+        """Renew many held leases in one store round-trip (the device
+        lane's write-back; amortizes what syncWorker does per node,
+        node_lease_controller.go:174-214).  Returns the names whose
+        renewal failed (lease gone/taken) — callers hand those back to
+        the acquisition path."""
+        ts = self._micro(self._now())
+        with self._mut:
+            held = [n for n in names if n in self._holding and n in self._wanted]
+        if not held:
+            return list(names)
+        data = {
+            "spec": {
+                "holderIdentity": self.holder,
+                "leaseDurationSeconds": self.lease_duration,
+                "renewTime": ts,
+            }
+        }
+        ops = [
+            {
+                "verb": "patch",
+                "kind": "Lease",
+                "name": n,
+                "namespace": NAMESPACE_NODE_LEASE,
+                "data": data,
+                "patch_type": "merge",
+            }
+            for n in held
+        ]
+        failed = [n for n in names if n not in set(held)]
+        if hasattr(self.store, "bulk"):
+            try:
+                results = self.store.bulk(ops)
+            except Exception:  # noqa: BLE001 — apiserver hiccup: retry next tick
+                return failed
+            for n, res in zip(held, results):
+                if res.get("status") == "ok":
+                    self.renew_count += 1
+                else:
+                    failed.append(n)
+        else:
+            for n in held:
+                try:
+                    self.store.patch(
+                        "Lease",
+                        n,
+                        data,
+                        patch_type="merge",
+                        namespace=NAMESPACE_NODE_LEASE,
+                    )
+                    self.renew_count += 1
+                except (NotFound, Conflict):
+                    failed.append(n)
+        return failed

@@ -26,6 +26,15 @@ non-deletionTimestamp sources, inconsistent effects across pre-states)
 raises ``StageCompileError`` — the controller then routes that resource
 class to the host slow path, mirroring how the reference keeps full
 generality.
+
+The fallback granularity is deliberately **per kind, not per stage**:
+one exotic stage in a set demotes the whole kind to the host backend
+(Controller._start_device_controller catches the error and returns
+False).  Splitting a kind across backends would need two engines to
+agree on weighted-choice PRNG streams and informer dedup for the same
+rows — the parity cost outweighs the win, since stage sets are
+per-kind artifacts anyway.  ``tests/test_device_backend.py::
+test_exotic_stage_demotes_kind_to_host`` pins the behavior.
 """
 
 from __future__ import annotations

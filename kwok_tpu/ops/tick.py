@@ -219,6 +219,36 @@ tick = functools.partial(jax.jit, static_argnames=("dt_ms",), donate_argnums=(1,
 )
 
 
+class LeaseLane(NamedTuple):
+    """Device-resident lease-renewal timers: one slot per held node
+    (SURVEY §7 step 5 / §2.9 lease-renewal lanes).  Replaces the host
+    DelayingQueue cadence of the reference's NodeLeaseController
+    syncWorkers (node_lease_controller.go:108-143) with a vectorized
+    fire-time column ticked alongside the stage SoA; all due leases in
+    a tick drain as ONE batched write-back."""
+
+    fire_at: jax.Array  # [N] int32 virtual ms; NEVER = empty slot
+    key: jax.Array  # PRNG key (renewal jitter)
+
+
+def _lease_tick_impl(
+    lane: LeaseLane, now: jax.Array, renew_ms: jax.Array, jitter_ms: jax.Array
+) -> Tuple[LeaseLane, jax.Array, jax.Array]:
+    """One pass: rows whose renewal is due, their lag, and rescheduled
+    fire times (renew interval + one-sided jitter — the reference's
+    duration/4 + 4% cadence, controller.go:245-249)."""
+    key, k = jax.random.split(lane.key)
+    due = lane.fire_at <= now
+    u = jax.random.uniform(k, lane.fire_at.shape)
+    nxt = now + renew_ms + (u * jitter_ms.astype(jnp.float32)).astype(jnp.int32)
+    lag = jnp.where(due, now - lane.fire_at, 0)
+    fire_at = jnp.where(due, nxt, lane.fire_at)
+    return LeaseLane(fire_at=fire_at, key=key), due, lag
+
+
+lease_tick = functools.partial(jax.jit, donate_argnums=(0,))(_lease_tick_impl)
+
+
 def _run_ticks_impl(
     params: TickParams, soa: SoA, dt_ms: int, num_ticks: int
 ) -> Tuple[SoA, jax.Array]:

@@ -18,12 +18,14 @@ Re-implements the reference server surface (pkg/kwok/server/server.go:118
 - ``/debug/threads``                            (stand-in for Go pprof,
   profiling.go:26 — dumps Python thread stacks)
 
-Transport note: the reference streams exec/attach/port-forward over
-SPDY/WebSocket upgrades to be kubectl-compatible; this server uses plain
-HTTP chunked bodies for the same operations (POST body → stdin/socket,
-response body ← stdout).  The simulation semantics — which command runs,
-which file is replayed, which target is dialed, per-pod config resolution —
-match the reference.
+Transport note: exec/attach/port-forward speak BOTH transports — the
+WebSocket channel protocols real kubectl uses (``v4/v5.channel.k8s.io``
+stream framing, ``portforward.k8s.io`` per-port channels; see
+server/websocket.py, mirroring the reference's k8s.io/apiserver
+upgrade handlers) and a plain-HTTP body fallback for simple clients
+(POST body → stdin/socket, response body ← stdout).  The simulation
+semantics — which command runs, which file is replayed, which target is
+dialed, per-pod config resolution — match the reference.
 """
 
 from __future__ import annotations
@@ -58,6 +60,18 @@ from kwok_tpu.metrics.collectors import Gauge, Registry
 from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
 from kwok_tpu.metrics.usage import UsageEvaluator
 from kwok_tpu.server.router import Router
+from kwok_tpu.server.websocket import (
+    CHAN_ERROR,
+    CHAN_STDERR,
+    CHAN_STDIN,
+    CHAN_STDOUT,
+    PORT_FORWARD_PROTOCOLS,
+    REMOTE_COMMAND_PROTOCOLS,
+    accept_upgrade as ws_accept,
+    is_upgrade as ws_is_upgrade,
+    status_failure as ws_status_failure,
+    status_success as ws_status_success,
+)
 
 __all__ = ["Server", "ServerConfig"]
 
@@ -82,6 +96,16 @@ class ServerConfig:
         self.list_pods = list_pods
         self.list_nodes = list_nodes
         self.now = now or time.time
+
+
+def _ws_flag(query: Dict[str, List[str]], *names: str) -> bool:
+    """True when any of the boolean query params is set (kubectl sends
+    e.g. ``stdin=true``; the kubelet API historically used ``input``)."""
+    for n in names:
+        v = query.get(n)
+        if v and v[0].lower() in ("1", "true"):
+            return True
+    return False
 
 
 def _resolve_pod_config(rules, cluster_rules, namespace: str, name: str):
@@ -411,8 +435,47 @@ class Server:
         if not os.path.exists(entry.logs_file):
             req.reply(404, f"log file not found: {entry.logs_file}")
             return
+        if ws_is_upgrade(req.headers):
+            self._attach_ws(req, entry.logs_file)
+            return
         with open(entry.logs_file, "rb") as f:
             req.reply(200, f.read())
+
+    def _attach_ws(self, req: "_Request", logs_file: str) -> None:
+        """kubectl attach: replay + follow the configured log file over
+        stdout channel frames until the client detaches."""
+        accepted = ws_accept(req.handler, REMOTE_COMMAND_PROTOCOLS)
+        if accepted is None:
+            return
+        ws, _proto = accepted
+        req.started = True
+        detached = threading.Event()
+
+        def watch_client():
+            while ws.recv() is not None:
+                pass  # stdin/resize frames are accepted and ignored
+            detached.set()
+
+        threading.Thread(target=watch_client, daemon=True).start()
+        offset = 0
+        deadline = time.monotonic() + 300
+        try:
+            while not detached.is_set() and time.monotonic() < deadline:
+                try:
+                    with open(logs_file, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read()
+                except OSError:
+                    break
+                if chunk:
+                    if not ws.send_channel(CHAN_STDOUT, chunk):
+                        break
+                    offset += len(chunk)
+                else:
+                    detached.wait(0.05)
+        finally:
+            ws.send_channel(CHAN_ERROR, ws_status_success())
+            ws.close()
 
     # -- exec ----------------------------------------------------------
     def _exec(self, req: "_Request", **params) -> None:
@@ -452,6 +515,9 @@ class Server:
                 kwargs["user"] = sc.run_as_user
             if sc.run_as_group is not None:
                 kwargs["group"] = sc.run_as_group
+        if ws_is_upgrade(req.headers):
+            self._exec_ws(req, cmd, kwargs)
+            return
         stdin_data = req.body if req.body else None
         if stdin_data is not None:
             kwargs["stdin"] = subprocess.PIPE
@@ -466,6 +532,106 @@ class Server:
             return
         req.reply(200, out + (err or b""))
 
+    def _exec_ws(self, req: "_Request", cmd: List[str], kwargs: Dict[str, Any]) -> None:
+        """kubectl-grade exec: WebSocket channel streaming (reference
+        debugging_exec.go via k8s.io/apiserver remotecommand; kubectl
+        ≥1.29 speaks v5.channel.k8s.io by default)."""
+        accepted = ws_accept(req.handler, REMOTE_COMMAND_PROTOCOLS)
+        if accepted is None:
+            return
+        ws, proto = accepted
+        req.started = True
+        want_stdin = _ws_flag(req.query, "input", "stdin")
+        if want_stdin:
+            kwargs["stdin"] = subprocess.PIPE
+        try:
+            proc = subprocess.Popen(cmd, **kwargs)
+        except (OSError, PermissionError) as exc:
+            ws.send_channel(CHAN_ERROR, ws_status_failure(f"exec failed: {exc}"))
+            ws.close()
+            return
+
+        def pump(stream, channel):
+            try:
+                while True:
+                    chunk = stream.read1(65536)
+                    if not chunk:
+                        break
+                    if not ws.send_channel(channel, chunk):
+                        break
+            except (ValueError, OSError):
+                pass
+
+        pumps = [
+            threading.Thread(target=pump, args=(proc.stdout, CHAN_STDOUT), daemon=True),
+            threading.Thread(target=pump, args=(proc.stderr, CHAN_STDERR), daemon=True),
+        ]
+        for t in pumps:
+            t.start()
+
+        def feed_stdin():
+            while True:
+                msg = ws.recv()
+                if msg is None:
+                    # client hung up: stop a still-running command
+                    if proc.poll() is None:
+                        proc.kill()
+                    break
+                _, payload = msg
+                if not payload:
+                    continue
+                channel, data = payload[0], payload[1:]
+                if channel == CHAN_STDIN and proc.stdin is not None:
+                    try:
+                        proc.stdin.write(data)
+                        proc.stdin.flush()
+                    except (BrokenPipeError, OSError):
+                        pass
+                elif (
+                    channel == 255
+                    and proto == "v5.channel.k8s.io"
+                    and data
+                    and data[0] == CHAN_STDIN
+                    and proc.stdin is not None
+                ):
+                    # v5 close-channel frame: stdin EOF without detach
+                    try:
+                        proc.stdin.close()
+                    except OSError:
+                        pass
+                # CHAN_RESIZE frames are accepted and ignored — there is
+                # no real TTY behind a fake pod
+
+        reader = threading.Thread(target=feed_stdin, daemon=True)
+        reader.start()
+        try:
+            proc.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(timeout=10)  # reap so returncode is real
+            except subprocess.TimeoutExpired:
+                pass
+        if proc.stdin is not None:
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+        for t in pumps:
+            t.join(timeout=10)
+        rc = proc.returncode
+        if rc == 0:
+            ws.send_channel(CHAN_ERROR, ws_status_success())
+        else:
+            ws.send_channel(
+                CHAN_ERROR,
+                ws_status_failure(
+                    f"command terminated: exit code {rc}",
+                    exit_code=rc if rc is not None and rc > 0 else None,
+                ),
+            )
+        ws.close()
+
     # -- port forward --------------------------------------------------
     def _port_forward(self, req: "_Request", **params) -> None:
         ns, pod = params["podNamespace"], params["podID"]
@@ -475,6 +641,9 @@ class Server:
         rule, _ = _resolve_pod_config(
             self.port_forwards, self.cluster_port_forwards, ns, pod
         )
+        if ws_is_upgrade(req.headers):
+            self._port_forward_ws(req, rule)
+            return
         port_q = req.query.get("port")
         port = int(port_q[0]) if port_q else 0
         fwd = rule.find(port) if rule is not None else None
@@ -520,6 +689,87 @@ class Server:
             req.reply(502, f"dial failed: {exc}")
             return
         req.reply(200, b"".join(chunks))
+
+    def _port_forward_ws(self, req: "_Request", rule) -> None:
+        """kubectl port-forward over WebSocket (portforward.k8s.io
+        subprotocols): per requested port, channel 2i carries data and
+        2i+1 errors, each opened with a little-endian uint16 port
+        frame — the kubelet convention kubectl's tunneling client
+        expects."""
+        import struct as _struct
+
+        ports = [int(p) for p in (req.query.get("ports") or req.query.get("port") or [])]
+        accepted = ws_accept(req.handler, PORT_FORWARD_PROTOCOLS)
+        if accepted is None:
+            return
+        ws, _proto = accepted
+        req.started = True
+        if not ports:
+            ws.close(code=1002, reason=b"no ports requested")
+            return
+
+        socks: List[Optional[socket.socket]] = []
+        threads: List[threading.Thread] = []
+        for i, port in enumerate(ports):
+            data_ch, err_ch = 2 * i, 2 * i + 1
+            port_frame = _struct.pack("<H", port)
+            ws.send_channel(data_ch, port_frame)
+            ws.send_channel(err_ch, port_frame)
+            fwd = rule.find(port) if rule is not None else None
+            if fwd is None or fwd.target is None:
+                ws.send_channel(err_ch, f"no port forward found for port {port}".encode())
+                socks.append(None)
+                continue
+            try:
+                sock = socket.create_connection(
+                    (fwd.target.address, fwd.target.port), timeout=10
+                )
+            except OSError as exc:
+                ws.send_channel(err_ch, f"dial failed: {exc}".encode())
+                socks.append(None)
+                continue
+            socks.append(sock)
+
+            def pump(sock=sock, ch=data_ch):
+                try:
+                    while True:
+                        chunk = sock.recv(65536)
+                        if not chunk:
+                            break
+                        if not ws.send_channel(ch, chunk):
+                            break
+                except OSError:
+                    pass
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            threads.append(t)
+
+        try:
+            while True:
+                msg = ws.recv()
+                if msg is None:
+                    break
+                _, payload = msg
+                if len(payload) < 2:
+                    continue
+                channel, data = payload[0], payload[1:]
+                idx = channel // 2
+                if channel % 2 == 0 and idx < len(socks) and socks[idx] is not None:
+                    try:
+                        socks[idx].sendall(data)
+                    except OSError:
+                        pass
+        finally:
+            for sock in socks:
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            for t in threads:
+                t.join(timeout=5)
+            ws.close()
 
     # ------------------------------------------------------------------
     # HTTP plumbing

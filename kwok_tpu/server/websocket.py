@@ -1,0 +1,239 @@
+"""Minimal RFC 6455 WebSocket server + the Kubernetes channel protocols.
+
+The reference kubelet surface streams exec/attach/port-forward over
+SPDY or WebSocket upgrades (reference pkg/kwok/server/debugging.go:
+36-102 wires k8s.io/apiserver's upgrade-aware handlers); kubectl ≥1.29
+defaults to WebSocket.  This module implements the wire format those
+clients speak, on top of the stdlib HTTP handler's raw socket:
+
+- the RFC 6455 handshake (Sec-WebSocket-Accept) with subprotocol
+  negotiation,
+- frame encode/decode (client→server masked, fragmentation, ping/pong,
+  close), and
+- the channel conventions:
+
+  * remote command (``v4.channel.k8s.io``/``v5.channel.k8s.io``):
+    binary frames whose first byte selects the stream — 0 stdin,
+    1 stdout, 2 stderr, 3 an error/status JSON trailer, 4 terminal
+    resize (ignored here);
+  * port forward (``portforward.k8s.io``/``v2.portforward.k8s.io``):
+    two channels per requested port (2i data, 2i+1 error), each
+    opening with a little-endian uint16 port frame.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "REMOTE_COMMAND_PROTOCOLS",
+    "PORT_FORWARD_PROTOCOLS",
+    "CHAN_STDIN",
+    "CHAN_STDOUT",
+    "CHAN_STDERR",
+    "CHAN_ERROR",
+    "CHAN_RESIZE",
+    "WebSocket",
+    "is_upgrade",
+    "accept_upgrade",
+    "status_success",
+    "status_failure",
+]
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+#: newest first — the server picks the first supported protocol the
+#: client offered, like k8s.io/apiserver's negotiation
+REMOTE_COMMAND_PROTOCOLS = ["v5.channel.k8s.io", "v4.channel.k8s.io"]
+PORT_FORWARD_PROTOCOLS = ["v2.portforward.k8s.io", "portforward.k8s.io"]
+
+CHAN_STDIN = 0
+CHAN_STDOUT = 1
+CHAN_STDERR = 2
+CHAN_ERROR = 3
+CHAN_RESIZE = 4
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def is_upgrade(headers) -> bool:
+    conn = (headers.get("Connection") or "").lower()
+    return "upgrade" in conn and (headers.get("Upgrade") or "").lower() == "websocket"
+
+
+def _accept_key(key: str) -> str:
+    digest = hashlib.sha1((key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()
+
+
+def negotiate_protocol(headers, supported: List[str]) -> Optional[str]:
+    offered = []
+    for part in (headers.get("Sec-WebSocket-Protocol") or "").split(","):
+        part = part.strip()
+        if part:
+            offered.append(part)
+    for proto in supported:
+        if proto in offered:
+            return proto
+    return None
+
+
+def accept_upgrade(
+    handler, supported_protocols: List[str]
+) -> Optional[Tuple["WebSocket", str]]:
+    """Complete the 101 handshake on a BaseHTTPRequestHandler; returns
+    (socket wrapper, chosen protocol) or None (a 400 was sent)."""
+    key = handler.headers.get("Sec-WebSocket-Key")
+    proto = negotiate_protocol(handler.headers, supported_protocols)
+    if not key or proto is None:
+        handler.send_response(400)
+        body = b"unable to negotiate websocket subprotocol"
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return None
+    # raw 101 — send_response would add Content-Length/Date noise
+    handler.wfile.write(
+        (
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {_accept_key(key)}\r\n"
+            f"Sec-WebSocket-Protocol: {proto}\r\n"
+            "\r\n"
+        ).encode()
+    )
+    handler.wfile.flush()
+    handler.close_connection = True
+    return WebSocket(handler.rfile, handler.wfile), proto
+
+
+class WebSocket:
+    """Server side of one upgraded connection."""
+
+    def __init__(self, rfile, wfile):
+        self.rfile = rfile
+        self.wfile = wfile
+        self.closed = False
+        # stdout/stderr pumps + the recv thread's PONGs write
+        # concurrently; frames must hit the wire whole
+        self._send_mut = threading.Lock()
+
+    # ---------------------------------------------------------------- send
+
+    def send(self, payload: bytes, opcode: int = OP_BINARY) -> bool:
+        length = len(payload)
+        head = bytes([0x80 | opcode])
+        if length < 126:
+            head += bytes([length])
+        elif length < 2**16:
+            head += bytes([126]) + struct.pack(">H", length)
+        else:
+            head += bytes([127]) + struct.pack(">Q", length)
+        with self._send_mut:
+            if self.closed:
+                return False
+            try:
+                self.wfile.write(head + payload)
+                self.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionError, OSError):
+                self.closed = True
+                return False
+
+    def send_channel(self, channel: int, data: bytes) -> bool:
+        return self.send(bytes([channel]) + data)
+
+    def close(self, code: int = 1000, reason: bytes = b"") -> None:
+        if not self.closed:
+            self.send(struct.pack(">H", code) + reason, opcode=OP_CLOSE)
+            self.closed = True
+
+    # ---------------------------------------------------------------- recv
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.rfile.read(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def recv(self) -> Optional[Tuple[int, bytes]]:
+        """Next complete message as (opcode, payload); handles masking,
+        fragmentation and ping/pong internally.  None on EOF/close."""
+        message = b""
+        message_op = None
+        while True:
+            head = self._read_exact(2)
+            if head is None:
+                self.closed = True
+                return None
+            fin = bool(head[0] & 0x80)
+            opcode = head[0] & 0x0F
+            masked = bool(head[1] & 0x80)
+            length = head[1] & 0x7F
+            if length == 126:
+                ext = self._read_exact(2)
+                if ext is None:
+                    return None
+                length = struct.unpack(">H", ext)[0]
+            elif length == 127:
+                ext = self._read_exact(8)
+                if ext is None:
+                    return None
+                length = struct.unpack(">Q", ext)[0]
+            mask = self._read_exact(4) if masked else None
+            payload = self._read_exact(length) if length else b""
+            if payload is None:
+                return None
+            if mask:
+                payload = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+            if opcode == OP_PING:
+                self.send(payload, opcode=OP_PONG)
+                continue
+            if opcode == OP_PONG:
+                continue
+            if opcode == OP_CLOSE:
+                self.closed = True
+                return None
+            if opcode in (OP_TEXT, OP_BINARY):
+                message_op = opcode
+                message += payload
+            elif opcode == OP_CONT:
+                message += payload
+            if fin:
+                return (message_op if message_op is not None else OP_BINARY), message
+
+
+def status_success() -> bytes:
+    return json.dumps(
+        {"metadata": {}, "status": "Success"}
+    ).encode()
+
+
+def status_failure(message: str, exit_code: Optional[int] = None) -> bytes:
+    body = {
+        "metadata": {},
+        "status": "Failure",
+        "message": message,
+        "reason": "InternalError",
+    }
+    if exit_code is not None:
+        # the shape kubectl's exec exit-code handling expects
+        body["reason"] = "NonZeroExitCode"
+        body["details"] = {
+            "causes": [{"reason": "ExitCode", "message": str(exit_code)}]
+        }
+    return json.dumps(body).encode()

@@ -255,3 +255,56 @@ spec:
         assert store.get("Pod", "p0")["status"]["oddField"] == "p0-node-0"
     finally:
         ctr.stop()
+
+
+def test_exotic_stage_demotes_kind_to_host():
+    """The compile-subset seam is per KIND, not per stage: one
+    non-lowerable stage (json-patch type) in the Pod set routes ALL pod
+    simulation to the host backend, while Node stays on device
+    (engine/compiler.py docstring pins the rationale)."""
+    from kwok_tpu.api.types import Stage
+
+    exotic = Stage.from_dict(
+        {
+            "metadata": {"name": "exotic-json-patch"},
+            "spec": {
+                "resourceRef": {"kind": "Pod"},
+                "selector": {
+                    "matchExpressions": [
+                        {"key": ".metadata.annotations.exotic", "operator": "Exists"}
+                    ]
+                },
+                "next": {"patches": [{"type": "json", "template": "[]"}]},
+            },
+        }
+    )
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            node_lease_duration_seconds=0,
+        ),
+        local_stages={
+            "Node": default_node_stages(),
+            "Pod": default_pod_stages() + [exotic],
+        },
+        seed=0,
+    )
+    ctr.start()
+    try:
+        assert "Pod" not in ctr.device_players, "exotic set must not lower"
+        assert ctr.pods is not None, "host PodController must take over"
+        assert "Node" in ctr.device_players, "Node set unaffected"
+        # the demoted kind still simulates correctly on the host path
+        store.create(make_node("node-0"))
+        assert wait_for(lambda: ctr.manages("node-0"))
+        store.create(make_pod("p0"))
+        assert wait_for(
+            lambda: (store.get("Pod", "p0").get("status") or {}).get("phase")
+            == "Running",
+            timeout=15.0,
+        )
+    finally:
+        ctr.stop()

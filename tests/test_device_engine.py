@@ -287,6 +287,47 @@ class TestCompileErrors:
 
 
 class TestReviewRegressions:
+    def test_virtual_clock_rebases_before_int32_wrap(self):
+        """Past REBASE_AT_MS the clock shifts into epoch and timers
+        rebase, so long runs never collide with NEVER/SENTINEL
+        (VERDICT r01 weak #6)."""
+        import datetime
+
+        import jax.numpy as jnp
+
+        from kwok_tpu.engine.simulator import REBASE_AT_MS
+
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=4)
+        sim.admit(new_pod(0))
+        sim.step(dt_ms=100)  # pod-ready fires
+        epoch0 = sim.epoch
+        # fast-forward the virtual clock to the threshold
+        sim._invalidate_device()
+        sim._dev_now = jnp.int32(REBASE_AT_MS + 123)
+        sim.step(dt_ms=100)
+        # rebase happened at step entry (so the prior tick's timestamps
+        # rendered against the old epoch), then the tick advanced 100ms
+        assert sim.now_ms == 100, "clock must restart after rebase"
+        delta = sim.epoch - epoch0
+        assert delta == datetime.timedelta(milliseconds=REBASE_AT_MS + 123)
+        # absolute wall time is continuous across the rebase
+        # (epoch + now is the same instant before and after)
+        from kwok_tpu.engine.compiler import NEVER
+
+        assert all(f == NEVER or f < 10**9 for f in sim.fire_at)
+        # the FSM keeps working on the rebased clock
+        sim.admit(new_pod(1))
+        fired = []
+        for _ in range(20):
+            fired += sim.step(dt_ms=100)
+        assert any(tr.stage_name == "pod-ready" for tr in fired)
+        # timestamps rendered for post-rebase transitions are ~epoch0 +
+        # the full elapsed virtual time, not reset to epoch0
+        last = [tr for tr in fired if tr.stage_name == "pod-ready"][-1]
+        ts = sim.now_string(last.t_ms)
+        year_expected = (epoch0 + delta).year
+        assert ts.startswith(str(year_expected))
+
     def test_virtual_clock_survives_mid_run_admit(self):
         """Admitting after stepping must not reset now/PRNG (review
         finding: re-upload used now=0 + fresh key)."""

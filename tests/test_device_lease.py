@@ -1,0 +1,203 @@
+"""Device lease lanes (controllers/device_lease.py): lease renewals on
+the vectorized fire-time lane, batched write-back, lag tracking, and
+failure handoff back to the host acquisition path (SURVEY §7 step 5;
+reference node_lease_controller.go:108-143 syncWorker cadence)."""
+
+import time
+
+import pytest
+
+from kwok_tpu.api.config import KwokConfiguration
+from kwok_tpu.cluster.store import NotFound, ResourceStore
+from kwok_tpu.controllers.controller import Controller
+from kwok_tpu.controllers.device_lease import DeviceLeaseLane
+from kwok_tpu.controllers.node_lease_controller import (
+    NAMESPACE_NODE_LEASE,
+    NodeLeaseController,
+)
+from kwok_tpu.ctl.scale import scale
+from kwok_tpu.stages import default_node_stages, default_pod_stages
+
+
+def wait_until(cond, budget=10.0):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+@pytest.fixture()
+def held_lane():
+    store = ResourceStore()
+    ctrl = NodeLeaseController(store, "inst-a", lease_duration_seconds=40)
+    lane = DeviceLeaseLane(ctrl, capacity=16, seed=0)
+    ctrl.attach_device_lane(lane)
+    ctrl.start()
+    for i in range(3):
+        ctrl.try_hold(f"n{i}")
+    assert wait_until(lambda: len(lane) == 3), "leases not handed to the lane"
+    yield store, ctrl, lane
+    ctrl.stop()
+
+
+def renew_time(store, name):
+    lease = store.get("Lease", name, namespace=NAMESPACE_NODE_LEASE)
+    return (lease.get("spec") or {}).get("renewTime")
+
+
+def test_lane_renews_on_schedule(held_lane):
+    store, ctrl, lane = held_lane
+    renew_ms = lane.renew_ms  # 10s virtual
+    before = {f"n{i}": renew_time(store, f"n{i}") for i in range(3)}
+
+    # before the interval elapses: nothing due
+    assert lane.tick(renew_ms // 2) == 0
+    assert {f"n{i}": renew_time(store, f"n{i}") for i in range(3)} == before
+
+    # past the interval: all three renew in one batch
+    n = lane.tick(renew_ms + 100)
+    assert n == 3
+    after = {f"n{i}": renew_time(store, f"n{i}") for i in range(3)}
+    assert all(after[k] != before[k] for k in before)
+    assert ctrl.renew_count >= 6  # 3 acquisitions + 3 lane renewals
+
+    # rescheduled within [renew, renew*(1+0.04)] of the due time
+    # (one-sided jitter, reference controller.go:245-249): ticking just
+    # under the minimum next due time renews nothing, ticking past the
+    # jitter bound renews everything
+    now = renew_ms + 100
+    assert lane.tick(now + renew_ms - 200) == 0
+    assert lane.tick(now + int(renew_ms * 1.04) + 100) == 3
+    # lag samples recorded (virtual seconds, small positive)
+    assert len(lane.renew_lags) >= 6
+    assert all(0 <= lag < 5.0 for lag in lane.renew_lags)
+
+
+def test_lane_failure_hands_back_to_host_path(held_lane):
+    store, ctrl, lane = held_lane
+    # lease vanishes behind our back (e.g. raw hack delete)
+    store.delete("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)
+    try:
+        store.delete("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)
+    except NotFound:
+        pass
+    assert store.count("Lease") == 2
+    lane.tick(lane.renew_ms + 100)
+    # host path re-acquires and re-registers on the lane
+    assert wait_until(
+        lambda: store.count("Lease") == 3 and len(lane) == 3
+    ), "lease not re-acquired after lane failure"
+    assert ctrl.held("n1")
+
+
+def test_unregister_on_release(held_lane):
+    store, ctrl, lane = held_lane
+    ctrl.release_hold("n1")
+    assert len(lane) == 2
+    # released lease no longer renews
+    before = renew_time(store, "n1")
+    lane.tick(lane.renew_ms * 3)
+    assert renew_time(store, "n1") == before
+
+
+def test_detach_returns_renewals_to_host_path(held_lane):
+    """A demoted Node kind (Stage-CR change → host fallback) must not
+    strand held leases on a dead lane: detach re-queues them on the
+    host workers, which renew immediately."""
+    store, ctrl, lane = held_lane
+    before = {f"n{i}": renew_time(store, f"n{i}") for i in range(3)}
+    ctrl.detach_device_lane()
+    assert wait_until(
+        lambda: all(renew_time(store, f"n{i}") != before[f"n{i}"] for i in range(3))
+    ), "host workers did not resume renewals after detach"
+    assert all(ctrl.held(f"n{i}") for i in range(3))
+
+
+def test_lane_grows_past_capacity():
+    store = ResourceStore()
+    ctrl = NodeLeaseController(store, "inst-a", lease_duration_seconds=40)
+    lane = DeviceLeaseLane(ctrl, capacity=4, seed=0)
+    ctrl.attach_device_lane(lane)
+    ctrl.start()
+    try:
+        for i in range(40):
+            ctrl.try_hold(f"n{i}")
+        assert wait_until(lambda: len(lane) == 40)
+        assert lane.tick(lane.renew_ms + 50) == 40
+    finally:
+        ctrl.stop()
+
+
+def test_device_backend_lease_lanes_under_churn():
+    """Integration: device backend renews every held lease within
+    duration/4 + jitter while nodes churn (VERDICT r01 #6 done bar,
+    scaled to suite budget)."""
+    store = ResourceStore()
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            device_tick_ms=20,
+            node_lease_duration_seconds=4,  # renew every 1s
+        ),
+        local_stages={
+            "Node": default_node_stages(lease=True),
+            "Pod": default_pod_stages(),
+        },
+        seed=0,
+    )
+    ctr.start()
+    try:
+        scale(store, "node", 40)
+        assert wait_until(
+            lambda: store.count("Lease") == 40
+            and len(ctr.node_leases.held_nodes()) == 40,
+            20.0,
+        )
+        lane = ctr.node_leases._lane
+        assert lane is not None
+        assert wait_until(lambda: len(lane) == 40, 10.0), (
+            "held leases not migrated onto the device lane"
+        )
+        # churn: add nodes mid-flight, delete some
+        scale(store, "node", 10, name_prefix="late")
+        for i in range(5):
+            store.delete("Node", f"node-{i}")
+        assert wait_until(lambda: len(lane) == 45, 20.0), len(lane)
+
+        # liveness: every remaining lease keeps renewing — renewTime
+        # advances for all (budget absorbs XLA compile stalls on a
+        # loaded machine; the cadence contract is checked via lag below)
+        before = {
+            (ln.get("metadata") or {}).get("name"): (ln.get("spec") or {}).get(
+                "renewTime"
+            )
+            for ln in store.list("Lease")[0]
+            if (ln.get("metadata") or {}).get("name") not in {
+                f"node-{i}" for i in range(5)
+            }
+        }
+
+        def all_renewed():
+            after = {
+                (ln.get("metadata") or {}).get("name"): (ln.get("spec") or {}).get(
+                    "renewTime"
+                )
+                for ln in store.list("Lease")[0]
+            }
+            return all(after.get(k) != v for k, v in before.items())
+
+        assert wait_until(all_renewed, 15.0), "leases stopped renewing"
+        # cadence: lag past each scheduled renew time (wall-anchored)
+        # stays inside the expiry margin (duration 4s - interval 1s =
+        # 3s of headroom) — lag absorbs tick-loop slowness on a loaded
+        # machine, which is exactly what the metric is for
+        lags = sorted(lane.renew_lags)
+        assert lags, "no lag samples recorded"
+        assert lags[len(lags) // 2] < 2.0, f"median lag {lags[len(lags) // 2]}"
+        assert lags[int(0.99 * (len(lags) - 1))] < 3.0, lags[-5:]
+    finally:
+        ctr.stop()
