@@ -403,8 +403,36 @@ class ClusterClient:
         namespace: Optional[str] = None,
         subresource: str = "",
         as_user: Optional[str] = None,
+        expect: Optional[Dict[str, Any]] = None,
     ) -> dict:
         plural = self.resource_type(kind).plural
+        if expect:
+            # the legacy PATCH route carries no precondition; route a
+            # guarded patch through /bulk, which does (store duck-type:
+            # same expect semantics as ResourceStore.patch)
+            res = self.bulk(
+                [
+                    {
+                        "verb": "patch",
+                        "kind": kind,
+                        "name": name,
+                        "namespace": namespace,
+                        "data": data,
+                        "patch_type": patch_type,
+                        "subresource": subresource,
+                        "as_user": as_user,
+                        "expect": expect,
+                    }
+                ]
+            )[0]
+            if res.get("status") == "ok":
+                return res.get("object")
+            _raise_for(
+                {"NotFound": 404, "Conflict": 409, "Expired": 410}.get(
+                    res.get("reason"), 400
+                ),
+                res,
+            )
         headers = {"Content-Type": _PATCH_CT.get(patch_type, _PATCH_CT["merge"])}
         user = self._user_hdr(as_user)
         if user:

@@ -600,6 +600,7 @@ class ResourceStore:
         namespace: Optional[str] = None,
         subresource: str = "",
         as_user: Optional[str] = None,
+        expect: Optional[Dict[str, Any]] = None,
     ) -> dict:
         with self._mut:
             st = self._state(kind)
@@ -608,6 +609,19 @@ class ResourceStore:
             cur = st.objects.get(key)
             if cur is None:
                 raise NotFound(f"{kind} {ns}/{name} not found")
+            if expect:
+                # compare-and-swap precondition: dotted paths must hold
+                # their expected values under the same lock the patch
+                # commits under (the batched-lease-renewal guard against
+                # stomping a peer's takeover; the single-object analog
+                # is update()'s resourceVersion conflict)
+                for path, want in expect.items():
+                    have = _dotted_get(cur, path)
+                    if have != want:
+                        raise Conflict(
+                            f"{kind} {ns}/{name}: expected {path}={want!r}, "
+                            f"found {have!r}"
+                        )
             new = apply_patch(cur, data, patch_type)
             if subresource:
                 # subresource patches may only change that one field
@@ -713,8 +727,10 @@ class ResourceStore:
         device↔apiserver boundary; batching amortizes the per-op HTTP
         round-trip when the store is remote).  Each op:
 
-        ``{"verb": "patch"|"delete", "kind", "name", "namespace"?,
-           "data"?, "patch_type"?, "subresource"?, "as_user"?}``
+        ``{"verb": "patch"|"delete"|"create", "kind", "name",
+           "namespace"?, "data"?, "patch_type"?, "subresource"?,
+           "as_user"?, "expect"?}`` — ``expect`` maps dotted paths to
+        required current values (CAS precondition; mismatch → Conflict)
 
         Per-op failures do not abort the batch; results align with ops:
         ``{"status": "ok", "object": ...}`` (object None for a
@@ -733,6 +749,7 @@ class ResourceStore:
                         namespace=op.get("namespace"),
                         subresource=op.get("subresource", ""),
                         as_user=op.get("as_user"),
+                        expect=op.get("expect"),
                     )
                 elif verb == "delete":
                     out = self.delete(

@@ -169,13 +169,18 @@ class NodeLeaseController:
 
                 traceback.print_exc()
                 next_try = self.renew_interval
-            if self._lane is not None and self.held(name):
-                # renewal cadence moves to the device lane; this worker
-                # is done with the node unless the lane hands it back
-                self._lane.register(name)
+            # snapshot the lane; detach_device_lane may race this (the
+            # handoff must be atomic with the _queued bookkeeping or a
+            # node can strand on a dead lane with no queue entry)
+            lane = self._lane
+            if lane is not None:
                 with self._mut:
-                    self._queued.discard(name)
-                continue
+                    hand_off = name in self._holding and self._lane is lane
+                    if hand_off:
+                        self._queued.discard(name)
+                if hand_off:
+                    lane.register(name)
+                    continue
             self._queue.add_after(name, next_try)
 
     def _now(self) -> datetime.datetime:
@@ -275,6 +280,11 @@ class NodeLeaseController:
                 "renewTime": ts,
             }
         }
+        # CAS guard: only renew leases we still hold ON THE SERVER — a
+        # peer that legitimately took over after our stall must not be
+        # stomped (the host _sync path reads + backs off the same way;
+        # tryAcquireOrRenew, node_lease_controller.go:293-306)
+        expect = {"spec.holderIdentity": self.holder}
         ops = [
             {
                 "verb": "patch",
@@ -283,6 +293,7 @@ class NodeLeaseController:
                 "namespace": NAMESPACE_NODE_LEASE,
                 "data": data,
                 "patch_type": "merge",
+                "expect": expect,
             }
             for n in held
         ]
@@ -290,8 +301,11 @@ class NodeLeaseController:
         if hasattr(self.store, "bulk"):
             try:
                 results = self.store.bulk(ops)
-            except Exception:  # noqa: BLE001 — apiserver hiccup: retry next tick
-                return failed
+            except Exception:  # noqa: BLE001 — transport failure: the
+                # lane already rescheduled a full interval out, so hand
+                # everything back for an immediate host-path retry
+                # rather than silently burning an expiry margin
+                return list(names)
             for n, res in zip(held, results):
                 if res.get("status") == "ok":
                     self.renew_count += 1
@@ -306,6 +320,7 @@ class NodeLeaseController:
                         data,
                         patch_type="merge",
                         namespace=NAMESPACE_NODE_LEASE,
+                        expect=expect,
                     )
                     self.renew_count += 1
                 except (NotFound, Conflict):

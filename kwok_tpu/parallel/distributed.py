@@ -1,0 +1,150 @@
+"""Multi-host scale-out: jax.distributed bootstrap + cross-process row
+sharding (SURVEY §2.9 / §7 step 7).
+
+Two cooperating layers give kwok-tpu the reference's multi-instance
+scale-out story (reference pkg/kwok/controllers/controller.go:286-296:
+N kwok processes shard a cluster by Lease ownership):
+
+1. **Ownership plane (host)** — unchanged: each process's
+   NodeLeaseController acquires leases; a node's rows (and its pods')
+   live in the SoA of the process holding its lease.  Killing a process
+   expires its leases and the survivors admit those rows — elastic
+   recovery needs no collective (tests/test_failover.py,
+   tests/test_distributed.py).
+
+2. **Compute plane (device)** — this module: one *logical* simulator
+   spanning the devices of several hosts.  ``initialize`` wires
+   jax.distributed (ICI within a host/slice, DCN across hosts — on CPU
+   test rigs, Gloo), ``global_mesh`` builds a rows-axis Mesh over every
+   device of every process, and ``make_global_soa`` assembles the
+   struct-of-arrays so each process uploads only its local row block.
+   The tick is the same SPMD program everywhere; XLA inserts exactly
+   one cross-host collective (the fired-count psum), everything else
+   stays in local HBM.
+
+The compute plane is static SPMD: if a participant dies, the collective
+world must be rebuilt (that is physics, not policy — NCCL/MPI worlds in
+the reference's ecosystem behave the same).  Elasticity therefore lives
+in the ownership plane: run one mesh *per process* (the default) and
+let leases move rows between processes; span hosts with a global mesh
+only for throughput on a stable fleet.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "initialize",
+    "global_mesh",
+    "process_row_block",
+    "make_global_soa",
+    "local_rows",
+]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Wire this process into a multi-process jax world.
+
+    Falls back to env (``KWOK_COORDINATOR``, ``KWOK_NUM_PROCESSES``,
+    ``KWOK_PROCESS_ID``) and no-ops single-process, so the same
+    entrypoint serves laptops and fleets.  Returns True when a
+    multi-process world was joined."""
+    coordinator_address = coordinator_address or os.environ.get("KWOK_COORDINATOR")
+    if num_processes is None and os.environ.get("KWOK_NUM_PROCESSES"):
+        num_processes = int(os.environ["KWOK_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("KWOK_PROCESS_ID"):
+        process_id = int(os.environ["KWOK_PROCESS_ID"])
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id or 0,
+    )
+    return True
+
+
+def global_mesh():
+    """1-D rows mesh over every device of every process."""
+    import jax
+
+    from kwok_tpu.parallel.mesh import ROWS_AXIS
+
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (ROWS_AXIS,))
+
+
+def process_row_block(n_rows: int) -> Tuple[int, int]:
+    """[start, stop) of this process's contiguous row block when
+    ``n_rows`` divide evenly over processes (pad with
+    ``mesh.pad_rows(n, process_count * local_devices)`` first)."""
+    import jax
+
+    pc, pid = jax.process_count(), jax.process_index()
+    per = n_rows // pc
+    return pid * per, (pid + 1) * per
+
+
+def make_global_soa(soa, mesh):
+    """Assemble a globally-sharded SoA from per-process host arrays.
+
+    ``soa`` is the host-built SoA (numpy-convertible leaves) where each
+    process only needs its own row block to hold real data — the
+    callback is invoked for *addressable* shards only, so remote rows
+    are never touched.  Scalar leaves (now/key) are replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kwok_tpu.ops.tick import SoA
+    from kwok_tpu.parallel.mesh import ROWS_AXIS
+
+    rows = NamedSharding(mesh, P(ROWS_AXIS))
+    rep = NamedSharding(mesh, P())
+
+    def place_rowwise(arr):
+        host = np.asarray(arr)
+
+        def cb(index):
+            return host[index]
+
+        return jax.make_array_from_callback(host.shape, rows, cb)
+
+    return SoA(
+        features=place_rowwise(soa.features),
+        sig=place_rowwise(soa.sig),
+        ovc=place_rowwise(soa.ovc),
+        stage=place_rowwise(soa.stage),
+        fire_at=place_rowwise(soa.fire_at),
+        active=place_rowwise(soa.active),
+        rematch=place_rowwise(soa.rematch),
+        del_ts=place_rowwise(soa.del_ts),
+        now=jax.device_put(soa.now, rep),
+        key=jax.device_put(soa.key, rep),
+    )
+
+
+def local_rows(global_array) -> Tuple[np.ndarray, np.ndarray]:
+    """(row_indices, values) of this process's shards of a row-sharded
+    global array — the drain path reads only what it owns."""
+    idx_parts = []
+    val_parts = []
+    for shard in global_array.addressable_shards:
+        sl = shard.index[0]
+        start = sl.start or 0
+        data = np.asarray(shard.data)
+        idx_parts.append(np.arange(start, start + data.shape[0]))
+        val_parts.append(data)
+    if not idx_parts:
+        return np.empty(0, np.int64), np.empty(0)
+    return np.concatenate(idx_parts), np.concatenate(val_parts)

@@ -458,9 +458,10 @@ class Server:
 
         threading.Thread(target=watch_client, daemon=True).start()
         offset = 0
-        deadline = time.monotonic() + 300
         try:
-            while not detached.is_set() and time.monotonic() < deadline:
+            # stream until the client detaches (the reference attach has
+            # no server-side deadline either)
+            while not detached.is_set():
                 try:
                     with open(logs_file, "rb") as f:
                         f.seek(offset)
@@ -604,14 +605,10 @@ class Server:
 
         reader = threading.Thread(target=feed_stdin, daemon=True)
         reader.start()
-        try:
-            proc.wait(timeout=300)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            try:
-                proc.wait(timeout=10)  # reap so returncode is real
-            except subprocess.TimeoutExpired:
-                pass
+        # no server-side command deadline (matches the reference's exec);
+        # a client hangup kills the process via the reader thread, which
+        # unblocks this wait
+        proc.wait()
         if proc.stdin is not None:
             try:
                 proc.stdin.close()

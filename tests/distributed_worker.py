@@ -1,0 +1,125 @@
+"""Worker for the 2-process global-mesh test (not a pytest module).
+
+Usage: python distributed_worker.py <pid> <nprocs> <port> <n_rows>
+
+Joins the jax.distributed world, assembles the flagship FSM population
+on the cross-process rows mesh (each process uploads its own block),
+runs a fixed number of SPMD ticks, and checks trajectory parity against
+a local single-device run of the same population."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main() -> int:
+    pid, nprocs, port, n_rows = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+    )
+    from kwok_tpu.parallel import distributed
+
+    joined = distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert joined and jax.process_count() == nprocs
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kwok_tpu.engine.simulator import DeviceSimulator
+    from kwok_tpu.ops.tick import tick
+    from kwok_tpu.parallel.mesh import sharded_tick
+
+    from kwok_tpu.stages import load_builtin
+
+    def build_sim():
+        stages = load_builtin("pod-general") + load_builtin("pod-chaos")
+        sim = DeviceSimulator(stages, capacity=n_rows, seed=0)
+        sim.admit_bulk(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "pod",
+                    "namespace": "default",
+                    "uid": "uid",
+                    "labels": {
+                        "pod-container-running-failed.stage.kwok.x-k8s.io": "true"
+                    },
+                },
+                "spec": {
+                    "nodeName": "node-0",
+                    "containers": [{"name": "app", "image": "fake"}],
+                },
+                "status": {},
+            },
+            n_rows,
+        )
+        return sim
+
+    mesh = distributed.global_mesh()
+    assert len(mesh.devices) == nprocs * jax.local_device_count()
+
+    sim = build_sim()
+    params, soa = sim.to_device()
+
+    # replicate params / shard rows across the whole world
+    rep = NamedSharding(mesh, P())
+
+    def replicate(arr):
+        host = np.asarray(arr)
+        return jax.make_array_from_callback(host.shape, rep, lambda idx: host[idx])
+
+    params = type(params)(*[replicate(a) for a in params])
+    gsoa = distributed.make_global_soa(soa, mesh)
+
+    step = sharded_tick(mesh, dt_ms=500)
+    n_ticks = 5
+    total = 0
+    local_fired = 0
+    for _ in range(n_ticks):
+        gsoa, out = step(params, gsoa)
+        total += int(out.fired_count)
+        _, vals = distributed.local_rows(out.fired)
+        local_fired += int(vals.sum())
+
+    # single-device reference of the same population, local to this proc
+    sim2 = build_sim()
+    p1, s1 = sim2.to_device()
+    ref_total = 0
+    for _ in range(n_ticks):
+        s1, out1 = tick(p1, s1, 500)
+        ref_total += int(out1.fired_count)
+
+    rows_idx, _ = distributed.local_rows(gsoa.stage)
+    lo, hi = distributed.process_row_block(n_rows)
+    block_ok = rows_idx.min() == lo and rows_idx.max() == hi - 1
+
+    # local stages must match the reference's same rows
+    _, local_stage = distributed.local_rows(gsoa.stage)
+    ref_stage = np.asarray(s1.stage)[lo:hi]
+    parity = total == ref_total and bool((local_stage == ref_stage).all())
+
+    print(
+        f"proc={pid} total={total} local_fired={local_fired} "
+        f"block={lo}:{hi} block_ok={block_ok} parity={'OK' if parity else 'FAIL'}",
+        flush=True,
+    )
+    return 0 if parity and block_ok and total > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,80 @@ def test_lane_failure_hands_back_to_host_path(held_lane):
     assert ctrl.held("n1")
 
 
+def test_lane_never_stomps_a_peers_takeover(held_lane):
+    """Split-brain guard: if a peer legitimately took a lease over
+    (after our stall), the lane's batched renewal must NOT write our
+    holderIdentity back — it hands the node to the host path, which
+    defers until expiry (reference tryAcquireOrRenew,
+    node_lease_controller.go:293-306)."""
+    store, ctrl, lane = held_lane
+    # peer takeover behind our back
+    lease = store.get("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)
+    lease["spec"]["holderIdentity"] = "inst-b"
+    store.update(lease)
+    lane.tick(lane.renew_ms + 100)
+    taken = store.get("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)
+    assert taken["spec"]["holderIdentity"] == "inst-b", "lease was stomped"
+    # the other two kept renewing normally
+    assert lane.renew_count >= 2
+    # n1 left the lane and this instance no longer claims to hold it
+    assert wait_until(lambda: "n1" not in ctrl.held_nodes())
+    assert len(lane) == 2
+
+
+def test_store_patch_expect_precondition():
+    """store.patch(expect=...) is an atomic CAS: mismatch raises
+    Conflict and leaves the object untouched (bulk forwards it)."""
+    from kwok_tpu.cluster.store import Conflict
+
+    store = ResourceStore()
+    store.create(
+        {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": "l", "namespace": NAMESPACE_NODE_LEASE},
+            "spec": {"holderIdentity": "a"},
+        }
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(Conflict):
+        store.patch(
+            "Lease",
+            "l",
+            {"spec": {"holderIdentity": "b"}},
+            namespace=NAMESPACE_NODE_LEASE,
+            expect={"spec.holderIdentity": "not-a"},
+        )
+    assert (
+        store.get("Lease", "l", namespace=NAMESPACE_NODE_LEASE)["spec"][
+            "holderIdentity"
+        ]
+        == "a"
+    )
+    out = store.patch(
+        "Lease",
+        "l",
+        {"spec": {"holderIdentity": "b"}},
+        namespace=NAMESPACE_NODE_LEASE,
+        expect={"spec.holderIdentity": "a"},
+    )
+    assert out["spec"]["holderIdentity"] == "b"
+    res = store.bulk(
+        [
+            {
+                "verb": "patch",
+                "kind": "Lease",
+                "name": "l",
+                "namespace": NAMESPACE_NODE_LEASE,
+                "data": {"spec": {"holderIdentity": "c"}},
+                "expect": {"spec.holderIdentity": "zzz"},
+            }
+        ]
+    )
+    assert res[0]["status"] == "error" and res[0]["reason"] == "Conflict"
+
+
 def test_unregister_on_release(held_lane):
     store, ctrl, lane = held_lane
     ctrl.release_hold("n1")
