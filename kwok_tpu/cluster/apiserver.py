@@ -73,6 +73,26 @@ _K8S_HEADS = {"api", "apis", "version", "openapi"}
 _BOOKMARK_EVERY = 15.0
 
 
+def _traced(fn):
+    """Span per mutating request, continuing the caller's W3C trace
+    (the kube-apiserver OTLP tracing analog; reference
+    k8s/kube_apiserver_tracing_config.go:34-47 samples everything)."""
+    verb = fn.__name__[3:]
+
+    def wrapper(self):
+        from kwok_tpu.utils.trace import from_traceparent, get_tracer
+
+        tr = get_tracer("apiserver")
+        if not tr.enabled:
+            return fn(self)
+        tid, pid = from_traceparent(self.headers.get("traceparent"))
+        with tr.span(f"apiserver.{verb}", trace_id=tid, parent_id=pid) as sp:
+            sp.set("http.target", self.path)
+            return fn(self)
+
+    return wrapper
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kwok-tpu-apiserver"
@@ -157,6 +177,17 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if head == "healthz" or head == "readyz" or head == "livez":
                 self._send_json(200, {"status": "ok"})
+            elif head == "dashboard":
+                # built-in live dashboard — the kubernetes-dashboard
+                # component seat (reference components/dashboard.go runs
+                # the real dashboard image; a source-tree framework
+                # serves its own page off the cluster state)
+                body = _DASHBOARD_HTML.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif head == "state":
                 # raw store dump — the etcd-snapshot analog (reference
                 # kwokctl snapshot save, etcd/save.go)
@@ -204,6 +235,7 @@ class _Handler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionError):
                 pass
 
+    @_traced
     def do_POST(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "POST", head, rest, q):
@@ -233,6 +265,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001
             self._send_error(exc)
 
+    @_traced
     def do_PUT(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PUT", head, rest, q):
@@ -252,6 +285,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001
             self._send_error(exc)
 
+    @_traced
     def do_PATCH(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PATCH", head, rest, q):
@@ -276,6 +310,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001
             self._send_error(exc)
 
+    @_traced
     def do_DELETE(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "DELETE", head, rest, q):
@@ -357,6 +392,47 @@ class _Handler(BaseHTTPRequestHandler):
     def _write_line(self, payload: dict) -> None:
         self.wfile.write(self._encode_line(payload))
         self.wfile.flush()
+
+
+#: one self-contained page; data comes from the k8s-protocol routes the
+#: page shares a port with, refreshed client-side
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><title>kwok-tpu dashboard</title><style>
+body{font-family:sans-serif;margin:2em}table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px;text-align:left}
+.ok{color:#0a0}.bad{color:#a00}</style></head><body>
+<h1>kwok-tpu cluster</h1><div id=counts></div>
+<h2>Nodes</h2><table id=nodes></table>
+<h2>Pods</h2><table id=pods></table>
+<script>
+async function j(u){return (await fetch(u)).json()}
+// object names are attacker-controlled input: always escape before
+// interpolating into markup (stored-XSS guard)
+const esc=s=>String(s??'').replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+function cond(o,t){for(const c of (o.status&&o.status.conditions)||[])
+  if(c.type===t)return c.status==='True';return false}
+async function refresh(){
+  const s=await j('/stats');
+  document.getElementById('counts').textContent=
+    'resourceVersion '+s.resourceVersion+' — '+
+    Object.entries(s.counts).filter(e=>e[1]>0)
+      .map(e=>e[0]+': '+e[1]).join(', ');
+  const ns=await j('/api/v1/nodes');
+  document.getElementById('nodes').innerHTML=
+    '<tr><th>name</th><th>ready</th><th>created</th></tr>'+
+    ns.items.map(n=>'<tr><td>'+esc(n.metadata.name)+'</td><td class='+
+      (cond(n,'Ready')?'ok>Ready':'bad>NotReady')+'</td><td>'+
+      esc(n.metadata.creationTimestamp||'')+'</td></tr>').join('');
+  const ps=await j('/api/v1/pods?limit=500');
+  document.getElementById('pods').innerHTML=
+    '<tr><th>namespace</th><th>name</th><th>node</th><th>phase</th></tr>'+
+    ps.items.map(p=>'<tr><td>'+esc(p.metadata.namespace||'')+'</td><td>'+
+      esc(p.metadata.name)+'</td><td>'+esc((p.spec&&p.spec.nodeName)||'')+
+      '</td><td>'+esc((p.status&&p.status.phase)||'')+'</td></tr>').join('');
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>"""
 
 
 class APIServer:

@@ -197,6 +197,14 @@ class ClusterClient:
         hdrs = {"Content-Type": "application/json"}
         if headers:
             hdrs.update(headers)
+        if method != "GET":
+            # propagate the caller's trace across the process boundary
+            # (W3C traceparent; the apiserver continues the trace)
+            from kwok_tpu.utils.trace import get_tracer, traceparent
+
+            tp = traceparent(get_tracer().current())
+            if tp:
+                hdrs.setdefault("traceparent", tp)
         payload = json.dumps(body) if body is not None else None
         try:
             conn.request(method, path, body=payload, headers=hdrs)

@@ -118,6 +118,14 @@ def status_for(exc: Exception) -> dict:
     return status_body(code, reason, str(exc))
 
 
+def _usage_quantities(cpu_cores: float, mem_bytes: float) -> dict:
+    """k8s resource.Quantity strings: cpu in nanocores, memory in Ki."""
+    return {
+        "cpu": f"{int(cpu_cores * 1e9)}n",
+        "memory": f"{int(mem_bytes) // 1024}Ki",
+    }
+
+
 class _Route:
     """Parsed resource route below a group/version prefix."""
 
@@ -195,13 +203,16 @@ class K8sFacade:
         }
 
     def _api_group_list(self) -> dict:
+        groups = {g: vs for g, vs in self._groups().items() if g}
+        if self.kubelet_url:
+            # the metrics-server seat: resource metrics are served from
+            # kubelet scrapes (see _metrics_api), so advertise the group
+            groups.setdefault("metrics.k8s.io", {"v1beta1"})
         return {
             "kind": "APIGroupList",
             "apiVersion": "v1",
             "groups": [
-                self._api_group(g, vs)
-                for g, vs in sorted(self._groups().items())
-                if g  # core group lives under /api
+                self._api_group(g, vs) for g, vs in sorted(groups.items())
             ],
         }
 
@@ -344,6 +355,8 @@ class K8sFacade:
                 if method != "GET":
                     return self._method_not_allowed(handler, method)
                 groups = self._groups()
+                if self.kubelet_url:
+                    groups.setdefault("metrics.k8s.io", {"v1beta1"})
                 if rest[0] not in groups:
                     raise NotFound(f"no API group {rest[0]!r}")
                 self._send(handler, 200, self._api_group(rest[0], groups[rest[0]]))
@@ -355,6 +368,8 @@ class K8sFacade:
                 and parts[0] == "customresourcedefinitions"
             ):
                 return self._crd(handler, method, parts, q)
+            if group == "metrics.k8s.io":
+                return self._metrics_api(handler, method, version, parts)
             if not parts:
                 if method != "GET":
                     return self._method_not_allowed(handler, method)
@@ -755,6 +770,194 @@ class K8sFacade:
         except (BrokenPipeError, ConnectionError, OSError):
             pass
         return True
+
+    # ----------------------------------------------------- metrics.k8s.io
+
+    def _metrics_api(self, handler, method, version, parts) -> bool:
+        """The metrics-server seat: serve ``metrics.k8s.io/v1beta1``
+        NodeMetrics/PodMetrics from kubelet resource-metrics scrapes —
+        exactly how the real metrics-server works (scrape kubelets,
+        rate the cpu counter between scrapes).  Enables stock
+        ``kubectl top`` against the cluster (reference runs a real
+        metrics-server component, components/metrics_server.go; the
+        scrape source is the metrics-usage Metric CR asset)."""
+        if method != "GET":
+            return self._method_not_allowed(handler, method)
+        if not self.kubelet_url:
+            raise NotFound("no kubelet registered for resource metrics")
+        if not parts:
+            self._send(
+                handler,
+                200,
+                {
+                    "kind": "APIResourceList",
+                    "apiVersion": "v1",
+                    "groupVersion": f"metrics.k8s.io/{version}",
+                    "resources": [
+                        {
+                            "name": "nodes",
+                            "singularName": "",
+                            "namespaced": False,
+                            "kind": "NodeMetrics",
+                            "verbs": ["get", "list"],
+                        },
+                        {
+                            "name": "pods",
+                            "singularName": "",
+                            "namespaced": True,
+                            "kind": "PodMetrics",
+                            "verbs": ["get", "list"],
+                        },
+                    ],
+                },
+            )
+            return True
+        namespace = None
+        if parts[0] == "namespaces" and len(parts) >= 3:
+            namespace = parts[1]
+            parts = parts[2:]
+        plural, name = parts[0], parts[1] if len(parts) > 1 else None
+        pods_u, nodes_u, window = self._usage_rates()
+        ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        win = f"{window:.0f}s"
+        if plural == "nodes":
+            items = [
+                {
+                    "metadata": {"name": n},
+                    "timestamp": ts,
+                    "window": win,
+                    "usage": _usage_quantities(cpu, mem),
+                }
+                for n, (cpu, mem) in sorted(nodes_u.items())
+                if name is None or n == name
+            ]
+            if name is not None:
+                if not items:
+                    raise NotFound(f"node metrics for {name!r} not found")
+                self._send(
+                    handler,
+                    200,
+                    dict(items[0], kind="NodeMetrics", apiVersion=f"metrics.k8s.io/{version}"),
+                )
+                return True
+            self._send(
+                handler,
+                200,
+                {
+                    "kind": "NodeMetricsList",
+                    "apiVersion": f"metrics.k8s.io/{version}",
+                    "metadata": {},
+                    "items": items,
+                },
+            )
+            return True
+        if plural == "pods":
+            items = []
+            for (ns, pod), containers in sorted(pods_u.items()):
+                if namespace is not None and ns != namespace:
+                    continue
+                if name is not None and pod != name:
+                    continue
+                items.append(
+                    {
+                        "metadata": {"name": pod, "namespace": ns},
+                        "timestamp": ts,
+                        "window": win,
+                        "containers": [
+                            {"name": c, "usage": _usage_quantities(cpu, mem)}
+                            for c, (cpu, mem) in sorted(containers.items())
+                        ],
+                    }
+                )
+            if name is not None:
+                if not items:
+                    raise NotFound(f"pod metrics for {name!r} not found")
+                self._send(
+                    handler,
+                    200,
+                    dict(items[0], kind="PodMetrics", apiVersion=f"metrics.k8s.io/{version}"),
+                )
+                return True
+            self._send(
+                handler,
+                200,
+                {
+                    "kind": "PodMetricsList",
+                    "apiVersion": f"metrics.k8s.io/{version}",
+                    "metadata": {},
+                    "items": items,
+                },
+            )
+            return True
+        raise NotFound(f"no metrics resource {plural!r}")
+
+    def _usage_rates(self):
+        """(pod_containers, node_usage, window_s): cpu cores (rated
+        between this scrape and the cached previous one) + memory
+        working-set bytes.  First call takes a short double-scrape."""
+        now = time.monotonic()
+        cur = self._scrape_all()
+        prev = getattr(self, "_usage_prev", None)
+        if prev is None or now - prev[0] <= 0:
+            time.sleep(0.25)
+            prev = (now, cur)
+            now = time.monotonic()
+            cur = self._scrape_all()
+        self._usage_prev = (now, cur)
+        t0, (pods0, nodes0) = prev
+        dt = max(now - t0, 1e-3)
+        pods1, nodes1 = cur
+        pod_rates = {}
+        for key, containers in pods1.items():
+            out = {}
+            for c, (cpu1, mem1) in containers.items():
+                cpu0 = (pods0.get(key) or {}).get(c, (cpu1, mem1))[0]
+                out[c] = (max(cpu1 - cpu0, 0.0) / dt, mem1)
+            pod_rates[key] = out
+        node_rates = {}
+        for n, (cpu1, mem1) in nodes1.items():
+            cpu0 = nodes0.get(n, (cpu1, mem1))[0]
+            node_rates[n] = (max(cpu1 - cpu0, 0.0) / dt, mem1)
+        return pod_rates, node_rates, dt
+
+    def _scrape_all(self):
+        """Scrape every node's resource metrics off the kubelet.
+        Returns ({(ns, pod): {container: (cpu_s, mem_b)}},
+        {node: (cpu_s, mem_b)})."""
+        import urllib.request
+
+        pods: dict = {}
+        nodes: dict = {}
+        try:
+            node_objs, _ = self.store.list("Node")
+        except (KeyError, NotFound):
+            return pods, nodes
+        from kwok_tpu.utils.promtext import iter_samples
+
+        for node in node_objs:
+            nname = (node.get("metadata") or {}).get("name") or ""
+            url = f"{self.kubelet_url}/metrics/nodes/{nname}/metrics/resource"
+            try:
+                body = urllib.request.urlopen(url, timeout=10).read().decode()
+            except OSError:
+                continue
+            for mname, labels, fval in iter_samples(body):
+                key = (labels.get("namespace", ""), labels.get("pod", ""))
+                container = labels.get("container", "")
+                if mname == "container_cpu_usage_seconds_total":
+                    cur = pods.setdefault(key, {}).setdefault(container, [0.0, 0.0])
+                    cur[0] = fval
+                elif mname == "container_memory_working_set_bytes":
+                    cur = pods.setdefault(key, {}).setdefault(container, [0.0, 0.0])
+                    cur[1] = fval
+                elif mname == "node_cpu_usage_seconds_total":
+                    nodes.setdefault(nname, [0.0, 0.0])[0] = fval
+                elif mname == "node_memory_working_set_bytes":
+                    nodes.setdefault(nname, [0.0, 0.0])[1] = fval
+        return (
+            {k: {c: tuple(v) for c, v in cs.items()} for k, cs in pods.items()},
+            {n: tuple(v) for n, v in nodes.items()},
+        )
 
     # --------------------------------------------------------- stream proxy
 

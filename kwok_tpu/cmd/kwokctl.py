@@ -73,6 +73,7 @@ def cmd_create_cluster(args) -> int:
         backend=args.backend,
         config_paths=args.config,
         controller_args=args.controller_arg,
+        enable_tracing=args.enable_tracing,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -165,6 +166,8 @@ def _scrape_resource_metrics(rt, nodes):
     per-node samples {key: (cpu_seconds, memory_bytes)}."""
     import urllib.request
 
+    from kwok_tpu.utils.promtext import iter_samples
+
     conf = rt.load_config()
     port = conf["ports"]["kubelet"]
     pods = {}
@@ -175,29 +178,17 @@ def _scrape_resource_metrics(rt, nodes):
             body = urllib.request.urlopen(url, timeout=10).read().decode()
         except OSError:
             continue
-        for line in body.splitlines():
-            if line.startswith("#") or " " not in line:
-                continue
-            series, val = line.rsplit(" ", 1)
-            labels = {}
-            if "{" in series:
-                name, lbl = series.split("{", 1)
-                for part in lbl.rstrip("}").split(","):
-                    if "=" in part:
-                        k, v = part.split("=", 1)
-                        labels[k] = v.strip('"')
-            else:
-                name = series
+        for name, labels, val in iter_samples(body):
             if name == "pod_cpu_usage_seconds_total":
                 key = (labels.get("namespace", ""), labels.get("pod", ""))
-                pods.setdefault(key, [0.0, 0.0])[0] = float(val)
+                pods.setdefault(key, [0.0, 0.0])[0] = val
             elif name == "pod_memory_working_set_bytes":
                 key = (labels.get("namespace", ""), labels.get("pod", ""))
-                pods.setdefault(key, [0.0, 0.0])[1] = float(val)
+                pods.setdefault(key, [0.0, 0.0])[1] = val
             elif name == "node_cpu_usage_seconds_total":
-                node_samples.setdefault(node, [0.0, 0.0])[0] = float(val)
+                node_samples.setdefault(node, [0.0, 0.0])[0] = val
             elif name == "node_memory_working_set_bytes":
-                node_samples.setdefault(node, [0.0, 0.0])[1] = float(val)
+                node_samples.setdefault(node, [0.0, 0.0])[1] = val
     return pods, node_samples
 
 
@@ -409,6 +400,143 @@ def cmd_snapshot_replay(args) -> int:
         restore_tty()
     print(f"\nreplayed {n} patches")
     return 0
+
+
+def cmd_proxy(args) -> int:
+    """Localhost no-auth relay to the apiserver — the kubectl-proxy
+    component seat (reference components/kubectl_proxy.go)."""
+    rt = _require_cluster(args)
+    conf = rt.load_config()
+    kwargs = {}
+    if conf.get("secure"):
+        pki = os.path.join(rt.workdir, "pki")
+        kwargs = {
+            "ca_cert": os.path.join(pki, "ca.crt"),
+            "client_cert": os.path.join(pki, "admin.crt"),
+            "client_key": os.path.join(pki, "admin.key"),
+        }
+    from kwok_tpu.ctl.proxy import ApiProxy
+
+    proxy = ApiProxy(conf["serverURL"], port=args.port, **kwargs)
+    host, port = proxy.address
+    print(f"Starting to serve on {host}:{port}", flush=True)
+    try:
+        proxy.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _etcd_key(client, key: str):
+    """Map an etcd-style ``/registry/{plural}[/{ns}]/{name}`` key to
+    (kind, namespace, name); trailing parts may be absent for
+    prefix-style keys."""
+    parts = [p for p in key.split("/") if p]
+    if not parts or parts[0] != "registry" or len(parts) < 2:
+        raise SystemExit(f"key {key!r} is not under /registry/<resource>/")
+    rtype = client.resource_type(parts[1])
+    if rtype.namespaced:
+        ns = parts[2] if len(parts) > 2 else None
+        name = parts[3] if len(parts) > 3 else None
+    else:
+        ns = None
+        name = parts[2] if len(parts) > 2 else None
+    return rtype, ns, name
+
+
+def _etcd_key_of(rtype, obj) -> str:
+    meta = obj.get("metadata") or {}
+    if rtype.namespaced:
+        return f"/registry/{rtype.plural}/{meta.get('namespace', '')}/{meta.get('name', '')}"
+    return f"/registry/{rtype.plural}/{meta.get('name', '')}"
+
+
+def cmd_etcdctl(args) -> int:
+    """etcdctl-flavored access to cluster state by /registry keys
+    (reference kwokctl etcdctl passes through to real etcdctl,
+    cmd/root.go:61-76; here the store IS the registry)."""
+    rt = _require_cluster(args)
+    live = rt.running_components().get("apiserver")
+    if not live and args.etcd_verb in ("put", "del"):
+        print("apiserver is not running; start the cluster first", file=sys.stderr)
+        return 1
+    if live:
+        client = rt.client()
+    else:
+        from kwok_tpu.cluster.store import ResourceStore
+
+        client = ResourceStore()
+        state_path = os.path.join(rt.workdir, "state.json")
+        if os.path.exists(state_path):
+            client.load_file(state_path)
+    if args.etcd_verb == "get":
+        rtype, ns, name = _etcd_key(client, args.key)
+        if name and not args.prefix:
+            try:
+                objs = [client.get(rtype.kind, name, namespace=ns)]
+            except KeyError:
+                objs = []
+        else:
+            objs, _ = client.list(rtype.kind, namespace=ns)
+            if args.prefix and name:
+                objs = [
+                    o
+                    for o in objs
+                    if (o.get("metadata") or {}).get("name", "").startswith(name)
+                ]
+        if args.count_only:
+            # etcdctl --count-only prints ONLY the count
+            print(len(objs))
+            return 0
+        for obj in objs:
+            print(_etcd_key_of(rtype, obj))
+            print(json.dumps(obj))
+        return 0
+    if args.etcd_verb == "put":
+        rtype, ns, name = _etcd_key(client, args.key)
+        obj = json.loads(args.value)
+        obj.setdefault("kind", rtype.kind)
+        obj.setdefault("apiVersion", rtype.api_version)
+        meta = obj.setdefault("metadata", {})
+        if name:
+            meta.setdefault("name", name)
+        if ns:
+            meta.setdefault("namespace", ns)
+        try:
+            client.create(obj, namespace=ns)
+        except Conflict:
+            cur = client.get(rtype.kind, meta["name"], namespace=ns)
+            obj.setdefault("metadata", {})["resourceVersion"] = (
+                cur.get("metadata") or {}
+            ).get("resourceVersion")
+            client.update(obj)
+        print("OK")
+        return 0
+    if args.etcd_verb == "del":
+        rtype, ns, name = _etcd_key(client, args.key)
+        if name and not args.prefix:
+            targets = [(ns, name)]
+        else:
+            objs, _ = client.list(rtype.kind, namespace=ns)
+            targets = [
+                (
+                    (o.get("metadata") or {}).get("namespace"),
+                    (o.get("metadata") or {}).get("name", ""),
+                )
+                for o in objs
+                if not name
+                or (o.get("metadata") or {}).get("name", "").startswith(name)
+            ]
+        n = 0
+        for tns, tname in targets:
+            try:
+                client.delete(rtype.kind, tname, namespace=tns)
+                n += 1
+            except KeyError:
+                pass
+        print(n)
+        return 0
+    return 2
 
 
 def cmd_hack(args) -> int:
@@ -667,6 +795,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument("--config", action="append", default=[])
     c.add_argument("--controller-arg", action="append", default=[])
+    c.add_argument(
+        "--enable-tracing",
+        action="store_true",
+        help="run the trace collector component and point every "
+        "component's tracer at it (the jaeger seat)",
+    )
     c.add_argument("--wait", type=float, default=60.0)
     c.set_defaults(fn=cmd_create_cluster)
 
@@ -733,6 +867,26 @@ def build_parser() -> argparse.ArgumentParser:
     rep.add_argument("--speed", type=float, default=1.0)
     rep.add_argument("--no-snapshot", action="store_true")
     rep.set_defaults(fn=cmd_snapshot_replay)
+
+    pe = sub.add_parser("etcdctl", help="etcd-style /registry key access")
+    pes = pe.add_subparsers(dest="etcd_verb", required=True)
+    eg = pes.add_parser("get")
+    eg.add_argument("key")
+    eg.add_argument("--prefix", action="store_true")
+    eg.add_argument("--count-only", action="store_true", dest="count_only")
+    eg.set_defaults(fn=cmd_etcdctl)
+    ep = pes.add_parser("put")
+    ep.add_argument("key")
+    ep.add_argument("value")
+    ep.set_defaults(fn=cmd_etcdctl, prefix=False)
+    ed = pes.add_parser("del")
+    ed.add_argument("key")
+    ed.add_argument("--prefix", action="store_true")
+    ed.set_defaults(fn=cmd_etcdctl)
+
+    ppx = sub.add_parser("proxy", help="localhost no-auth relay to the apiserver")
+    ppx.add_argument("--port", type=int, default=8001)
+    ppx.set_defaults(fn=cmd_proxy)
 
     ph = sub.add_parser("hack", help="direct state-file access (cluster may be stopped)")
     phs = ph.add_subparsers(dest="hack_verb", required=True)

@@ -1,0 +1,238 @@
+"""Trace collector daemon: ``python -m kwok_tpu.cmd.tracing``.
+
+The Jaeger seat in the cluster composition (reference
+pkg/kwokctl/components/jaeger.go:42 launches jaeger-all-in-one and
+points kube-apiserver's OTLP exporter at it,
+k8s/kube_apiserver_tracing_config.go:34-47).  This daemon accepts the
+OTLP/HTTP JSON that kwok-tpu's tracer (utils/trace.py) exports and
+serves a Jaeger-flavored query surface:
+
+- ``POST /v1/traces``                 OTLP/HTTP JSON ingest
+- ``GET  /api/services``              known service names
+- ``GET  /api/traces?service=&limit=`` recent traces (span lists)
+- ``GET  /api/traces/{trace_id}``     one trace
+- ``GET  /``                          minimal HTML trace browser
+- ``GET  /healthz``
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import signal
+import sys
+import threading
+from collections import OrderedDict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List
+from urllib.parse import parse_qs, unquote, urlsplit
+
+MAX_TRACES = 4096
+
+
+class TraceStore:
+    def __init__(self):
+        self._mut = threading.Lock()
+        #: trace_id -> list of span dicts (insertion-ordered, bounded)
+        self._traces: "OrderedDict[str, List[dict]]" = OrderedDict()
+        self._order: deque = deque()
+        self.services: Dict[str, int] = {}
+        self.received = 0
+
+    def ingest(self, payload: dict) -> int:
+        n = 0
+        with self._mut:
+            for rs in payload.get("resourceSpans") or []:
+                service = "unknown"
+                for a in (rs.get("resource") or {}).get("attributes") or []:
+                    if a.get("key") == "service.name":
+                        service = (a.get("value") or {}).get("stringValue", service)
+                for ss in rs.get("scopeSpans") or []:
+                    for span in ss.get("spans") or []:
+                        span = dict(span)
+                        span["service"] = service
+                        tid = span.get("traceId") or ""
+                        if tid not in self._traces:
+                            if len(self._traces) >= MAX_TRACES:
+                                old = self._order.popleft()
+                                self._traces.pop(old, None)
+                            self._traces[tid] = []
+                            self._order.append(tid)
+                        self._traces[tid].append(span)
+                        self.services[service] = self.services.get(service, 0) + 1
+                        n += 1
+            self.received += n
+        return n
+
+    def query(self, service: str = "", limit: int = 20) -> List[dict]:
+        with self._mut:
+            out = []
+            for tid in reversed(self._order):
+                spans = self._traces.get(tid) or []
+                if service and not any(s["service"] == service for s in spans):
+                    continue
+                out.append({"traceID": tid, "spans": spans})
+                if len(out) >= limit:
+                    break
+            return out
+
+    def get(self, trace_id: str):
+        with self._mut:
+            spans = self._traces.get(trace_id)
+            return None if spans is None else {"traceID": trace_id, "spans": list(spans)}
+
+
+def _render_trace_html(trace: dict) -> str:
+    spans = sorted(trace["spans"], key=lambda s: int(s.get("startTimeUnixNano") or 0))
+    if not spans:
+        return "<p>empty trace</p>"
+    t0 = int(spans[0].get("startTimeUnixNano") or 0)
+    rows = []
+    for s in spans:
+        start = (int(s.get("startTimeUnixNano") or 0) - t0) / 1e6
+        dur = (
+            int(s.get("endTimeUnixNano") or 0) - int(s.get("startTimeUnixNano") or 0)
+        ) / 1e6
+        attrs = ", ".join(
+            f"{a['key']}={list(a['value'].values())[0]}"
+            for a in s.get("attributes") or []
+        )
+        rows.append(
+            f"<tr><td>{html.escape(s['service'])}</td>"
+            f"<td>{html.escape(s.get('name') or '')}</td>"
+            f"<td>{start:.2f}ms</td><td>{dur:.2f}ms</td>"
+            f"<td><small>{html.escape(attrs)}</small></td></tr>"
+        )
+    return (
+        f"<h2>trace {html.escape(trace['traceID'])}</h2>"
+        "<table border=1 cellpadding=4><tr><th>service</th><th>span</th>"
+        "<th>start</th><th>duration</th><th>attributes</th></tr>"
+        + "".join(rows)
+        + "</table>"
+    )
+
+
+def serve(store: TraceStore, host: str, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _json(self, code, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _html(self, body: str):
+            data = f"<html><body>{body}</body></html>".encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_POST(self):
+            u = urlsplit(self.path)
+            if u.path != "/v1/traces":
+                self._json(404, {"error": "not found"})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                n = store.ingest(json.loads(raw or b"{}"))
+            except (ValueError, KeyError) as exc:
+                self._json(400, {"error": str(exc)})
+                return
+            self._json(200, {"accepted": n})
+
+        def do_GET(self):
+            u = urlsplit(self.path)
+            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            parts = [unquote(p) for p in u.path.split("/") if p]
+            if u.path == "/healthz":
+                self._json(200, {"status": "ok", "received": store.received})
+            elif u.path == "/api/services":
+                self._json(200, {"data": sorted(store.services)})
+            elif parts[:2] == ["api", "traces"] and len(parts) == 3:
+                tr = store.get(parts[2])
+                if tr is None:
+                    self._json(404, {"error": "no such trace"})
+                else:
+                    self._json(200, {"data": [tr]})
+            elif parts[:2] == ["api", "traces"]:
+                self._json(
+                    200,
+                    {
+                        "data": store.query(
+                            service=q.get("service", ""),
+                            limit=int(q.get("limit") or 20),
+                        )
+                    },
+                )
+            elif not parts:
+                traces = store.query(limit=50)
+                # trace ids and service names come from untrusted OTLP
+                # ingest — escape (and quote for hrefs) before rendering
+                from urllib.parse import quote
+
+                items = "".join(
+                    f'<li><a href="/trace/{quote(t["traceID"], safe="")}">'
+                    f"{html.escape(t['traceID'][:16])}…</a> "
+                    f"({len(t['spans'])} spans, "
+                    f"{html.escape(str(sorted({s['service'] for s in t['spans']})))})"
+                    "</li>"
+                    for t in traces
+                )
+                self._html(
+                    f"<h1>kwok-tpu traces</h1><p>{store.received} spans received"
+                    f"</p><ul>{items}</ul>"
+                )
+            elif parts[0] == "trace" and len(parts) == 2:
+                tr = store.get(parts[1])
+                self._html(_render_trace_html(tr) if tr else "<p>no such trace</p>")
+            else:
+                self._json(404, {"error": "not found"})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    return httpd
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwok-tpu-tracing", description=__doc__)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=4318)
+    p.add_argument("-v", "--verbosity", action="count", default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    store = TraceStore()
+    httpd = serve(store, args.host, args.port)
+    print(
+        f"tracing collector on http://{args.host}:{httpd.server_address[1]}",
+        flush=True,
+    )
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    done.wait()
+    httpd.shutdown()
+    httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,18 @@ class StagePlayer:
     def play_stage(self, obj: dict, stage: CompiledStage) -> bool:
         """Apply one stage's effects; returns need_retry
         (reference pod_controller.go:290-360 playStage)."""
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            meta = obj.get("metadata") or {}
+            with tracer.span(f"play.{self.kind}") as sp:
+                sp.set("stage", stage.name)
+                sp.set("object", f"{meta.get('namespace', '')}/{meta.get('name', '')}")
+                return self._play_stage_inner(obj, stage)
+        return self._play_stage_inner(obj, stage)
+
+    def _play_stage_inner(self, obj: dict, stage: CompiledStage) -> bool:
         lc = self.lifecycle
         effects = lc.effects(stage)
         if effects is None:

@@ -202,6 +202,35 @@ class DeviceStagePlayer:
         per tick instead of one per dirty row (SURVEY §2.9: dirty rows
         stream across the boundary).  Transitions that touch finalizers
         or need multiple dependent patches keep the sequential path."""
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return self._step_inner(dt_ms)
+        # one span per firing tick (empty ticks are never finished, so
+        # they are not exported); store round-trips inside inherit it
+        # via the thread-local stack.  push/pop balance is guarded by
+        # the finally — an unbalanced stack would mis-parent every
+        # later span on this thread.
+        span = tracer.span(f"tick.{self.kind}")
+        tok = tracer._push(span)
+        transitions: List[Transition] = []
+        try:
+            transitions = self._step_inner(dt_ms)
+            return transitions
+        except Exception as exc:
+            span.error(str(exc))
+            span.end()
+            span = None
+            raise
+        finally:
+            tracer._pop(tok)
+            if span is not None and transitions:
+                span.set("kind", self.kind)
+                span.set("fired", len(transitions))
+                span.end()
+
+    def _step_inner(self, dt_ms: Optional[int] = None) -> List[Transition]:
         t0 = time.perf_counter()
         transitions = self.sim.step(
             dt_ms if dt_ms is not None else self.tick_ms, materialize=False

@@ -151,9 +151,23 @@ class Scheduler:
     # --------------------------------------------------------------- binding
 
     def _bind(self, pod: dict) -> None:
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            meta = pod.get("metadata") or {}
+            with tracer.span("schedule.bind") as sp:
+                sp.set("pod", f"{meta.get('namespace', 'default')}/{meta.get('name')}")
+                self._bind_inner(pod, sp)
+        else:
+            self._bind_inner(pod, None)
+
+    def _bind_inner(self, pod: dict, span) -> None:
         meta = pod.get("metadata") or {}
         name, ns = meta.get("name") or "", meta.get("namespace") or "default"
         target = self._pick_node(pod)
+        if span is not None:
+            span.set("node", target or "")
         if target is None:
             self.recorder.event(
                 pod,

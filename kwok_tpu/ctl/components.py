@@ -89,6 +89,21 @@ def build_apiserver_component(
     return Component(name="apiserver", args=args, ports={"http": port})
 
 
+def build_tracing_component(port: int) -> Component:
+    """The jaeger seat (reference components/jaeger.go:42
+    BuildJaegerComponent): an OTLP/HTTP collector + trace browser."""
+    args = [
+        sys.executable,
+        "-m",
+        "kwok_tpu.cmd.tracing",
+        "--host",
+        "127.0.0.1",
+        "--port",
+        str(port),
+    ]
+    return Component(name="tracing", args=args, ports={"otlp": port})
+
+
 def build_scheduler_component(
     server_url: str,
     secure: bool = False,

@@ -1,0 +1,147 @@
+"""kubectl-proxy seat: localhost, no-auth HTTP relay to the apiserver.
+
+The reference composes a kubectl-proxy component so tooling without
+cluster credentials can reach the apiserver on a local port (reference
+pkg/kwokctl/components/kubectl_proxy.go).  This is the same relay for
+kwok-tpu clusters: it owns the TLS client identity (admin cert from the
+cluster's pki) and forwards any HTTP request — including watch
+streams — to the apiserver, so ``kwokctl proxy`` + plain ``curl
+localhost:8001/api/v1/pods`` works against a secure cluster.
+"""
+
+from __future__ import annotations
+
+import http.client
+import socket
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+__all__ = ["ApiProxy"]
+
+_HOP_HEADERS = {
+    "connection",
+    "keep-alive",
+    "proxy-authenticate",
+    "proxy-authorization",
+    "te",
+    "trailers",
+    "transfer-encoding",
+    "upgrade",
+    "host",
+}
+
+
+class ApiProxy:
+    def __init__(
+        self,
+        target_url: str,
+        host: str = "127.0.0.1",
+        port: int = 8001,
+        ca_cert: Optional[str] = None,
+        client_cert: Optional[str] = None,
+        client_key: Optional[str] = None,
+    ):
+        self._https = target_url.startswith("https://")
+        hostport = target_url.split("://", 1)[1].rstrip("/")
+        thost, _, tport = hostport.partition(":")
+        self._target = (thost, int(tport or (443 if self._https else 80)))
+        self._ssl_ctx = None
+        if self._https:
+            ctx = ssl.create_default_context(cafile=ca_cert)
+            if client_cert and client_key:
+                ctx.load_cert_chain(client_cert, client_key)
+            self._ssl_ctx = ctx
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _relay(self):
+                proxy._relay(self)
+
+            do_GET = do_POST = do_PUT = do_PATCH = do_DELETE = do_HEAD = _relay
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    def _relay(self, handler: BaseHTTPRequestHandler) -> None:
+        thost, tport = self._target
+        if self._https:
+            conn = http.client.HTTPSConnection(
+                thost, tport, timeout=300, context=self._ssl_ctx
+            )
+        else:
+            conn = http.client.HTTPConnection(thost, tport, timeout=300)
+        headers_sent = False
+        try:
+            length = int(handler.headers.get("Content-Length") or 0)
+            body = handler.rfile.read(length) if length else None
+            headers = {
+                k: v
+                for k, v in handler.headers.items()
+                if k.lower() not in _HOP_HEADERS
+            }
+            conn.request(handler.command, handler.path, body=body, headers=headers)
+            resp = conn.getresponse()
+            handler.send_response(resp.status)
+            for k, v in resp.getheaders():
+                if k.lower() in _HOP_HEADERS | {"content-length"}:
+                    continue
+                handler.send_header(k, v)
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            headers_sent = True
+            handler.close_connection = True
+            # stream until upstream EOF — covers unary bodies AND
+            # long-lived watch streams
+            while True:
+                chunk = resp.read(65536)
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (
+            OSError,
+            http.client.HTTPException,
+            BrokenPipeError,
+            socket.timeout,
+        ):
+            if headers_sent:
+                # mid-stream failure: a second status line would corrupt
+                # the relayed body — just drop the connection (clean EOF)
+                handler.close_connection = True
+            else:
+                try:
+                    handler.send_response(502)
+                    handler.send_header("Content-Length", "0")
+                    handler.end_headers()
+                except (OSError, ValueError):
+                    pass
+        finally:
+            conn.close()
+
+    def start(self) -> "ApiProxy":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -38,6 +38,7 @@ from kwok_tpu.ctl.components import (
     build_apiserver_component,
     build_kwok_controller_component,
     build_scheduler_component,
+    build_tracing_component,
     free_port,
 )
 from kwok_tpu.ctl.dryrun import dry_run
@@ -106,6 +107,7 @@ class BinaryRuntime:
         backend: str = "host",
         config_paths: Optional[List[str]] = None,
         controller_args: Optional[List[str]] = None,
+        enable_tracing: bool = False,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -160,6 +162,19 @@ class BinaryRuntime:
                 extra_args=controller_args,
             ),
         ]
+        tracing_port = 0
+        if enable_tracing:
+            # the jaeger seat: collector first, every other component
+            # exports to it (reference wires the apiserver's OTLP
+            # endpoint at jaeger the same way,
+            # k8s/kube_apiserver_tracing_config.go:34-47)
+            tracing_port = free_port()
+            endpoint = f"http://127.0.0.1:{tracing_port}/v1/traces"
+            for comp in components:
+                comp.env["KWOK_TRACE_ENDPOINT"] = endpoint
+                comp.env["KWOK_TRACE_SERVICE"] = comp.name
+                comp.depends_on = list(set(comp.depends_on) | {"tracing"})
+            components.insert(0, build_tracing_component(tracing_port))
         conf = {
             "kind": "KwokctlConfiguration",
             "name": self.name,
@@ -169,6 +184,8 @@ class BinaryRuntime:
             "backend": backend,
             "ports": {"apiserver": apiserver_port, "kubelet": kubelet_port},
         }
+        if tracing_port:
+            conf["ports"]["tracing"] = tracing_port
         self.write_prometheus_config(kubelet_port)
         self._installed_components = components
         if dry_run.enabled:

@@ -64,12 +64,19 @@ def initialize(
         process_id = int(os.environ["KWOK_PROCESS_ID"])
     if not coordinator_address or not num_processes or num_processes <= 1:
         return False
+    if process_id is None:
+        # defaulting would silently give two hosts the same id and hang
+        # the whole world at initialize — fail loudly instead
+        raise ValueError(
+            "multi-process world needs an explicit process id "
+            "(KWOK_PROCESS_ID or process_id=)"
+        )
     import jax
 
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
-        process_id=process_id or 0,
+        process_id=process_id,
     )
     return True
 

@@ -1,0 +1,43 @@
+"""Prometheus text-format parsing, shared by every scrape consumer
+(`kwokctl kubectl top` and the metrics.k8s.io facade both read the
+kubelet's resource-metrics endpoint; one parser keeps them from
+drifting).  Handles quoted label values containing commas and escaped
+quotes, which naive ``split(",")`` parsers mis-split."""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterator, Tuple
+
+__all__ = ["iter_samples"]
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape(value: str) -> str:
+    out = value
+    for k, v in _UNESCAPE.items():
+        out = out.replace(k, v)
+    return out
+
+
+def iter_samples(text: str) -> Iterator[Tuple[str, Dict[str, str], float]]:
+    """Yield (metric_name, labels, value) for each sample line."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        series, _, val = line.rpartition(" ")
+        if not series:
+            continue
+        try:
+            fval = float(val)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = series
+        if "{" in series:
+            name, _, lbl = series.partition("{")
+            labels = {k: _unescape(v) for k, v in _LABEL_RE.findall(lbl)}
+        yield name.strip(), labels, fval

@@ -1,0 +1,284 @@
+"""In-process tracer: spans, W3C context propagation, OTLP export.
+
+The reference delegates tracing to the ecosystem: kwokctl launches a
+Jaeger all-in-one (reference pkg/kwokctl/components/jaeger.go:42) and
+configures kube-apiserver's OTLP exporter at full sampling
+(reference pkg/kwokctl/k8s/kube_apiserver_tracing_config.go:34-47);
+kwok itself only exposes pprof.  This rebuild has no external binaries
+to lean on, so the tracer is built in:
+
+- :class:`Tracer` — cheap spans (trace/span ids, wall ns, attributes,
+  status), thread-local current-span context, bounded in-memory buffer
+  flushed by a background exporter thread;
+- W3C ``traceparent`` header helpers so a trace crosses the
+  client→apiserver process boundary the way OTLP ecosystems expect;
+- OTLP/HTTP JSON export (``resourceSpans`` shape) to a collector URL —
+  the bundled collector (cmd/tracing.py, the Jaeger seat) or any real
+  OTLP endpoint.
+
+Disabled (no endpoint) the tracer is a few dict lookups per span; the
+device tick's inner loop is never traced per-row — spans wrap whole
+batched operations, keeping observability off the hot path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "Tracer", "get_tracer", "set_global", "traceparent", "from_traceparent"]
+
+
+class Span:
+    __slots__ = (
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "name",
+        "start_ns",
+        "end_ns",
+        "attributes",
+        "status_ok",
+        "_tracer",
+        "_token",
+    )
+
+    def __init__(self, tracer, name, trace_id, span_id, parent_id):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attributes: Dict[str, Any] = {}
+        self.status_ok = True
+        self._token = None
+
+    def set(self, key: str, value: Any) -> "Span":
+        self.attributes[key] = value
+        return self
+
+    def error(self, message: str) -> "Span":
+        self.status_ok = False
+        self.attributes["error.message"] = message
+        return self
+
+    def end(self) -> None:
+        self.end_ns = time.time_ns()
+        self._tracer._finish(self)
+
+    # context-manager sugar: `with tracer.span("x") as sp:`
+    def __enter__(self) -> "Span":
+        self._token = self._tracer._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.error(str(exc))
+        self._tracer._pop(self._token)
+        self.end()
+
+
+class Tracer:
+    """One per process/component; export is best-effort and bounded."""
+
+    MAX_BUFFER = 8192
+    FLUSH_EVERY = 2.0
+
+    def __init__(
+        self,
+        service: str,
+        endpoint: Optional[str] = None,
+        resource: Optional[Dict[str, Any]] = None,
+    ):
+        self.service = service
+        self.endpoint = endpoint  # e.g. http://127.0.0.1:4318/v1/traces
+        self.resource = dict(resource or {})
+        self._local = threading.local()
+        self._buf: List[Span] = []
+        self._mut = threading.Lock()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dropped = 0
+        self.exported = 0
+        if endpoint:
+            self._thread = threading.Thread(
+                target=self._flush_loop, daemon=True, name=f"trace-{service}"
+            )
+            self._thread.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self.endpoint is not None
+
+    # ----------------------------------------------------------------- spans
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def current(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def span(
+        self,
+        name: str,
+        parent: Optional[Span] = None,
+        trace_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
+    ) -> Span:
+        """New span.  Parent defaults to the thread's current span;
+        pass trace_id/parent_id (e.g. from a traceparent header) to
+        continue a remote trace."""
+        if parent is None and trace_id is None:
+            parent = self.current()
+        if parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+        if trace_id is None:
+            trace_id = secrets.token_hex(16)
+        return Span(self, name, trace_id, secrets.token_hex(8), parent_id)
+
+    def _push(self, span: Span):
+        st = self._stack()
+        st.append(span)
+        return len(st) - 1
+
+    def _pop(self, token) -> None:
+        st = self._stack()
+        if token is not None and token < len(st):
+            del st[token:]
+
+    def _finish(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._mut:
+            if len(self._buf) >= self.MAX_BUFFER:
+                self.dropped += 1
+                return
+            self._buf.append(span)
+
+    # ---------------------------------------------------------------- export
+
+    def _flush_loop(self) -> None:
+        while not self._done.wait(self.FLUSH_EVERY):
+            self.flush()
+        self.flush()
+
+    def flush(self) -> None:
+        with self._mut:
+            batch, self._buf = self._buf, []
+        if not batch or not self.endpoint:
+            return
+        try:
+            payload = json.dumps(self._otlp(batch)).encode()
+            req = urllib.request.Request(
+                self.endpoint,
+                data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=5).read()
+            self.exported += len(batch)
+        except Exception:  # noqa: BLE001 — a dead collector must not
+            # break the traced component; spans from this batch are lost
+            self.dropped += len(batch)
+
+    def _otlp(self, batch: List[Span]) -> dict:
+        def attr(k, v):
+            if isinstance(v, bool):
+                return {"key": k, "value": {"boolValue": v}}
+            if isinstance(v, int):
+                return {"key": k, "value": {"intValue": str(v)}}
+            if isinstance(v, float):
+                return {"key": k, "value": {"doubleValue": v}}
+            return {"key": k, "value": {"stringValue": str(v)}}
+
+        res_attrs = [attr("service.name", self.service)] + [
+            attr(k, v) for k, v in self.resource.items()
+        ]
+        spans = []
+        for s in batch:
+            spans.append(
+                {
+                    "traceId": s.trace_id,
+                    "spanId": s.span_id,
+                    "parentSpanId": s.parent_id or "",
+                    "name": s.name,
+                    "kind": 1,
+                    "startTimeUnixNano": str(s.start_ns),
+                    "endTimeUnixNano": str(s.end_ns),
+                    "attributes": [attr(k, v) for k, v in s.attributes.items()],
+                    "status": {"code": 1 if s.status_ok else 2},
+                }
+            )
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {"attributes": res_attrs},
+                    "scopeSpans": [
+                        {"scope": {"name": "kwok-tpu"}, "spans": spans}
+                    ],
+                }
+            ]
+        }
+
+    def stop(self) -> None:
+        self._done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ------------------------------------------------------------- propagation
+
+
+def traceparent(span: Optional[Span]) -> Optional[str]:
+    """W3C traceparent header for outgoing requests."""
+    if span is None:
+        return None
+    return f"00-{span.trace_id}-{span.span_id}-01"
+
+
+def from_traceparent(header: Optional[str]):
+    """(trace_id, parent_span_id) out of an incoming header, or
+    (None, None)."""
+    if not header:
+        return None, None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None, None
+    return parts[1], parts[2]
+
+
+# ------------------------------------------------------------ global tracer
+
+_global: Optional[Tracer] = None
+_global_mut = threading.Lock()
+
+
+def set_global(tracer: Optional[Tracer]) -> None:
+    """Install (or with None, reset) the process-global tracer."""
+    global _global
+    with _global_mut:
+        _global = tracer
+
+
+def get_tracer(service: str = "kwok") -> Tracer:
+    """Process-wide tracer; configured from ``KWOK_TRACE_ENDPOINT`` on
+    first use (how kwokctl components inherit the collector address)."""
+    global _global
+    with _global_mut:
+        if _global is None:
+            _global = Tracer(
+                service=os.environ.get("KWOK_TRACE_SERVICE", service),
+                endpoint=os.environ.get("KWOK_TRACE_ENDPOINT") or None,
+            )
+        return _global

@@ -258,6 +258,41 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
         # zeros would mean the CEL eval silently failed
         assert "1Mi" in top_out, top_out
 
+        # the metrics.k8s.io API group (the metrics-server seat): what
+        # stock `kubectl top` consumes, served by the apiserver facade
+        # from kubelet scrapes (cluster/k8s_api.py::_metrics_api)
+        import json as _json
+        import urllib.request as _rq
+
+        base = rt.load_config()["serverURL"]
+        groups = _json.loads(_rq.urlopen(f"{base}/apis", timeout=10).read())
+        assert "metrics.k8s.io" in {g["name"] for g in groups["groups"]}
+        nm = _json.loads(
+            _rq.urlopen(
+                f"{base}/apis/metrics.k8s.io/v1beta1/nodes", timeout=30
+            ).read()
+        )
+        assert nm["kind"] == "NodeMetricsList"
+        assert {i["metadata"]["name"] for i in nm["items"]} == {"node-0", "node-1"}
+        assert all("cpu" in i["usage"] and "memory" in i["usage"] for i in nm["items"])
+        pm = _json.loads(
+            _rq.urlopen(
+                f"{base}/apis/metrics.k8s.io/v1beta1/namespaces/default/pods",
+                timeout=30,
+            ).read()
+        )
+        assert pm["kind"] == "PodMetricsList" and len(pm["items"]) == 3
+        c0 = pm["items"][0]["containers"][0]
+        # 1Mi working set from the asset default = 1024Ki
+        assert c0["usage"]["memory"] == "1024Ki", pm["items"][0]
+        one = _json.loads(
+            _rq.urlopen(
+                f"{base}/apis/metrics.k8s.io/v1beta1/namespaces/default/pods/pod-0",
+                timeout=30,
+            ).read()
+        )
+        assert one["kind"] == "PodMetrics"
+
         # export logs collects component logs + cluster config
         exp = os.path.join(str(home), "exported")
         assert kwokctl_main(["--name", name, "export", "logs", exp]) == 0

@@ -1,0 +1,218 @@
+"""Tracing subsystem (utils/trace.py + cmd/tracing.py): span nesting,
+W3C propagation across the client→apiserver boundary, OTLP ingest, the
+collector query surface, and the kwokctl --enable-tracing composition
+(reference: jaeger component components/jaeger.go:42 + apiserver OTLP
+config k8s/kube_apiserver_tracing_config.go:34-47)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.cmd.tracing import TraceStore, serve
+from kwok_tpu.utils.trace import (
+    Tracer,
+    from_traceparent,
+    get_tracer,
+    set_global,
+    traceparent,
+)
+
+
+@pytest.fixture()
+def collector():
+    store = TraceStore()
+    httpd = serve(store, "127.0.0.1", 0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    yield store, f"http://127.0.0.1:{port}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+@pytest.fixture(autouse=True)
+def reset_global_tracer():
+    yield
+    set_global(None)
+
+
+def test_span_nesting_and_propagation():
+    tr = Tracer("t")  # disabled: no endpoint
+    with tr.span("outer") as outer:
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            hdr = traceparent(inner)
+        tid, pid = from_traceparent(hdr)
+        assert tid == outer.trace_id and pid == inner.span_id
+    assert from_traceparent("garbage") == (None, None)
+    assert from_traceparent(None) == (None, None)
+    # remote continuation
+    child = tr.span("remote", trace_id=tid, parent_id=pid)
+    assert child.trace_id == tid and child.parent_id == pid
+
+
+def test_export_to_collector_and_query(collector):
+    store, url = collector
+    tr = Tracer("svc-a", endpoint=f"{url}/v1/traces")
+    with tr.span("op") as sp:
+        sp.set("answer", 42).set("ok", True).set("ratio", 0.5)
+    with tr.span("failing") as sp:
+        sp.error("boom")
+    tr.flush()
+    assert store.received == 2
+
+    # query API — jaeger-flavored
+    services = json.loads(
+        urllib.request.urlopen(f"{url}/api/services").read()
+    )["data"]
+    assert services == ["svc-a"]
+    traces = json.loads(
+        urllib.request.urlopen(f"{url}/api/traces?service=svc-a").read()
+    )["data"]
+    assert len(traces) == 2
+    all_spans = [s for t in traces for s in t["spans"]]
+    op = next(s for s in all_spans if s["name"] == "op")
+    attrs = {a["key"]: a["value"] for a in op["attributes"]}
+    assert attrs["answer"] == {"intValue": "42"}
+    assert attrs["ok"] == {"boolValue": True}
+    failing = next(s for s in all_spans if s["name"] == "failing")
+    assert failing["status"]["code"] == 2
+    # single-trace endpoint + HTML browser
+    one = json.loads(
+        urllib.request.urlopen(f"{url}/api/traces/{op['traceId']}").read()
+    )["data"][0]
+    assert one["traceID"] == op["traceId"]
+    page = urllib.request.urlopen(f"{url}/trace/{op['traceId']}").read()
+    assert b"op" in page
+    assert urllib.request.urlopen(url).status == 200
+
+
+def test_trace_crosses_client_apiserver_boundary(collector):
+    """A span around a client mutation and the apiserver's span for
+    that request share one trace (W3C traceparent over the wire)."""
+    store, url = collector
+    tracer = Tracer("e2e", endpoint=f"{url}/v1/traces")
+    set_global(tracer)
+    rstore = ResourceStore()
+    with APIServer(rstore) as srv:
+        client = ClusterClient(srv.url)
+        with tracer.span("client.create-pod") as sp:
+            client.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": "traced", "namespace": "default"},
+                    "spec": {"nodeName": "n", "containers": [{"name": "c"}]},
+                    "status": {},
+                }
+            )
+            client.patch(
+                "Pod", "traced", {"metadata": {"labels": {"x": "1"}}}
+            )
+            trace_id = sp.trace_id
+    tracer.flush()
+    spans = (TraceStore.get(store, trace_id) or {}).get("spans") or []
+    names = sorted(s["name"] for s in spans)
+    assert "client.create-pod" in names
+    assert "apiserver.POST" in names and "apiserver.PATCH" in names
+    post = next(s for s in spans if s["name"] == "apiserver.POST")
+    client_span = next(s for s in spans if s["name"] == "client.create-pod")
+    assert post["parentSpanId"] == client_span["spanId"]
+
+
+def test_disabled_tracer_is_inert():
+    tr = Tracer("noop")
+    with tr.span("x") as sp:
+        sp.set("k", "v")
+    assert tr.exported == 0 and tr.dropped == 0
+    assert not tr._buf
+
+
+def test_collector_survives_garbage_and_bounds(collector):
+    store, url = collector
+    req = urllib.request.Request(
+        f"{url}/v1/traces", data=b"not json", headers={"Content-Type": "application/json"}
+    )
+    try:
+        urllib.request.urlopen(req)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+    # unknown routes 404
+    try:
+        urllib.request.urlopen(f"{url}/api/traces/nope")
+        assert False
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 404
+
+
+def test_cluster_with_tracing_component(tmp_path, monkeypatch):
+    """kwokctl --enable-tracing: collector component runs, every
+    component exports, and one scheduling trace spans scheduler +
+    apiserver processes."""
+    import urllib.error
+
+    from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    name = "traced"
+    assert (
+        kwokctl_main(
+            ["--name", name, "create", "cluster", "--enable-tracing", "--wait", "60"]
+        )
+        == 0
+    )
+    try:
+        rt = BinaryRuntime(name)
+        conf = rt.load_config()
+        tport = conf["ports"]["tracing"]
+        turl = f"http://127.0.0.1:{tport}"
+        assert "tracing" in rt.running_components()
+        assert kwokctl_main(["--name", name, "scale", "node", "--replicas", "1"]) == 0
+        assert kwokctl_main(["--name", name, "scale", "pod", "--replicas", "1"]) == 0
+
+        def services():
+            try:
+                return json.loads(
+                    urllib.request.urlopen(f"{turl}/api/services", timeout=5).read()
+                )["data"]
+            except (urllib.error.URLError, OSError):
+                return []
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            svc = services()
+            if {"apiserver", "scheduler"} <= set(svc):
+                break
+            time.sleep(0.5)
+        assert {"apiserver", "scheduler"} <= set(services()), services()
+
+        # the bind trace crosses processes: scheduler span + apiserver
+        # PATCH span with the same traceId
+        traces = json.loads(
+            urllib.request.urlopen(
+                f"{turl}/api/traces?service=scheduler&limit=50", timeout=5
+            ).read()
+        )["data"]
+        bind_traces = [
+            t
+            for t in traces
+            if any(s["name"] == "schedule.bind" for s in t["spans"])
+        ]
+        assert bind_traces, [s["name"] for t in traces for s in t["spans"]]
+        crossed = any(
+            {s["service"] for s in t["spans"]} >= {"scheduler", "apiserver"}
+            for t in bind_traces
+        )
+        assert crossed, bind_traces
+    finally:
+        kwokctl_main(["--name", name, "delete", "cluster"])
