@@ -26,7 +26,6 @@ controllers use this object directly (the Go↔device bridge boundary).
 
 from __future__ import annotations
 
-import copy
 import datetime
 import threading
 import time
@@ -165,6 +164,20 @@ def match_label_selector(obj: dict, sel: Selector) -> bool:
         if op == "notin" and labels.get(k) in _set_values(v):
             return False
     return True
+
+
+def copy_json(x: Any) -> Any:
+    """Deep copy for JSON-shaped data (dict/list/scalars).  Store
+    objects are JSON by contract (they arrive via HTTP or from_dict),
+    so the general ``copy.deepcopy`` machinery (memo dict, reductor
+    dispatch) is pure overhead on the hot copy paths — this is ~3x
+    faster and shares immutable leaves."""
+    t = type(x)
+    if t is dict:
+        return {k: copy_json(v) for k, v in x.items()}
+    if t is list:
+        return [copy_json(v) for v in x]
+    return x
 
 
 def atomic_write_json(path: str, data: Any) -> None:
@@ -377,7 +390,7 @@ class ResourceStore:
         return (ns, meta.get("name") or "")
 
     def _emit(self, st: _TypeState, etype: str, obj: dict, rv: int) -> None:
-        ev = WatchEvent(type=etype, object=copy.deepcopy(obj), rv=rv)
+        ev = WatchEvent(type=etype, object=copy_json(obj), rv=rv)
         st.history.append(ev)
         for w in list(st.watchers):
             w._push(ev)
@@ -398,7 +411,7 @@ class ResourceStore:
     def create(
         self, obj: dict, namespace: Optional[str] = None, as_user: Optional[str] = None
     ) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = copy_json(obj)
         kind = obj.get("kind") or ""
         with self._mut:
             st = self._state(kind)
@@ -418,7 +431,7 @@ class ResourceStore:
             st.objects[key] = obj
             self._index_update(st, key, None, obj)
             self._emit(st, ADDED, obj, rv)
-            return copy.deepcopy(obj)
+            return copy_json(obj)
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> dict:
         with self._mut:
@@ -427,7 +440,7 @@ class ResourceStore:
             obj = st.objects.get((ns, name))
             if obj is None:
                 raise NotFound(f"{kind} {ns}/{name} not found")
-            return copy.deepcopy(obj)
+            return copy_json(obj)
 
     @staticmethod
     def _index_candidates(st: _TypeState, field_selector: Selector):
@@ -469,7 +482,7 @@ class ResourceStore:
                         continue
                     if not match_label_selector(obj, label_selector):
                         continue
-                    items.append(copy.deepcopy(obj))
+                    items.append(copy_json(obj))
                 return items, self._rv
             items = []
             for (ns, _), obj in sorted(st.objects.items()):
@@ -479,7 +492,7 @@ class ResourceStore:
                     continue
                 if not match_field_selector(obj, field_selector):
                     continue
-                items.append(copy.deepcopy(obj))
+                items.append(copy_json(obj))
             return items, self._rv
 
     def list_paged(
@@ -550,7 +563,7 @@ class ResourceStore:
                     continue
                 if not match_field_selector(obj, field_selector):
                     continue
-                items.append(copy.deepcopy(obj))
+                items.append(copy_json(obj))
             if not limit or scanned < limit:
                 next_token = None
             return items, self._rv, next_token
@@ -561,7 +574,7 @@ class ResourceStore:
         subresource: str = "",
         as_user: Optional[str] = None,
     ) -> dict:
-        obj = copy.deepcopy(obj)
+        obj = copy_json(obj)
         kind = obj.get("kind") or ""
         with self._mut:
             st = self._state(kind)
@@ -576,7 +589,7 @@ class ResourceStore:
                     f"got {expect_rv}"
                 )
             if subresource:
-                new = copy.deepcopy(cur)
+                new = copy_json(cur)
                 new[subresource] = obj.get(subresource)
             else:
                 new = obj
@@ -625,7 +638,7 @@ class ResourceStore:
             new = apply_patch(cur, data, patch_type)
             if subresource:
                 # subresource patches may only change that one field
-                scoped = copy.deepcopy(cur)
+                scoped = copy_json(cur)
                 scoped[subresource] = new.get(subresource)
                 new = scoped
             else:
@@ -650,12 +663,12 @@ class ResourceStore:
             del st.objects[key]
             self._index_update(st, key, old, None)
             self._emit(st, DELETED, new, rv)
-            return copy.deepcopy(new)
+            return copy_json(new)
         rv = self._bump(new)
         st.objects[key] = new
         self._index_update(st, key, old, new)
         self._emit(st, MODIFIED, new, rv)
-        return copy.deepcopy(new)
+        return copy_json(new)
 
     def delete(
         self,
@@ -680,7 +693,7 @@ class ResourceStore:
                     meta["deletionTimestamp"] = self._now_string()
                     rv = self._bump(cur)
                     self._emit(st, MODIFIED, cur, rv)
-                return copy.deepcopy(cur)
+                return copy_json(cur)
             rv = self._bump(cur)
             del st.objects[key]
             self._index_update(st, key, cur, None)
@@ -801,7 +814,7 @@ class ResourceStore:
                     }
                 )
                 st = self._state(rt.kind)
-                objects.extend(copy.deepcopy(o) for o in st.objects.values())
+                objects.extend(copy_json(o) for o in st.objects.values())
             return {
                 "resourceVersion": self._rv,
                 "uidCounter": self._uid,
@@ -839,7 +852,7 @@ class ResourceStore:
                 st = self._state(obj.get("kind") or "")
                 key = self._key(st, obj)
                 old = st.objects.get(key)
-                st.objects[key] = copy.deepcopy(obj)
+                st.objects[key] = copy_json(obj)
                 self._index_update(st, key, old, obj)
                 self._emit(st, ADDED, obj, self._rv)
                 n += 1

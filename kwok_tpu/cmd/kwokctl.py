@@ -514,6 +514,11 @@ def cmd_etcdctl(args) -> int:
         return 0
     if args.etcd_verb == "del":
         rtype, ns, name = _etcd_key(client, args.key)
+        if not name and not args.prefix:
+            # etcdctl semantics: an exact-key del on a non-leaf key
+            # matches nothing (only --prefix sweeps)
+            print(0)
+            return 0
         if name and not args.prefix:
             targets = [(ns, name)]
         else:

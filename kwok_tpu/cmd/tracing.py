@@ -50,9 +50,29 @@ class TraceStore:
                         service = (a.get("value") or {}).get("stringValue", service)
                 for ss in rs.get("scopeSpans") or []:
                     for span in ss.get("spans") or []:
+                        if not isinstance(span, dict):
+                            continue
                         span = dict(span)
-                        span["service"] = service
-                        tid = span.get("traceId") or ""
+                        span["service"] = str(service)
+                        # coerce the fields the query/browser arithmetic
+                        # relies on — ingest is untrusted input and a
+                        # 200-accepted span must never crash a later GET
+                        for f in ("startTimeUnixNano", "endTimeUnixNano"):
+                            try:
+                                span[f] = str(int(span.get(f) or 0))
+                            except (TypeError, ValueError):
+                                span[f] = "0"
+                        span["name"] = str(span.get("name") or "")
+                        attrs = span.get("attributes")
+                        span["attributes"] = [
+                            a
+                            for a in (attrs if isinstance(attrs, list) else [])
+                            if isinstance(a, dict)
+                            and "key" in a
+                            and isinstance(a.get("value"), dict)
+                        ]
+                        tid = str(span.get("traceId") or "")
+                        span["traceId"] = tid
                         if tid not in self._traces:
                             if len(self._traces) >= MAX_TRACES:
                                 old = self._order.popleft()
@@ -151,6 +171,18 @@ def serve(store: TraceStore, host: str, port: int) -> ThreadingHTTPServer:
             self._json(200, {"accepted": n})
 
         def do_GET(self):
+            try:
+                self._do_get()
+            except (BrokenPipeError, ConnectionError):
+                pass
+            except Exception as exc:  # noqa: BLE001 — bad params/data
+                # must answer, not drop the connection
+                try:
+                    self._json(400, {"error": str(exc)})
+                except (OSError, ValueError):
+                    pass
+
+        def _do_get(self):
             u = urlsplit(self.path)
             q = {k: v[-1] for k, v in parse_qs(u.query).items()}
             parts = [unquote(p) for p in u.path.split("/") if p]

@@ -85,6 +85,21 @@ class DeviceStagePlayer:
         from collections import deque
 
         self.tick_lags = deque(maxlen=1024)
+        # which object state the stage templates read: gates whether a
+        # multi-op transition may render every patch from one base (see
+        # _collect_ops)
+        rp = set(self.sim.cset._read_paths)
+        self._reads_finalizers = ("metadata", "finalizers") in rp
+        self._reads_state = bool(rp)
+        #: row -> stage_idx -> rendered patches with a Now sentinel.
+        #: Sound only when templates read no mutable object state
+        #: (self._reads_state False — the compiler's own read-path
+        #: analysis): then a row's render for a stage depends only on
+        #: its admission-time identity, its row-stable funcs (pod/node
+        #: IPs), and Now, which is substituted per use.  Invalidated
+        #: whenever the row's identity changes (full refresh, release,
+        #: re-admit).
+        self._render_cache: Dict[int, Dict[int, List]] = {}
         # virtual-time anchor: device ms 0 == clock.now() at start
         self._t0: Optional[float] = None
         self.cache = None
@@ -137,6 +152,7 @@ class DeviceStagePlayer:
                     self.sim.release(row)
                     del self._rows[key]
                     self._written_rv.pop(row, None)
+                    self._drop_render_cache(row)
                 if self.on_delete is not None:
                     self.on_delete(obj)
                 return
@@ -146,6 +162,7 @@ class DeviceStagePlayer:
             if row is None:
                 row = self.sim.admit(obj)
                 self._rows[key] = row
+                self._drop_render_cache(row)
             else:
                 if _rv_stale(rv, self._written_rv.get(row)):
                     # echo of one of our own patches (possibly an
@@ -153,8 +170,11 @@ class DeviceStagePlayer:
                     # finalizer patch then status patch); the row
                     # already reflects the final write
                     return
+                old = self.sim.objects[row]
                 self.sim.objects[row] = obj
                 self.sim.refresh_row(row)
+                if not self._render_identity_same(old, obj):
+                    self._drop_render_cache(row)
 
     # --------------------------------------------------------------- tick loop
 
@@ -239,66 +259,44 @@ class DeviceStagePlayer:
         self.t_device += t_dev - t0
         t_store_this = 0.0
         can_bulk = hasattr(self.store, "bulk")
-        batch_ops: List[dict] = []
-        batch_keys: List[Tuple[str, str]] = []
+        groups: List[Tuple[Tuple[str, str], List[dict]]] = []
         for tr in transitions:
             try:
-                op = self._collect_simple(tr) if can_bulk else None
-                if op is not None:
-                    key, bulk_op = op
-                    if bulk_op is not None:
-                        batch_ops.append(bulk_op)
-                        batch_keys.append(key)
+                g = self._collect_ops(tr) if can_bulk else None
+                if g is not None:
+                    key, ops = g
+                    if ops:
+                        groups.append((key, ops))
                 else:
                     self._play_transition(tr)
             except Exception:  # noqa: BLE001 — one bad row must not stop the drain
                 import traceback
 
                 traceback.print_exc()
-        if batch_ops:
+        if groups:
+            flat = [
+                {k: v for k, v in op.items() if k != "_fin"}
+                for _, ops in groups
+                for op in ops
+            ]
             tb = time.perf_counter()
             try:
-                results = self.store.bulk(batch_ops)
+                results = self.store.bulk(flat)
             except Exception:  # noqa: BLE001 — drop to per-op on bulk failure
                 results = None
             t_store_this = time.perf_counter() - tb
             if results is None:
-                for key, op in zip(batch_keys, batch_ops):
-                    try:
-                        self._apply_op_sequential(key, op)
-                    except NotFound:
-                        self._release(key)
-                    except Exception:  # noqa: BLE001 — per-op isolation,
-                        # matching the sequential path's guard
-                        import traceback
+                results = [self._op_sequential_result(op) for op in flat]
+            idx = 0
+            for key, ops in groups:
+                rs = results[idx : idx + len(ops)]
+                idx += len(ops)
+                try:
+                    self._apply_group_results(key, ops, rs)
+                except Exception:  # noqa: BLE001 — per-group isolation
+                    import traceback
 
-                        traceback.print_exc()
-            else:
-                for (key, op), res in zip(zip(batch_keys, batch_ops), results):
-                    if res.get("status") == "ok":
-                        if op["verb"] == "delete":
-                            self._finish_delete(key, res.get("object"))
-                        else:
-                            self.patches += 1
-                            self.transitions += 1
-                            obj = res.get("object")
-                            if obj is not None:
-                                self._refresh(key, obj)
-                    elif res.get("reason") == "NotFound":
-                        if op["verb"] == "delete":
-                            # already gone counts as a completed delete
-                            # transition (sequential-path parity)
-                            self._finish_delete(key, None)
-                        else:
-                            self._release(key)
-                    else:
-                        # Conflict/Invalid: surface it like the
-                        # sequential path's per-transition traceback did
-                        print(
-                            f"device bulk op failed for {key}: "
-                            f"{res.get('reason')}: {res.get('error')}",
-                            file=sys.stderr,
-                        )
+                    traceback.print_exc()
         self.t_store += t_store_this
         self.t_host += (time.perf_counter() - t_dev) - t_store_this
         if self.post_tick is not None:
@@ -329,90 +327,244 @@ class DeviceStagePlayer:
         else:
             self._refresh(key, out)
 
-    def _apply_op_sequential(self, key: Tuple[str, str], op: dict) -> None:
-        """Per-op fallback when the bulk round-trip itself failed."""
-        if op["verb"] == "delete":
-            try:
+    #: timestamp that can never occur in real renders (pre-epoch)
+    _NOW_SENTINEL = "1987-06-05T04:03:02.000001Z"
+
+    def _render(self, tr: Transition, obj: dict, effects) -> List:
+        """Template patches for a transition, through the per-row render
+        cache when sound (see _render_cache).  The gotpl render + YAML
+        parse is the host drain's hottest Python; in steady churn a row
+        re-renders the same stage with only Now changing."""
+        if self._reads_state:
+            funcs = dict(self.funcs_for(obj))
+            funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
+            return list(effects.patches(obj, funcs))
+        row_cache = self._render_cache.setdefault(tr.row, {})
+        cached = row_cache.get(tr.stage_idx)
+        if cached is None:
+            funcs = dict(self.funcs_for(obj))
+            funcs["Now"] = lambda: self._NOW_SENTINEL
+            cached = row_cache[tr.stage_idx] = list(effects.patches(obj, funcs))
+        now_s = self.sim.now_string(tr.t_ms)
+        sent = self._NOW_SENTINEL
+
+        def sub(x):
+            t = type(x)
+            if t is str:
+                return x.replace(sent, now_s) if sent in x else x
+            if t is dict:
+                return {k: sub(v) for k, v in x.items()}
+            if t is list:
+                return [sub(v) for v in x]
+            return x
+
+        from kwok_tpu.engine.lifecycle import Patch
+
+        return [
+            Patch(
+                data=sub(p.data),
+                type=p.type,
+                subresource=p.subresource,
+                impersonation=p.impersonation,
+            )
+            for p in cached
+        ]
+
+    def _drop_render_cache(self, row: int) -> None:
+        self._render_cache.pop(row, None)
+
+    def _render_identity_same(self, old: Optional[dict], new: dict) -> bool:
+        """Whether a row's cached renders survive this object change:
+        with no state read paths, renders depend only on spec, labels,
+        and annotations (name/ns/uid are immutable per row)."""
+        if self._reads_state or old is None:
+            return False
+        om = old.get("metadata") or {}
+        nm = new.get("metadata") or {}
+        return (
+            old.get("spec") == new.get("spec")
+            and om.get("labels") == nm.get("labels")
+            and om.get("annotations") == nm.get("annotations")
+        )
+
+    def _op_sequential_result(self, op: dict) -> dict:
+        """Per-op fallback when the bulk round-trip itself failed:
+        apply the op directly and shape the outcome like a bulk result
+        so the group handler stays the single accounting path."""
+        try:
+            if op["verb"] == "delete":
                 out = self.store.delete(
                     op["kind"], op["name"], namespace=op.get("namespace")
                 )
-            except NotFound:
-                out = None
-            self._finish_delete(key, out)
-            return
-        obj = self.store.patch(
-            op["kind"],
-            op["name"],
-            op["data"],
-            op.get("patch_type", "merge"),
-            namespace=op.get("namespace"),
-            subresource=op.get("subresource") or "",
-            as_user=op.get("as_user"),
-        )
-        self.patches += 1
-        self.transitions += 1
-        self._refresh(key, obj)
+            else:
+                out = self.store.patch(
+                    op["kind"],
+                    op["name"],
+                    op["data"],
+                    op.get("patch_type", "merge"),
+                    namespace=op.get("namespace"),
+                    subresource=op.get("subresource") or "",
+                    as_user=op.get("as_user"),
+                )
+            return {"status": "ok", "object": out}
+        except NotFound as exc:
+            return {"status": "error", "reason": "NotFound", "error": str(exc)}
+        except Exception as exc:  # noqa: BLE001 — shaped like bulk's guard
+            return {"status": "error", "reason": "Invalid", "error": str(exc)}
 
-    def _collect_simple(self, tr: Transition):
-        """If the transition is the batchable shape, emit its bulk op:
-        returns (key, op_or_None) — op None means a no-op patch (counted
-        as a transition, nothing to send); returns None for complex
-        transitions needing the sequential path."""
+    def _apply_group_results(
+        self, key: Tuple[str, str], ops: List[dict], results: List[dict]
+    ) -> None:
+        """Account one transition's ordered op results (bulk or the
+        sequential fallback): deletes finish the row, patch successes
+        count once per transition, the last patch result refreshes the
+        row (fast confirm when it was a lone status patch)."""
+        last_obj = None
+        last_simple = False
+        own_fin = any(op.get("_fin") for op in ops)
+        n_ok = 0
+        for op, res in zip(ops, results):
+            ok = res.get("status") == "ok"
+            if op["verb"] == "delete":
+                if ok:
+                    self._finish_delete(key, res.get("object"))
+                elif res.get("reason") == "NotFound":
+                    # already gone counts as a completed delete
+                    # transition (sequential-path parity)
+                    self._finish_delete(key, None)
+                else:
+                    print(
+                        f"device bulk delete failed for {key}: "
+                        f"{res.get('reason')}: {res.get('error')}",
+                        file=sys.stderr,
+                    )
+                return
+            if ok:
+                n_ok += 1
+                self.patches += 1
+                if res.get("object") is not None:
+                    last_obj = res["object"]
+                    last_simple = op.get("subresource") == "status"
+            elif res.get("reason") == "NotFound":
+                self._release(key)
+                return
+            else:
+                # Conflict/Invalid: surface it like the sequential
+                # path's per-transition traceback did.  Keep consuming
+                # the group — bulk already executed the later ops (its
+                # contract: per-op failures do not abort the batch), so
+                # their results must still be accounted.
+                print(
+                    f"device bulk op failed for {key}: "
+                    f"{res.get('reason')}: {res.get('error')}",
+                    file=sys.stderr,
+                )
+        if n_ok:
+            self.transitions += 1
+        if last_obj is not None:
+            # confirm_row falls back to a full refresh on any
+            # unexpected delta; our own finalizer write is expected
+            # (its effect is lowered on device)
+            self._refresh(
+                key, last_obj, simple=last_simple, own_finalizers=own_fin
+            )
+
+    def _collect_ops(self, tr: Transition):
+        """Lower a transition to an ORDERED op group for the bulk drain:
+        returns (key, [op, ...]) — empty list means pure no-op (counted
+        as a transition, nothing to send); returns None for transitions
+        that genuinely need the sequential path (a later render would
+        depend on an earlier op's server-side result).
+
+        Multi-op groups render every template patch from the SAME
+        pre-transition base; that matches the sequential path exactly
+        unless a template reads state an earlier op in the group mutates
+        (finalizers for finalizer+patch groups, any read path for
+        patch+patch groups) — those shapes stay sequential."""
         with self._mut:
             obj = self.sim.objects[tr.row]
         if obj is None:
-            return ("", ""), None
+            return ("", ""), []
         meta = obj.get("metadata") or {}
         cs = self.sim.cset.compiled[tr.stage_idx]
         effects = self.sim.cset.lifecycle.effects(cs)
         if effects is None:
-            return (self._key(obj), None)
-        if effects.finalizers_patch(meta.get("finalizers") or []):
-            return None
+            return (self._key(obj), [])
+        key = self._key(obj)
+        name = meta.get("name") or ""
+        ns = meta.get("namespace")
+        ops: List[dict] = []
+
+        fin = effects.finalizers_patch(meta.get("finalizers") or [])
+        if fin is not None:
+            if self._reads_finalizers:
+                return None  # a template depends on the finalizer write
+            ops.append(
+                {
+                    "verb": "patch",
+                    "kind": self.kind,
+                    "name": name,
+                    "namespace": ns,
+                    "data": fin.data,
+                    "patch_type": fin.type,
+                    "_fin": True,  # local marker, stripped before send
+                }
+            )
+
         if effects.delete:
-            # no finalizer change → the delete is a single op; batch it
             if tr.event is not None and self.recorder is not None:
                 self.recorder.event(
                     obj, tr.event.type or "Normal", tr.event.reason, tr.event.message
                 )
-            return (
-                self._key(obj),
+            ops.append(
                 {
                     "verb": "delete",
                     "kind": self.kind,
-                    "name": meta.get("name") or "",
-                    "namespace": meta.get("namespace"),
-                },
+                    "name": name,
+                    "namespace": ns,
+                }
             )
-        funcs = dict(self.funcs_for(obj))
-        funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
-        patches = list(effects.patches(obj, funcs))
-        if len(patches) > 1:
+            return (key, ops)
+
+        patches = [
+            p
+            for p in self._render(tr, obj, effects)
+            if not is_noop_patch(obj, p.data, p.type)
+        ]
+        if len(patches) > 1 and (
+            self._reads_state or any(p.subresource != "status" for p in patches)
+        ):
+            # multiple template patches only batch when none can read
+            # what an earlier one writes: all status-subresource writes
+            # with no state read paths.  A non-status patch could write
+            # labels/spec, which templates may read without appearing in
+            # _read_paths (the compiler excludes identity reads) — those
+            # shapes keep the sequential base-chaining path.
             return None
         if tr.event is not None and self.recorder is not None:
             self.recorder.event(
                 obj, tr.event.type or "Normal", tr.event.reason, tr.event.message
             )
-        if not patches or is_noop_patch(obj, patches[0].data, patches[0].type):
+        if not patches and not ops:
             # nothing to send — the transition is complete here; ops
             # that DO ship count only once their patch lands (parity
             # with the sequential path's post-success increment)
             self.transitions += 1
-            return (self._key(obj), None)
-        p = patches[0]
-        return (
-            self._key(obj),
-            {
-                "verb": "patch",
-                "kind": self.kind,
-                "name": meta.get("name") or "",
-                "namespace": meta.get("namespace"),
-                "data": p.data,
-                "patch_type": p.type,
-                "subresource": p.subresource,
-                "as_user": p.impersonation,
-            },
-        )
+            return (key, [])
+        for p in patches:
+            ops.append(
+                {
+                    "verb": "patch",
+                    "kind": self.kind,
+                    "name": name,
+                    "namespace": ns,
+                    "data": p.data,
+                    "patch_type": p.type,
+                    "subresource": p.subresource,
+                    "as_user": p.impersonation,
+                }
+            )
+        return (key, ops)
 
     # ----------------------------------------------------------- store effects
 
@@ -486,8 +638,15 @@ class DeviceStagePlayer:
             if row is not None:
                 self.sim.release(row)
                 self._written_rv.pop(row, None)
+                self._drop_render_cache(row)
 
-    def _refresh(self, key: Tuple[str, str], obj: dict) -> None:
+    def _refresh(
+        self,
+        key: Tuple[str, str],
+        obj: dict,
+        simple: bool = False,
+        own_finalizers: bool = False,
+    ) -> None:
         with self._mut:
             row = self._rows.get(key)
             if row is None:
@@ -495,8 +654,18 @@ class DeviceStagePlayer:
             # store reaped it (deletionTimestamp + no finalizers)?
             mm = obj.get("metadata") or {}
             self._written_rv[row] = mm.get("resourceVersion")
+            if simple and self.sim.confirm_row(
+                row, obj, ignore_finalizers=own_finalizers
+            ):
+                # our own patch echoed back unchanged elsewhere: device
+                # state already reflects it (no re-extract, no SoA
+                # re-upload)
+                return
+            old = self.sim.objects[row]
             self.sim.objects[row] = obj
             self.sim.refresh_row(row)
+            if not self._render_identity_same(old, obj):
+                self._drop_render_cache(row)
 
 
 def _rv_stale(rv, last) -> bool:

@@ -334,6 +334,46 @@ class DeviceSimulator:
         self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
         self.rematch[row] = True
 
+    def confirm_row(self, row: int, obj: dict, ignore_finalizers: bool = False) -> bool:
+        """Adopt the store's echo of OUR OWN single status-class patch
+        without re-extraction and — critically — without invalidating
+        the device SoA (a full re-upload per firing tick breaks the
+        "only dirty rows cross the boundary" contract at 1M rows).
+
+        Sound because the tick already applied this (sig, stage)'s
+        feature deltas on device, and the effect tables are derived
+        from the same host renderer (compiler docstring; parity pinned
+        by check_feature_parity tests).  Returns False — caller falls
+        back to :meth:`refresh_row` — when the echo differs anywhere
+        that feeds signature/override/deadline classification, i.e. a
+        writer interleaved with something beyond our status patch.
+        External *status* writers are not detected here; in this
+        framework status is controller-owned (the reference makes the
+        same assumption: kubelet/kwok owns status).
+
+        ``ignore_finalizers``: the caller's op group included its OWN
+        finalizer patch — finalizer effects are lowered into feature
+        columns by the compiler (finalizer columns exist and effect
+        exploration drives the same host engine), so the device already
+        reflects the change and the finalizer delta is expected."""
+        old = self.objects[row]
+        if old is None:
+            return False
+        om = old.get("metadata") or {}
+        nm = obj.get("metadata") or {}
+        if (
+            old.get("spec") != obj.get("spec")
+            or om.get("labels") != nm.get("labels")
+            or om.get("annotations") != nm.get("annotations")
+            or om.get("ownerReferences") != nm.get("ownerReferences")
+            or om.get("deletionTimestamp") != nm.get("deletionTimestamp")
+        ):
+            return False
+        if not ignore_finalizers and om.get("finalizers") != nm.get("finalizers"):
+            return False
+        self.objects[row] = obj
+        return True
+
     # ---------------------------------------------------------------- device ops
 
     def to_device(self) -> Tuple[TickParams, SoA]:

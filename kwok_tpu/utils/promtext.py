@@ -12,14 +12,28 @@ from typing import Dict, Iterator, Tuple
 __all__ = ["iter_samples"]
 
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-_UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+_ESCAPES = {"\\": "\\", '"': '"', "n": "\n"}
 
 
 def _unescape(value: str) -> str:
-    out = value
-    for k, v in _UNESCAPE.items():
-        out = out.replace(k, v)
-    return out
+    """Left-to-right escape scan — sequential whole-string replaces
+    would corrupt an escaped backslash followed by 'n' into a
+    newline."""
+    if "\\" not in value:
+        return value
+    out = []
+    i = 0
+    n = len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            out.append(_ESCAPES.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def iter_samples(text: str) -> Iterator[Tuple[str, Dict[str, str], float]]:

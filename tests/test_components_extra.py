@@ -229,3 +229,45 @@ def test_proxy_cli_serves(home):
             proxy.stop()
     finally:
         kwokctl_main(["--name", name, "delete", "cluster"])
+
+
+def test_promtext_escapes():
+    from kwok_tpu.utils.promtext import iter_samples
+
+    text = 'm{a="x,y",b="q\\"z",c="a\\\\nb",d="r\\ns"} 2.5\nplain 1\n# comment\n'
+    samples = list(iter_samples(text))
+    name, labels, val = samples[0]
+    assert name == "m" and val == 2.5
+    assert labels["a"] == "x,y"          # comma inside quotes
+    assert labels["b"] == 'q"z'          # escaped quote
+    assert labels["c"] == "a\\nb"        # escaped backslash THEN n
+    assert labels["d"] == "r\ns"         # real newline escape
+    assert samples[1] == ("plain", {}, 1.0)
+
+
+def test_etcdctl_del_bare_resource_key_is_noop(home, capsys):
+    name = "etcd2"
+    assert kwokctl_main(["--name", name, "create", "cluster", "--wait", "60"]) == 0
+    try:
+        kwokctl_main(
+            ["--name", name, "etcdctl", "put",
+             "/registry/configmaps/default/keepme", "{}"]
+        )
+        capsys.readouterr()
+        # exact-key del on a non-leaf key matches nothing (etcdctl
+        # semantics) — no silent mass delete
+        assert (
+            kwokctl_main(["--name", name, "etcdctl", "del", "/registry/configmaps"])
+            == 0
+        )
+        assert capsys.readouterr().out.strip() == "0"
+        assert (
+            kwokctl_main(
+                ["--name", name, "etcdctl", "get",
+                 "/registry/configmaps/default/keepme"]
+            )
+            == 0
+        )
+        assert "keepme" in capsys.readouterr().out
+    finally:
+        kwokctl_main(["--name", name, "delete", "cluster"])

@@ -135,6 +135,49 @@ def test_disabled_tracer_is_inert():
     assert not tr._buf
 
 
+def test_collector_coerces_malformed_spans(collector):
+    """Untrusted OTLP ingest: bad field types are coerced at ingest so
+    later query/browser GETs never crash."""
+    store, url = collector
+    payload = {
+        "resourceSpans": [
+            {
+                "resource": {"attributes": [{"key": "service.name", "value": {"stringValue": "evil"}}]},
+                "scopeSpans": [
+                    {
+                        "spans": [
+                            {
+                                "traceId": "abc",
+                                "spanId": "d",
+                                "name": 123,
+                                "startTimeUnixNano": "abc",
+                                "attributes": [{"bogus": 1}, "junk"],
+                            },
+                            "not-a-span",
+                        ]
+                    }
+                ],
+            }
+        ]
+    }
+    req = urllib.request.Request(
+        f"{url}/v1/traces",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    assert urllib.request.urlopen(req).status == 200
+    # query + browser endpoints keep working
+    traces = json.loads(urllib.request.urlopen(f"{url}/api/traces").read())["data"]
+    assert traces and traces[0]["spans"][0]["startTimeUnixNano"] == "0"
+    assert urllib.request.urlopen(f"{url}/trace/abc").status == 200
+    # bad query params answer 400, not a dropped connection
+    try:
+        urllib.request.urlopen(f"{url}/api/traces?limit=abc")
+        assert False
+    except urllib.error.HTTPError as exc:
+        assert exc.code == 400
+
+
 def test_collector_survives_garbage_and_bounds(collector):
     store, url = collector
     req = urllib.request.Request(
