@@ -42,6 +42,10 @@ DT_MS = int(os.environ.get("BENCH_DT_MS", 100))
 E2E_PODS = int(os.environ.get("BENCH_E2E_PODS", 100_000))
 E2E_TICKS = int(os.environ.get("BENCH_E2E_TICKS", 100))
 E2E_WARM_TICKS = int(os.environ.get("BENCH_E2E_WARM_TICKS", 150))
+#: wall-clock cap for each e2e phase (warm, measure): the drain is
+#: host-Python-bound, so an over-ambitious tick count must degrade to
+#: fewer ticks, not an unbounded bench run
+E2E_BUDGET_S = float(os.environ.get("BENCH_E2E_BUDGET_S", 180))
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", 5))
 INIT_RETRY_DELAY = float(os.environ.get("BENCH_INIT_RETRY_DELAY", 60))
 TARGET_TPS = 100_000.0
@@ -206,16 +210,24 @@ def run_e2e_bench() -> dict:
     player._drain_events()
     setup_s = time.time() - t_setup0
 
+    warm_deadline = time.time() + E2E_BUDGET_S
     for _ in range(E2E_WARM_TICKS):
+        if time.time() >= warm_deadline:
+            break
         player._drain_events()
         player.step(DT_MS)
 
     tr0, p0 = player.transitions, player.patches
     d0, s0, h0 = player.t_device, player.t_store, player.t_host
     t0 = time.time()
+    measured_ticks = 0
+    deadline = t0 + E2E_BUDGET_S
     for _ in range(E2E_TICKS):
+        if measured_ticks and time.time() >= deadline:
+            break
         player._drain_events()
         player.step(DT_MS)
+        measured_ticks += 1
     wall = time.time() - t0
     player._done.set()
 
@@ -230,6 +242,7 @@ def run_e2e_bench() -> dict:
         "transitions_per_sec": round((player.transitions - tr0) / wall),
         "dirty_rows_per_sec": round((player.patches - p0) / wall),
         "setup_s": round(setup_s, 1),
+        "measured_ticks": measured_ticks,
         "bottleneck": bottleneck,
         "breakdown_s": breakdown,
     }
