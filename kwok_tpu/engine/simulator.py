@@ -207,6 +207,9 @@ class DeviceSimulator:
         """Add an object; returns its row index. Reuses released rows;
         grows the SoA (2x, device re-upload) when full."""
         obj = to_json_standard(obj)
+        # pull device progress BEFORE writing host rows — with the lazy
+        # host mirror, a later sync would clobber these writes
+        self._invalidate_device()
         if self._free:
             row = self._free.pop()
         else:
@@ -249,7 +252,8 @@ class DeviceSimulator:
         return range(start, start + count)
 
     def _finish_admit(self, row: int, obj: dict) -> None:
-        self._invalidate_device()
+        # caller (admit) already invalidated BEFORE the sig/ovc/features
+        # row writes — the required ordering lives there, not here
         self.objects[row] = obj
         self.active[row] = True
         self.rematch[row] = True
@@ -450,14 +454,17 @@ class DeviceSimulator:
                 transitions.append(tr)
                 if materialize:
                     self.materialize(tr)
-        # Host mirror of device row state is pulled lazily: when nothing
-        # fired and no uploaded rematch flags were pending, the device
-        # changed nothing but now/key, so the host arrays stay valid
-        # ("only dirty rows come back").
+        # Host mirror of device row state is pulled LAZILY: a firing
+        # tick only marks it stale; the actual device->host download of
+        # the full SoA happens on the next host mutation
+        # (_invalidate_device before admit/refresh/release/rebase).
+        # Steady-state churn with the confirm_row drain therefore moves
+        # only the small per-tick output arrays across the boundary —
+        # "only dirty rows come back" at 1M rows means NOT shipping a
+        # [N, C] features download every tick.
         if transitions or self._rematch_pending:
             self._host_synced = False
             self._rematch_pending = False
-            self._ensure_synced()
         return transitions
 
     def _rebase(self) -> None:
