@@ -316,6 +316,12 @@ def main(argv=None) -> int:
                 platforms=plat,
                 error=str(exc),
             )
+    # NOTE: kwok daemons deliberately do NOT auto-join a jax.distributed
+    # world: each daemon runs an independent tick loop, and asymmetric
+    # programs across a shared collective world deadlock.  Multi-host
+    # daemons shard by lease ownership on process-local meshes
+    # (parallel/distributed.py docstring); cross-host global-mesh
+    # compute is for symmetric SPMD workers (tests/distributed_worker.py).
     docs = load_config_docs(args.config)
     if args.enable_metrics_usage:
         from kwok_tpu.stages import METRICS_USAGE, load_builtin_docs

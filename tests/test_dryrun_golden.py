@@ -76,3 +76,12 @@ def test_create_cluster_secure_device_golden(home):
 def test_delete_cluster_golden(home):
     got = run_dry(home, ["--name", "golden", "--dry-run", "delete", "cluster"])
     check_golden("delete_cluster.txt", got)
+
+
+def test_create_cluster_tracing_golden(home):
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "cluster",
+         "--enable-tracing"],
+    )
+    check_golden("create_cluster_tracing.txt", got)
