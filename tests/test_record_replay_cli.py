@@ -28,7 +28,7 @@ def test_record_then_replay_across_clusters(home):
             target=kwokctl_main,
             args=(
                 ["--name", "src", "snapshot", "record", "--path", rec_path,
-                 "--duration", "6"],
+                 "--duration", "10"],
             ),
         )
         rec_thread.start()
@@ -38,7 +38,10 @@ def test_record_then_replay_across_clusters(home):
             ["--name", "src", "scale", "pod", "--replicas", "3",
              "--param", ".nodeName=node-0"]
         ) == 0
-        rec_thread.join(timeout=30)
+        # the mutations must land inside the recording window even on a
+        # loaded machine — the scales above are synchronous, so only
+        # the watch->recorder hop remains; the generous duration covers it
+        rec_thread.join(timeout=40)
         assert not rec_thread.is_alive()
 
         docs = [d for d in yaml.safe_load_all(open(rec_path)) if d]
