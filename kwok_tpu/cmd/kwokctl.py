@@ -402,6 +402,247 @@ def cmd_snapshot_replay(args) -> int:
     return 0
 
 
+def _apiserver_endpoint(rt):
+    """(host, port, ssl_context) for speaking WebSocket to the cluster
+    apiserver (the exec/attach/port-forward subresources tunnel to the
+    kubelet there)."""
+    conf = rt.load_config()
+    url = conf["serverURL"]
+    hostport = url.split("://", 1)[1]
+    host, _, port = hostport.partition(":")
+    ctx = None
+    if conf.get("secure"):
+        import ssl as _ssl
+
+        pki = os.path.join(rt.workdir, "pki")
+        ctx = _ssl.create_default_context(cafile=os.path.join(pki, "ca.crt"))
+        ctx.load_cert_chain(
+            os.path.join(pki, "admin.crt"), os.path.join(pki, "admin.key")
+        )
+    return host, int(port), ctx
+
+
+def _parse_exec_remainder(args) -> list:
+    """Split argparse.REMAINDER into (misplaced flags, remote command).
+    kubectl accepts ``exec POD -n foo -c app -- CMD``; REMAINDER
+    swallows everything after POD, so flags before the ``--`` are
+    re-parsed here instead of being shipped as the remote command."""
+    raw = list(args.command or [])
+    if "--" in raw:
+        idx = raw.index("--")
+        pre, cmd = raw[:idx], raw[idx + 1 :]
+    else:
+        pre, cmd = [], raw
+    if pre:
+        mini = argparse.ArgumentParser(prog="kubectl exec", add_help=False)
+        mini.add_argument("-n", "--namespace", default=args.namespace)
+        mini.add_argument("-c", "--container", default=args.container)
+        mini.add_argument("-i", "--stdin", action="store_true", default=args.stdin)
+        parsed, leftover = mini.parse_known_args(pre)
+        if leftover:
+            raise SystemExit(
+                f"unrecognized arguments before '--': {' '.join(leftover)}"
+            )
+        args.namespace = parsed.namespace
+        args.container = parsed.container
+        args.stdin = parsed.stdin
+    return cmd
+
+
+def cmd_kubectl_exec(args) -> int:
+    """``kwokctl kubectl exec POD [-c C] [-i] -- CMD...`` over the
+    WebSocket channel protocol, via the apiserver subresource tunnel
+    (the kubectl exec wire path; reference e2e test/e2e/cases.go)."""
+    from urllib.parse import urlencode
+
+    from kwok_tpu.utils.wsclient import exec_stream
+
+    rt = _require_cluster(args)
+    cmd = _parse_exec_remainder(args)
+    if not cmd:
+        print("no command given (use: ... exec POD -- CMD)", file=sys.stderr)
+        return 2
+    host, port, ctx = _apiserver_endpoint(rt)
+    q = [("command", c) for c in cmd] + [("output", "1"), ("error", "1")]
+    if args.container:
+        q.append(("container", args.container))
+    stdin = None
+    if args.stdin:
+        q.append(("input", "1"))
+        stdin = sys.stdin.buffer.read()
+    path = (
+        f"/api/v1/namespaces/{args.namespace}/pods/{args.object_name}/exec?"
+        + urlencode(q)
+    )
+    try:
+        rc, status = exec_stream(
+            host,
+            port,
+            path,
+            stdin=stdin,
+            on_stdout=lambda d: (sys.stdout.buffer.write(d), sys.stdout.buffer.flush()),
+            on_stderr=lambda d: (sys.stderr.buffer.write(d), sys.stderr.buffer.flush()),
+            ssl_context=ctx,
+        )
+    except (ConnectionError, OSError) as exc:
+        print(_ws_error_line(exc), file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130
+    if rc and status.get("message"):
+        print(status["message"], file=sys.stderr)
+    return rc
+
+
+def _ws_error_line(exc: Exception) -> str:
+    """One-line error out of a failed WS dial/handshake (the exception
+    carries 'HTTP/1.1 NNN ...: {Status json}' on rejections)."""
+    text = str(exc)
+    if "{" in text:
+        try:
+            msg = json.loads(text[text.index("{") :]).get("message")
+            if msg:
+                return f"error: {msg}"
+        except (ValueError, AttributeError):
+            pass
+    return f"error: {text.splitlines()[0] if text else exc.__class__.__name__}"
+
+
+def cmd_kubectl_attach(args) -> int:
+    """``kwokctl kubectl attach POD [-c C]`` — stream the configured
+    attach log over the WebSocket channel protocol until EOF/Ctrl-C."""
+    from urllib.parse import urlencode
+
+    from kwok_tpu.utils.wsclient import REMOTE_COMMAND_PROTOCOLS, WSClient
+
+    rt = _require_cluster(args)
+    host, port, ctx = _apiserver_endpoint(rt)
+    q = [("output", "1")]
+    if args.container:
+        q.append(("container", args.container))
+    path = (
+        f"/api/v1/namespaces/{args.namespace}/pods/{args.object_name}/attach?"
+        + urlencode(q)
+    )
+    from kwok_tpu.utils.wsclient import CHAN_ERROR, CHAN_STDOUT
+
+    try:
+        c = WSClient(host, port, path, REMOTE_COMMAND_PROTOCOLS, ssl_context=ctx)
+    except (ConnectionError, OSError) as exc:
+        print(_ws_error_line(exc), file=sys.stderr)
+        return 1
+    try:
+        while True:
+            msg = c.recv()
+            if msg is None:
+                break
+            _, payload = msg
+            if payload and payload[0] == CHAN_STDOUT:
+                sys.stdout.buffer.write(payload[1:])
+                sys.stdout.buffer.flush()
+            elif payload and payload[0] == CHAN_ERROR:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        c.close()
+    return 0
+
+
+def cmd_kubectl_port_forward(args) -> int:
+    """``kwokctl kubectl port-forward POD LOCAL:REMOTE`` — listen
+    locally, relay each connection over a portforward.k8s.io WebSocket
+    through the apiserver tunnel."""
+    import socket as _socket
+    import threading as _threading
+
+    from kwok_tpu.utils.wsclient import PORT_FORWARD_PROTOCOLS, WSClient
+
+    rt = _require_cluster(args)
+    local_s, _, remote_s = args.mapping.partition(":")
+    # kubectl forms: "8080" (same both sides), "8080:80", ":80"
+    # (ephemeral local port — the bound port is printed)
+    local = int(local_s) if local_s else 0
+    remote = int(remote_s or local_s)
+    host, port, ctx = _apiserver_endpoint(rt)
+    path = (
+        f"/api/v1/namespaces/{args.namespace}/pods/{args.object_name}"
+        f"/portforward?ports={remote}"
+    )
+
+    def handle(conn):
+        try:
+            ws = WSClient(host, port, path, PORT_FORWARD_PROTOCOLS, ssl_context=ctx)
+        except (OSError, ConnectionError) as exc:
+            print(_ws_error_line(exc), file=sys.stderr)
+            conn.close()
+            return
+        try:
+            for _ in range(2):  # initial port announcements
+                ws.recv()
+
+            def pump_ws_to_sock():
+                while True:
+                    msg = ws.recv()
+                    if msg is None:
+                        break
+                    _, payload = msg
+                    if not payload:
+                        continue
+                    if payload[0] == 0:  # data channel for port 0
+                        try:
+                            conn.sendall(payload[1:])
+                        except OSError:
+                            break
+                    elif payload[0] == 1 and payload[1:]:
+                        # error channel: e.g. target dial failure — tell
+                        # the operator and drop the local connection
+                        # instead of hanging it silently
+                        print(
+                            "port-forward error: "
+                            + payload[1:].decode(errors="replace"),
+                            file=sys.stderr,
+                        )
+                        break
+                try:
+                    conn.shutdown(_socket.SHUT_WR)
+                except OSError:
+                    pass
+
+            t = _threading.Thread(target=pump_ws_to_sock, daemon=True)
+            t.start()
+            while True:
+                data = conn.recv(65536)
+                if not data:
+                    break
+                ws.send_channel(0, data)
+        except OSError:
+            pass
+        finally:
+            ws.close()
+            conn.close()
+
+    srv = _socket.socket()
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind((args.address, local))
+    srv.listen(16)
+    bound = srv.getsockname()[1]
+    print(f"Forwarding from {args.address}:{bound} -> {remote}", flush=True)
+    try:
+        if args.once:
+            conn, _ = srv.accept()
+            handle(conn)
+        else:
+            while True:
+                conn, _ = srv.accept()
+                _threading.Thread(target=handle, args=(conn,), daemon=True).start()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
 def cmd_proxy(args) -> int:
     """Localhost no-auth relay to the apiserver — the kubectl-proxy
     component seat (reference components/kubectl_proxy.go)."""
@@ -943,6 +1184,27 @@ def build_parser() -> argparse.ArgumentParser:
     kt.add_argument("--window", type=float, default=1.0,
                     help="rate window in seconds for CPU")
     kt.set_defaults(fn=cmd_kubectl_top)
+    ke = pks.add_parser("exec")
+    ke.add_argument("object_name")
+    ke.add_argument("-n", "--namespace", default="default")
+    ke.add_argument("-c", "--container", default="")
+    ke.add_argument("-i", "--stdin", action="store_true",
+                    help="pipe this process's stdin to the command")
+    ke.add_argument("command", nargs=argparse.REMAINDER)
+    ke.set_defaults(fn=cmd_kubectl_exec)
+    kat = pks.add_parser("attach")
+    kat.add_argument("object_name")
+    kat.add_argument("-n", "--namespace", default="default")
+    kat.add_argument("-c", "--container", default="")
+    kat.set_defaults(fn=cmd_kubectl_attach)
+    kpf = pks.add_parser("port-forward")
+    kpf.add_argument("object_name")
+    kpf.add_argument("mapping", help="LOCAL:REMOTE (or just PORT)")
+    kpf.add_argument("-n", "--namespace", default="default")
+    kpf.add_argument("--address", default="127.0.0.1")
+    kpf.add_argument("--once", action="store_true",
+                     help="serve a single connection, then exit")
+    kpf.set_defaults(fn=cmd_kubectl_port_forward)
 
     return p
 

@@ -1,0 +1,244 @@
+"""WebSocket client for the Kubernetes channel protocols.
+
+The client half of server/websocket.py: speaks RFC 6455 with masked
+frames plus the k8s conventions — remote-command channels
+(``v4/v5.channel.k8s.io``: 0 stdin, 1 stdout, 2 stderr, 3 status
+trailer) and per-port port-forward channels
+(``portforward.k8s.io``).  Used by ``kwokctl kubectl
+exec/attach/port-forward`` (the kubectl seat; reference e2e exercises
+the same flows, test/e2e/cases.go) and by the protocol tests.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+# one source of truth for the protocol vocabulary — the server half
+# (kwok_tpu/server/websocket.py) defines it; drifting copies would
+# break negotiation silently
+from kwok_tpu.server.websocket import (
+    CHAN_ERROR,
+    CHAN_STDERR,
+    CHAN_STDIN,
+    CHAN_STDOUT,
+    PORT_FORWARD_PROTOCOLS,
+    REMOTE_COMMAND_PROTOCOLS,
+    _GUID,
+)
+
+__all__ = [
+    "WSClient",
+    "exec_stream",
+    "REMOTE_COMMAND_PROTOCOLS",
+    "PORT_FORWARD_PROTOCOLS",
+    "CHAN_STDIN",
+    "CHAN_STDOUT",
+    "CHAN_STDERR",
+    "CHAN_ERROR",
+]
+
+
+class WSClient:
+    """One upgraded connection (client side, masked frames)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        path: str,
+        protocols: List[str],
+        timeout: float = 30.0,
+        ssl_context=None,
+    ):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        if ssl_context is not None:
+            self.sock = ssl_context.wrap_socket(self.sock, server_hostname=host)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET {path} HTTP/1.1\r\n"
+            f"Host: {host}:{port}\r\n"
+            "Upgrade: websocket\r\n"
+            "Connection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n"
+            f"Sec-WebSocket-Protocol: {', '.join(protocols)}\r\n"
+            "\r\n"
+        )
+        self.sock.sendall(req.encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self.sock.recv(4096)
+            if not chunk:
+                raise ConnectionError(f"no handshake response: {buf!r}")
+            buf += chunk
+        head, _, rest = buf.partition(b"\r\n\r\n")
+        self.handshake = head.decode(errors="replace")
+        self._buf = rest
+        status = self.handshake.split("\r\n")[0]
+        if "101" not in status:
+            # drain the rejection body (a k8s Status JSON) so callers
+            # can show its message instead of a raw header dump
+            import re
+
+            m = re.search(r"content-length:\s*(\d+)", self.handshake, re.I)
+            body = self._buf
+            if m:
+                want = int(m.group(1))
+                while len(body) < want:
+                    try:
+                        chunk = self.sock.recv(4096)
+                    except OSError:
+                        break
+                    if not chunk:
+                        break
+                    body += chunk
+            self.sock.close()
+            raise ConnectionError(
+                f"{status}: {body.decode(errors='replace')}".strip(": ")
+            )
+        accept = base64.b64encode(
+            hashlib.sha1((key + _GUID).encode()).digest()
+        ).decode()
+        if accept not in self.handshake:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self.protocol: Optional[str] = next(
+            (
+                line.split(":", 1)[1].strip()
+                for line in self.handshake.split("\r\n")
+                if line.lower().startswith("sec-websocket-protocol:")
+            ),
+            None,
+        )
+
+    # ------------------------------------------------------------------ recv
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        while len(self._buf) < n:
+            try:
+                chunk = self.sock.recv(65536)
+            except (OSError, ValueError):
+                # socket closed (possibly by another thread's close())
+                return None
+            if not chunk:
+                return None
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def recv(self) -> Optional[Tuple[int, bytes]]:
+        """Next (opcode, payload); None on close/EOF; answers pings."""
+        while True:
+            head = self._read_exact(2)
+            if head is None:
+                return None
+            opcode = head[0] & 0x0F
+            n = head[1] & 0x7F
+            if n == 126:
+                ext = self._read_exact(2)
+                if ext is None:
+                    return None
+                n = struct.unpack(">H", ext)[0]
+            elif n == 127:
+                ext = self._read_exact(8)
+                if ext is None:
+                    return None
+                n = struct.unpack(">Q", ext)[0]
+            payload = self._read_exact(n) if n else b""
+            if payload is None:
+                return None
+            if opcode == 0x8:  # close
+                return None
+            if opcode == 0x9:  # ping
+                self.send(payload, opcode=0xA)
+                continue
+            if opcode == 0xA:  # pong
+                continue
+            return opcode, payload
+
+    # ------------------------------------------------------------------ send
+
+    def send(self, payload: bytes, opcode: int = 0x2) -> None:
+        mask = os.urandom(4)
+        head = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        elif n < 2**16:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + masked)
+
+    def send_channel(self, channel: int, data: bytes = b"") -> None:
+        self.send(bytes([channel]) + data)
+
+    def close(self) -> None:
+        try:
+            self.send(struct.pack(">H", 1000), opcode=0x8)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def exec_stream(
+    host: str,
+    port: int,
+    path: str,
+    stdin: Optional[bytes] = None,
+    on_stdout=None,
+    on_stderr=None,
+    ssl_context=None,
+) -> Tuple[int, dict]:
+    """Run a remote-command stream to completion: returns (exit_code,
+    status_dict).  Exit code decodes the NonZeroExitCode Status trailer
+    the way kubectl does."""
+    c = WSClient(
+        host, port, path, REMOTE_COMMAND_PROTOCOLS, ssl_context=ssl_context
+    )
+    status: dict = {}
+    try:
+        if stdin is not None:
+            c.send_channel(CHAN_STDIN, stdin)
+            if c.protocol == "v5.channel.k8s.io":
+                c.send_channel(255, bytes([0]))  # close stdin
+        while True:
+            msg = c.recv()
+            if msg is None:
+                break
+            _, payload = msg
+            if not payload:
+                continue
+            channel, data = payload[0], payload[1:]
+            if channel == CHAN_STDOUT and on_stdout:
+                on_stdout(data)
+            elif channel == CHAN_STDERR and on_stderr:
+                on_stderr(data)
+            elif channel == CHAN_ERROR:
+                try:
+                    status = json.loads(data)
+                except ValueError:
+                    status = {
+                        "status": "Failure",
+                        "message": data.decode(errors="replace"),
+                    }
+    finally:
+        c.close()
+    if status.get("status") == "Success":
+        return 0, status
+    for cause in ((status.get("details") or {}).get("causes")) or []:
+        if cause.get("reason") == "ExitCode":
+            try:
+                return int(cause.get("message") or 1), status
+            except ValueError:
+                break
+    return 1, status

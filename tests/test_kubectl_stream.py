@@ -1,0 +1,240 @@
+"""kwokctl kubectl exec/attach/port-forward — the kubectl seat for the
+streaming debug endpoints, end to end through a real cluster:
+CLI → apiserver subresource tunnel → kubelet WebSocket handlers
+(reference e2e exercises the same flows, test/e2e/cases.go exec/attach/
+port_forward)."""
+
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+import yaml
+
+from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Echo(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                data = self.request.recv(65536)
+                if not data:
+                    break
+                self.request.sendall(b"echo:" + data)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    home = tmp_path_factory.mktemp("home")
+    os.environ["KWOK_TPU_HOME"] = str(home)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    echo = _Echo(("127.0.0.1", 0), _Echo.Handler)
+    threading.Thread(target=echo.serve_forever, daemon=True).start()
+    echo_port = echo.server_address[1]
+
+    logf = home / "attach.log"
+    logf.write_text("attach says hi\n")
+    cfg = home / "stream-config.yaml"
+    docs = [
+        {
+            "apiVersion": "kwok.x-k8s.io/v1alpha1",
+            "kind": "ClusterExec",
+            "metadata": {"name": "all"},
+            "spec": {"execs": [{"local": {}}]},
+        },
+        {
+            "apiVersion": "kwok.x-k8s.io/v1alpha1",
+            "kind": "ClusterAttach",
+            "metadata": {"name": "all"},
+            "spec": {"attaches": [{"logsFile": str(logf)}]},
+        },
+        {
+            "apiVersion": "kwok.x-k8s.io/v1alpha1",
+            "kind": "ClusterPortForward",
+            "metadata": {"name": "all"},
+            "spec": {
+                "forwards": [
+                    {"target": {"port": echo_port, "address": "127.0.0.1"}}
+                ]
+            },
+        },
+    ]
+    cfg.write_text(yaml.safe_dump_all(docs))
+
+    name = "stream"
+    assert (
+        kwokctl_main(
+            ["--name", name, "create", "cluster", "--config", str(cfg), "--wait", "60"]
+        )
+        == 0
+    )
+    assert kwokctl_main(["--name", name, "scale", "node", "--replicas", "1"]) == 0
+    assert kwokctl_main(["--name", name, "scale", "pod", "--replicas", "1"]) == 0
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    client = BinaryRuntime(name).client()
+    # wait for Running: proves the kwok daemon (and its kubelet server,
+    # the tunnel's far end) is fully up, not just the apiserver
+    deadline = time.monotonic() + 90
+    pods = []
+    while time.monotonic() < deadline:
+        pods, _ = client.list("Pod")
+        if pods and all(
+            (p.get("status") or {}).get("phase") == "Running" for p in pods
+        ):
+            break
+        time.sleep(0.3)
+    assert pods and all(
+        (p.get("status") or {}).get("phase") == "Running" for p in pods
+    ), "pod never reached Running"
+    yield name, str(home)
+    kwokctl_main(["--name", name, "delete", "cluster"])
+    echo.shutdown()
+    echo.server_close()
+
+
+def run_cli(home, args, stdin=None, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.cmd.kwokctl", *args],
+        input=stdin,
+        capture_output=True,
+        timeout=timeout,
+        env={
+            **os.environ,
+            "KWOK_TPU_HOME": home,
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+
+
+def test_kubectl_exec_stdout_and_exit_code(cluster):
+    name, home = cluster
+    out = run_cli(
+        home,
+        ["--name", name, "kubectl", "exec", "pod-0", "--",
+         "sh", "-c", "echo from-exec; echo on-err >&2"],
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout == b"from-exec\n"
+    assert b"on-err" in out.stderr
+
+    out = run_cli(
+        home,
+        ["--name", name, "kubectl", "exec", "pod-0", "--", "sh", "-c", "exit 7"],
+    )
+    assert out.returncode == 7
+
+
+def test_kubectl_exec_stdin(cluster):
+    name, home = cluster
+    out = run_cli(
+        home,
+        ["--name", name, "kubectl", "exec", "-i", "pod-0", "--", "cat"],
+        stdin=b"piped through ws\n",
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout == b"piped through ws\n"
+
+
+def test_kubectl_attach_streams(cluster):
+    name, home = cluster
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kwok_tpu.cmd.kwokctl",
+         "--name", name, "kubectl", "attach", "pod-0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        env={
+            **os.environ,
+            "KWOK_TPU_HOME": home,
+            "PYTHONPATH": REPO,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    try:
+        got = b""
+        deadline = time.monotonic() + 30
+        while b"attach says hi" not in got and time.monotonic() < deadline:
+            got += proc.stdout.read1(4096) or b""
+        assert b"attach says hi" in got
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_kubectl_port_forward_once(cluster):
+    name, home = cluster
+    # free local port
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        local = s.getsockname()[1]
+
+    class Args:
+        pass
+
+    rc = []
+    t = threading.Thread(
+        target=lambda: rc.append(
+            kwokctl_main(
+                ["--name", name, "kubectl", "port-forward", "pod-0",
+                 f"{local}:9090", "--once"]
+            )
+        ),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.monotonic() + 20
+    conn = None
+    while conn is None and time.monotonic() < deadline:
+        try:
+            conn = socket.create_connection(("127.0.0.1", local), timeout=1)
+        except OSError:
+            time.sleep(0.2)
+    assert conn is not None, "local forward port never opened"
+    try:
+        conn.sendall(b"ping")
+        got = b""
+        conn.settimeout(15)
+        while b"echo:ping" not in got:
+            chunk = conn.recv(4096)
+            assert chunk, got
+            got += chunk
+    finally:
+        conn.close()
+    t.join(timeout=20)
+    assert rc == [0]
+
+
+def test_kubectl_exec_flags_after_pod_name(cluster):
+    """kubectl accepts flags between POD and '--'; REMAINDER must not
+    ship them as the remote command."""
+    name, home = cluster
+    out = run_cli(
+        home,
+        ["--name", name, "kubectl", "exec", "pod-0", "-n", "default",
+         "--", "sh", "-c", "echo flagged"],
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout == b"flagged\n"
+
+
+def test_kubectl_exec_missing_pod_prints_one_line_error(cluster):
+    name, home = cluster
+    out = run_cli(
+        home,
+        ["--name", name, "kubectl", "exec", "no-such-pod", "--", "ls"],
+    )
+    assert out.returncode == 1
+    assert out.stderr.startswith(b"error: ")
+    assert b"Traceback" not in out.stderr
+    assert b"no-such-pod" in out.stderr or b"not found" in out.stderr

@@ -6,11 +6,7 @@ A from-scratch masked-frame client below exercises the exact wire
 format, including the apiserver→kubelet tunnel for
 ``kubectl exec`` through ``/api/v1/.../pods/{name}/exec``."""
 
-import base64
-import hashlib
 import json
-import os
-import socket
 import socketserver
 import struct
 import threading
@@ -29,100 +25,9 @@ PODS = [
 ]
 
 
-class WSClient:
-    """Masked-frame RFC 6455 client, enough to speak the k8s channel
-    protocols the way kubectl's tunneling transport does."""
-
-    def __init__(self, host, port, path, protocols):
-        self.sock = socket.create_connection((host, port), timeout=15)
-        key = base64.b64encode(os.urandom(16)).decode()
-        req = (
-            f"GET {path} HTTP/1.1\r\n"
-            f"Host: {host}:{port}\r\n"
-            "Upgrade: websocket\r\n"
-            "Connection: Upgrade\r\n"
-            f"Sec-WebSocket-Key: {key}\r\n"
-            "Sec-WebSocket-Version: 13\r\n"
-            f"Sec-WebSocket-Protocol: {', '.join(protocols)}\r\n"
-            "\r\n"
-        )
-        self.sock.sendall(req.encode())
-        # read the 101 response headers
-        buf = b""
-        while b"\r\n\r\n" not in buf:
-            chunk = self.sock.recv(4096)
-            if not chunk:
-                raise ConnectionError(f"no handshake response: {buf!r}")
-            buf += chunk
-        head, _, rest = buf.partition(b"\r\n\r\n")
-        self.handshake = head.decode()
-        self._buf = rest
-        status = self.handshake.split("\r\n")[0]
-        if "101" not in status:
-            raise ConnectionError(self.handshake)
-        accept = hashlib.sha1(
-            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
-        ).digest()
-        assert base64.b64encode(accept).decode() in self.handshake
-        self.protocol = next(
-            (
-                line.split(":", 1)[1].strip()
-                for line in self.handshake.split("\r\n")
-                if line.lower().startswith("sec-websocket-protocol:")
-            ),
-            None,
-        )
-
-    def _read_exact(self, n):
-        while len(self._buf) < n:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                return None
-            self._buf += chunk
-        out, self._buf = self._buf[:n], self._buf[n:]
-        return out
-
-    def send(self, payload: bytes, opcode=0x2):
-        mask = os.urandom(4)
-        head = bytes([0x80 | opcode])
-        n = len(payload)
-        if n < 126:
-            head += bytes([0x80 | n])
-        elif n < 2**16:
-            head += bytes([0x80 | 126]) + struct.pack(">H", n)
-        else:
-            head += bytes([0x80 | 127]) + struct.pack(">Q", n)
-        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
-        self.sock.sendall(head + mask + masked)
-
-    def send_channel(self, channel: int, data: bytes = b""):
-        self.send(bytes([channel]) + data)
-
-    def recv(self):
-        """Next (opcode, payload) message, or None on close/EOF."""
-        while True:
-            head = self._read_exact(2)
-            if head is None:
-                return None
-            opcode = head[0] & 0x0F
-            n = head[1] & 0x7F
-            if n == 126:
-                n = struct.unpack(">H", self._read_exact(2))[0]
-            elif n == 127:
-                n = struct.unpack(">Q", self._read_exact(8))[0]
-            payload = self._read_exact(n) if n else b""
-            if opcode == 0x8:  # close
-                return None
-            if opcode in (0x9, 0xA):  # ping/pong
-                continue
-            return opcode, payload
-
-    def close(self):
-        try:
-            self.send(struct.pack(">H", 1000), opcode=0x8)
-        except OSError:
-            pass
-        self.sock.close()
+# the package's kubectl-transport client IS the protocol test client —
+# one implementation, exercised from both ends
+from kwok_tpu.utils.wsclient import WSClient  # noqa: E402
 
 
 def collect_channels(client):
