@@ -308,3 +308,65 @@ def test_exotic_stage_demotes_kind_to_host():
         )
     finally:
         ctr.stop()
+
+
+def test_custom_cr_kind_on_device_backend():
+    """Generic kinds (the StageController seat) also lower to the
+    device path: a Widget stage set compiles, the kind gets a device
+    player, and status converges through the batched drain."""
+    from kwok_tpu.api.loader import load_stages
+    from kwok_tpu.cluster.store import ResourceType
+
+    store = ResourceStore()
+    store.register_type(ResourceType("example.com/v1", "Widget", "widgets"))
+    stages = load_stages(
+        """
+apiVersion: kwok.x-k8s.io/v1alpha1
+kind: Stage
+metadata:
+  name: widget-ready
+spec:
+  resourceRef:
+    apiGroup: example.com/v1
+    kind: Widget
+  selector:
+    matchExpressions:
+      - key: .status.phase
+        operator: DoesNotExist
+  next:
+    statusTemplate: |
+      phase: Ready
+"""
+    )
+    ctr = Controller(
+        store,
+        KwokConfiguration(
+            manage_all_nodes=True,
+            backend="device",
+            device_tick_ms=20,
+            node_lease_duration_seconds=0,
+        ),
+        local_stages={"Widget": stages},
+        seed=0,
+    )
+    ctr.start()
+    try:
+        assert "Widget" in ctr.device_players, "widget stages should lower"
+        for i in range(5):
+            store.create(
+                {
+                    "apiVersion": "example.com/v1",
+                    "kind": "Widget",
+                    "metadata": {"name": f"w{i}"},
+                }
+            )
+        assert wait_for(
+            lambda: all(
+                (store.get("Widget", f"w{i}").get("status") or {}).get("phase")
+                == "Ready"
+                for i in range(5)
+            ),
+            timeout=15.0,
+        )
+    finally:
+        ctr.stop()
