@@ -34,7 +34,14 @@ from kwok_tpu.engine.compiler import (
     StageCompileError,
 )
 from kwok_tpu.engine.lifecycle import to_json_standard
-from kwok_tpu.ops.tick import SoA, TickParams, params_from_compiled, tick
+from kwok_tpu.ops.tick import (
+    SoA,
+    TickParams,
+    params_from_compiled,
+    run_ticks_collect,
+    scatter_rows,
+    tick,
+)
 from kwok_tpu.utils.patch import apply_patch
 
 DEFAULT_EPOCH = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
@@ -162,6 +169,13 @@ class DeviceSimulator:
         self._dev_key = None  # preserved PRNG state across re-uploads
         self._rematch_pending = False
         self._host_synced = True
+        #: host mirror of the device virtual clock — ticks advance it
+        #: deterministically, so reading now_ms never costs a device
+        #: round-trip (the tunnel TPU makes every blocking read ~RTT)
+        self._now_host = 0
+        #: rows mutated on host since the last device upload; flushed as
+        #: one scatter_rows call instead of a full SoA re-upload
+        self._pending: set = set()
 
     # ------------------------------------------------------------------ host ops
 
@@ -205,11 +219,11 @@ class DeviceSimulator:
 
     def admit(self, obj: dict) -> int:
         """Add an object; returns its row index. Reuses released rows;
-        grows the SoA (2x, device re-upload) when full."""
+        grows the SoA (2x, device re-upload) when full. The row's new
+        host values reach the device as part of the next tick's batched
+        scatter (see _flush_pending) — no full re-upload."""
         obj = to_json_standard(obj)
-        # pull device progress BEFORE writing host rows — with the lazy
-        # host mirror, a later sync would clobber these writes
-        self._invalidate_device()
+        self._pre_mutate()
         if self._free:
             row = self._free.pop()
         else:
@@ -221,7 +235,10 @@ class DeviceSimulator:
         self.sig[row] = sig
         self.ovc[row] = ovc
         self.features[row] = feats
+        self.stage[row] = IDLE
+        self.fire_at[row] = NEVER
         self._finish_admit(row, obj)
+        self._mark_pending(row)
         return row
 
     def admit_bulk(self, obj: dict, count: int) -> range:
@@ -237,7 +254,10 @@ class DeviceSimulator:
         obj = to_json_standard(obj)
         start = self.num_rows
         self.ensure_capacity(start + count)
-        self._invalidate_device()
+        if self._soa is not None:
+            # bulk admits are setup-path; a full re-upload beats a
+            # giant scatter here
+            self._invalidate_device()
         sl = slice(start, start + count)
         self.sig[sl] = self.cset.signature_for(obj)
         self.ovc[sl] = self.cset.override_class_for(obj)
@@ -252,12 +272,56 @@ class DeviceSimulator:
         return range(start, start + count)
 
     def _finish_admit(self, row: int, obj: dict) -> None:
-        # caller (admit) already invalidated BEFORE the sig/ovc/features
-        # row writes — the required ordering lives there, not here
         self.objects[row] = obj
         self.active[row] = True
         self.rematch[row] = True
         self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
+
+    def _pre_mutate(self) -> None:
+        """Mesh path only: pull device progress BEFORE host row writes
+        (the full re-upload on next to_device would otherwise clobber
+        them on sync).  The single-device path instead scatters the
+        touched rows after the writes (_mark_pending)."""
+        if self._soa is not None and self.mesh is not None:
+            self._invalidate_device()
+
+    def _mark_pending(self, row: int) -> None:
+        """Record a host-mutated row for the next batched device scatter.
+        With no live device SoA the next to_device() uploads everything
+        anyway; the mesh path keeps the full re-upload (scatter into
+        sharded arrays is not worth the per-shape compile cache there)."""
+        if self._soa is not None and self.mesh is None:
+            self._pending.add(row)
+
+    def _flush_pending(self) -> None:
+        """Scatter pending host rows into the live device SoA (one jit
+        call, rows padded to a power of two to bound recompiles)."""
+        if not self._pending:
+            return
+        if self._soa is None:
+            self._pending.clear()
+            return
+        rows = np.fromiter(self._pending, np.int32, len(self._pending))
+        self._pending.clear()
+        k = len(rows)
+        pad = 1 << max(k - 1, 0).bit_length()
+        if pad > k:
+            # duplicate scatters carry identical values, so padding with
+            # a repeated real row is deterministic
+            rows = np.concatenate([rows, np.full(pad - k, rows[0], np.int32)])
+        self._soa = scatter_rows(
+            self._soa,
+            jnp.asarray(rows),
+            jnp.asarray(self.features[rows]),
+            jnp.asarray(self.sig[rows]),
+            jnp.asarray(self.ovc[rows]),
+            jnp.asarray(self.stage[rows]),
+            jnp.asarray(self.fire_at[rows]),
+            jnp.asarray(self.active[rows]),
+            jnp.asarray(self.rematch[rows]),
+            jnp.asarray(self.del_ts[rows]),
+        )
+        self._rematch_pending = True
 
     def _invalidate_device(self) -> None:
         """Pull device progress into the host arrays (so a host mutation
@@ -268,13 +332,14 @@ class DeviceSimulator:
             self._dev_now = self._soa.now
             self._dev_key = self._soa.key
             self._soa = None
+        self._pending.clear()
 
     def release(self, row: int) -> None:
         """Retire a row (object gone from the cluster); the row is
         recycled by the next admit."""
         if self.objects[row] is None and not self.active[row]:
             return
-        self._invalidate_device()
+        self._pre_mutate()
         self.objects[row] = None
         self.active[row] = False
         self.stage[row] = IDLE
@@ -282,6 +347,7 @@ class DeviceSimulator:
         self.rematch[row] = False
         self.del_ts[row] = SENTINEL
         self._free.append(row)
+        self._mark_pending(row)
 
     def ensure_capacity(self, n: int) -> None:
         """Grow the SoA to hold at least n rows (amortized doubling)."""
@@ -328,15 +394,23 @@ class DeviceSimulator:
         self.refresh_row(row)
 
     def refresh_row(self, row: int) -> None:
-        """Re-extract features after an external mutation and force rematch."""
-        self._invalidate_device()
+        """Re-extract features after an external mutation and force
+        rematch.  The row's armed timer is reset (stage IDLE, fire_at
+        NEVER): the reference re-enqueues a changed object with a fresh
+        delay, replacing the old queue entry (pod_controller.go:205-214
+        resourceVersion dedup + addStageJob), so a reset, not a carried
+        timer, is the parity-correct behavior."""
+        self._pre_mutate()
         obj = self.objects[row]
         sig, ovc, feats = self._classify(obj)
         self.features[row] = feats
         self.ovc[row] = ovc
         self.sig[row] = sig
+        self.stage[row] = IDLE
+        self.fire_at[row] = NEVER
         self.del_ts[row] = self.cset.deletion_ts_ms(obj, self.epoch)
         self.rematch[row] = True
+        self._mark_pending(row)
 
     def confirm_row(self, row: int, obj: dict, ignore_finalizers: bool = False) -> bool:
         """Adopt the store's echo of OUR OWN single status-class patch
@@ -384,6 +458,8 @@ class DeviceSimulator:
         if self._params is None or self._params_version != self.cset.version:
             self._params = params_from_compiled(self.cset)
             self._params_version = self.cset.version
+        if self._soa is not None:
+            self._flush_pending()
         if self._soa is None:
             self._soa = SoA(
                 features=jnp.asarray(self.features),
@@ -418,53 +494,68 @@ class DeviceSimulator:
             fn = self._sharded_ticks[dt_ms] = sharded_tick(self.mesh, dt_ms)
         return fn
 
-    def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
-        """One tick; drains and (optionally) materializes transitions."""
-        # rebase at the START of a step, not after the tick: callers on
-        # the materialize=False path render the previous tick's
-        # timestamps (now_string) after step() returns, which must
-        # happen against the epoch those t_ms are relative to
+    def tick_many(self, dt_ms: int, n_ticks: int) -> Tuple[np.ndarray, int]:
+        """Advance ``n_ticks`` device ticks; returns (fired_stage [K, N]
+        int8 with IDLE = not fired, t0_ms = virtual now before the first
+        tick).  ONE dispatch + ONE device->host transfer for the whole
+        macro-tick — the per-tick blocking reads of the old step() were
+        the dominant e2e device cost over the tunnel TPU.  Sub-tick k
+        (0-based) fired at virtual time t0_ms + (k+1)*dt_ms; deleted
+        rows are stage_delete[fired_stage] (host table).
+
+        Host mirror of device row state is pulled LAZILY: a firing tick
+        only marks it stale; the actual full download happens on the
+        next _ensure_synced.  Steady-state churn with the fast drain
+        moves only this [K, N] int8 across the boundary — "only dirty
+        rows come back" at 1M rows."""
         if self.now_ms >= REBASE_AT_MS:
             self._rebase()
+        t0_ms = self._now_host
         params, soa = self.to_device()
-        new_soa, out = self._tick_fn(dt_ms)(params, soa)
-        self._soa = new_soa
-
-        transitions: List[Transition] = []
-        if int(out.fired_count) > 0:
-            fired = np.asarray(out.fired)
-            fired_stage = np.asarray(out.fired_stage)
-            deleted = np.asarray(out.deleted)
-            t_ms = int(new_soa.now)
-            for row in np.nonzero(fired)[0]:
-                s_idx = int(fired_stage[row])
-                cs = self.cset.compiled[s_idx]
-                event = None
-                eid = int(self.cset.stage_event[s_idx])
-                if eid >= 0:
-                    event = self.cset.events[eid]
-                tr = Transition(
-                    row=int(row),
-                    stage_idx=s_idx,
-                    stage_name=cs.name,
-                    t_ms=t_ms,
-                    deleted=bool(deleted[row]),
-                    event=event,
-                )
-                transitions.append(tr)
-                if materialize:
-                    self.materialize(tr)
-        # Host mirror of device row state is pulled LAZILY: a firing
-        # tick only marks it stale; the actual device->host download of
-        # the full SoA happens on the next host mutation
-        # (_invalidate_device before admit/refresh/release/rebase).
-        # Steady-state churn with the confirm_row drain therefore moves
-        # only the small per-tick output arrays across the boundary —
-        # "only dirty rows come back" at 1M rows means NOT shipping a
-        # [N, C] features download every tick.
-        if transitions or self._rematch_pending:
+        if self.mesh is not None or self.num_stages_over_int8():
+            outs = []
+            for _ in range(n_ticks):
+                soa, out = self._tick_fn(dt_ms)(params, soa)
+                outs.append(np.asarray(out.fired_stage).astype(np.int8))
+            self._soa = soa
+            stages_np = np.stack(outs) if outs else np.empty((0, 0), np.int8)
+        else:
+            new_soa, stages = run_ticks_collect(params, soa, dt_ms, n_ticks)
+            self._soa = new_soa
+            stages_np = np.asarray(jax.device_get(stages))
+        self._now_host = t0_ms + dt_ms * n_ticks
+        if (stages_np >= 0).any() or self._rematch_pending:
             self._host_synced = False
             self._rematch_pending = False
+        return stages_np, t0_ms
+
+    def num_stages_over_int8(self) -> bool:
+        return len(self.cset.compiled) > 126
+
+    def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
+        """One tick; drains and (optionally) materializes transitions."""
+        stages_np, t0_ms = self.tick_many(dt_ms, 1)
+        st = stages_np[0]
+        t_ms = t0_ms + dt_ms
+        transitions: List[Transition] = []
+        for row in np.nonzero(st >= 0)[0]:
+            s_idx = int(st[row])
+            cs = self.cset.compiled[s_idx]
+            event = None
+            eid = int(self.cset.stage_event[s_idx])
+            if eid >= 0:
+                event = self.cset.events[eid]
+            tr = Transition(
+                row=int(row),
+                stage_idx=s_idx,
+                stage_name=cs.name,
+                t_ms=t_ms,
+                deleted=bool(self.cset.stage_delete[s_idx]),
+                event=event,
+            )
+            transitions.append(tr)
+            if materialize:
+                self.materialize(tr)
         return transitions
 
     def _rebase(self) -> None:
@@ -481,9 +572,16 @@ class DeviceSimulator:
         dl = self.del_ts != SENTINEL
         self.del_ts[dl] = self.del_ts[dl] - delta
         self._dev_now = jnp.int32(0)
+        self._now_host = 0
 
     def _ensure_synced(self) -> None:
-        if self._host_synced or self._soa is None:
+        if self._soa is None:
+            self._pending.clear()
+            return
+        # pending host rows must reach the device BEFORE the download,
+        # or the download would clobber them with stale device values
+        self._flush_pending()
+        if self._host_synced:
             return
         soa = self._soa
         # np.array (not asarray): device views are read-only and the host
@@ -499,12 +597,9 @@ class DeviceSimulator:
 
     @property
     def now_ms(self) -> int:
-        """Current virtual time in ms (0 before the first tick)."""
-        if self._soa is not None:
-            return int(self._soa.now)
-        if self._dev_now is not None:
-            return int(self._dev_now)
-        return 0
+        """Current virtual time in ms (0 before the first tick).  Host
+        mirror — never a device read (see tick_many)."""
+        return self._now_host
 
     def now_string(self, t_ms: int) -> str:
         t = self.epoch + datetime.timedelta(milliseconds=int(t_ms))

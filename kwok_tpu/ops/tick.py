@@ -219,6 +219,62 @@ tick = functools.partial(jax.jit, static_argnames=("dt_ms",), donate_argnums=(1,
 )
 
 
+def _run_ticks_collect_impl(
+    params: TickParams, soa: SoA, dt_ms: int, num_ticks: int
+) -> Tuple[SoA, jax.Array]:
+    """Macro-tick: advance ``num_ticks`` ticks on device, collecting the
+    per-tick fired stage as one compact [K, N] int8 array (IDLE = not
+    fired).  One dispatch + ONE device->host transfer replaces 4 blocking
+    reads per tick — on a high-latency link (the tunnel TPU) the
+    round-trip, not compute, dominates the e2e device cost (VERDICT r02
+    weak #2).  ``deleted`` is recomputed on host from stage_delete[stage];
+    sub-tick virtual times are now0 + (k+1)*dt."""
+
+    def body(soa, _):
+        soa, out = _tick_impl(params, soa, dt_ms)
+        return soa, out.fired_stage.astype(jnp.int8)
+
+    soa, stages = jax.lax.scan(body, soa, None, length=num_ticks)
+    return soa, stages
+
+
+run_ticks_collect = functools.partial(
+    jax.jit, static_argnames=("dt_ms", "num_ticks"), donate_argnums=(1,)
+)(_run_ticks_collect_impl)
+
+
+def _scatter_rows_impl(
+    soa: SoA,
+    rows: jax.Array,
+    features: jax.Array,
+    sig: jax.Array,
+    ovc: jax.Array,
+    stage: jax.Array,
+    fire_at: jax.Array,
+    active: jax.Array,
+    rematch: jax.Array,
+    del_ts: jax.Array,
+) -> SoA:
+    """Write a batch of host-mutated rows into the device SoA in place
+    (donated).  This is the host->device half of the "only dirty rows
+    cross the boundary" contract: admit/refresh/release used to force a
+    full SoA re-upload (capacity x C ints both ways per firing tick at
+    worst); now they scatter just the touched rows."""
+    return soa._replace(
+        features=soa.features.at[rows].set(features),
+        sig=soa.sig.at[rows].set(sig),
+        ovc=soa.ovc.at[rows].set(ovc),
+        stage=soa.stage.at[rows].set(stage),
+        fire_at=soa.fire_at.at[rows].set(fire_at),
+        active=soa.active.at[rows].set(active),
+        rematch=soa.rematch.at[rows].set(rematch),
+        del_ts=soa.del_ts.at[rows].set(del_ts),
+    )
+
+
+scatter_rows = functools.partial(jax.jit, donate_argnums=(0,))(_scatter_rows_impl)
+
+
 class LeaseLane(NamedTuple):
     """Device-resident lease-renewal timers: one slot per held node
     (SURVEY §7 step 5 / §2.9 lease-renewal lanes).  Replaces the host

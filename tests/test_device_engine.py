@@ -301,9 +301,11 @@ class TestReviewRegressions:
         sim.admit(new_pod(0))
         sim.step(dt_ms=100)  # pod-ready fires
         epoch0 = sim.epoch
-        # fast-forward the virtual clock to the threshold
+        # fast-forward the virtual clock to the threshold (the host
+        # mirror now_ms and the device scalar move together)
         sim._invalidate_device()
         sim._dev_now = jnp.int32(REBASE_AT_MS + 123)
+        sim._now_host = REBASE_AT_MS + 123
         sim.step(dt_ms=100)
         # rebase happened at step entry (so the prior tick's timestamps
         # rendered against the old epoch), then the tick advanced 100ms
