@@ -105,6 +105,20 @@ class RemoteWatcher:
 
         return WatchEvent(type=ev["type"], object=ev["object"], rv=ev.get("rv", 0))
 
+    def drain(self):
+        """Pop every currently-buffered event without blocking (same
+        surface as store.Watcher.drain — the informer batches on it)."""
+        from kwok_tpu.cluster.store import WatchEvent
+
+        out = []
+        while True:
+            ev, ok = self._queue.get()
+            if not ok:
+                return out
+            out.append(
+                WatchEvent(type=ev["type"], object=ev["object"], rv=ev.get("rv", 0))
+            )
+
     def __iter__(self):
         while True:
             ev = self.next(timeout=0.5)

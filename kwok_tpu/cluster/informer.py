@@ -31,6 +31,11 @@ from kwok_tpu.cluster.store import (
 )
 from kwok_tpu.utils.queue import Queue
 
+# drain accelerator (native/kwok_fastdrain.c); None -> pure Python
+from kwok_tpu.native.fastdrain import load as _load_fastdrain
+
+_FAST = _load_fastdrain()
+
 
 @dataclass
 class InformerEvent:
@@ -73,6 +78,20 @@ class CacheGetter:
                 self._items.pop(key, None)
             else:
                 self._items[key] = obj
+
+    def _apply_batch(self, pairs) -> None:
+        """Apply many (etype, obj) under one lock hold (the reflector
+        forwards store batches; a lock per event was measurable at
+        drain rates)."""
+        items = self._items
+        with self._mut:
+            for etype, obj in pairs:
+                meta = obj.get("metadata") or {}
+                key = (meta.get("namespace") or "", meta.get("name") or "")
+                if etype == DELETED:
+                    items.pop(key, None)
+                else:
+                    items[key] = obj
 
     def __len__(self) -> int:
         with self._mut:
@@ -185,20 +204,41 @@ class Informer:
                                 # the reflector resume path
                                 break
                             continue
-                        obj = ev.object
-                        if opt.predicate is not None and not opt.predicate(obj):
-                            # object left the predicate set: surface as a
-                            # delete so controllers stop managing it
-                            if use_cache and getter.get(
-                                (obj.get("metadata") or {}).get("name") or "",
-                                (obj.get("metadata") or {}).get("namespace") or "",
-                            ):
-                                getter._apply(DELETED, obj)
-                                events.add(InformerEvent(DELETED, obj))
+                        # drain everything already queued and forward it
+                        # as ONE batch: at device-drain rates the
+                        # per-event queue wakeups dominate this thread
+                        batch = [ev]
+                        batch.extend(w.drain())
+                        if opt.predicate is None and _FAST is not None:
+                            # native fast path: update the cache mirror
+                            # in one pass and forward the store events
+                            # as-is (WatchEvent and InformerEvent are
+                            # duck-compatible: .type/.object)
+                            if use_cache:
+                                with getter._mut:
+                                    _FAST.cache_apply(getter._items, batch)
+                            events.extend(batch)
                             continue
-                        if use_cache:
-                            getter._apply(ev.type, obj)
-                        events.add(InformerEvent(ev.type, obj))
+                        out = []
+                        cache_ops = []
+                        for ev in batch:
+                            obj = ev.object
+                            if opt.predicate is not None and not opt.predicate(obj):
+                                # object left the predicate set: surface as
+                                # a delete so controllers stop managing it
+                                if use_cache and getter.get(
+                                    (obj.get("metadata") or {}).get("name") or "",
+                                    (obj.get("metadata") or {}).get("namespace") or "",
+                                ):
+                                    cache_ops.append((DELETED, obj))
+                                    out.append(InformerEvent(DELETED, obj))
+                                continue
+                            if use_cache:
+                                cache_ops.append((ev.type, obj))
+                            out.append(InformerEvent(ev.type, obj))
+                        if cache_ops:
+                            getter._apply_batch(cache_ops)
+                        events.extend(out)
                     # fall through: either done was set (outer loop exits)
                     # or the stream died (outer loop re-lists + re-watches)
                 finally:

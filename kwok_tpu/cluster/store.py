@@ -36,6 +36,11 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.patch import apply_patch
 
+# drain accelerator (native/kwok_fastdrain.c); None -> pure Python
+from kwok_tpu.native.fastdrain import load as _load_fastdrain
+
+_FAST = _load_fastdrain()
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -166,18 +171,9 @@ def match_label_selector(obj: dict, sel: Selector) -> bool:
     return True
 
 
-def copy_json(x: Any) -> Any:
-    """Deep copy for JSON-shaped data (dict/list/scalars).  Store
-    objects are JSON by contract (they arrive via HTTP or from_dict),
-    so the general ``copy.deepcopy`` machinery (memo dict, reductor
-    dispatch) is pure overhead on the hot copy paths — this is ~3x
-    faster and shares immutable leaves."""
-    t = type(x)
-    if t is dict:
-        return {k: copy_json(v) for k, v in x.items()}
-    if t is list:
-        return [copy_json(v) for v in x]
-    return x
+# canonical implementation lives beside the patch appliers; re-exported
+# here because store callers historically import it from this module
+from kwok_tpu.utils.patch import copy_json  # noqa: E402,F401
 
 
 def atomic_write_json(path: str, data: Any) -> None:
@@ -228,9 +224,17 @@ def match_field_selector(obj: dict, sel: Selector) -> bool:
 class Watcher:
     """One watch subscription; iterate or poll its events."""
 
-    def __init__(self, store: "ResourceStore", filt: Callable[[dict], bool]):
+    def __init__(
+        self,
+        store: "ResourceStore",
+        filt: Callable[[dict], bool],
+        trivial: bool = False,
+    ):
         self._store = store
         self._filter = filt
+        #: a trivial filter (no namespace/selectors) lets batch pushes
+        #: skip the per-event filter call on the store thread
+        self._trivial = trivial
         self._events: deque = deque()
         self._signal = threading.Event()
         self._stopped = threading.Event()
@@ -242,6 +246,29 @@ class Watcher:
             return
         self._events.append(ev)
         self._signal.set()
+
+    def _push_batch(self, evs: List["WatchEvent"]) -> None:
+        """Deliver many events with one signal (the status-batch drain
+        emits thousands per tick; per-event Event.set wakeups and filter
+        calls were measurable at that rate)."""
+        if self._stopped.is_set() or not evs:
+            return
+        if self._trivial:
+            self._events.extend(evs)
+        else:
+            f = self._filter
+            self._events.extend(ev for ev in evs if f(ev.object))
+        self._signal.set()
+
+    def drain(self) -> List["WatchEvent"]:
+        """Pop every currently-queued event without blocking."""
+        evs: List[WatchEvent] = []
+        pop = self._events.popleft
+        while True:
+            try:
+                evs.append(pop())
+            except IndexError:
+                return evs
 
     def next(self, timeout: Optional[float] = 0.5) -> Optional["WatchEvent"]:
         while True:
@@ -307,7 +334,9 @@ class ResourceStore:
         self._rv = 0
         self._uid = 0
         self._types: Dict[str, _TypeState] = {}
-        self._audit: List[Tuple[str, str, Optional[str]]] = []  # (verb, key, as_user)
+        #: (verb, key, as_user); bounded — at device-drain rates an
+        #: unbounded list is a slow memory leak
+        self._audit: deque = deque(maxlen=1_000_000)
         for t in BUILTIN_TYPES:
             self.register_type(t)
         # the hottest field-selector in the system: the kubelet server
@@ -642,8 +671,13 @@ class ResourceStore:
                 scoped[subresource] = new.get(subresource)
                 new = scoped
             else:
-                # metadata invariants
-                new.setdefault("metadata", {})["uid"] = cur["metadata"].get("uid")
+                # fresh metadata dict before the invariant writes:
+                # apply_merge_patch shares cur's metadata when the patch
+                # does not touch it, and stored instances are handed out
+                # by reference (apply_status_batch contract) — an
+                # in-place _bump would mutate cached/history copies
+                new["metadata"] = dict(new.get("metadata") or {})
+                new["metadata"]["uid"] = cur["metadata"].get("uid")
                 new["metadata"]["creationTimestamp"] = cur["metadata"].get("creationTimestamp")
                 new["metadata"]["name"] = cur["metadata"].get("name")
                 if st.rtype.namespaced:
@@ -687,11 +721,16 @@ class ResourceStore:
             if cur is None:
                 raise NotFound(f"{kind} {ns}/{name} not found")
             self._audit.append(("delete", f"{kind}:{key}", as_user))
-            meta = cur.setdefault("metadata", {})
+            # copy-on-write: stored instances may be shared with watch
+            # histories and informer caches (apply_status_batch hands
+            # them out by reference) — never mutate one in place
+            cur = dict(cur)
+            meta = cur["metadata"] = dict(cur.get("metadata") or {})
             if meta.get("finalizers"):
                 if meta.get("deletionTimestamp") is None:
                     meta["deletionTimestamp"] = self._now_string()
                     rv = self._bump(cur)
+                    st.objects[key] = cur
                     self._emit(st, MODIFIED, cur, rv)
                 return copy_json(cur)
             rv = self._bump(cur)
@@ -721,7 +760,15 @@ class ResourceStore:
                     obj, field_selector
                 )
 
-            w = Watcher(self, filt)
+            w = Watcher(
+                self,
+                filt,
+                trivial=(
+                    (namespace is None or not st.rtype.namespaced)
+                    and label_selector is None
+                    and field_selector is None
+                ),
+            )
             if since_rv is not None and since_rv < self._rv:
                 hist = list(st.history)
                 if hist and hist[0].rv > since_rv + 1 and len(hist) == st.history.maxlen:
@@ -733,6 +780,73 @@ class ResourceStore:
             return w
 
     # --------------------------------------------------------------------- bulk
+
+    def apply_status_batch(
+        self, kind: str, items: List[Tuple[Optional[str], str, dict]]
+    ) -> List[Optional[Tuple[int, dict]]]:
+        """Device-drain fast path: replace the ``status`` of many
+        objects in one locked pass (the columnar op batch of VERDICT r02
+        next-#1 — no per-op dicts, no JSON deep copies).
+
+        ``items``: ``[(namespace, name, new_status)]``.  Ownership
+        contract (in-process only): status dicts are handed over to the
+        store, and the returned/emitted objects are the stored instances
+        — callers and watchers must treat them as immutable.  Every
+        other store path already builds fresh objects on mutation, so
+        sharing is safe.  Returns per item ``(resourceVersion, object)``
+        or None when the key does not exist (NotFound).
+
+        Semantics match ``patch(subresource="status", type=merge)`` for
+        a patch that replaces status wholesale: metadata invariants
+        cannot change, and the finalizer-reap check cannot trigger (a
+        status write never clears finalizers)."""
+        with self._mut:
+            st = self._state(kind)
+            namespaced = st.rtype.namespaced
+            status_indexed = any(p.startswith("status.") for p in st.indexes)
+            if _FAST is not None and not status_indexed:
+                out, evs, self._rv = _FAST.status_commit(
+                    st.objects, items, self._rv, namespaced, WatchEvent
+                )
+                if evs:
+                    st.history.extend(evs)
+                    self._audit.append(
+                        ("patch-status-batch", f"{kind}:{len(evs)}", None)
+                    )
+                    for w in list(st.watchers):
+                        w._push_batch(evs)
+                return out
+            out: List[Optional[Tuple[int, dict]]] = []
+            evs: List[WatchEvent] = []
+            history = st.history
+            objects = st.objects
+            for ns, name, status in items:
+                key = ((ns or "default") if namespaced else "", name)
+                cur = objects.get(key)
+                if cur is None:
+                    out.append(None)
+                    continue
+                new = dict(cur)
+                new["status"] = status
+                nm = dict(cur["metadata"])
+                self._rv += 1
+                rv = self._rv
+                nm["resourceVersion"] = str(rv)
+                new["metadata"] = nm
+                objects[key] = new
+                if status_indexed:
+                    self._index_update(st, key, cur, new)
+                ev = WatchEvent(type=MODIFIED, object=new, rv=rv)
+                history.append(ev)
+                evs.append(ev)
+                out.append((rv, new))
+            if evs:
+                self._audit.append(
+                    ("patch-status-batch", f"{kind}:{len(evs)}", None)
+                )
+                for w in list(st.watchers):
+                    w._push_batch(evs)
+            return out
 
     def bulk(self, ops: List[dict]) -> List[dict]:
         """Apply many mutations in one call — the device backend's

@@ -23,13 +23,21 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from kwok_tpu.api.types import Stage
 from kwok_tpu.cluster.informer import Informer, InformerEvent, WatchOptions
 from kwok_tpu.cluster.store import DELETED, EventRecorder, NotFound, ResourceStore
+from kwok_tpu.engine.render_plan import RenderPlan, compile_plan
+from kwok_tpu.engine.render_plan import build as _plan_build
 from kwok_tpu.engine.simulator import DEFAULT_EPOCH, DeviceSimulator, Transition
+from kwok_tpu.native.fastdrain import load as _load_fastdrain
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.patch import is_noop_patch
 from kwok_tpu.utils.queue import Queue
+
+# drain accelerator (native/kwok_fastdrain.c); None -> pure Python
+_FAST = _load_fastdrain()
 
 
 class DeviceStagePlayer:
@@ -100,6 +108,18 @@ class DeviceStagePlayer:
         #: whenever the row's identity changes (full refresh, release,
         #: re-admit).
         self._render_cache: Dict[int, Dict[int, List]] = {}
+        #: (stage_idx, sig) -> RenderPlan | None — the cross-row fast
+        #: drain (engine/render_plan.py).  Only sound when the stage
+        #: set's templates have no tracked read paths (identity reads
+        #: are sentinel-substituted; spec/labels/annotations are part of
+        #: the sig key).
+        self._plans: Dict[Tuple[int, int], Optional[RenderPlan]] = {}
+        self._fast_ok = not self.sim.cset._read_paths
+        self._store_has_batch = hasattr(store, "apply_status_batch")
+        #: row -> stage_idx -> resolved sentinel values (identity + env
+        #: funcs; both row-stable) — dropped with the render cache on
+        #: any identity change
+        self._vals_cache: Dict[int, Dict[int, Dict]] = {}
         # virtual-time anchor: device ms 0 == clock.now() at start
         self._t0: Optional[float] = None
         self.cache = None
@@ -134,47 +154,55 @@ class DeviceStagePlayer:
         return (meta.get("namespace") or "", meta.get("name") or "")
 
     def _drain_events(self) -> None:
-        """Apply queued watch deltas to the SoA (batched: at most one
-        device re-upload per tick)."""
-        while True:
-            ev, ok = self.events.get()
-            if not ok:
-                return
-            self._apply_event(ev)
-
-    def _apply_event(self, ev: InformerEvent) -> None:
-        obj = ev.object
-        key = self._key(obj)
+        """Apply queued watch deltas to the SoA (batched: one lock hold
+        for the whole backlog, at most one device re-upload per tick).
+        Self-echoes — MODIFIED events at or below the row's last written
+        resourceVersion, the per-write common case — are dropped in one
+        native pass when the accelerator is present."""
+        evs = self.events.drain()
+        if not evs:
+            return
         with self._mut:
-            row = self._rows.get(key)
-            if ev.type == DELETED:
-                if row is not None:
-                    self.sim.release(row)
-                    del self._rows[key]
-                    self._written_rv.pop(row, None)
-                    self._drop_render_cache(row)
-                if self.on_delete is not None:
-                    self.on_delete(obj)
-                return
-            if self.read_only is not None and self.read_only(obj):
-                return
-            rv = (obj.get("metadata") or {}).get("resourceVersion")
-            if row is None:
-                row = self.sim.admit(obj)
-                self._rows[key] = row
+            if _FAST is not None:
+                evs = _FAST.filter_stale(evs, self._rows, self._written_rv)
+            for ev in evs:
+                self._apply_event_locked(ev)
+
+    def _apply_event_locked(self, ev: InformerEvent) -> None:
+        obj = ev.object
+        meta = obj.get("metadata") or {}
+        key = (meta.get("namespace") or "", meta.get("name") or "")
+        row = self._rows.get(key)
+        if ev.type == DELETED:
+            if row is not None:
+                self.sim.release(row)
+                del self._rows[key]
+                self._written_rv.pop(row, None)
                 self._drop_render_cache(row)
-            else:
-                if _rv_stale(rv, self._written_rv.get(row)):
-                    # echo of one of our own patches (possibly an
-                    # intermediate state of a multi-patch transition —
-                    # finalizer patch then status patch); the row
-                    # already reflects the final write
-                    return
-                old = self.sim.objects[row]
-                self.sim.objects[row] = obj
-                self.sim.refresh_row(row)
-                if not self._render_identity_same(old, obj):
-                    self._drop_render_cache(row)
+            if self.on_delete is not None:
+                self.on_delete(obj)
+            return
+        if row is not None and _rv_stale(
+            meta.get("resourceVersion"), self._written_rv.get(row)
+        ):
+            # echo of one of our own patches (possibly an intermediate
+            # state of a multi-patch transition — finalizer patch then
+            # status patch); the row already reflects the final write.
+            # Checked FIRST: self-echo suppression is the per-write
+            # common case and must not pay the read_only predicate.
+            return
+        if self.read_only is not None and self.read_only(obj):
+            return
+        if row is None:
+            row = self.sim.admit(obj)
+            self._rows[key] = row
+            self._drop_render_cache(row)
+        else:
+            old = self.sim.objects[row]
+            self.sim.objects[row] = obj
+            self.sim.refresh_row(row)
+            if not self._render_identity_same(old, obj):
+                self._drop_render_cache(row)
 
     # --------------------------------------------------------------- tick loop
 
@@ -213,31 +241,41 @@ class DeviceStagePlayer:
                 self.tick_lags.append(-sleep)
                 next_tick = self.clock.now()  # fell behind; don't spiral
 
-    def step(self, dt_ms: Optional[int] = None) -> List[Transition]:
-        """One device tick + host drain of dirty rows.
+    def step(self, dt_ms: Optional[int] = None) -> int:
+        """One device tick + host drain; returns the fired-row count."""
+        return self.step_batch(dt_ms, 1)
 
-        The common transition shapes — event? + one rendered status
-        patch, or a finalizer-free delete — batch into a single
-        ``store.bulk`` call, so a remote apiserver costs one round-trip
-        per tick instead of one per dirty row (SURVEY §2.9: dirty rows
-        stream across the boundary).  Transitions that touch finalizers
-        or need multiple dependent patches keep the sequential path."""
+    def step_batch(self, dt_ms: Optional[int] = None, n_ticks: int = 1) -> int:
+        """``n_ticks`` device ticks in one dispatch (macro-tick), then a
+        per-sub-tick host drain of dirty rows.
+
+        Drain routing per fired row:
+
+        - **fast path** — rows whose stage compiles to a RenderPlan
+          (merge patches on the status subresource, no finalizers, no
+          delete, no recorder-bound event): the patch is rebuilt from
+          the cross-row plan (sentinel substitution, no gotpl render)
+          and the whole tick's rows commit through ONE
+          ``store.apply_status_batch`` call.
+        - **slow path** — everything else keeps the per-row semantics:
+          grouped ops through ``store.bulk``, sequential fallback for
+          order-dependent shapes."""
         from kwok_tpu.utils.trace import get_tracer
 
         tracer = get_tracer()
         if not tracer.enabled:
-            return self._step_inner(dt_ms)
-        # one span per firing tick (empty ticks are never finished, so
-        # they are not exported); store round-trips inside inherit it
-        # via the thread-local stack.  push/pop balance is guarded by
-        # the finally — an unbalanced stack would mis-parent every
-        # later span on this thread.
+            return self._step_batch_inner(dt_ms, n_ticks)
+        # one span per firing macro-tick (empty ticks are never
+        # finished, so they are not exported); store round-trips inside
+        # inherit it via the thread-local stack.  push/pop balance is
+        # guarded by the finally — an unbalanced stack would mis-parent
+        # every later span on this thread.
         span = tracer.span(f"tick.{self.kind}")
         tok = tracer._push(span)
-        transitions: List[Transition] = []
+        fired = 0
         try:
-            transitions = self._step_inner(dt_ms)
-            return transitions
+            fired = self._step_batch_inner(dt_ms, n_ticks)
+            return fired
         except Exception as exc:
             span.error(str(exc))
             span.end()
@@ -245,18 +283,264 @@ class DeviceStagePlayer:
             raise
         finally:
             tracer._pop(tok)
-            if span is not None and transitions:
+            if span is not None and fired:
                 span.set("kind", self.kind)
-                span.set("fired", len(transitions))
+                span.set("fired", fired)
                 span.end()
 
-    def _step_inner(self, dt_ms: Optional[int] = None) -> List[Transition]:
+    def _step_batch_inner(self, dt_ms: Optional[int], n_ticks: int) -> int:
+        dt = dt_ms if dt_ms is not None else self.tick_ms
         t0 = time.perf_counter()
-        transitions = self.sim.step(
-            dt_ms if dt_ms is not None else self.tick_ms, materialize=False
+        stages_np, t0_ms = self.sim.tick_many(dt, n_ticks)
+        self.t_device += time.perf_counter() - t0
+        fired_total = 0
+        for k in range(stages_np.shape[0]):
+            st = stages_np[k]
+            rows = np.nonzero(st >= 0)[0]
+            if rows.size:
+                fired_total += int(rows.size)
+                try:
+                    self._drain_tick(rows, st, t0_ms + (k + 1) * dt)
+                except Exception:  # noqa: BLE001 — one bad sub-tick must
+                    # not kill the loop for this kind
+                    import traceback
+
+                    traceback.print_exc()
+        if self.post_tick is not None:
+            # wall-anchored ms, not the sim's virtual clock: lease
+            # renewal is a real-time contract (expiry is judged on wall
+            # time by peers), so a tick loop running behind schedule
+            # must not slow the heartbeat cadence
+            if self._t0 is not None:
+                lane_now = int((self.clock.now() - self._t0) * 1000)
+            else:
+                lane_now = self.sim.now_ms
+            try:
+                self.post_tick(lane_now)
+            except Exception:  # noqa: BLE001 — lane trouble must not
+                # stall the stage loop
+                import traceback
+
+                traceback.print_exc()
+        return fired_total
+
+    _PLAN_MISS = object()
+
+    def _plan_for(self, s_idx: int, sig: int, obj: dict) -> Optional[RenderPlan]:
+        key = (s_idx, sig)
+        plan = self._plans.get(key, self._PLAN_MISS)
+        if plan is self._PLAN_MISS:
+            if len(self._plans) >= 8192:
+                self._plans.clear()  # coarse bound (sig classes x stages)
+            try:
+                plan = compile_plan(
+                    self.sim.cset.lifecycle,
+                    self.sim.cset.compiled[s_idx],
+                    obj,
+                    list(self.funcs_for(obj)),
+                )
+            except Exception:  # noqa: BLE001 — plan trouble = slow path
+                plan = None
+            self._plans[key] = plan
+        return plan
+
+    def _drain_tick(self, rows: np.ndarray, st: np.ndarray, t_ms: int) -> None:
+        """Drain one sub-tick's fired rows: fast rows through the
+        columnar status batch, the rest through the legacy group path.
+        Rows are grouped by (stage, sig) so each group resolves its
+        RenderPlan and tick binding once and the inner loop is pure
+        per-row substitution."""
+        cset = self.sim.cset
+        stage_delete = cset.stage_delete
+        sigs = self.sim.sig
+        objects = self.sim.objects
+        slow: List[Transition] = []
+        fast_rows: List[int] = []
+        fast_items: List[Tuple[Optional[str], str, dict]] = []
+        fast_patches: List[dict] = []
+        now_s: Optional[str] = None
+        t_host0 = time.perf_counter()
+        srow = st[rows]
+        sigrow = sigs[rows]
+        order = np.lexsort((sigrow, srow))
+        rows_l = rows[order].tolist()
+        srow_l = srow[order].tolist()
+        sig_l = sigrow[order].tolist()
+        n = len(rows_l)
+        vals_cache = self._vals_cache
+        with self._mut:
+            i = 0
+            while i < n:
+                s_idx = srow_l[i]
+                sig = sig_l[i]
+                j = i
+                while j < n and srow_l[j] == s_idx and sig_l[j] == sig:
+                    j += 1
+                group = rows_l[i:j]
+                i = j
+                rep = None
+                for row in group:
+                    rep = objects[row]
+                    if rep is not None:
+                        break
+                if rep is None:
+                    continue
+                plan = None
+                if self._fast_ok and not stage_delete[s_idx]:
+                    plan = self._plan_for(s_idx, sig, rep)
+                if plan is None or not plan.fast or (
+                    plan.has_event and self.recorder is not None
+                ):
+                    # deletes, finalizer ops, recorder-bound events,
+                    # non-status patches: per-row path (which still
+                    # renders through the plan when one exists)
+                    for row in group:
+                        if objects[row] is not None:
+                            slow.append(self._make_transition(row, s_idx, t_ms))
+                    continue
+                if now_s is None:
+                    now_s = self.sim.now_string(t_ms)
+                bound, comp = plan.bind_tick(now_s)
+                check_noop = not plan.has_now
+                transitions_local = 0
+                for row in group:
+                    obj = objects[row]
+                    if obj is None:
+                        continue
+                    try:
+                        if comp is None:
+                            patch = bound  # tick-static: shared by rows
+                        else:
+                            rowc = vals_cache.get(row)
+                            if rowc is None:
+                                rowc = vals_cache[row] = {}
+                            vals = rowc.get(s_idx)
+                            if vals is None:
+                                vals = rowc[s_idx] = plan.row_vals(
+                                    obj, self.funcs_for(obj)
+                                )
+                            patch = _plan_build(comp, vals)
+                        cur_status = obj.get("status") or {}
+                        new_status = plan.new_status(cur_status, patch)
+                    except Exception:  # noqa: BLE001 — fall back per row
+                        slow.append(self._make_transition(row, s_idx, t_ms))
+                        continue
+                    # a Now-stamping patch can never no-op against an
+                    # earlier tick's status (timestamps strictly increase)
+                    if check_noop and new_status == cur_status:
+                        transitions_local += 1  # pure no-op transition
+                        continue
+                    meta = obj.get("metadata") or {}
+                    fast_rows.append(row)
+                    fast_items.append(
+                        (meta.get("namespace"), meta.get("name") or "", new_status)
+                    )
+                    fast_patches.append(patch)
+                self.transitions += transitions_local
+        self.t_host += time.perf_counter() - t_host0
+
+        if fast_items:
+            tb = time.perf_counter()
+            results = self._store_status_batch(fast_items, fast_patches)
+            self.t_store += time.perf_counter() - tb
+            t_host0 = time.perf_counter()
+            with self._mut:
+                objects = self.sim.objects
+                written = self._written_rv
+                sim = self.sim
+                for row, item, res in zip(fast_rows, fast_items, results):
+                    if res is False:
+                        continue  # store error, surfaced already
+                    if res is None:
+                        self._release_locked((item[0] or "", item[1]))
+                        continue
+                    rv, new_obj = res
+                    written[row] = str(rv)
+                    self.transitions += 1
+                    self.patches += 1
+                    if objects[row] is None:
+                        continue
+                    # confirm_row guards against an interleaved external
+                    # write (e.g. a scheduler spec patch committed between
+                    # our object read and the store batch): the store's
+                    # echo carries it, and since _written_rv now covers
+                    # its rv, this is the only place it can be noticed —
+                    # fall back to a full feature re-extraction
+                    if not sim.confirm_row(row, new_obj):
+                        old = objects[row]
+                        objects[row] = new_obj
+                        sim.refresh_row(row)
+                        if not self._render_identity_same(old, new_obj):
+                            self._drop_render_cache(row)
+            self.t_host += time.perf_counter() - t_host0
+
+        if slow:
+            self._drain_slow(slow)
+
+    def _make_transition(self, row: int, s_idx: int, t_ms: int) -> Transition:
+        cset = self.sim.cset
+        event = None
+        eid = int(cset.stage_event[s_idx])
+        if eid >= 0:
+            event = cset.events[eid]
+        return Transition(
+            row=row,
+            stage_idx=s_idx,
+            stage_name=cset.compiled[s_idx].name,
+            t_ms=t_ms,
+            deleted=bool(cset.stage_delete[s_idx]),
+            event=event,
         )
+
+    def _store_status_batch(self, items, patches):
+        """Commit the fast rows; returns aligned results:
+        (rv, object) | None (NotFound) | False (error, skip row)."""
+        if self._store_has_batch:
+            return self.store.apply_status_batch(self.kind, items)
+        # remote store: the columnar call degrades to a bulk of status
+        # merge patches (the server applies the merge, so its echo, not
+        # our precomputed status, is authoritative)
+        ops = [
+            {
+                "verb": "patch",
+                "kind": self.kind,
+                "name": name,
+                "namespace": ns,
+                "data": {"status": patch},
+                "patch_type": "merge",
+                "subresource": "status",
+            }
+            for (ns, name, _), patch in zip(items, patches)
+        ]
+        try:
+            results = self.store.bulk(ops)
+        except Exception:  # noqa: BLE001 — drop to per-op on bulk failure
+            results = [self._op_sequential_result(op) for op in ops]
+        out = []
+        for r in results:
+            if r.get("status") == "ok" and r.get("object") is not None:
+                o = r["object"]
+                try:
+                    rv = int((o.get("metadata") or {}).get("resourceVersion") or 0)
+                except (TypeError, ValueError):
+                    rv = 0
+                out.append((rv, o))
+            elif r.get("reason") == "NotFound":
+                out.append(None)
+            else:
+                print(
+                    f"device status batch op failed: {r.get('reason')}: "
+                    f"{r.get('error')}",
+                    file=sys.stderr,
+                )
+                out.append(False)
+        return out
+
+    def _drain_slow(self, transitions: List[Transition]) -> None:
+        """Legacy per-transition drain (deletes, finalizers, events,
+        non-status patches): grouped ops through store.bulk with the
+        sequential fallback."""
         t_dev = time.perf_counter()
-        self.t_device += t_dev - t0
         t_store_this = 0.0
         can_bulk = hasattr(self.store, "bulk")
         groups: List[Tuple[Tuple[str, str], List[dict]]] = []
@@ -299,23 +583,6 @@ class DeviceStagePlayer:
                     traceback.print_exc()
         self.t_store += t_store_this
         self.t_host += (time.perf_counter() - t_dev) - t_store_this
-        if self.post_tick is not None:
-            # wall-anchored ms, not the sim's virtual clock: lease
-            # renewal is a real-time contract (expiry is judged on wall
-            # time by peers), so a tick loop running behind schedule
-            # must not slow the heartbeat cadence
-            if self._t0 is not None:
-                lane_now = int((self.clock.now() - self._t0) * 1000)
-            else:
-                lane_now = self.sim.now_ms
-            try:
-                self.post_tick(lane_now)
-            except Exception:  # noqa: BLE001 — lane trouble must not
-                # stall the stage loop
-                import traceback
-
-                traceback.print_exc()
-        return transitions
 
     def _finish_delete(self, key: Tuple[str, str], out: Optional[dict]) -> None:
         """Complete a stage-driven delete: fully gone → release the
@@ -331,10 +598,16 @@ class DeviceStagePlayer:
     _NOW_SENTINEL = "1987-06-05T04:03:02.000001Z"
 
     def _render(self, tr: Transition, obj: dict, effects) -> List:
-        """Template patches for a transition, through the per-row render
-        cache when sound (see _render_cache).  The gotpl render + YAML
-        parse is the host drain's hottest Python; in steady churn a row
-        re-renders the same stage with only Now changing."""
+        """Template patches for a transition: cross-row RenderPlan when
+        available (sentinel substitution, no gotpl), else the per-row
+        render cache when sound (see _render_cache), else a full gotpl
+        render + YAML parse per row."""
+        if self._fast_ok:
+            plan = self._plan_for(tr.stage_idx, int(self.sim.sig[tr.row]), obj)
+            if plan is not None:
+                return plan.build_patches(
+                    obj, self.sim.now_string(tr.t_ms), self.funcs_for(obj)
+                )
         if self._reads_state:
             funcs = dict(self.funcs_for(obj))
             funcs.setdefault("Now", lambda: self.sim.now_string(tr.t_ms))
@@ -372,6 +645,7 @@ class DeviceStagePlayer:
 
     def _drop_render_cache(self, row: int) -> None:
         self._render_cache.pop(row, None)
+        self._vals_cache.pop(row, None)
 
     def _render_identity_same(self, old: Optional[dict], new: dict) -> bool:
         """Whether a row's cached renders survive this object change:
@@ -634,11 +908,14 @@ class DeviceStagePlayer:
 
     def _release(self, key: Tuple[str, str]) -> None:
         with self._mut:
-            row = self._rows.pop(key, None)
-            if row is not None:
-                self.sim.release(row)
-                self._written_rv.pop(row, None)
-                self._drop_render_cache(row)
+            self._release_locked(key)
+
+    def _release_locked(self, key: Tuple[str, str]) -> None:
+        row = self._rows.pop(key, None)
+        if row is not None:
+            self.sim.release(row)
+            self._written_rv.pop(row, None)
+            self._drop_render_cache(row)
 
     def _refresh(
         self,

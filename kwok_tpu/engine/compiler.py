@@ -161,7 +161,7 @@ class CompiledStageSet:
                 self.cond_valid[i, j] = True
 
         # --- scalars ---------------------------------------------------------
-        self.events: List[Optional[dict]] = []
+        self.events: List[Any] = []  # StageEvent objects (see below)
         self.scalars: List[StageScalars] = []
         for cs in self.compiled:
             st = cs.raw
@@ -205,13 +205,9 @@ class CompiledStageSet:
             event_id = -1
             if nxt is not None and nxt.event is not None:
                 event_id = len(self.events)
-                self.events.append(
-                    {
-                        "type": nxt.event.type,
-                        "reason": nxt.event.reason,
-                        "message": nxt.event.message,
-                    }
-                )
+                # the StageEvent object itself (attribute access —
+                # Transition.event consumers read .type/.reason/.message)
+                self.events.append(nxt.event)
             if nxt is not None:
                 for p in nxt.patches:
                     if (p.type or "merge") != "merge":

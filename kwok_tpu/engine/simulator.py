@@ -513,12 +513,14 @@ class DeviceSimulator:
         t0_ms = self._now_host
         params, soa = self.to_device()
         if self.mesh is not None or self.num_stages_over_int8():
+            # int32 here on purpose: this branch exists (in part) because
+            # int8 cannot hold >126 stage indices
             outs = []
             for _ in range(n_ticks):
                 soa, out = self._tick_fn(dt_ms)(params, soa)
-                outs.append(np.asarray(out.fired_stage).astype(np.int8))
+                outs.append(np.asarray(out.fired_stage))
             self._soa = soa
-            stages_np = np.stack(outs) if outs else np.empty((0, 0), np.int8)
+            stages_np = np.stack(outs) if outs else np.empty((0, 0), np.int32)
         else:
             new_soa, stages = run_ticks_collect(params, soa, dt_ms, n_ticks)
             self._soa = new_soa
@@ -590,7 +592,11 @@ class DeviceSimulator:
         self.fire_at = np.array(soa.fire_at)
         self.active = np.array(soa.active)
         self.features = np.array(soa.features)
-        self.rematch = np.zeros(self.capacity, np.bool_)
+        # the true device value, NOT zeros: rows scattered with
+        # rematch=True that have not ticked yet must keep the flag
+        # across a re-upload or they never arm (found as stuck rows
+        # admitted right before a capacity growth)
+        self.rematch = np.array(soa.rematch)
         self._host_synced = True
 
     # ------------------------------------------------------------- materialization

@@ -47,11 +47,29 @@ class Queue(Generic[T]):
             self._items.append(item)
         self._signal.set()
 
+    def extend(self, items) -> None:
+        """Enqueue many items with one lock hold and one wakeup (the
+        informer's batched event delivery)."""
+        if not items:
+            return
+        with self._mut:
+            self._items.extend(items)
+        self._signal.set()
+
     def get(self) -> Tuple[Optional[T], bool]:
         with self._mut:
             if self._items:
                 return self._items.popleft(), True
         return None, False
+
+    def drain(self) -> List[T]:
+        """Pop everything queued under one lock hold."""
+        with self._mut:
+            if not self._items:
+                return []
+            items = list(self._items)
+            self._items.clear()
+        return items
 
     def remove(self, item: T) -> bool:
         """Remove a not-yet-consumed item from the FIFO."""

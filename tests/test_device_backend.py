@@ -370,3 +370,61 @@ spec:
         )
     finally:
         ctr.stop()
+
+
+def test_fast_drain_notices_interleaved_external_write():
+    """An external write (label removal) committed to the store but not
+    yet drained when the row's next transition fires must be adopted
+    WITH a feature re-extraction: the fast drain's commit echo carries
+    it, and its own watch event is then rv-suppressed, so the echo
+    adoption guard (confirm_row -> refresh_row) is the only place it
+    can take effect (code-review r03 finding #1)."""
+    from kwok_tpu.cluster.informer import WatchOptions
+    from kwok_tpu.controllers.device_player import DeviceStagePlayer
+    from kwok_tpu.controllers.pod_controller import PodEnv
+
+    store = ResourceStore()
+    stages = load_builtin("pod-general") + load_builtin("pod-chaos")
+    env = PodEnv()
+    player = DeviceStagePlayer(
+        store, "Pod", stages, capacity=8, tick_ms=100,
+        funcs_for=env.funcs, on_delete=env.release, seed=3,
+    )
+    pod = make_pod("p0")
+    pod["metadata"]["labels"] = {
+        "pod-container-running-failed.stage.kwok.x-k8s.io": "true"
+    }
+    store.create(pod)
+    player.cache = player._informer.watch_with_cache(
+        WatchOptions(), player.events, done=player._done
+    )
+    time.sleep(0.3)
+    player._drain_events()
+    # let the chaos<->ready cycle establish itself
+    for _ in range(6):
+        player._drain_events()
+        player.step_batch(100, 10)
+    assert player.transitions >= 2
+
+    # external writer removes the chaos opt-in label; do NOT drain —
+    # the next fired transition's commit echo must carry it
+    store.patch(
+        "Pod", "p0",
+        {"metadata": {"labels": {
+            "pod-container-running-failed.stage.kwok.x-k8s.io": None}}},
+        "merge", namespace="default",
+    )
+    for _ in range(4):
+        player.step_batch(100, 10)
+        player._drain_events()
+    # chaos must stop matching: transitions settle (at most a final
+    # pod-ready) and the pod ends Running
+    settled = player.transitions
+    for _ in range(6):
+        player._drain_events()
+        player.step_batch(100, 10)
+    assert player.transitions - settled <= 1, (
+        "row kept cycling on stale features after external label removal"
+    )
+    assert store.get("Pod", "p0", namespace="default")["status"]["phase"] == "Running"
+    player._done.set()

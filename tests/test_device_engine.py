@@ -287,6 +287,29 @@ class TestCompileErrors:
 
 
 class TestReviewRegressions:
+    def test_admits_spanning_capacity_growth_all_fire(self):
+        """Rows admitted between ticks — including right before a
+        capacity-growth re-upload — must all arm and fire.  Regression:
+        _ensure_synced used to zero the host rematch mirror, losing the
+        flag for rows scattered but not yet ticked (stuck pods in the
+        2000-node benchmark gate)."""
+        sim = DeviceSimulator(load_builtin(POD_FAST), capacity=4)
+        rows = [sim.admit(new_pod(0))]
+        sim.step(dt_ms=100)
+        # admit a flood that forces several ensure_capacity growths
+        # while the device SoA is live
+        for i in range(1, 40):
+            rows.append(sim.admit(new_pod(i)))
+            if i % 7 == 0:
+                sim.step(dt_ms=100)
+        for _ in range(80):
+            sim.step(dt_ms=100)
+        phases = [
+            (sim.objects[r] or {}).get("status", {}).get("phase") for r in rows
+        ]
+        assert all(p == "Running" for p in phases), phases
+
+
     def test_virtual_clock_rebases_before_int32_wrap(self):
         """Past REBASE_AT_MS the clock shifts into epoch and timers
         rebase, so long runs never collide with NEVER/SENTINEL

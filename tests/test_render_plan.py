@@ -1,0 +1,155 @@
+"""Cross-row render plans: C/Python builder parity, merge-template
+composition, no-op shortcuts, and plan-vs-gotpl render equivalence
+(the fast drain's soundness contract)."""
+
+import pytest
+
+from kwok_tpu.engine import render_plan as rp
+from kwok_tpu.engine.render_plan import (
+    NAME_S,
+    NOW_S,
+    UID_S,
+    RenderPlan,
+    _build,
+    _compile_node,
+    _merge_templates,
+    compile_plan,
+)
+from kwok_tpu.stages import load_builtin
+
+
+def _cases():
+    tpl1 = {
+        "phase": "Running",
+        "podIP": "zq9kws.f0.z",
+        "host": "ip=zq9kws.f1.z port=zq9kws.f2.z",
+        "conditions": [
+            {"type": "Ready", "t": NOW_S, "probe": None},
+            {"type": "Init", "t": NOW_S},
+        ],
+        "meta": {"who": NAME_S, "uid": UID_S},
+        "static": {"deep": [1, 2, {"x": "y"}]},
+    }
+    vals1 = {
+        NOW_S: "2026-01-01T00:00:00Z",
+        NAME_S: "pod-7",
+        UID_S: "u-7",
+        "zq9kws.f0.z": "10.1.2.3",
+        "zq9kws.f1.z": "10.0.0.1",
+        "zq9kws.f2.z": 10250,
+    }
+    tpl2 = {"exact_int": "zq9kws.f0.z", "lst": ["zq9kws.f0.z", "keep"]}
+    vals2 = {"zq9kws.f0.z": 42}
+    return [(tpl1, vals1), (tpl2, vals2)]
+
+
+def test_c_python_builder_parity():
+    """The C extension's build() must produce results identical to the
+    pure-Python _build on representative templates (typed exact-token
+    substitution, embedded tokens, static subtree sharing)."""
+    from kwok_tpu.native.fastdrain import load
+
+    c = load()
+    if c is None:
+        pytest.skip("native toolchain unavailable")
+    for tpl, vals in _cases():
+        comp = _compile_node(tpl)
+        assert comp is not None
+        assert c.build(comp, vals) == _build(comp, vals)
+    # typed substitution: exact token keeps the value's type
+    comp = _compile_node({"port": "zq9kws.f0.z"})
+    assert c.build(comp, {"zq9kws.f0.z": 10250})["port"] == 10250
+    # static subtrees are shared, not copied (immutability contract)
+    tpl = {"a": NOW_S, "b": {"deep": [1, 2]}}
+    comp = _compile_node(tpl)
+    out = c.build(comp, {NOW_S: "t"})
+    assert out["b"] is tpl["b"]
+    assert _build(comp, {NOW_S: "t"})["b"] is tpl["b"]
+    # missing token raises KeyError on both
+    comp = _compile_node({"x": NOW_S})
+    with pytest.raises(KeyError):
+        c.build(comp, {})
+    with pytest.raises(KeyError):
+        _build(comp, {})
+
+
+def test_merge_template_composition_law():
+    """apply(apply(x, a), b) == apply(x, merge(a, b)) for the shapes
+    _merge_templates accepts; incomposable shapes raise."""
+    from kwok_tpu.utils.patch import apply_merge_patch
+
+    x = {"s": {"p": 1, "q": {"r": 2}}, "k": [1]}
+    a = {"s": {"p": 9}, "k": [2, 3]}
+    b = {"s": {"q": {"r": 5}}, "n": "v"}
+    m = _merge_templates(a, b)
+    assert apply_merge_patch(apply_merge_patch(x, a), b) == apply_merge_patch(x, m)
+    # null delete marker survives composition
+    m2 = _merge_templates({"k": [1]}, {"k": None})
+    assert apply_merge_patch(x, m2).get("k") is None or "k" not in apply_merge_patch(x, m2)
+    # scalar-then-dict does not compose
+    with pytest.raises(rp._Incomposable):
+        _merge_templates({"s": 1}, {"s": {"a": 2}})
+
+
+def test_plan_render_matches_gotpl_render():
+    """A plan-built patch must equal the full gotpl render for the same
+    object/funcs/Now (the fast path's parity oracle)."""
+    from kwok_tpu.engine.lifecycle import Lifecycle
+
+    stages = load_builtin("pod-general") + load_builtin("pod-chaos")
+    lc = Lifecycle(stages)
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "p1",
+            "namespace": "ns1",
+            "uid": "u1",
+            "labels": {"pod-container-running-failed.stage.kwok.x-k8s.io": "true"},
+        },
+        "spec": {"nodeName": "n1", "containers": [{"name": "c", "image": "img"}]},
+        "status": {"phase": "Running"},
+    }
+    now = "2026-02-03T04:05:06.000007Z"
+    funcs = {
+        "Now": lambda: now,
+        "PodIP": lambda: "10.9.9.9",
+        "PodIPWith": lambda *a: "10.9.9.9",
+        "NodeIP": lambda: "10.0.0.5",
+        "NodeIPWith": lambda *a: "10.0.0.5",
+        "NodeName": lambda: "n1",
+        "NodePort": lambda: 10250,
+    }
+    for cs in lc.stages:
+        if cs.name not in ("pod-container-running-failed", "pod-ready"):
+            continue
+        plan = compile_plan(lc, cs, pod, list(funcs))
+        assert plan is not None and plan.fast, cs.name
+        built = plan.build_patch(pod, now, funcs)
+        effects = lc.effects(cs)
+        rendered = [p.data for p in effects.patches(pod, funcs)]
+        assert len(rendered) == 1
+        assert built == rendered[0]["status"], cs.name
+
+
+def test_new_status_shortcuts_match_full_merge():
+    """The all-top-plain replace/update shortcuts must equal a real
+    RFC 7386 merge."""
+    from kwok_tpu.utils.patch import apply_merge_patch
+
+    tpl = {"phase": "Running", "conds": [{"t": 1}], "ip": "x"}
+    plan = RenderPlan(tpl, [], False, False, True, [])
+    assert plan.all_top_plain and not plan.has_null
+    cur_subset = {"phase": "Failed", "conds": [{"t": 0}]}
+    cur_extra = {"phase": "Failed", "startTime": "s", "other": {"a": 1}}
+    patch = {"phase": "Running", "conds": [{"t": 1}], "ip": "x"}
+    assert plan.new_status(cur_subset, patch) == apply_merge_patch(cur_subset, patch)
+    assert plan.new_status(cur_extra, patch) == apply_merge_patch(cur_extra, patch)
+    # dict-valued template key -> full merge path
+    tpl2 = {"nested": {"a": 1}}
+    plan2 = RenderPlan(tpl2, [], False, False, True, [])
+    assert not plan2.all_top_plain
+    cur = {"nested": {"a": 0, "b": 2}}
+    assert plan2.new_status(cur, {"nested": {"a": 1}}) == apply_merge_patch(
+        cur, {"nested": {"a": 1}}
+    )
