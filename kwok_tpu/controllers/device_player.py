@@ -120,6 +120,9 @@ class DeviceStagePlayer:
         #: funcs; both row-stable) — dropped with the render cache on
         #: any identity change
         self._vals_cache: Dict[int, Dict[int, Dict]] = {}
+        #: in-flight macro-tick (stages device array, t0_ms, dt) for
+        #: the overlapped step_pipelined path
+        self._inflight = None
         # virtual-time anchor: device ms 0 == clock.now() at start
         self._t0: Optional[float] = None
         self.cache = None
@@ -293,19 +296,7 @@ class DeviceStagePlayer:
         t0 = time.perf_counter()
         stages_np, t0_ms = self.sim.tick_many(dt, n_ticks)
         self.t_device += time.perf_counter() - t0
-        fired_total = 0
-        for k in range(stages_np.shape[0]):
-            st = stages_np[k]
-            rows = np.nonzero(st >= 0)[0]
-            if rows.size:
-                fired_total += int(rows.size)
-                try:
-                    self._drain_tick(rows, st, t0_ms + (k + 1) * dt)
-                except Exception:  # noqa: BLE001 — one bad sub-tick must
-                    # not kill the loop for this kind
-                    import traceback
-
-                    traceback.print_exc()
+        fired_total = self._drain_stages(stages_np, t0_ms, dt)
         if self.post_tick is not None:
             # wall-anchored ms, not the sim's virtual clock: lease
             # renewal is a real-time contract (expiry is judged on wall
@@ -323,6 +314,64 @@ class DeviceStagePlayer:
 
                 traceback.print_exc()
         return fired_total
+
+    def _drain_stages(self, stages_np: np.ndarray, t0_ms: int, dt: int) -> int:
+        fired_total = 0
+        for k in range(stages_np.shape[0]):
+            st = stages_np[k]
+            rows = np.nonzero(st >= 0)[0]
+            if rows.size:
+                fired_total += int(rows.size)
+                try:
+                    self._drain_tick(rows, st, t0_ms + (k + 1) * dt)
+                except Exception:  # noqa: BLE001 — one bad sub-tick must
+                    # not kill the loop for this kind
+                    import traceback
+
+                    traceback.print_exc()
+        return fired_total
+
+    def step_pipelined(self, dt_ms: Optional[int] = None, n_ticks: int = 1) -> int:
+        """Overlapped macro-tick: dispatch the NEXT n_ticks on device,
+        then drain the PREVIOUS dispatch's output — device compute and
+        host drain run concurrently (the device queues the new scan
+        behind the in-flight one; JAX dispatch is async).
+
+        Host mutations from the drain (scatters, releases) therefore
+        reach the device one macro-tick late — the same eventual
+        semantics the reference has between its informer and play
+        workers.  Rows released mid-flight may fire once more; the
+        drain drops them (object already None).  Call
+        :meth:`flush_pipeline` to drain the final in-flight batch."""
+        dt = dt_ms if dt_ms is not None else self.tick_ms
+        if self.sim.mesh is not None or self.sim.num_stages_over_int8():
+            return self.step_batch(dt, n_ticks)
+        import jax
+
+        prev = self._inflight
+        t0 = time.perf_counter()
+        stages_dev, t0_ms = self.sim.tick_many_async(dt, n_ticks)
+        self._inflight = (stages_dev, t0_ms, dt)
+        self.t_device += time.perf_counter() - t0
+        fired = 0
+        if prev is not None:
+            p_stages, p_t0, p_dt = prev
+            t1 = time.perf_counter()
+            stages_np = np.asarray(jax.device_get(p_stages))
+            self.t_device += time.perf_counter() - t1
+            fired = self._drain_stages(stages_np, p_t0, p_dt)
+        return fired
+
+    def flush_pipeline(self) -> int:
+        """Drain the last in-flight macro-tick (pipelined mode)."""
+        prev, self._inflight = self._inflight, None
+        if prev is None:
+            return 0
+        import jax
+
+        stages_dev, t0_ms, dt = prev
+        stages_np = np.asarray(jax.device_get(stages_dev))
+        return self._drain_stages(stages_np, t0_ms, dt)
 
     _PLAN_MISS = object()
 

@@ -534,6 +534,25 @@ class DeviceSimulator:
     def num_stages_over_int8(self) -> bool:
         return len(self.cset.compiled) > 126
 
+    def tick_many_async(self, dt_ms: int, n_ticks: int):
+        """Like tick_many, but returns the [K, N] fired-stage DEVICE
+        array without blocking — the caller overlaps the device compute
+        with host work (drain of the previous macro-tick) and fetches
+        via jax.device_get when ready.  Single-device path only (the
+        caller falls back to tick_many for mesh / >int8 stage sets)."""
+        assert self.mesh is None and not self.num_stages_over_int8()
+        if self.now_ms >= REBASE_AT_MS:
+            self._rebase()
+        t0_ms = self._now_host
+        params, soa = self.to_device()
+        new_soa, stages = run_ticks_collect(params, soa, dt_ms, n_ticks)
+        self._soa = new_soa
+        self._now_host = t0_ms + dt_ms * n_ticks
+        # pessimistic: fired rows are not visible until the fetch
+        self._host_synced = False
+        self._rematch_pending = False
+        return stages, t0_ms
+
     def step(self, dt_ms: int = 100, materialize: bool = True) -> List[Transition]:
         """One tick; drains and (optionally) materializes transitions."""
         stages_np, t0_ms = self.tick_many(dt_ms, 1)
