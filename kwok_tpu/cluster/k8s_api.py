@@ -39,6 +39,7 @@ import time
 from typing import List, Optional, Tuple
 
 from kwok_tpu.cluster.store import (
+    AlreadyExists,
     Conflict,
     Expired,
     NotFound,
@@ -104,8 +105,12 @@ def error_code_reason(exc: Exception) -> Tuple[int, str]:
     the legacy dialect and the k8s Status path share."""
     if isinstance(exc, NotFound):
         return 404, "NotFound"
-    if isinstance(exc, Conflict):
+    if isinstance(exc, AlreadyExists):
         return 409, "AlreadyExists"
+    if isinstance(exc, Conflict):
+        # update/patch rv or CAS precondition: client-go
+        # retry.RetryOnConflict keys on this exact reason string
+        return 409, "Conflict"
     if isinstance(exc, Expired):
         return 410, "Expired"
     if isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
@@ -182,6 +187,41 @@ class K8sFacade:
             g, v = group_version(rt)
             groups.setdefault(g, set()).add(v)
         return groups
+
+    def _openapi_v3(self) -> dict:
+        """OpenAPI v3 document carrying the strategic-merge metadata
+        (x-kubernetes-patch-merge-key / x-kubernetes-patch-strategy) for
+        every kind with typed metadata — the discovery source the
+        reference consumes for unstructured no-op detection and merges
+        (reference pkg/utils/patch/openapi.go:43-248).  The tables in
+        utils/patch.py are the single source of truth; this route just
+        projects them, so server and in-process appliers can never
+        disagree."""
+        from kwok_tpu.utils.patch import STRATEGIC_META
+
+        schemas = {}
+        for kind, table in sorted(STRATEGIC_META.items()):
+            props: dict = {}
+            for path, (strategy, key) in sorted(table.items()):
+                node = props
+                for seg in path[:-1]:
+                    node = node.setdefault(seg, {"type": "object"}).setdefault(
+                        "properties", {}
+                    )
+                leaf = node.setdefault(path[-1], {"type": "array"})
+                leaf["x-kubernetes-patch-strategy"] = strategy
+                if key is not None:
+                    leaf["x-kubernetes-patch-merge-key"] = key
+            schemas[f"io.k8s.api.core.v1.{kind}"] = {
+                "type": "object",
+                "properties": props,
+            }
+        return {
+            "openapi": "3.0.0",
+            "info": {"title": "kwok-tpu", "version": "v1.29.0"},
+            "paths": {},
+            "components": {"schemas": schemas},
+        }
 
     def _api_versions(self) -> dict:
         return {
@@ -324,7 +364,7 @@ class K8sFacade:
                     },
                 )
             else:
-                self._send(handler, 200, {"openapi": "3.0.0", "paths": {}})
+                self._send(handler, 200, self._openapi_v3())
             return True
         if head == "api":
             if not rest:
@@ -678,15 +718,22 @@ class K8sFacade:
         deadline = time.monotonic() + timeout_s if timeout_s else None
         try:
             if initial:
-                handler.wfile.write(
-                    b"".join(
+                # incremental chunks, not one giant join: an rv=0 watch
+                # over a 1M-pod set would otherwise build a multi-GB
+                # bytes object in this handler thread (ADVICE r02)
+                chunk: list = []
+                for o in initial:
+                    chunk.append(
                         json.dumps(
                             {"type": "ADDED", "object": self._stamp(r.rtype, o)}
                         ).encode()
                         + b"\n"
-                        for o in initial
                     )
-                )
+                    if len(chunk) >= 512:
+                        handler.wfile.write(b"".join(chunk))
+                        chunk.clear()
+                if chunk:
+                    handler.wfile.write(b"".join(chunk))
                 handler.wfile.flush()
             idle = 0.0
             while shutdown is None or not shutdown.is_set():
@@ -749,10 +796,13 @@ class K8sFacade:
         ns = r.namespace or "default"
         container = q.get("container") or ""
         url = f"{self.kubelet_url}/containerLogs/{ns}/{r.name}/{container}"
-        if q.get("follow") in ("true", "1"):
+        follow = q.get("follow") in ("true", "1")
+        if follow:
             url += "?follow=true"
         try:
-            resp = urllib.request.urlopen(url, timeout=30)
+            # follow streams idle between log lines — no read deadline
+            # (the 30s timeout silently ended quiet follows, ADVICE r02)
+            resp = urllib.request.urlopen(url, timeout=None if follow else 30)
         except Exception as exc:  # noqa: BLE001
             raise NotFound(f"kubelet log fetch failed: {exc}")
         handler.send_response(200)
@@ -997,6 +1047,12 @@ class K8sFacade:
         upstream = _socket.create_connection(
             (ku.hostname, ku.port or 80), timeout=30
         )
+        # the 30s deadline covers CONNECT only: an idle exec waiting for
+        # input, a quiet attach, or a parked port-forward must live
+        # indefinitely (kubectl documents no server-side deadline) —
+        # recv raising socket.timeout here used to read as EOF and tear
+        # the tunnel down (ADVICE r02 medium)
+        upstream.settimeout(None)
         upgrading = "upgrade" in (handler.headers.get("Connection") or "").lower()
         try:
             lines = [f"{handler.command} {path} HTTP/1.1"]

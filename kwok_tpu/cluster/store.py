@@ -46,13 +46,24 @@ MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 SYNC = "SYNC"  # informer re-list marker, never emitted by the store
 
+#: the namespace-lifecycle finalizer (the apiserver's
+#: ``spec.finalizers: [kubernetes]`` analog; consumed by
+#: controllers/gc_controller.py)
+NS_FINALIZER = "kwok.x-k8s.io/namespace"
+
 
 class NotFound(KeyError):
     pass
 
 
 class Conflict(ValueError):
-    """resourceVersion precondition failed."""
+    """resourceVersion / CAS precondition failed."""
+
+
+class AlreadyExists(Conflict):
+    """create of an existing key — distinct from update conflicts so the
+    wire facade can report reason "AlreadyExists" vs "Conflict" (stock
+    client-go retry.RetryOnConflict keys on the reason string)."""
 
 
 class Expired(ValueError):
@@ -328,7 +339,19 @@ class ResourceStore:
 
     HISTORY = 16384
 
-    def __init__(self, clock: Optional[Clock] = None):
+    def __init__(
+        self,
+        clock: Optional[Clock] = None,
+        namespace_finalizers: bool = False,
+    ):
+        #: inject NS_FINALIZER on Namespace create (the real apiserver
+        #: injects spec.finalizers the same way) — opt-in by cluster
+        #: composition, because a store WITHOUT a GC controller would
+        #: otherwise strand every deleted namespace in Terminating.
+        #: Injection at create time (not GC-on-sight) closes the window
+        #: where a namespace created and deleted back-to-back is reaped
+        #: before the finalizer lands, orphaning its contents.
+        self.namespace_finalizers = namespace_finalizers
         self._clock = clock or RealClock()
         self._mut = threading.RLock()
         self._rv = 0
@@ -451,9 +474,13 @@ class ResourceStore:
                 meta["name"] = meta["generateName"] + f"{self._uid + 1:05x}"
             key = self._key(st, obj)
             if key in st.objects:
-                raise Conflict(f"{kind} {key} already exists")
+                raise AlreadyExists(f"{kind} {key} already exists")
             meta.setdefault("uid", self._next_uid())
             meta.setdefault("creationTimestamp", self._now_string())
+            if self.namespace_finalizers and kind == "Namespace":
+                fins = meta.setdefault("finalizers", [])
+                if NS_FINALIZER not in fins:
+                    fins.append(NS_FINALIZER)
             obj.setdefault("apiVersion", st.rtype.api_version)
             self._audit.append(("create", f"{kind}:{key}", as_user))
             rv = self._bump(obj)
@@ -664,7 +691,7 @@ class ResourceStore:
                             f"{kind} {ns}/{name}: expected {path}={want!r}, "
                             f"found {have!r}"
                         )
-            new = apply_patch(cur, data, patch_type)
+            new = apply_patch(cur, data, patch_type, kind=st.rtype.kind)
             if subresource:
                 # subresource patches may only change that one field
                 scoped = copy_json(cur)

@@ -43,7 +43,9 @@ def main(argv=None) -> int:
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
-    store = ResourceStore()
+    # namespace finalizers ON: cluster compositions always include the
+    # controller-manager seat that finalizes them (ctl/runtime.py)
+    store = ResourceStore(namespace_finalizers=True)
     if args.state_file and os.path.exists(args.state_file):
         n = store.load_file(args.state_file)
         print(f"restored {n} objects from {args.state_file}", flush=True)

@@ -1,0 +1,61 @@
+"""Controller-manager daemon: ``python -m kwok_tpu.cmd.kcm``.
+
+The kube-controller-manager seat in the cluster composition (reference
+pkg/kwokctl/components/kube_controller_manager.go:46 builds it;
+runtime/binary/cluster.go:316-728 starts it after the apiserver).
+Connects to the cluster apiserver and runs ownerReference garbage
+collection + namespace lifecycle (controllers/gc_controller.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.controllers.gc_controller import GCController
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwok-tpu-kcm", description=__doc__)
+    p.add_argument("--server", required=True, help="apiserver base URL")
+    p.add_argument("--ca-cert", default="")
+    p.add_argument("--client-cert", default="")
+    p.add_argument("--client-key", default="")
+    p.add_argument("-v", "--verbosity", action="count", default=0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from kwok_tpu.utils.log import setup as log_setup
+
+    log_setup(args.verbosity)
+    client = ClusterClient(
+        args.server,
+        ca_cert=args.ca_cert or None,
+        client_cert=args.client_cert or None,
+        client_key=args.client_key or None,
+    )
+    if not client.wait_ready(timeout=60):
+        print("apiserver not ready", file=sys.stderr)
+        return 1
+    gc = GCController(client).start()
+    print("controller-manager running", flush=True)
+
+    done = threading.Event()
+
+    def _stop(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    done.wait()
+    gc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

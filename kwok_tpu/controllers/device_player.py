@@ -292,28 +292,35 @@ class DeviceStagePlayer:
                 span.end()
 
     def _step_batch_inner(self, dt_ms: Optional[int], n_ticks: int) -> int:
+        # a pending pipelined batch must drain FIRST or transitions
+        # apply out of order when callers mix the two step flavors
+        self.flush_pipeline()
         dt = dt_ms if dt_ms is not None else self.tick_ms
         t0 = time.perf_counter()
         stages_np, t0_ms = self.sim.tick_many(dt, n_ticks)
         self.t_device += time.perf_counter() - t0
         fired_total = self._drain_stages(stages_np, t0_ms, dt)
-        if self.post_tick is not None:
-            # wall-anchored ms, not the sim's virtual clock: lease
-            # renewal is a real-time contract (expiry is judged on wall
-            # time by peers), so a tick loop running behind schedule
-            # must not slow the heartbeat cadence
-            if self._t0 is not None:
-                lane_now = int((self.clock.now() - self._t0) * 1000)
-            else:
-                lane_now = self.sim.now_ms
-            try:
-                self.post_tick(lane_now)
-            except Exception:  # noqa: BLE001 — lane trouble must not
-                # stall the stage loop
-                import traceback
-
-                traceback.print_exc()
+        self._run_post_tick()
         return fired_total
+
+    def _run_post_tick(self) -> None:
+        if self.post_tick is None:
+            return
+        # wall-anchored ms, not the sim's virtual clock: lease renewal
+        # is a real-time contract (expiry is judged on wall time by
+        # peers), so a tick loop running behind schedule must not slow
+        # the heartbeat cadence
+        if self._t0 is not None:
+            lane_now = int((self.clock.now() - self._t0) * 1000)
+        else:
+            lane_now = self.sim.now_ms
+        try:
+            self.post_tick(lane_now)
+        except Exception:  # noqa: BLE001 — lane trouble must not
+            # stall the stage loop
+            import traceback
+
+            traceback.print_exc()
 
     def _drain_stages(self, stages_np: np.ndarray, t0_ms: int, dt: int) -> int:
         fired_total = 0
@@ -342,9 +349,14 @@ class DeviceStagePlayer:
         semantics the reference has between its informer and play
         workers.  Rows released mid-flight may fire once more; the
         drain drops them (object already None).  Call
-        :meth:`flush_pipeline` to drain the final in-flight batch."""
+        :meth:`flush_pipeline` to drain the final in-flight batch.
+
+        Runs the post_tick hook (lease lanes) like step_batch does, so
+        switching a run loop between the two flavors never silently
+        stops heartbeats."""
         dt = dt_ms if dt_ms is not None else self.tick_ms
         if self.sim.mesh is not None or self.sim.num_stages_over_int8():
+            # step_batch flushes any in-flight batch first (ordering)
             return self.step_batch(dt, n_ticks)
         import jax
 
@@ -360,6 +372,7 @@ class DeviceStagePlayer:
             stages_np = np.asarray(jax.device_get(p_stages))
             self.t_device += time.perf_counter() - t1
             fired = self._drain_stages(stages_np, p_t0, p_dt)
+        self._run_post_tick()
         return fired
 
     def flush_pipeline(self) -> int:

@@ -98,6 +98,9 @@ class Scheduler:
         self._pod_usage: Dict[str, Tuple[str, float, float]] = {}
         self._used_agg: Dict[str, Tuple[float, float, int]] = {}
         self._rr = 0  # round-robin cursor
+        #: name-sorted node objects; invalidated on node events and
+        #: rebuilt lazily at the next bind (not per bind)
+        self._sorted_nodes: Optional[list] = None
         self._threads = []
         self._mut = threading.Lock()
 
@@ -128,24 +131,39 @@ class Scheduler:
 
     # --------------------------------------------------------------- fitting
 
+    def _sorted(self) -> list:
+        """Node objects in name order, maintained from informer events
+        (ADVICE r02: re-sorting the cache per bind made scheduling
+        O(pods x nodes log nodes) at reference scale)."""
+        nodes = self._sorted_nodes
+        if nodes is None:
+            nodes = self._sorted_nodes = sorted(
+                self._nodes.list(), key=lambda n: n["metadata"]["name"]
+            )
+        return nodes
+
     def _pick_node(self, pod: dict) -> Optional[str]:
-        nodes = sorted(self._nodes.list(), key=lambda n: n["metadata"]["name"])
+        nodes = self._sorted()
         if not nodes:
             return None
         cpu, mem = _requests(pod)
-        with self._mut:
-            used = dict(self._used_agg)
         n = len(nodes)
-        for i in range(n):
-            node = nodes[(self._rr + i) % n]
-            if not _ready(node):
-                continue
-            name = node["metadata"]["name"]
-            a_cpu, a_mem, a_pods = _allocatable(node)
-            u_cpu, u_mem, u_pods = used.get(name, (0.0, 0.0, 0))
-            if u_cpu + cpu <= a_cpu and u_mem + mem <= a_mem and u_pods + 1 <= a_pods:
-                self._rr = (self._rr + i + 1) % n
-                return name
+        with self._mut:
+            used = self._used_agg  # read under the same lock binds write
+            for i in range(n):
+                node = nodes[(self._rr + i) % n]
+                if not _ready(node):
+                    continue
+                name = node["metadata"]["name"]
+                a_cpu, a_mem, a_pods = _allocatable(node)
+                u_cpu, u_mem, u_pods = used.get(name, (0.0, 0.0, 0))
+                if (
+                    u_cpu + cpu <= a_cpu
+                    and u_mem + mem <= a_mem
+                    and u_pods + 1 <= a_pods
+                ):
+                    self._rr = (self._rr + i + 1) % n
+                    return name
         return None
 
     # --------------------------------------------------------------- binding
@@ -210,7 +228,10 @@ class Scheduler:
                 continue
             obj = ev.object
             if obj.get("kind") == "Node":
-                continue  # cache updated by the informer; retry path covers it
+                # cache updated by the informer; drop the sorted view so
+                # the next bind rebuilds it (retry path covers pods)
+                self._sorted_nodes = None
+                continue
             if ev.type == DELETED:
                 self._untrack(obj)
                 continue

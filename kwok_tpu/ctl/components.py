@@ -129,6 +129,33 @@ def build_scheduler_component(
     return Component(name="scheduler", args=args, depends_on=["apiserver"])
 
 
+def build_kcm_component(
+    server_url: str,
+    secure: bool = False,
+    pki_dir: Optional[str] = None,
+) -> Component:
+    """Controller-manager seat: ownerRef GC + namespace lifecycle
+    (reference components/kube_controller_manager.go:46
+    BuildKubeControllerManagerComponent)."""
+    args = [
+        sys.executable,
+        "-m",
+        "kwok_tpu.cmd.kcm",
+        "--server",
+        server_url,
+    ]
+    if secure and pki_dir:
+        args += [
+            "--ca-cert",
+            os.path.join(pki_dir, "ca.crt"),
+            "--client-cert",
+            os.path.join(pki_dir, "admin.crt"),
+            "--client-key",
+            os.path.join(pki_dir, "admin.key"),
+        ]
+    return Component(name="kube-controller-manager", args=args, depends_on=["apiserver"])
+
+
 def build_kwok_controller_component(
     workdir: str,
     server_url: str,

@@ -37,6 +37,7 @@ from kwok_tpu.ctl.components import (
     Component,
     build_apiserver_component,
     build_kwok_controller_component,
+    build_kcm_component,
     build_scheduler_component,
     build_tracing_component,
     free_port,
@@ -149,6 +150,9 @@ class BinaryRuntime:
                 kubelet_port=kubelet_port,
             ),
             build_scheduler_component(
+                server_url, secure=secure, pki_dir=pki_dir
+            ),
+            build_kcm_component(
                 server_url, secure=secure, pki_dir=pki_dir
             ),
             build_kwok_controller_component(

@@ -508,28 +508,26 @@ class DeviceSimulator:
         next _ensure_synced.  Steady-state churn with the fast drain
         moves only this [K, N] int8 across the boundary — "only dirty
         rows come back" at 1M rows."""
-        if self.now_ms >= REBASE_AT_MS:
-            self._rebase()
-        t0_ms = self._now_host
-        params, soa = self.to_device()
         if self.mesh is not None or self.num_stages_over_int8():
-            # int32 here on purpose: this branch exists (in part) because
-            # int8 cannot hold >126 stage indices
+            if self.now_ms >= REBASE_AT_MS:
+                self._rebase()
+            t0_ms = self._now_host
+            params, soa = self.to_device()
+            # int32 here on purpose: this branch exists (in part)
+            # because int8 cannot hold >126 stage indices
             outs = []
             for _ in range(n_ticks):
                 soa, out = self._tick_fn(dt_ms)(params, soa)
                 outs.append(np.asarray(out.fired_stage))
             self._soa = soa
             stages_np = np.stack(outs) if outs else np.empty((0, 0), np.int32)
-        else:
-            new_soa, stages = run_ticks_collect(params, soa, dt_ms, n_ticks)
-            self._soa = new_soa
-            stages_np = np.asarray(jax.device_get(stages))
-        self._now_host = t0_ms + dt_ms * n_ticks
-        if (stages_np >= 0).any() or self._rematch_pending:
-            self._host_synced = False
-            self._rematch_pending = False
-        return stages_np, t0_ms
+            self._now_host = t0_ms + dt_ms * n_ticks
+            if (stages_np >= 0).any() or self._rematch_pending:
+                self._host_synced = False
+                self._rematch_pending = False
+            return stages_np, t0_ms
+        stages, t0_ms = self.tick_many_async(dt_ms, n_ticks)
+        return np.asarray(jax.device_get(stages)), t0_ms
 
     def num_stages_over_int8(self) -> bool:
         return len(self.cset.compiled) > 126
@@ -539,7 +537,8 @@ class DeviceSimulator:
         array without blocking — the caller overlaps the device compute
         with host work (drain of the previous macro-tick) and fetches
         via jax.device_get when ready.  Single-device path only (the
-        caller falls back to tick_many for mesh / >int8 stage sets)."""
+        caller falls back to tick_many for mesh / >int8 stage sets);
+        tick_many's single-device branch is this + the blocking get."""
         assert self.mesh is None and not self.num_stages_over_int8()
         if self.now_ms >= REBASE_AT_MS:
             self._rebase()

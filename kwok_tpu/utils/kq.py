@@ -1,24 +1,40 @@
-"""kq — a small jq-subset query engine over JSON-standard objects.
+"""kq — a jq query engine over JSON-standard objects.
 
 The reference drives all Stage selector matchExpressions, weightFrom and
-durationFrom expressions through gojq (reference: pkg/utils/expression/query.go:25-88).
-The stage vocabulary only ever uses a narrow jq subset — field paths,
-string indexing, array iteration, `select(...)` with equality — so kq
-implements exactly that subset with gojq-compatible behavior:
+durationFrom expressions through gojq (reference:
+pkg/utils/expression/query.go:25-88 — the *whole* language).  kq is an
+independent jq interpreter covering the constructs real stages use —
+paths, iteration, ``select``, pipes, the alternative operator ``//``,
+boolean/comparison/arithmetic operators, array/object construction,
+``if/then/elif/else/end``, the ``?`` error suppressor, and the common
+builtin functions (length, any, all, map, has, test, split, join,
+startswith, contains, ...) — with gojq-compatible semantics:
 
-- results are a stream; `null` outputs are dropped from the result list
-  (reference: query.go:60-66);
+- results are a stream; ``null`` outputs are dropped from the result
+  list (reference: query.go:60-66);
 - any evaluation error aborts the query and yields an *empty* result
   (gojq errors are swallowed: query.go:57-59 returns nil, nil);
-- iterating a non-iterable (including null/missing) is an error;
-- field access on null/missing yields null, not an error.
+- iterating a non-iterable (including null/missing) is an error unless
+  suppressed with ``?``;
+- field access on null/missing yields null, not an error;
+- jq's total value order (null < false < true < numbers < strings <
+  arrays < objects) backs ``< <= > >=``, sort, min, max;
+- ``true != 1`` (no bool/number coercion).
 
-Queries that fall outside the subset raise ``KqCompileError`` at parse
-time; callers route those objects to the host slow path.
+Constructs outside the implemented grammar raise ``KqCompileError`` at
+parse time — reductions (``reduce``/``foreach``), ``def``, variables
+(``$x``), ``label``/``try-catch`` are the known gaps; everything the
+reference's expression test corpus exercises parses and runs here
+(tests/test_kq.py).
+
+The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
+are public shape contracts: the device compiler pattern-matches them to
+lower selector expressions (engine/features.py).
 """
 
 from __future__ import annotations
 
+import math
 import re
 from dataclasses import dataclass
 from typing import Any, Iterator, List, Optional, Sequence, Tuple
@@ -40,8 +56,8 @@ _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<string>"(?:[^"\\]|\\.)*")
-  | (?P<number>-?\d+(?:\.\d+)?)
-  | (?P<op>==|!=|\||\(|\)|\[|\]|\.|,)
+  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<op>//|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
     re.VERBOSE,
@@ -79,10 +95,18 @@ class Iterate:
 
 
 @dataclass(frozen=True)
+class Index:
+    """Array index ``.[0]`` (negative from the end, like jq)."""
+
+    i: int
+
+
+@dataclass(frozen=True)
 class Path:
-    """A `.a.b["c"].[]`-style navigation; ops are Field/Iterate."""
+    """A `.a.b["c"].[]`-style navigation; ops are Field/Iterate/Index."""
 
     ops: Tuple[Any, ...]
+    optional: bool = False  # trailing '?'
 
 
 @dataclass(frozen=True)
@@ -93,7 +117,7 @@ class Literal:
 @dataclass(frozen=True)
 class Compare:
     left: Any
-    op: str  # "==" or "!="
+    op: str  # == != < <= > >=
     right: Any
 
 
@@ -107,6 +131,81 @@ class Pipe:
     stages: Tuple[Any, ...]
 
 
+@dataclass(frozen=True)
+class Comma:
+    parts: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class Alternative:
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class BoolOp:
+    op: str  # "and" | "or"
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Arith:
+    op: str  # + - * / %
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class Neg:
+    expr: Any
+
+
+@dataclass(frozen=True)
+class Func:
+    name: str
+    args: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class If:
+    cond: Any
+    then: Any
+    orelse: Any  # None -> identity
+
+
+@dataclass(frozen=True)
+class ArrayCons:
+    expr: Any  # None -> []
+
+
+@dataclass(frozen=True)
+class ObjectCons:
+    entries: Tuple[Tuple[Any, Any], ...]  # (key expr|str, value expr)
+
+
+@dataclass(frozen=True)
+class Optional_:
+    """`expr?` — suppress evaluation errors of expr."""
+
+    expr: Any
+
+
+#: zero-arg builtins (applied as a filter to each input)
+_FUNCS0 = {
+    "length", "keys", "values", "type", "tostring", "tonumber", "not",
+    "empty", "add", "any", "all", "first", "last", "min", "max", "sort",
+    "unique", "floor", "ceil", "ascii_downcase", "ascii_upcase", "abs",
+    "reverse", "tojson", "fromjson",
+}
+#: one-arg builtins
+_FUNCS1 = {
+    "select", "has", "map", "test", "startswith", "endswith", "contains",
+    "split", "join", "any", "all", "sort_by", "min_by", "max_by", "range",
+    "error",
+}
+
+
 class _Parser:
     def __init__(self, tokens: List[Tuple[str, str]], src: str):
         self.tokens = tokens
@@ -115,6 +214,10 @@ class _Parser:
 
     def peek(self) -> Optional[Tuple[str, str]]:
         return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def peek_text(self) -> Optional[str]:
+        t = self.peek()
+        return t[1] if t else None
 
     def next(self) -> Tuple[str, str]:
         tok = self.peek()
@@ -128,6 +231,8 @@ class _Parser:
         if tok[1] != text:
             raise KqCompileError(f"expected {text!r}, got {tok[1]!r} in {self.src!r}")
 
+    # precedence chain: pipe > comma > // > or > and > cmp > add > mul > unary
+
     def parse_query(self) -> Any:
         node = self.parse_pipe()
         if self.peek() is not None:
@@ -135,23 +240,82 @@ class _Parser:
         return node
 
     def parse_pipe(self) -> Any:
-        stages = [self.parse_term()]
-        while self.peek() is not None and self.peek()[1] == "|":
+        stages = [self.parse_comma()]
+        while self.peek_text() == "|":
             self.next()
-            stages.append(self.parse_term())
+            stages.append(self.parse_comma())
         if len(stages) == 1:
             return stages[0]
         return Pipe(tuple(stages))
 
-    def parse_term(self) -> Any:
-        """One pipe stage: a path, select(...), or a literal — optionally
-        followed by an ==/!= comparison."""
-        node = self.parse_primary()
+    def parse_comma(self) -> Any:
+        parts = [self.parse_alt()]
+        while self.peek_text() == ",":
+            self.next()
+            parts.append(self.parse_alt())
+        if len(parts) == 1:
+            return parts[0]
+        return Comma(tuple(parts))
+
+    def parse_alt(self) -> Any:
+        node = self.parse_or()
+        while self.peek_text() == "//":
+            self.next()
+            node = Alternative(node, self.parse_or())
+        return node
+
+    def parse_or(self) -> Any:
+        node = self.parse_and()
+        while self.peek_text() == "or":
+            self.next()
+            node = BoolOp("or", node, self.parse_and())
+        return node
+
+    def parse_and(self) -> Any:
+        node = self.parse_cmp()
+        while self.peek_text() == "and":
+            self.next()
+            node = BoolOp("and", node, self.parse_cmp())
+        return node
+
+    def parse_cmp(self) -> Any:
+        node = self.parse_add()
         tok = self.peek()
-        if tok is not None and tok[1] in ("==", "!="):
+        if tok is not None and tok[1] in ("==", "!=", "<", "<=", ">", ">="):
             op = self.next()[1]
-            right = self.parse_primary()
+            right = self.parse_add()
             node = Compare(node, op, right)
+        return node
+
+    def parse_add(self) -> Any:
+        node = self.parse_mul()
+        while self.peek_text() in ("+", "-"):
+            op = self.next()[1]
+            node = Arith(op, node, self.parse_mul())
+        return node
+
+    def parse_mul(self) -> Any:
+        node = self.parse_unary()
+        while self.peek_text() in ("*", "/", "%"):
+            op = self.next()[1]
+            node = Arith(op, node, self.parse_unary())
+        return node
+
+    def parse_unary(self) -> Any:
+        if self.peek_text() == "-":
+            self.next()
+            return Neg(self.parse_unary())
+        return self.parse_postfix()
+
+    def parse_postfix(self) -> Any:
+        node = self.parse_primary()
+        while True:
+            t = self.peek_text()
+            if t == "?":
+                self.next()
+                node = Optional_(node)
+            else:
+                break
         return node
 
     def parse_primary(self) -> Any:
@@ -166,6 +330,16 @@ class _Parser:
             node = self.parse_pipe()
             self.expect(")")
             return node
+        if text == "[":
+            self.next()
+            if self.peek_text() == "]":
+                self.next()
+                return ArrayCons(None)
+            node = self.parse_pipe()
+            self.expect("]")
+            return ArrayCons(node)
+        if text == "{":
+            return self.parse_object()
         if kind == "string":
             self.next()
             return Literal(_unquote(text))
@@ -173,17 +347,100 @@ class _Parser:
             self.next()
             return Literal(float(text) if "." in text else int(text))
         if kind == "ident":
-            if text == "select":
-                self.next()
-                self.expect("(")
-                cond = self.parse_pipe()
-                self.expect(")")
-                return Select(cond)
+            if text == "if":
+                return self.parse_if()
             if text in ("true", "false", "null"):
                 self.next()
                 return Literal({"true": True, "false": False, "null": None}[text])
+            if text in _FUNCS0 or text in _FUNCS1:
+                self.next()
+                if self.peek_text() == "(":
+                    if text not in _FUNCS1:
+                        raise KqCompileError(
+                            f"{text} takes no argument in {self.src!r}"
+                        )
+                    self.next()
+                    arg = self.parse_pipe()
+                    self.expect(")")
+                    if text == "select":
+                        return Select(arg)
+                    return Func(text, (arg,))
+                if text not in _FUNCS0:
+                    raise KqCompileError(
+                        f"{text} requires an argument in {self.src!r}"
+                    )
+                return Func(text, ())
             raise KqCompileError(f"unsupported function {text!r} in {self.src!r}")
         raise KqCompileError(f"unexpected token {text!r} in {self.src!r}")
+
+    def parse_if(self) -> Any:
+        self.expect("if")
+        cond = self.parse_pipe()
+        self.expect("then")
+        then = self.parse_pipe()
+        tok = self.peek()
+        if tok is not None and tok[1] == "elif":
+            # rewrite elif as nested if
+            self.next()
+            # re-parse as if-chain: build manually
+            sub_cond = self.parse_pipe()
+            self.expect("then")
+            sub_then = self.parse_pipe()
+            rest = self._finish_if(sub_cond, sub_then)
+            return If(cond, then, rest)
+        if tok is not None and tok[1] == "else":
+            self.next()
+            orelse = self.parse_pipe()
+            self.expect("end")
+            return If(cond, then, orelse)
+        self.expect("end")
+        return If(cond, then, None)
+
+    def _finish_if(self, cond: Any, then: Any) -> Any:
+        tok = self.peek()
+        if tok is not None and tok[1] == "elif":
+            self.next()
+            sub_cond = self.parse_pipe()
+            self.expect("then")
+            sub_then = self.parse_pipe()
+            return If(cond, then, self._finish_if(sub_cond, sub_then))
+        if tok is not None and tok[1] == "else":
+            self.next()
+            orelse = self.parse_pipe()
+            self.expect("end")
+            return If(cond, then, orelse)
+        self.expect("end")
+        return If(cond, then, None)
+
+    def parse_object(self) -> Any:
+        self.expect("{")
+        entries: List[Tuple[Any, Any]] = []
+        if self.peek_text() != "}":
+            while True:
+                tok = self.next()
+                if tok[0] == "ident":
+                    key: Any = tok[1]
+                elif tok[0] == "string":
+                    key = _unquote(tok[1])
+                elif tok[1] == "(":
+                    key = self.parse_pipe()
+                    self.expect(")")
+                else:
+                    raise KqCompileError(f"bad object key {tok[1]!r} in {self.src!r}")
+                if self.peek_text() == ":":
+                    self.next()
+                    val = self.parse_alt()
+                else:
+                    if not isinstance(key, str):
+                        raise KqCompileError(f"shorthand needs ident key in {self.src!r}")
+                    val = Path((Field(key),))
+                entries.append((key, val))
+                if self.peek_text() == ",":
+                    self.next()
+                    continue
+                break
+        self.expect("}")
+        return ObjectCons(tuple(entries))
 
     def parse_path(self) -> Path:
         ops: List[Any] = []
@@ -194,6 +451,9 @@ class _Parser:
                 break
             kind, text = tok
             if kind == "ident":
+                # identifiers that are keywords/operators end the path
+                if text in ("and", "or", "then", "else", "elif", "end", "as"):
+                    break
                 self.next()
                 ops.append(Field(text))
             elif text == "[":
@@ -204,6 +464,13 @@ class _Parser:
                 elif nxt[0] == "string":
                     self.expect("]")
                     ops.append(Field(_unquote(nxt[1])))
+                elif nxt[0] == "number" and "." not in nxt[1]:
+                    self.expect("]")
+                    ops.append(Index(int(nxt[1])))
+                elif nxt[1] == "-" and self.peek() and self.peek()[0] == "number":
+                    num = self.next()[1]
+                    self.expect("]")
+                    ops.append(Index(-int(num)))
                 else:
                     raise KqCompileError(
                         f"unsupported index {nxt[1]!r} in {self.src!r}"
@@ -216,6 +483,9 @@ class _Parser:
                     raise KqCompileError(f"dangling '.' in {self.src!r}")
             else:
                 break
+        if self.peek_text() == "?":
+            self.next()
+            return Path(tuple(ops), optional=True)
         return Path(tuple(ops))
 
 
@@ -234,13 +504,137 @@ def _truthy(v: Any) -> bool:
     return v is not None and v is not False
 
 
+_TYPE_ORDER = {"null": 0, "boolean": 1, "number": 2, "string": 3, "array": 4, "object": 5}
+
+
+def _jq_type(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "array"
+    if isinstance(v, dict):
+        return "object"
+    raise _KqRuntimeError(f"non-JSON value {type(v).__name__}")
+
+
+def _jq_cmp(a: Any, b: Any) -> int:
+    """jq's total value order."""
+    ta, tb = _jq_type(a), _jq_type(b)
+    if ta != tb:
+        return -1 if _TYPE_ORDER[ta] < _TYPE_ORDER[tb] else 1
+    if ta in ("null",):
+        return 0
+    if ta == "boolean":
+        return (a > b) - (a < b)
+    if ta in ("number", "string"):
+        return (a > b) - (a < b)
+    if ta == "array":
+        for x, y in zip(a, b):
+            c = _jq_cmp(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    # object: compare sorted keys, then values in key order
+    ka, kb = sorted(a), sorted(b)
+    c = _jq_cmp(ka, kb)
+    if c:
+        return c
+    for k in ka:
+        c = _jq_cmp(a[k], b[k])
+        if c:
+            return c
+    return 0
+
+
+def _arith(op: str, a: Any, b: Any) -> Any:
+    if op == "+":
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if isinstance(a, bool) or isinstance(b, bool):
+            raise _KqRuntimeError("boolean + boolean")
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            return a + b
+        if isinstance(a, str) and isinstance(b, str):
+            return a + b
+        if isinstance(a, list) and isinstance(b, list):
+            return a + b
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            out.update(b)
+            return out
+        raise _KqRuntimeError(f"cannot add {_jq_type(a)} and {_jq_type(b)}")
+    if op == "-":
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and not (
+            isinstance(a, bool) or isinstance(b, bool)
+        ):
+            return a - b
+        if isinstance(a, list) and isinstance(b, list):
+            return [x for x in a if x not in b]
+        raise _KqRuntimeError(f"cannot subtract {_jq_type(b)} from {_jq_type(a)}")
+    if op == "*":
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and not (
+            isinstance(a, bool) or isinstance(b, bool)
+        ):
+            return a * b
+        if isinstance(a, dict) and isinstance(b, dict):
+            return _deep_merge(a, b)
+        raise _KqRuntimeError(f"cannot multiply {_jq_type(a)} and {_jq_type(b)}")
+    if op == "/":
+        if isinstance(a, str) and isinstance(b, str):
+            return a.split(b)
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and not (
+            isinstance(a, bool) or isinstance(b, bool)
+        ):
+            if b == 0:
+                raise _KqRuntimeError("division by zero")
+            out = a / b
+            return out
+        raise _KqRuntimeError(f"cannot divide {_jq_type(a)} by {_jq_type(b)}")
+    if op == "%":
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and not (
+            isinstance(a, bool) or isinstance(b, bool)
+        ):
+            if int(b) == 0:
+                raise _KqRuntimeError("modulo by zero")
+            return int(math.fmod(int(a), int(b)))
+        raise _KqRuntimeError(f"cannot mod {_jq_type(a)} by {_jq_type(b)}")
+    raise _KqRuntimeError(f"unknown operator {op}")
+
+
+def _deep_merge(a: dict, b: dict) -> dict:
+    out = dict(a)
+    for k, v in b.items():
+        if isinstance(out.get(k), dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def _eval(node: Any, value: Any) -> Iterator[Any]:
     if isinstance(node, Literal):
         yield node.value
     elif isinstance(node, Path):
-        yield from _eval_path(node.ops, 0, value)
+        if node.optional:
+            try:
+                yield from list(_eval_path(node.ops, 0, value))
+            except _KqRuntimeError:
+                return
+        else:
+            yield from _eval_path(node.ops, 0, value)
     elif isinstance(node, Pipe):
         yield from _eval_pipe(node.stages, 0, value)
+    elif isinstance(node, Comma):
+        for part in node.parts:
+            yield from _eval(part, value)
     elif isinstance(node, Select):
         for out in _eval(node.cond, value):
             if _truthy(out):
@@ -248,10 +642,317 @@ def _eval(node: Any, value: Any) -> Iterator[Any]:
     elif isinstance(node, Compare):
         for lv in _eval(node.left, value):
             for rv in _eval(node.right, value):
-                eq = _json_equal(lv, rv)
-                yield eq if node.op == "==" else not eq
+                if node.op == "==":
+                    yield _json_equal(lv, rv)
+                elif node.op == "!=":
+                    yield not _json_equal(lv, rv)
+                else:
+                    c = _jq_cmp(lv, rv)
+                    yield {
+                        "<": c < 0,
+                        "<=": c <= 0,
+                        ">": c > 0,
+                        ">=": c >= 0,
+                    }[node.op]
+    elif isinstance(node, Alternative):
+        got = False
+        try:
+            for out in _eval(node.left, value):
+                if _truthy(out):
+                    got = True
+                    yield out
+        except _KqRuntimeError:
+            pass
+        if not got:
+            yield from _eval(node.right, value)
+    elif isinstance(node, BoolOp):
+        for lv in _eval(node.left, value):
+            lt = _truthy(lv)
+            if node.op == "and" and not lt:
+                yield False
+            elif node.op == "or" and lt:
+                yield True
+            else:
+                for rv in _eval(node.right, value):
+                    yield _truthy(rv)
+    elif isinstance(node, Arith):
+        for lv in _eval(node.left, value):
+            for rv in _eval(node.right, value):
+                yield _arith(node.op, lv, rv)
+    elif isinstance(node, Neg):
+        for v in _eval(node.expr, value):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise _KqRuntimeError(f"cannot negate {_jq_type(v)}")
+            yield -v
+    elif isinstance(node, If):
+        for c in _eval(node.cond, value):
+            if _truthy(c):
+                yield from _eval(node.then, value)
+            elif node.orelse is not None:
+                yield from _eval(node.orelse, value)
+            else:
+                yield value
+    elif isinstance(node, ArrayCons):
+        if node.expr is None:
+            yield []
+        else:
+            yield list(_eval(node.expr, value))
+    elif isinstance(node, ObjectCons):
+        yield from _eval_object(node.entries, 0, value, {})
+    elif isinstance(node, Optional_):
+        try:
+            yield from list(_eval(node.expr, value))
+        except _KqRuntimeError:
+            return
+    elif isinstance(node, Func):
+        yield from _eval_func(node, value)
     else:  # pragma: no cover
         raise _KqRuntimeError(f"unknown node {node!r}")
+
+
+def _eval_object(entries, i, value, acc) -> Iterator[Any]:
+    if i == len(entries):
+        yield dict(acc)
+        return
+    key, val = entries[i]
+    keys = [key] if isinstance(key, str) else list(_eval(key, value))
+    for k in keys:
+        if not isinstance(k, str):
+            raise _KqRuntimeError("object key must be a string")
+        for v in _eval(val, value):
+            acc[k] = v
+            yield from _eval_object(entries, i + 1, value, acc)
+
+
+def _eval_func(node: Func, value: Any) -> Iterator[Any]:
+    name = node.name
+    if node.args:
+        arg = node.args[0]
+        if name == "has":
+            for k in _eval(arg, value):
+                if isinstance(value, dict) and isinstance(k, str):
+                    yield k in value
+                elif isinstance(value, list) and isinstance(k, int):
+                    yield 0 <= k < len(value)
+                else:
+                    raise _KqRuntimeError(f"cannot check has() on {_jq_type(value)}")
+        elif name == "map":
+            if not isinstance(value, list):
+                raise _KqRuntimeError("map over non-array")
+            out = []
+            for item in value:
+                out.extend(_eval(arg, item))
+            yield out
+        elif name in ("any", "all"):
+            if not isinstance(value, list):
+                raise _KqRuntimeError(f"{name} over non-array")
+            results = []
+            for item in value:
+                results.extend(_truthy(v) for v in _eval(arg, item))
+            yield any(results) if name == "any" else all(results)
+        elif name in ("test", "startswith", "endswith", "split"):
+            if not isinstance(value, str):
+                raise _KqRuntimeError(f"{name} on non-string")
+            for pat in _eval(arg, value):
+                if not isinstance(pat, str):
+                    raise _KqRuntimeError(f"{name} pattern must be a string")
+                if name == "test":
+                    yield re.search(pat, value) is not None
+                elif name == "startswith":
+                    yield value.startswith(pat)
+                elif name == "endswith":
+                    yield value.endswith(pat)
+                else:
+                    yield value.split(pat)
+        elif name == "contains":
+            for b in _eval(arg, value):
+                yield _contains(value, b)
+        elif name == "join":
+            if not isinstance(value, list):
+                raise _KqRuntimeError("join over non-array")
+            for sep in _eval(arg, value):
+                if not isinstance(sep, str):
+                    raise _KqRuntimeError("join separator must be a string")
+                yield sep.join(
+                    "" if x is None else (x if isinstance(x, str) else _tostring(x))
+                    for x in value
+                )
+        elif name in ("sort_by", "min_by", "max_by"):
+            if not isinstance(value, list):
+                raise _KqRuntimeError(f"{name} over non-array")
+            import functools
+
+            def key_of(item):
+                return list(_eval(arg, item))
+
+            decorated = [(key_of(x), x) for x in value]
+            cmp = functools.cmp_to_key(lambda p, q: _jq_cmp(p[0], q[0]))
+            if name == "sort_by":
+                yield [x for _, x in sorted(decorated, key=cmp)]
+            elif not decorated:
+                yield None
+            elif name == "min_by":
+                yield min(decorated, key=cmp)[1]
+            else:
+                yield max(decorated, key=cmp)[1]
+        elif name == "range":
+            for n in _eval(arg, value):
+                if isinstance(n, bool) or not isinstance(n, (int, float)):
+                    raise _KqRuntimeError("range over non-number")
+                i = 0
+                while i < n:
+                    yield i
+                    i += 1
+        elif name == "error":
+            for msg in _eval(arg, value):
+                raise _KqRuntimeError(str(msg))
+        else:  # pragma: no cover
+            raise _KqRuntimeError(f"unknown function {name}")
+        return
+
+    # zero-arg builtins
+    if name == "length":
+        if value is None:
+            yield 0
+        elif isinstance(value, bool):
+            raise _KqRuntimeError("boolean has no length")
+        elif isinstance(value, (int, float)):
+            yield abs(value)
+        elif isinstance(value, (str, list, dict)):
+            yield len(value)
+        else:
+            raise _KqRuntimeError("no length")
+    elif name == "keys":
+        if isinstance(value, dict):
+            yield sorted(value)
+        elif isinstance(value, list):
+            yield list(range(len(value)))
+        else:
+            raise _KqRuntimeError("keys on non-object")
+    elif name == "values":
+        if isinstance(value, dict):
+            yield [value[k] for k in sorted(value)]
+        elif isinstance(value, list):
+            yield list(value)
+        else:
+            raise _KqRuntimeError("values on non-object")
+    elif name == "type":
+        yield _jq_type(value)
+    elif name == "tostring":
+        yield value if isinstance(value, str) else _tostring(value)
+    elif name == "tonumber":
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            yield value
+        elif isinstance(value, str):
+            try:
+                yield float(value) if "." in value or "e" in value.lower() else int(value)
+            except ValueError:
+                raise _KqRuntimeError(f"cannot parse {value!r} as number") from None
+        else:
+            raise _KqRuntimeError(f"cannot parse {_jq_type(value)} as number")
+    elif name == "not":
+        yield not _truthy(value)
+    elif name == "empty":
+        return
+    elif name == "add":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("add over non-array")
+        acc: Any = None
+        for item in value:
+            acc = _arith("+", acc, item)
+        yield acc
+    elif name in ("any", "all"):
+        if not isinstance(value, list):
+            raise _KqRuntimeError(f"{name} over non-array")
+        yield any(_truthy(v) for v in value) if name == "any" else all(
+            _truthy(v) for v in value
+        )
+    elif name == "first":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("first over non-array")
+        if not value:
+            raise _KqRuntimeError("first of empty array")
+        yield value[0]
+    elif name == "last":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("last over non-array")
+        if not value:
+            raise _KqRuntimeError("last of empty array")
+        yield value[-1]
+    elif name in ("min", "max"):
+        if not isinstance(value, list):
+            raise _KqRuntimeError(f"{name} over non-array")
+        if not value:
+            yield None
+        else:
+            import functools
+
+            key = functools.cmp_to_key(_jq_cmp)
+            yield (min if name == "min" else max)(value, key=key)
+    elif name in ("sort", "unique"):
+        if not isinstance(value, list):
+            raise _KqRuntimeError(f"{name} over non-array")
+        import functools
+
+        key = functools.cmp_to_key(_jq_cmp)
+        out = sorted(value, key=key)
+        if name == "unique":
+            dedup: List[Any] = []
+            for x in out:
+                if not dedup or not _json_equal(dedup[-1], x):
+                    dedup.append(x)
+            out = dedup
+        yield out
+    elif name == "reverse":
+        if isinstance(value, list):
+            yield list(reversed(value))
+        elif isinstance(value, str):
+            yield value[::-1]
+        else:
+            raise _KqRuntimeError("reverse on non-array")
+    elif name in ("floor", "ceil", "abs"):
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise _KqRuntimeError(f"{name} on non-number")
+        yield {
+            "floor": math.floor,
+            "ceil": math.ceil,
+            "abs": abs,
+        }[name](value)
+    elif name in ("ascii_downcase", "ascii_upcase"):
+        if not isinstance(value, str):
+            raise _KqRuntimeError(f"{name} on non-string")
+        yield value.lower() if name == "ascii_downcase" else value.upper()
+    elif name == "tojson":
+        import json as _json
+
+        yield _json.dumps(value, separators=(",", ":"))
+    elif name == "fromjson":
+        import json as _json
+
+        if not isinstance(value, str):
+            raise _KqRuntimeError("fromjson on non-string")
+        try:
+            yield _json.loads(value)
+        except ValueError:
+            raise _KqRuntimeError("invalid json") from None
+    else:  # pragma: no cover
+        raise _KqRuntimeError(f"unknown function {name}")
+
+
+def _tostring(v: Any) -> str:
+    import json as _json
+
+    return _json.dumps(v, separators=(",", ":"))
+
+
+def _contains(a: Any, b: Any) -> bool:
+    if isinstance(a, str) and isinstance(b, str):
+        return b in a
+    if isinstance(a, list) and isinstance(b, list):
+        return all(any(_contains(x, y) for x in a) for y in b)
+    if isinstance(a, dict) and isinstance(b, dict):
+        return all(k in a and _contains(a[k], v) for k, v in b.items())
+    return _json_equal(a, b)
 
 
 def _eval_pipe(stages: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
@@ -276,6 +977,15 @@ def _eval_path(ops: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
             raise _KqRuntimeError(
                 f"cannot index {type(value).__name__} with {op.name!r}"
             )
+    elif isinstance(op, Index):
+        if value is None:
+            yield from _eval_path(ops, i + 1, None)
+        elif isinstance(value, list):
+            n = len(value)
+            j = op.i if op.i >= 0 else n + op.i
+            yield from _eval_path(ops, i + 1, value[j] if 0 <= j < n else None)
+        else:
+            raise _KqRuntimeError(f"cannot index {type(value).__name__} with number")
     else:  # Iterate
         if isinstance(value, list):
             for item in value:

@@ -24,8 +24,61 @@ PATCH_JSON = "json"
 PATCH_MERGE = "merge"
 PATCH_STRATEGIC = "strategic"
 
-# patch-merge keys for k8s list types (subset of the OpenAPI metadata the
-# reference discovers dynamically via pkg/utils/patch/openapi.go:43-248).
+# ---------------------------------------------------------------------------
+# Strategic-merge metadata
+#
+# The reference discovers patchMergeKey/patchStrategy per type from the
+# apiserver's OpenAPI v3 (pkg/utils/patch/openapi.go:43-248).  This repo IS
+# the apiserver, so the authoritative metadata lives here: a per-kind table
+# mirroring the upstream k8s struct tags (x-kubernetes-patch-merge-key /
+# x-kubernetes-patch-strategy), served back out via /openapi/v3
+# (cluster/k8s_api.py) so ecosystem tools discover the same truth.
+# ---------------------------------------------------------------------------
+
+#: ("merge", key) = merge by key; ("merge", None) = primitive set-merge;
+#: absent = atomic (replace wholesale)
+_POD_META = {
+    ("spec", "containers"): ("merge", "name"),
+    ("spec", "initContainers"): ("merge", "name"),
+    ("spec", "ephemeralContainers"): ("merge", "name"),
+    ("spec", "volumes"): ("merge", "name"),
+    ("spec", "containers", "env"): ("merge", "name"),
+    ("spec", "containers", "ports"): ("merge", "containerPort"),
+    ("spec", "containers", "volumeMounts"): ("merge", "mountPath"),
+    ("spec", "containers", "volumeDevices"): ("merge", "devicePath"),
+    ("spec", "initContainers", "env"): ("merge", "name"),
+    ("spec", "initContainers", "ports"): ("merge", "containerPort"),
+    ("spec", "initContainers", "volumeMounts"): ("merge", "mountPath"),
+    ("spec", "imagePullSecrets"): ("merge", "name"),
+    ("spec", "hostAliases"): ("merge", "ip"),
+    ("spec", "readinessGates"): ("merge", "conditionType"),
+    ("status", "conditions"): ("merge", "type"),
+    # NOTE upstream PodStatus.ContainerStatuses carries NO patch tags:
+    # atomic replace (the old name-keyed table diverged here)
+}
+_NODE_META = {
+    ("status", "conditions"): ("merge", "type"),
+    ("status", "addresses"): ("merge", "type"),
+    # taints, images, volumesAttached: atomic upstream
+}
+_SERVICE_META = {
+    ("spec", "ports"): ("merge", "port"),
+}
+_COMMON_META = {
+    ("metadata", "finalizers"): ("merge", None),  # primitive set-merge
+    ("metadata", "ownerReferences"): ("merge", "uid"),
+}
+
+#: kind -> {path tuple (list indices elided) -> ("merge", key|None)}
+STRATEGIC_META: Dict[str, Dict[tuple, tuple]] = {
+    "Pod": {**_COMMON_META, **_POD_META},
+    "Node": {**_COMMON_META, **_NODE_META},
+    "Service": {**_COMMON_META, **_SERVICE_META},
+}
+
+#: legacy field-NAME-keyed fallback for kinds without typed metadata
+#: (CRDs and untyped objects): matches the pre-OpenAPI behavior so
+#: unknown kinds keep merging the well-known k8s list shapes
 _MERGE_KEYS = {
     "conditions": "type",
     "containers": "name",
@@ -38,10 +91,29 @@ _MERGE_KEYS = {
     "env": "name",
     "ports": "containerPort",
     "addresses": "type",
-    # NOTE: node status.images, taints and tolerations are atomic lists in
-    # k8s (no patchMergeKey) and must replace wholesale.
     "finalizers": None,  # set-merge
 }
+
+
+def register_strategic_meta(kind: str, path: tuple, merge_key: Optional[str]) -> None:
+    """Register list metadata for a CRD kind (the CRD's
+    x-kubernetes-patch-merge-key analog)."""
+    STRATEGIC_META.setdefault(kind, dict(_COMMON_META))[tuple(path)] = (
+        "merge",
+        merge_key,
+    )
+
+
+def list_meta(kind: Optional[str], path: tuple, field_name: str):
+    """(strategy, merge_key) for a list field: typed table first, then
+    the name-keyed fallback for unknown kinds; None = atomic."""
+    if kind:
+        table = STRATEGIC_META.get(kind)
+        if table is not None:
+            return table.get(path)
+    if field_name in _MERGE_KEYS:
+        return ("merge", _MERGE_KEYS[field_name])
+    return None
 
 
 def apply_json_patch(obj: Any, ops: List[Dict[str, Any]]) -> Any:
@@ -146,44 +218,107 @@ def merge_patch_is_noop(obj: Any, patch: Any) -> bool:
     return True
 
 
-def apply_strategic_merge_patch(obj: Any, patch: Any, field_name: str = "") -> Any:
-    """Strategic merge: dicts merge recursively; lists of objects merge
-    by the field's patch-merge key; other lists replace."""
+_DIRECTIVE = "$patch"
+_DEL_PRIMITIVE = "$deleteFromPrimitiveList/"
+_SET_ORDER = "$setElementOrder/"
+
+
+def apply_strategic_merge_patch(
+    obj: Any,
+    patch: Any,
+    field_name: str = "",
+    kind: Optional[str] = None,
+    path: tuple = (),
+) -> Any:
+    """Strategic merge with k8s semantics: dicts merge recursively,
+    lists of objects merge by the field's patch-merge key (typed
+    metadata via ``list_meta``; see STRATEGIC_META), other lists
+    replace; ``$patch: replace|delete`` and ``$deleteFromPrimitiveList``
+    directives honored (``$setElementOrder`` is accepted and ignored —
+    element order follows merge order, a documented divergence).
+
+    (reference consumes the same metadata through OpenAPI discovery,
+    pkg/utils/patch/openapi.go:43-248)"""
     if isinstance(patch, dict) and isinstance(obj, dict):
+        directive = patch.get(_DIRECTIVE)
+        if directive == "replace":
+            return {
+                k: _copy_json(v) for k, v in patch.items() if k != _DIRECTIVE
+            }
+        if directive == "delete":
+            return None  # caller (dict/list merge) removes the entry
         out = dict(obj)
         for k, v in patch.items():
+            if k.startswith(_DEL_PRIMITIVE):
+                target = k[len(_DEL_PRIMITIVE):]
+                cur = out.get(target)
+                if isinstance(cur, list) and isinstance(v, list):
+                    out[target] = [x for x in cur if x not in v]
+                continue
+            if k.startswith(_SET_ORDER) or k == _DIRECTIVE:
+                continue
             if v is None:
                 out.pop(k, None)
-            elif k in out:
-                out[k] = apply_strategic_merge_patch(out[k], v, k)
+                continue
+            merged = (
+                apply_strategic_merge_patch(out[k], v, k, kind, path + (k,))
+                if k in out
+                else _strip_directives(v)
+            )
+            if merged is None:
+                out.pop(k, None)  # nested {"$patch": "delete"}
             else:
-                out[k] = _copy_json(v)
+                out[k] = merged
         return out
     if isinstance(patch, list) and isinstance(obj, list):
-        key = _MERGE_KEYS.get(field_name)
-        if key is None:
-            if field_name in _MERGE_KEYS:  # set-merge (e.g. finalizers)
-                merged = list(obj)
-                for item in patch:
-                    if item not in merged:
-                        merged.append(_copy_json(item))
-                return merged
-            return _copy_json(patch)
+        meta = list_meta(kind, path, field_name)
+        if meta is None:
+            return _strip_directives(patch)
+        key = meta[1]
+        if key is None:  # primitive set-merge (e.g. finalizers)
+            merged = list(obj)
+            for item in patch:
+                if item not in merged:
+                    merged.append(_copy_json(item))
+            return merged
         merged = [_copy_json(i) for i in obj]
         index = {i.get(key): n for n, i in enumerate(merged) if isinstance(i, dict)}
         for item in patch:
             if isinstance(item, dict) and item.get(key) in index:
                 n = index[item[key]]
-                merged[n] = apply_strategic_merge_patch(merged[n], item, "")
+                if item.get(_DIRECTIVE) == "delete":
+                    # mark for removal, fix indexes after
+                    merged[n] = None
+                    continue
+                merged[n] = apply_strategic_merge_patch(
+                    merged[n], item, "", kind, path
+                )
+            elif isinstance(item, dict) and item.get(_DIRECTIVE) == "delete":
+                continue  # delete of an absent element: no-op
             else:
-                merged.append(_copy_json(item))
+                merged.append(_strip_directives(item))
                 if isinstance(item, dict):
                     index[item.get(key)] = len(merged) - 1
-        return merged
-    return _copy_json(patch)
+        return [m for m in merged if m is not None]
+    return _strip_directives(patch)
 
 
-def apply_patch(obj: Any, data: Any, patch_type: str) -> Any:
+def _strip_directives(v: Any) -> Any:
+    """Deep copy minus $patch/$setElementOrder bookkeeping keys (a new
+    element carrying a directive must not store it)."""
+    t = type(v)
+    if t is dict:
+        return {
+            k: _strip_directives(x)
+            for k, x in v.items()
+            if k != _DIRECTIVE and not k.startswith(_SET_ORDER)
+        }
+    if t is list:
+        return [_strip_directives(x) for x in v]
+    return v
+
+
+def apply_patch(obj: Any, data: Any, patch_type: str, kind: Optional[str] = None) -> Any:
     if patch_type == PATCH_JSON:
         if isinstance(data, (str, bytes)):
             data = json.loads(data)
@@ -191,7 +326,7 @@ def apply_patch(obj: Any, data: Any, patch_type: str) -> Any:
     if isinstance(data, (str, bytes)):
         data = json.loads(data)
     if patch_type == PATCH_STRATEGIC:
-        return apply_strategic_merge_patch(obj, data)
+        return apply_strategic_merge_patch(obj, data, kind=kind)
     return apply_merge_patch(obj, data)
 
 
@@ -216,7 +351,9 @@ def wrap_json_patch_with_root(root: str, ops: List[Dict[str, Any]]) -> List[Dict
     return out
 
 
-def is_noop_patch(obj: Any, data: Any, patch_type: str) -> bool:
+def is_noop_patch(
+    obj: Any, data: Any, patch_type: str, kind: Optional[str] = None
+) -> bool:
     """Would applying this patch change the object?
     (reference controllers/utils.go:162-304 checkNeedPatch*)"""
     try:
@@ -224,6 +361,6 @@ def is_noop_patch(obj: Any, data: Any, patch_type: str) -> bool:
             if isinstance(data, (str, bytes)):
                 data = json.loads(data)
             return merge_patch_is_noop(obj, data)
-        return apply_patch(obj, data, patch_type) == obj
+        return apply_patch(obj, data, patch_type, kind=kind) == obj
     except (KeyError, IndexError, ValueError, TypeError):
         return False

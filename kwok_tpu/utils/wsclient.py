@@ -115,6 +115,10 @@ class WSClient:
             ),
             None,
         )
+        # the timeout covered connect+handshake only: an idle stream
+        # (exec waiting on input, quiet attach) must not hit a 30s recv
+        # deadline that _read_exact would treat as clean EOF (ADVICE r02)
+        self.sock.settimeout(None)
 
     # ------------------------------------------------------------------ recv
 

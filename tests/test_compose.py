@@ -24,7 +24,7 @@ def test_compose_document_topology(home):
 
     doc = yaml.safe_load(open(rt.compose_path))
     services = doc["services"]
-    assert set(services) == {"apiserver", "scheduler", "kwok-controller"}
+    assert set(services) == {"apiserver", "scheduler", "kube-controller-manager", "kwok-controller"}
     assert services["scheduler"]["depends_on"] == ["apiserver"]
 
     api = services["apiserver"]

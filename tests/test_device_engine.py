@@ -276,7 +276,12 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            {"key": ".spec.containers | length", "operator": "Exists"}
+                            # reduce/$vars are outside even the widened
+                            # kq grammar -> host fallback
+                            {
+                                "key": "reduce .spec.containers[] as $c (0; . + 1)",
+                                "operator": "Exists",
+                            }
                         ]
                     },
                 },
@@ -284,6 +289,42 @@ class TestCompileErrors:
         )
         with pytest.raises(StageCompileError):
             DeviceSimulator([s], capacity=1)
+
+    def test_widened_jq_lowers_as_opaque_column(self):
+        """Pipes to builtins (| length) now lower: the feature column
+        evaluates the full kq query host-side and the device sees its
+        vocab bitmask (no per-stage special cases needed)."""
+        s = Stage.from_dict(
+            {
+                "metadata": {"name": "has-two"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {
+                        "matchExpressions": [
+                            {
+                                "key": ".spec.containers | length",
+                                "operator": "In",
+                                "values": ["2"],
+                            }
+                        ]
+                    },
+                    "next": {"statusTemplate": "phase: Two"},
+                },
+            }
+        )
+        sim = DeviceSimulator([s], capacity=4)
+        one = new_pod(0)
+        two = new_pod(1)
+        two["spec"]["containers"] = [
+            {"name": "a", "image": "i"},
+            {"name": "b", "image": "i"},
+        ]
+        r1 = sim.admit(one)
+        r2 = sim.admit(two)
+        for _ in range(5):
+            sim.step(dt_ms=100)
+        assert (sim.objects[r1].get("status") or {}).get("phase") is None
+        assert sim.objects[r2]["status"]["phase"] == "Two"
 
 
 class TestReviewRegressions:
