@@ -49,15 +49,16 @@ from kwok_tpu.cluster.store import (
 
 __all__ = ["K8sFacade", "encode_continue", "decode_continue", "status_body"]
 
-#: Content-Type → store patch_type.  ``apply-patch+yaml`` (server-side
-#: apply) is accepted and degraded to a merge patch — the store has no
-#: field-manager tracking.
+#: Content-Type → store patch_type.  ``application/apply-patch+yaml``
+#: (server-side apply) is routed separately to ``store.apply`` with
+#: field-manager tracking and conflict detection.
 PATCH_CONTENT_TYPES = {
     "application/merge-patch+json": "merge",
     "application/json-patch+json": "json",
     "application/strategic-merge-patch+json": "strategic",
-    "application/apply-patch+yaml": "merge",
 }
+
+APPLY_CONTENT_TYPE = "application/apply-patch+yaml"
 
 _BOOKMARK_EVERY = 15.0
 
@@ -120,7 +121,22 @@ def error_code_reason(exc: Exception) -> Tuple[int, str]:
 
 def status_for(exc: Exception) -> dict:
     code, reason = error_code_reason(exc)
-    return status_body(code, reason, str(exc))
+    details = None
+    causes = getattr(exc, "causes", None)
+    if causes:
+        # ApplyConflict: the FieldManagerConflict causes kubectl parses
+        # to print its per-field "conflict with ..." hint
+        details = {
+            "causes": [
+                {
+                    "reason": "FieldManagerConflict",
+                    "message": f'conflict with "{manager}"',
+                    "field": field,
+                }
+                for manager, field in causes
+            ]
+        }
+    return status_body(code, reason, str(exc), details)
 
 
 def _usage_quantities(cpu_cores: float, mem_bytes: float) -> dict:
@@ -578,8 +594,40 @@ class K8sFacade:
             return True
         if method == "PATCH":
             ctype = (handler.headers.get("Content-Type") or "").split(";")[0].strip()
-            patch_type = PATCH_CONTENT_TYPES.get(ctype, "merge")
             body = self._read_body(handler)
+            if ctype == APPLY_CONTENT_TYPE and r.subresource:
+                # subresource apply (kubectl --subresource=status):
+                # degrade to a scoped merge patch — field ownership is
+                # tracked on the main resource only (pre-SSA behavior
+                # of this facade, kept so status managers don't regress)
+                out = self.store.patch(
+                    r.rtype.kind,
+                    r.name,
+                    body,
+                    patch_type="merge",
+                    namespace=ns,
+                    subresource=r.subresource,
+                    as_user=self._user(handler),
+                )
+                self._send(handler, 200, self._stamp(r.rtype, out))
+                return True
+            if ctype == APPLY_CONTENT_TYPE:
+                # server-side apply: field-manager tracked, kubectl
+                # conflict contract (store.apply docstring)
+                out, created = self.store.apply(
+                    r.rtype.kind,
+                    r.name,
+                    body or {},
+                    field_manager=q.get("fieldManager") or "unknown",
+                    force=str(q.get("force")).lower() in ("true", "1"),
+                    namespace=ns,
+                    as_user=self._user(handler),
+                )
+                self._send(
+                    handler, 201 if created else 200, self._stamp(r.rtype, out)
+                )
+                return True
+            patch_type = PATCH_CONTENT_TYPES.get(ctype, "merge")
             out = self.store.patch(
                 r.rtype.kind,
                 r.name,
@@ -775,7 +823,14 @@ class K8sFacade:
             w.stop()
 
     def _encode_event(self, rtype, ev) -> bytes:
-        obj = self._stamp(rtype, ev.object)
+        # watch events share the stored instance (store._emit contract):
+        # never _stamp it in place — graft missing kind/apiVersion onto
+        # a shallow copy instead
+        obj = ev.object
+        if "kind" not in obj or "apiVersion" not in obj:
+            obj = dict(obj)
+            obj.setdefault("kind", rtype.kind)
+            obj.setdefault("apiVersion", rtype.api_version)
         return json.dumps({"type": ev.type, "object": obj}).encode() + b"\n"
 
     @staticmethod

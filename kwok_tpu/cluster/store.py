@@ -66,6 +66,18 @@ class AlreadyExists(Conflict):
     client-go retry.RetryOnConflict keys on the reason string)."""
 
 
+class ApplyConflict(Conflict):
+    """Server-side apply hit fields owned by other managers.
+
+    ``causes`` is a list of ``(manager, dotted_field)`` pairs the wire
+    facade renders as FieldManagerConflict Status causes — the shape
+    kubectl parses to print its "conflict with ..." hint."""
+
+    def __init__(self, message: str, causes):
+        super().__init__(message)
+        self.causes = list(causes)
+
+
 class Expired(ValueError):
     """watch resume version fell out of the history ring."""
 
@@ -320,6 +332,13 @@ class WatchEvent:
     rv: int = 0
 
 
+if _FAST is not None and hasattr(_FAST, "WatchEvent"):
+    # slot-backed C event: same (type, object, rv) surface, but
+    # status_commit can allocate it without a Python __init__ call per
+    # row (every consumer is duck-typed on the three attributes)
+    WatchEvent = _FAST.WatchEvent  # noqa: F811
+
+
 @dataclass
 class _TypeState:
     rtype: ResourceType
@@ -332,6 +351,17 @@ class _TypeState:
     #: lazily maintained sorted key list; invalidated on add/remove so
     #: paged walks don't re-sort the keyspace per page
     sorted_keys: Optional[List[Tuple[str, str]]] = None
+    #: gap marker for the zero-copy commit lane: status batches with no
+    #: event consumer mutate stored objects in place and append nothing
+    #: to history; a watch resume at/below this version would replay a
+    #: gapped (and possibly instance-mutated) window, so it gets
+    #: Expired and re-lists — the legal watch-cache-too-small answer
+    inplace_rv: int = 0
+    #: monotonic deadline until which the zero-copy lane must yield to
+    #: the copy lane: set when a watch resume hits the gap marker, so a
+    #: list-then-watch consumer's NEXT attempt finds real history
+    #: instead of being starved by a continuously-advancing marker
+    lane_cooloff: float = 0.0
 
 
 class ResourceStore:
@@ -442,7 +472,13 @@ class ResourceStore:
         return (ns, meta.get("name") or "")
 
     def _emit(self, st: _TypeState, etype: str, obj: dict, rv: int) -> None:
-        ev = WatchEvent(type=etype, object=copy_json(obj), rv=rv)
+        # the event shares the stored instance — the same
+        # handed-out-by-reference contract apply_status_batch pins:
+        # every store mutation path is copy-on-write, so the instance
+        # is immutable from here on; watchers/caches must not mutate
+        # it.  (The former per-event deep copy was half the slow-path
+        # drain cost at 1M objects.)
+        ev = WatchEvent(type=etype, object=obj, rv=rv)
         st.history.append(ev)
         for w in list(st.watchers):
             w._push(ev)
@@ -523,7 +559,14 @@ class ResourceStore:
         namespace: Optional[str] = None,
         label_selector: Selector = None,
         field_selector: Selector = None,
+        copy: bool = True,
     ) -> Tuple[List[dict], int]:
+        """``copy=False`` hands out the stored instances themselves —
+        the read-only handed-out-by-reference contract (_emit /
+        apply_status_batch); used by the informer reflector, whose
+        consumers never mutate (a deep copy of 1M pods per re-list was
+        most of the e2e setup cost).  Default stays deep-copied."""
+        out = copy_json if copy else (lambda o: o)
         with self._mut:
             st = self._state(kind)
             cand = self._index_candidates(st, field_selector)
@@ -538,7 +581,7 @@ class ResourceStore:
                         continue
                     if not match_label_selector(obj, label_selector):
                         continue
-                    items.append(copy_json(obj))
+                    items.append(out(obj))
                 return items, self._rv
             items = []
             for (ns, _), obj in sorted(st.objects.items()):
@@ -548,7 +591,7 @@ class ResourceStore:
                     continue
                 if not match_field_selector(obj, field_selector):
                     continue
-                items.append(copy_json(obj))
+                items.append(out(obj))
             return items, self._rv
 
     def list_paged(
@@ -714,6 +757,135 @@ class ResourceStore:
             self._audit.append(("patch", f"{kind}:{key}", as_user))
             return self._store_mutation(st, key, new)
 
+    def apply(
+        self,
+        kind: str,
+        name: str,
+        applied: dict,
+        field_manager: str,
+        force: bool = False,
+        namespace: Optional[str] = None,
+        as_user: Optional[str] = None,
+    ) -> Tuple[dict, bool]:
+        """Server-side apply (``PATCH`` with
+        ``application/apply-patch+yaml``): merge the applied
+        configuration, track per-manager field ownership in
+        ``metadata.managedFields``, remove fields this manager
+        abandoned, and raise :class:`ApplyConflict` when another
+        manager owns a desired field (unless ``force`` transfers
+        ownership) — the contract real clusters get from the
+        kube-apiserver (reference runtime/binary/cluster.go:316-728).
+        Returns ``(object, created)``.
+        """
+        from kwok_tpu.utils import ssa
+
+        applied = copy_json(applied)
+        (applied.get("metadata") or {}).pop("managedFields", None)
+        desired = ssa.field_set(applied)
+        with self._mut:
+            st = self._state(kind)
+            ns = (namespace or "default") if st.rtype.namespaced else ""
+            body_meta = applied.get("metadata") or {}
+            if body_meta.get("name") and body_meta["name"] != name:
+                raise ValueError(
+                    f"the name in the body ({body_meta['name']}) does not "
+                    f"match the name on the request ({name})"
+                )
+            if (
+                st.rtype.namespaced
+                and body_meta.get("namespace")
+                and body_meta["namespace"] != ns
+            ):
+                raise ValueError(
+                    f"the namespace in the body ({body_meta['namespace']}) "
+                    f"does not match the namespace on the request ({ns})"
+                )
+            key = (ns, name)
+            cur = st.objects.get(key)
+            entry = {
+                "manager": field_manager,
+                "operation": "Apply",
+                "apiVersion": applied.get("apiVersion") or st.rtype.api_version,
+                "time": self._now_string(),
+                "fieldsType": "FieldsV1",
+                "fieldsV1": ssa.to_fields_v1(desired),
+            }
+            if cur is None:
+                meta = applied.setdefault("metadata", {})
+                meta.setdefault("name", name)
+                if st.rtype.namespaced:
+                    meta.setdefault("namespace", ns)
+                meta["managedFields"] = [entry]
+                applied.setdefault("kind", st.rtype.kind)
+                # RLock: create() re-enters the store mutex
+                return self.create(applied, namespace=ns, as_user=as_user), True
+
+            mf = list(cur["metadata"].get("managedFields") or [])
+            others = []
+            prior: ssa.FieldSet = set()
+            for e in mf:
+                fs = ssa.from_fields_v1(e.get("fieldsV1") or {})
+                if e.get("manager") == field_manager and e.get("operation") == "Apply":
+                    prior = fs
+                else:
+                    others.append((e, fs))
+            conflicts = ssa.find_conflicts(
+                desired,
+                [(e.get("manager") or "", fs) for e, fs in others],
+                applied,
+                cur,
+            )
+            if conflicts and not force:
+                causes = [(m, ssa.dotted(p)) for m, p in conflicts]
+                managers = sorted({m for m, _ in causes})
+                raise ApplyConflict(
+                    f"Apply failed with {len(causes)} conflict"
+                    f"{'s' if len(causes) != 1 else ''}: "
+                    + "; ".join(
+                        f'conflict with "{m}": {f}' for m, f in causes
+                    )
+                    + f" (managers {', '.join(managers)}; retry with force to take ownership)",
+                    causes,
+                )
+
+            new = copy_json(cur)
+            for path in prior - desired:
+                # the manager abandoned these fields and nobody else
+                # owns them: apply removes them
+                if not any(path in fs for _, fs in others):
+                    ssa.remove_path(new, path)
+            new = apply_patch(new, applied, "merge", kind=st.rtype.kind)
+
+            new_mf = []
+            taken = {(m, p) for m, p in conflicts} if force else set()
+            for e, fs in others:
+                m = e.get("manager") or ""
+                keep = {p for p in fs if (m, p) not in taken}
+                if keep != fs:
+                    if not keep:
+                        continue  # fully dispossessed by --force
+                    e = dict(e)
+                    e["fieldsV1"] = ssa.to_fields_v1(keep)
+                new_mf.append(e)
+            new_mf.append(entry)
+
+            # metadata invariants, exactly like patch()
+            new["metadata"] = dict(new.get("metadata") or {})
+            new["metadata"]["managedFields"] = new_mf
+            new["metadata"]["uid"] = cur["metadata"].get("uid")
+            new["metadata"]["creationTimestamp"] = cur["metadata"].get(
+                "creationTimestamp"
+            )
+            new["metadata"]["name"] = cur["metadata"].get("name")
+            if st.rtype.namespaced:
+                new["metadata"]["namespace"] = cur["metadata"].get("namespace")
+            if cur["metadata"].get("deletionTimestamp") is not None:
+                new["metadata"]["deletionTimestamp"] = cur["metadata"][
+                    "deletionTimestamp"
+                ]
+            self._audit.append(("apply", f"{kind}:{key}", as_user))
+            return self._store_mutation(st, key, new), False
+
     def _store_mutation(self, st: _TypeState, key: Tuple[str, str], new: dict) -> dict:
         """Commit an updated object; reap it if it is terminating with no
         finalizers left (the apiserver's finalizer GC)."""
@@ -797,6 +969,16 @@ class ResourceStore:
                 ),
             )
             if since_rv is not None and since_rv < self._rv:
+                if since_rv < st.inplace_rv:
+                    # the zero-copy lane left a gap below this version.
+                    # Yield the lane for a while so this consumer's
+                    # list-then-watch retry finds real history instead
+                    # of racing a continuously-advancing marker.
+                    st.lane_cooloff = time.monotonic() + 30.0
+                    raise Expired(
+                        f"resourceVersion {since_rv} is too old "
+                        "(compacted by the in-place commit lane)"
+                    )
                 hist = list(st.history)
                 if hist and hist[0].rv > since_rv + 1 and len(hist) == st.history.maxlen:
                     raise Expired(f"resourceVersion {since_rv} is too old")
@@ -809,7 +991,10 @@ class ResourceStore:
     # --------------------------------------------------------------------- bulk
 
     def apply_status_batch(
-        self, kind: str, items: List[Tuple[Optional[str], str, dict]]
+        self,
+        kind: str,
+        items: List[Tuple[Optional[str], str, dict]],
+        exclude: Optional[Watcher] = None,
     ) -> List[Optional[Tuple[int, dict]]]:
         """Device-drain fast path: replace the ``status`` of many
         objects in one locked pass (the columnar op batch of VERDICT r02
@@ -826,11 +1011,43 @@ class ResourceStore:
         Semantics match ``patch(subresource="status", type=merge)`` for
         a patch that replaces status wholesale: metadata invariants
         cannot change, and the finalizer-reap check cannot trigger (a
-        status write never clears finalizers)."""
+        status write never clears finalizers).
+
+        ``exclude``: a watcher to skip during event delivery — the
+        caller IS that watcher's consumer and adopts the returned
+        objects directly, so delivering its own echoes would only be
+        store-then-filter work (VERDICT r03 next-#1).  The events still
+        land in the history ring: an excluded watcher that dies and
+        resumes via ``watch(since_rv=...)`` replays them (and its
+        consumer's staleness filter drops them, as before)."""
         with self._mut:
             st = self._state(kind)
             namespaced = st.rtype.namespaced
             status_indexed = any(p.startswith("status.") for p in st.indexes)
+            if (
+                _FAST is not None
+                and not status_indexed
+                and exclude is not None
+                and all(w is exclude or w.stopped for w in st.watchers)
+                and time.monotonic() >= st.lane_cooloff
+            ):
+                # zero-copy lane: the only live watcher is the caller's
+                # own (excluded) one, so these events have no consumer —
+                # mutate stored objects in place, record the gap marker
+                # instead of history (see _TypeState.inplace_rv)
+                before_rv = self._rv
+                out, self._rv = _FAST.status_commit_inplace(
+                    st.objects, items, self._rv, namespaced
+                )
+                if self._rv != before_rv:
+                    # only a batch that actually mutated something
+                    # leaves a history gap — an all-missing batch must
+                    # not force consumers into spurious re-lists
+                    st.inplace_rv = self._rv
+                    self._audit.append(
+                        ("patch-status-batch", f"{kind}:{len(items)}", None)
+                    )
+                return out
             if _FAST is not None and not status_indexed:
                 out, evs, self._rv = _FAST.status_commit(
                     st.objects, items, self._rv, namespaced, WatchEvent
@@ -841,7 +1058,8 @@ class ResourceStore:
                         ("patch-status-batch", f"{kind}:{len(evs)}", None)
                     )
                     for w in list(st.watchers):
-                        w._push_batch(evs)
+                        if w is not exclude:
+                            w._push_batch(evs)
                 return out
             out: List[Optional[Tuple[int, dict]]] = []
             evs: List[WatchEvent] = []
@@ -872,7 +1090,8 @@ class ResourceStore:
                     ("patch-status-batch", f"{kind}:{len(evs)}", None)
                 )
                 for w in list(st.watchers):
-                    w._push_batch(evs)
+                    if w is not exclude:
+                        w._push_batch(evs)
             return out
 
     def bulk(self, ops: List[dict]) -> List[dict]:

@@ -33,6 +33,7 @@ from kwok_tpu.engine.render_plan import build as _plan_build
 from kwok_tpu.engine.simulator import DEFAULT_EPOCH, DeviceSimulator, Transition
 from kwok_tpu.native.fastdrain import load as _load_fastdrain
 from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.patch import apply_merge_patch as _merge_patch
 from kwok_tpu.utils.patch import is_noop_patch
 from kwok_tpu.utils.queue import Queue
 
@@ -76,6 +77,7 @@ class DeviceStagePlayer:
         #: row -> resourceVersion we last wrote (echo suppression)
         self._written_rv: Dict[int, str] = {}
         self._mut = threading.Lock()
+        self._paced = True
         self._done = threading.Event()
         self._threads: List[threading.Thread] = []
         self.transitions = 0
@@ -116,6 +118,19 @@ class DeviceStagePlayer:
         self._plans: Dict[Tuple[int, int], Optional[RenderPlan]] = {}
         self._fast_ok = not self.sim.cset._read_paths
         self._store_has_batch = hasattr(store, "apply_status_batch")
+        # one-time capability probe (duck-typed stores may implement
+        # the batch without the exclude kwarg)
+        self._batch_has_exclude = False
+        if self._store_has_batch:
+            import inspect
+
+            try:
+                self._batch_has_exclude = (
+                    "exclude"
+                    in inspect.signature(store.apply_status_batch).parameters
+                )
+            except (TypeError, ValueError):
+                self._batch_has_exclude = False
         #: row -> stage_idx -> resolved sentinel values (identity + env
         #: funcs; both row-stable) — dropped with the render cache on
         #: any identity change
@@ -132,12 +147,36 @@ class DeviceStagePlayer:
 
     # ------------------------------------------------------------------- wiring
 
-    def start(self) -> None:
+    def start(self, paced: bool = True) -> None:
+        """Wire the informer and start the tick loop.
+
+        ``paced=True`` (production): one tick per ``tick_ms`` of wall
+        clock; when the loop falls behind cadence it catches up with
+        ONE overlapped macro-tick (step_pipelined) covering the missed
+        ticks instead of spiraling.  ``paced=False`` (bench / replay):
+        saturate — overlapped macro-ticks back to back, measuring
+        sustained capacity rather than cadence.  Both modes run the
+        same drain pipeline, so what the bench measures is what the
+        daemon runs (VERDICT r03 next-#2/#7)."""
+        self._paced = paced
         self._t0 = self.clock.now()
         self.sim.epoch = _epoch_from(self._t0)
-        self.cache = self._informer.watch_with_cache(
-            WatchOptions(predicate=self._predicate), self.events, done=self._done
-        )
+        if isinstance(self.store, ResourceStore):
+            # in-process: no mirror to maintain — reads go straight to
+            # the store, and the reflector runs cache-less (its event
+            # stream alone feeds the SoA)
+            from kwok_tpu.cluster.informer import StoreBackedGetter
+
+            self.cache = StoreBackedGetter(self.store, self.kind)
+            self._informer.watch(
+                WatchOptions(predicate=self._predicate),
+                self.events,
+                done=self._done,
+            )
+        else:
+            self.cache = self._informer.watch_with_cache(
+                WatchOptions(predicate=self._predicate), self.events, done=self._done
+            )
         t = threading.Thread(target=self._tick_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -149,6 +188,17 @@ class DeviceStagePlayer:
         # rethrown"); a bounded join drains it cleanly
         for t in self._threads:
             t.join(timeout=max(2.0, 4 * self.tick_ms / 1000.0))
+        if any(t.is_alive() for t in self._threads):
+            # the tick thread is still draining (a 1M-row macro-tick
+            # can outlive the bounded join): it will flush its own
+            # in-flight batch on exit — flushing here too would race
+            # it on _inflight and apply sub-ticks out of order
+            return
+        # covers callers driving step_pipelined by hand around a stop
+        try:
+            self.flush_pipeline()
+        except Exception:  # noqa: BLE001 — best effort at shutdown
+            pass
 
     # ------------------------------------------------------------ event ingest
 
@@ -224,25 +274,59 @@ class DeviceStagePlayer:
             )
         self._informer.sync(opt, self.events)
 
+    #: catch-up / saturation macro-tick width (sub-ticks per device
+    #: dispatch); bounds how much virtual time one dispatch covers
+    macro_ticks = 8
+
     def _tick_loop(self) -> None:
+        dt_s = self.tick_ms / 1000.0
         next_tick = self.clock.now()
         while not self._done.is_set():
             try:
                 self._drain_events()
-                self.step()
+                if not self._paced:
+                    # saturation mode: overlapped macro-ticks back to
+                    # back — device computes batch N+1 while the host
+                    # drains batch N
+                    self.step_pipelined(self.tick_ms, self.macro_ticks)
+                    self.tick_lags.append(0.0)
+                    continue
+                behind = self.clock.now() - next_tick
+                # one lag sample per paced iteration: how far this
+                # tick started past its schedule
+                self.tick_lags.append(max(behind, 0.0))
+                if behind > dt_s:
+                    # behind cadence: cover the missed ticks with ONE
+                    # overlapped macro-tick instead of spiraling (the
+                    # next paced step flushes the in-flight batch)
+                    k = min(int(behind / dt_s) + 1, self.macro_ticks)
+                    self.step_pipelined(self.tick_ms, k)
+                    next_tick += k * dt_s
+                    if behind > 8 * self.macro_ticks * dt_s:
+                        # hopelessly behind (sustained overload): drop
+                        # the backlog instead of chasing it forever —
+                        # the old loop's don't-spiral reset
+                        next_tick = self.clock.now()
+                else:
+                    self.step()
+                    next_tick += dt_s
             except Exception:  # noqa: BLE001 — one bad batch must not
                 # kill the simulation for this kind
                 import traceback
 
                 traceback.print_exc()
-            next_tick += self.tick_ms / 1000.0
+                next_tick += dt_s
             sleep = next_tick - self.clock.now()
             if sleep > 0:
-                self.tick_lags.append(0.0)
-                time.sleep(min(sleep, self.tick_ms / 1000.0))
-            else:
-                self.tick_lags.append(-sleep)
-                next_tick = self.clock.now()  # fell behind; don't spiral
+                time.sleep(min(sleep, dt_s))
+        # drain the last in-flight macro-tick so stop() never strands
+        # fired rows
+        try:
+            self.flush_pipeline()
+        except Exception:  # noqa: BLE001 — best effort at shutdown
+            import traceback
+
+            traceback.print_exc()
 
     def step(self, dt_ms: Optional[int] = None) -> int:
         """One device tick + host drain; returns the fired-row count."""
@@ -421,7 +505,12 @@ class DeviceStagePlayer:
         fast_items: List[Tuple[Optional[str], str, dict]] = []
         fast_patches: List[dict] = []
         now_s: Optional[str] = None
+        # the native per-row loops need the in-process columnar commit:
+        # the remote degrade path re-sends patches, which the Python
+        # loop still collects
+        use_c = _FAST is not None and self._store_has_batch
         t_host0 = time.perf_counter()
+        t_store_before = self.t_store
         srow = st[rows]
         sigrow = sigs[rows]
         order = np.lexsort((sigrow, srow))
@@ -430,6 +519,34 @@ class DeviceStagePlayer:
         sig_l = sigrow[order].tolist()
         n = len(rows_l)
         vals_cache = self._vals_cache
+        # Chunked commit (native path): at large populations the row
+        # dicts fall out of CPU cache between the build pass, the store
+        # commit, and the confirm pass — running all three over ~2k-row
+        # chunks keeps each row's dict graph hot across the pipeline
+        # (the per-chunk store-call overhead is amortized to nothing).
+        chunk = 2048 if use_c else 0
+
+        def _flush_locked() -> None:
+            nonlocal fast_rows, fast_items
+            if not fast_items:
+                return
+            exclude = (
+                self._informer.active_watcher if self._batch_has_exclude else None
+            )
+            tb = time.perf_counter()
+            if exclude is not None:
+                results = self.store.apply_status_batch(
+                    self.kind, fast_items, exclude=exclude
+                )
+            else:
+                results = self.store.apply_status_batch(self.kind, fast_items)
+            self.t_store += time.perf_counter() - tb
+            self._confirm_native_locked(
+                results, fast_rows, fast_items, exclude is not None
+            )
+            fast_rows = []
+            fast_items = []
+
         with self._mut:
             i = 0
             while i < n:
@@ -464,6 +581,34 @@ class DeviceStagePlayer:
                     now_s = self.sim.now_string(t_ms)
                 bound, comp = plan.bind_tick(now_s)
                 check_noop = not plan.has_now
+                if use_c:
+                    row_vals_cb = (
+                        lambda obj, _p=plan: _p.row_vals(obj, self.funcs_for(obj))
+                    )
+                    for k in range(0, len(group), chunk or len(group)):
+                        sub = group[k : k + chunk] if chunk else group
+                        noops, slow_rows = _FAST.fast_group(
+                            objects,
+                            sub,
+                            s_idx,
+                            comp,
+                            bound,
+                            vals_cache,
+                            row_vals_cb,
+                            check_noop,
+                            plan.has_null,
+                            plan.all_top_plain,
+                            plan.top_plain,
+                            _merge_patch,
+                            fast_rows,
+                            fast_items,
+                        )
+                        self.transitions += noops
+                        for row in slow_rows:
+                            slow.append(self._make_transition(row, s_idx, t_ms))
+                        if chunk and len(fast_items) >= chunk:
+                            _flush_locked()
+                    continue
                 transitions_local = 0
                 for row in group:
                     obj = objects[row]
@@ -499,45 +644,102 @@ class DeviceStagePlayer:
                     )
                     fast_patches.append(patch)
                 self.transitions += transitions_local
-        self.t_host += time.perf_counter() - t_host0
+            if chunk:
+                _flush_locked()
+        # commit time spent inside the lock is already in t_store
+        self.t_host += (time.perf_counter() - t_host0) - (
+            self.t_store - t_store_before
+        )
 
         if fast_items:
+            # only the non-native path reaches here: with use_c the
+            # chunked _flush_locked above always drains fast_items
             tb = time.perf_counter()
             results = self._store_status_batch(fast_items, fast_patches)
             self.t_store += time.perf_counter() - tb
             t_host0 = time.perf_counter()
-            with self._mut:
-                objects = self.sim.objects
-                written = self._written_rv
-                sim = self.sim
-                for row, item, res in zip(fast_rows, fast_items, results):
-                    if res is False:
-                        continue  # store error, surfaced already
-                    if res is None:
-                        self._release_locked((item[0] or "", item[1]))
-                        continue
-                    rv, new_obj = res
-                    written[row] = str(rv)
-                    self.transitions += 1
-                    self.patches += 1
-                    if objects[row] is None:
-                        continue
-                    # confirm_row guards against an interleaved external
-                    # write (e.g. a scheduler spec patch committed between
-                    # our object read and the store batch): the store's
-                    # echo carries it, and since _written_rv now covers
-                    # its rv, this is the only place it can be noticed —
-                    # fall back to a full feature re-extraction
-                    if not sim.confirm_row(row, new_obj):
-                        old = objects[row]
-                        objects[row] = new_obj
-                        sim.refresh_row(row)
-                        if not self._render_identity_same(old, new_obj):
-                            self._drop_render_cache(row)
+            self._confirm_batch_python(results, fast_rows, fast_items)
             self.t_host += time.perf_counter() - t_host0
 
         if slow:
             self._drain_slow(slow)
+
+    def _confirm_native_locked(
+        self, results, fast_rows, fast_items, own_cache: bool
+    ) -> None:
+        """Adopt a status-batch's results via the C loop (self._mut
+        held); when the store excluded our watcher (own_cache) AND the
+        cache is a real mirror (hand-wired CacheGetter — the start()
+        path uses a StoreBackedGetter with nothing to maintain), also
+        maintain it here (under its lock — the informer thread still
+        applies non-batch events to it)."""
+        cache = self.cache if own_cache and hasattr(self.cache, "_items") else None
+        if cache is not None:
+            with cache._mut:
+                n_ok, releases, fallback_idx = _FAST.confirm_batch(
+                    results,
+                    fast_rows,
+                    fast_items,
+                    self.sim.objects,
+                    self._written_rv,
+                    cache._items,
+                )
+        else:
+            n_ok, releases, fallback_idx = _FAST.confirm_batch(
+                results,
+                fast_rows,
+                fast_items,
+                self.sim.objects,
+                self._written_rv,
+                None,
+            )
+        self.transitions += n_ok
+        self.patches += n_ok
+        for key in releases:
+            self._release_locked(key)
+        objects = self.sim.objects
+        sim = self.sim
+        for idx in fallback_idx:
+            # echo carried more than our status write: full refresh
+            row = fast_rows[idx]
+            if objects[row] is None:
+                continue
+            _, new_obj = results[idx]
+            old = objects[row]
+            objects[row] = new_obj
+            sim.refresh_row(row)
+            if not self._render_identity_same(old, new_obj):
+                self._drop_render_cache(row)
+
+    def _confirm_batch_python(self, results, fast_rows, fast_items) -> None:
+        with self._mut:
+            objects = self.sim.objects
+            written = self._written_rv
+            sim = self.sim
+            for row, item, res in zip(fast_rows, fast_items, results):
+                if res is False:
+                    continue  # store error, surfaced already
+                if res is None:
+                    self._release_locked((item[0] or "", item[1]))
+                    continue
+                rv, new_obj = res
+                written[row] = str(rv)
+                self.transitions += 1
+                self.patches += 1
+                if objects[row] is None:
+                    continue
+                # confirm_row guards against an interleaved external
+                # write (e.g. a scheduler spec patch committed between
+                # our object read and the store batch): the store's
+                # echo carries it, and since _written_rv now covers
+                # its rv, this is the only place it can be noticed —
+                # fall back to a full feature re-extraction
+                if not sim.confirm_row(row, new_obj):
+                    old = objects[row]
+                    objects[row] = new_obj
+                    sim.refresh_row(row)
+                    if not self._render_identity_same(old, new_obj):
+                        self._drop_render_cache(row)
 
     def _make_transition(self, row: int, s_idx: int, t_ms: int) -> Transition:
         cset = self.sim.cset

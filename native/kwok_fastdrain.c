@@ -13,14 +13,107 @@
  *                                    -> (results, evs, last_rv)
  *   filter_stale(evs, rows, written) -> [ev, ...]    (self-echo drop)
  *   cache_apply(cache, evs)          -> None         (informer mirror)
+ *   fast_group(...)                  -> (noops, slow_rows)  (drain loop)
+ *   confirm_batch(...)               -> (n_ok, releases, fallbacks)
+ *
+ * Types:
+ *   WatchEvent — slot-backed (type, object, rv) event; swapped in for
+ *   the Python dataclass by cluster/store.py so status_commit can
+ *   allocate events without a Python-level __init__ call per row.
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <stddef.h>
 #include <stdlib.h>
 
 static PyObject *s_metadata, *s_namespace, *s_name, *s_resourceVersion,
     *s_status, *s_MODIFIED, *s_DELETED, *s_default, *s_empty, *s_type,
-    *s_object;
+    *s_object, *s_spec, *s_labels, *s_annotations, *s_ownerReferences,
+    *s_deletionTimestamp, *s_finalizers;
+
+/* ------------------------------------------------------------ WatchEvent */
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *type;
+    PyObject *object;
+    long long rv;
+} FastEvent;
+
+static PyTypeObject FastEventType; /* fwd */
+
+static PyObject *
+fastevent_new(PyTypeObject *tp, PyObject *args, PyObject *kwds)
+{
+    static char *kwlist[] = {"type", "object", "rv", NULL};
+    PyObject *type, *object;
+    long long rv = 0;
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "OO|L", kwlist, &type,
+                                     &object, &rv))
+        return NULL;
+    FastEvent *ev = (FastEvent *)tp->tp_alloc(tp, 0);
+    if (!ev)
+        return NULL;
+    Py_INCREF(type);
+    ev->type = type;
+    Py_INCREF(object);
+    ev->object = object;
+    ev->rv = rv;
+    return (PyObject *)ev;
+}
+
+static void
+fastevent_dealloc(FastEvent *ev)
+{
+    Py_XDECREF(ev->type);
+    Py_XDECREF(ev->object);
+    Py_TYPE(ev)->tp_free((PyObject *)ev);
+}
+
+static PyObject *
+fastevent_richcompare(PyObject *a, PyObject *b, int op)
+{
+    if (op != Py_EQ && op != Py_NE)
+        Py_RETURN_NOTIMPLEMENTED;
+    if (!PyObject_TypeCheck(a, &FastEventType) ||
+        !PyObject_TypeCheck(b, &FastEventType))
+        Py_RETURN_NOTIMPLEMENTED;
+    FastEvent *x = (FastEvent *)a, *y = (FastEvent *)b;
+    int eq = x->rv == y->rv;
+    if (eq) {
+        eq = PyObject_RichCompareBool(x->type, y->type, Py_EQ);
+        if (eq < 0)
+            return NULL;
+    }
+    if (eq) {
+        eq = PyObject_RichCompareBool(x->object, y->object, Py_EQ);
+        if (eq < 0)
+            return NULL;
+    }
+    if (op == Py_NE)
+        eq = !eq;
+    if (eq)
+        Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+static PyMemberDef fastevent_members[] = {
+    {"type", Py_T_OBJECT_EX, offsetof(FastEvent, type), 0, NULL},
+    {"object", Py_T_OBJECT_EX, offsetof(FastEvent, object), 0, NULL},
+    {"rv", Py_T_LONGLONG, offsetof(FastEvent, rv), 0, NULL},
+    {NULL},
+};
+
+static PyTypeObject FastEventType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "kwok_fastdrain.WatchEvent",
+    .tp_basicsize = sizeof(FastEvent),
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_new = fastevent_new,
+    .tp_dealloc = (destructor)fastevent_dealloc,
+    .tp_richcompare = fastevent_richcompare,
+    .tp_members = fastevent_members,
+};
 
 /* ---------------------------------------------------------------- build */
 
@@ -198,8 +291,22 @@ py_status_commit(PyObject *self, PyObject *args)
         Py_DECREF(key);
         key = NULL;
         {
-            PyObject *ev = PyObject_CallFunction(ev_cls, "OOL", s_MODIFIED,
-                                                 newobj, rv);
+            PyObject *ev;
+            if (ev_cls == (PyObject *)&FastEventType) {
+                /* direct slot alloc: no Python __init__ per row */
+                FastEvent *fe = PyObject_New(FastEvent, &FastEventType);
+                if (!fe)
+                    goto fail_new2;
+                Py_INCREF(s_MODIFIED);
+                fe->type = s_MODIFIED;
+                Py_INCREF(newobj);
+                fe->object = newobj;
+                fe->rv = rv;
+                ev = (PyObject *)fe;
+            } else {
+                ev = PyObject_CallFunction(ev_cls, "OOL", s_MODIFIED,
+                                           newobj, rv);
+            }
             if (!ev)
                 goto fail_new2;
             if (PyList_Append(evs, ev) < 0) {
@@ -230,6 +337,87 @@ py_status_commit(PyObject *self, PyObject *args)
 fail:
     Py_XDECREF(results);
     Py_XDECREF(evs);
+    return NULL;
+}
+
+/* ------------------------------------------------- status_commit_inplace */
+
+/* The zero-copy commit lane: when the store has no event consumers for
+ * this batch (the only live watcher is the excluded self-consumer),
+ * there is nobody to hand instances to — so the stored object is
+ * mutated IN PLACE (status replaced, resourceVersion bumped) with no
+ * object/metadata copies, no event allocation, and no history append.
+ * The store records a gap marker instead; watch resumes older than it
+ * get Expired and re-list (legal watch semantics).
+ *
+ *   status_commit_inplace(objects, items, rv_start, namespaced)
+ *     -> (results, last_rv)
+ */
+static PyObject *
+py_status_commit_inplace(PyObject *self, PyObject *args)
+{
+    PyObject *objects, *items;
+    long long rv;
+    int namespaced;
+    if (!PyArg_ParseTuple(args, "OOLp", &objects, &items, &rv, &namespaced))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    PyObject *results = PyList_New(0);
+    if (!results)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(items, i); /* (ns, name, status) */
+        PyObject *ns = PyTuple_GET_ITEM(item, 0);
+        PyObject *name = PyTuple_GET_ITEM(item, 1);
+        PyObject *status = PyTuple_GET_ITEM(item, 2);
+        PyObject *keyns;
+        if (namespaced)
+            keyns = (ns != Py_None && PyObject_IsTrue(ns)) ? ns : s_default;
+        else
+            keyns = s_empty;
+        PyObject *key = PyTuple_Pack(2, keyns, name);
+        if (!key)
+            goto fail;
+        PyObject *cur = PyDict_GetItemWithError(objects, key);
+        Py_DECREF(key);
+        if (!cur) {
+            if (PyErr_Occurred())
+                goto fail;
+            if (PyList_Append(results, Py_None) < 0)
+                goto fail;
+            continue;
+        }
+        PyObject *meta = PyDict_GetItemWithError(cur, s_metadata);
+        if (!meta || !PyDict_Check(meta)) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_KeyError, "metadata");
+            goto fail;
+        }
+        rv += 1;
+        PyObject *rvs = PyUnicode_FromFormat("%lld", rv);
+        if (!rvs)
+            goto fail;
+        if (PyDict_SetItem(meta, s_resourceVersion, rvs) < 0) {
+            Py_DECREF(rvs);
+            goto fail;
+        }
+        Py_DECREF(rvs);
+        if (PyDict_SetItem(cur, s_status, status) < 0)
+            goto fail;
+        {
+            PyObject *res = Py_BuildValue("(LO)", rv, cur);
+            if (!res)
+                goto fail;
+            if (PyList_Append(results, res) < 0) {
+                Py_DECREF(res);
+                goto fail;
+            }
+            Py_DECREF(res);
+        }
+    }
+    return Py_BuildValue("(NL)", results, rv);
+fail:
+    Py_DECREF(results);
     return NULL;
 }
 
@@ -324,6 +512,391 @@ err:
     return NULL;
 }
 
+/* ----------------------------------------------------------- fast_group */
+
+/* Per-row drain loop for one (stage, sig) group on the columnar fast
+ * path (mirror of the Python loop in
+ * controllers/device_player.py::_drain_tick):
+ *
+ *   fast_group(objects, rows, s_idx, comp, bound, vals_cache,
+ *              row_vals_cb, check_noop, has_null, all_top_plain,
+ *              top_plain, merge_cb, fast_rows, fast_items)
+ *     -> (noop_count, slow_rows)
+ *
+ * Per row: resolve (or compute via row_vals_cb) the sentinel vals,
+ * build the patch, merge it onto the current status (wholesale-replace
+ * shortcut when the plan allows; merge_cb = apply_merge_patch
+ * otherwise), optionally drop pure no-ops, and append
+ * (ns, name, new_status) to fast_items.  Rows whose build/merge raises
+ * land in slow_rows for the per-row fallback path. */
+static PyObject *
+py_fast_group(PyObject *self, PyObject *args)
+{
+    PyObject *objects, *rows, *s_idx, *comp, *bound, *vals_cache,
+        *row_vals_cb, *top_plain, *merge_cb, *fast_rows, *fast_items;
+    int check_noop, has_null, all_top_plain;
+    if (!PyArg_ParseTuple(args, "OOOOOOOiiiOOOO", &objects, &rows, &s_idx,
+                          &comp, &bound, &vals_cache, &row_vals_cb,
+                          &check_noop, &has_null, &all_top_plain, &top_plain,
+                          &merge_cb, &fast_rows, &fast_items))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(rows);
+    long long noops = 0;
+    PyObject *slow_rows = PyList_New(0);
+    if (!slow_rows)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *row_obj = PyList_GET_ITEM(rows, i);
+        Py_ssize_t row = PyLong_AsSsize_t(row_obj);
+        if (row < 0 && PyErr_Occurred())
+            goto err;
+        PyObject *obj = PyList_GET_ITEM(objects, row);
+        if (obj == Py_None)
+            continue;
+        PyObject *patch; /* owned */
+        if (comp == Py_None) {
+            patch = bound; /* tick-static: shared by rows */
+            Py_INCREF(patch);
+        } else {
+            PyObject *rowc = PyDict_GetItemWithError(vals_cache, row_obj);
+            if (!rowc) {
+                if (PyErr_Occurred())
+                    goto err;
+                rowc = PyDict_New();
+                if (!rowc || PyDict_SetItem(vals_cache, row_obj, rowc) < 0) {
+                    Py_XDECREF(rowc);
+                    goto err;
+                }
+                Py_DECREF(rowc); /* dict keeps it alive */
+            }
+            PyObject *vals = PyDict_GetItemWithError(rowc, s_idx);
+            if (!vals) {
+                if (PyErr_Occurred())
+                    goto err;
+                vals = PyObject_CallFunctionObjArgs(row_vals_cb, obj, NULL);
+                if (!vals) {
+                    PyErr_Clear();
+                    if (PyList_Append(slow_rows, row_obj) < 0)
+                        goto err;
+                    continue;
+                }
+                if (PyDict_SetItem(rowc, s_idx, vals) < 0) {
+                    Py_DECREF(vals);
+                    goto err;
+                }
+                Py_DECREF(vals); /* rowc keeps it alive */
+            }
+            patch = build_node(comp, vals);
+            if (!patch) {
+                PyErr_Clear();
+                if (PyList_Append(slow_rows, row_obj) < 0)
+                    goto err;
+                continue;
+            }
+        }
+        PyObject *cur = PyDict_GetItemWithError(obj, s_status); /* borrowed */
+        if (!cur && PyErr_Occurred()) {
+            Py_DECREF(patch);
+            goto err;
+        }
+        if (cur == Py_None)
+            cur = NULL;
+        PyObject *new_status; /* owned */
+        if (!cur || (PyDict_Check(cur) && PyDict_GET_SIZE(cur) == 0)) {
+            new_status = patch;
+            Py_INCREF(new_status);
+            if (check_noop && PyDict_Check(patch) &&
+                PyDict_GET_SIZE(patch) == 0) {
+                noops++;
+                Py_DECREF(new_status);
+                Py_DECREF(patch);
+                continue;
+            }
+        } else if (!has_null && all_top_plain && PyDict_Check(cur)) {
+            int subset = 1;
+            Py_ssize_t pos = 0;
+            PyObject *k, *v;
+            while (PyDict_Next(cur, &pos, &k, &v)) {
+                int in = PySet_Contains(top_plain, k);
+                if (in < 0) {
+                    Py_DECREF(patch);
+                    goto err;
+                }
+                if (!in) {
+                    subset = 0;
+                    break;
+                }
+            }
+            if (subset) {
+                new_status = patch;
+                Py_INCREF(new_status);
+            } else {
+                new_status = PyDict_Copy(cur);
+                if (!new_status || PyDict_Update(new_status, patch) < 0) {
+                    Py_XDECREF(new_status);
+                    Py_DECREF(patch);
+                    goto err;
+                }
+            }
+        } else {
+            new_status =
+                PyObject_CallFunctionObjArgs(merge_cb, cur, patch, NULL);
+            if (!new_status) {
+                PyErr_Clear();
+                Py_DECREF(patch);
+                if (PyList_Append(slow_rows, row_obj) < 0)
+                    goto err;
+                continue;
+            }
+        }
+        Py_DECREF(patch);
+        if (check_noop && cur) {
+            int same = PyObject_RichCompareBool(new_status, cur, Py_EQ);
+            if (same < 0) {
+                Py_DECREF(new_status);
+                goto err;
+            }
+            if (same) {
+                noops++;
+                Py_DECREF(new_status);
+                continue;
+            }
+        }
+        PyObject *meta = PyDict_GetItemWithError(obj, s_metadata);
+        if (!meta || !PyDict_Check(meta)) {
+            Py_DECREF(new_status);
+            if (PyErr_Occurred())
+                goto err;
+            continue;
+        }
+        PyObject *ns = PyDict_GetItemWithError(meta, s_namespace);
+        if (!ns) {
+            if (PyErr_Occurred()) {
+                Py_DECREF(new_status);
+                goto err;
+            }
+            ns = Py_None;
+        }
+        PyObject *name = PyDict_GetItemWithError(meta, s_name);
+        if (!name || name == Py_None) {
+            if (PyErr_Occurred()) {
+                Py_DECREF(new_status);
+                goto err;
+            }
+            name = s_empty;
+        }
+        PyObject *item = PyTuple_Pack(3, ns, name, new_status);
+        Py_DECREF(new_status);
+        if (!item)
+            goto err;
+        if (PyList_Append(fast_items, item) < 0) {
+            Py_DECREF(item);
+            goto err;
+        }
+        Py_DECREF(item);
+        if (PyList_Append(fast_rows, row_obj) < 0)
+            goto err;
+    }
+    return Py_BuildValue("(LN)", noops, slow_rows);
+err:
+    Py_DECREF(slow_rows);
+    return NULL;
+}
+
+/* -------------------------------------------------------- confirm_batch */
+
+/* missing-treated-as-None equality with a pointer shortcut: the store's
+ * status commit shares every unchanged subtree, so the common case is
+ * pointer-equal */
+static int
+eq_field(PyObject *a, PyObject *b)
+{
+    if (!a)
+        a = Py_None;
+    if (!b)
+        b = Py_None;
+    if (a == b)
+        return 1;
+    return PyObject_RichCompareBool(a, b, Py_EQ);
+}
+
+/* Post-commit accounting for the columnar drain (mirror of the Python
+ * loop after _store_status_batch in device_player._drain_tick):
+ *
+ *   confirm_batch(results, rows, items, objects, written, cache)
+ *     -> (n_ok, releases, fallback_idx)
+ *
+ * Per result: None -> the object is gone, its (ns, name) key lands in
+ * releases; (rv, obj) -> record the written resourceVersion, adopt the
+ * store's echo into the row mirror when nothing beyond status changed
+ * (pointer-first compare on spec/labels/annotations/ownerReferences/
+ * deletionTimestamp/finalizers), else report the result index in
+ * fallback_idx for a full host re-extract.  ``cache`` (may be None) is
+ * the informer mirror to maintain directly when the store excluded our
+ * own watcher from event delivery; entries only move forward in
+ * resourceVersion. */
+static PyObject *
+py_confirm_batch(PyObject *self, PyObject *args)
+{
+    PyObject *results, *rows, *items, *objects, *written, *cache;
+    if (!PyArg_ParseTuple(args, "OOOOOO", &results, &rows, &items, &objects,
+                          &written, &cache))
+        return NULL;
+    if (cache == Py_None)
+        cache = NULL;
+    Py_ssize_t n = PyList_GET_SIZE(rows);
+    long long n_ok = 0;
+    PyObject *releases = PyList_New(0);
+    PyObject *fallbacks = PyList_New(0);
+    if (!releases || !fallbacks)
+        goto err;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *res = PyList_GET_ITEM(results, i);
+        PyObject *row_obj = PyList_GET_ITEM(rows, i);
+        if (res == Py_None) {
+            PyObject *item = PyList_GET_ITEM(items, i);
+            PyObject *ns = PyTuple_GET_ITEM(item, 0);
+            int truthy = (ns != Py_None) ? PyObject_IsTrue(ns) : 0;
+            if (truthy < 0)
+                goto err;
+            PyObject *key = PyTuple_Pack(2, truthy ? ns : s_empty,
+                                         PyTuple_GET_ITEM(item, 1));
+            if (!key)
+                goto err;
+            if (PyList_Append(releases, key) < 0) {
+                Py_DECREF(key);
+                goto err;
+            }
+            Py_DECREF(key);
+            continue;
+        }
+        if (res == Py_False)
+            continue; /* store error, surfaced already */
+        PyObject *rv_obj = PyTuple_GET_ITEM(res, 0);
+        PyObject *new_obj = PyTuple_GET_ITEM(res, 1);
+        n_ok++;
+        PyObject *nm = PyDict_GetItemWithError(new_obj, s_metadata);
+        if (!nm || !PyDict_Check(nm)) {
+            if (PyErr_Occurred())
+                goto err;
+            continue;
+        }
+        PyObject *rvs = PyDict_GetItemWithError(nm, s_resourceVersion);
+        if (!rvs) {
+            if (PyErr_Occurred())
+                goto err;
+            rvs = Py_None;
+        }
+        if (PyDict_SetItem(written, row_obj, rvs) < 0)
+            goto err;
+        Py_ssize_t row = PyLong_AsSsize_t(row_obj);
+        if (row < 0 && PyErr_Occurred())
+            goto err;
+        PyObject *old = PyList_GET_ITEM(objects, row);
+        if (cache) {
+            PyObject *ns = PyDict_GetItemWithError(nm, s_namespace);
+            if (!ns || ns == Py_None) {
+                if (PyErr_Occurred())
+                    goto err;
+                ns = s_empty;
+            }
+            PyObject *name = PyDict_GetItemWithError(nm, s_name);
+            if (!name || name == Py_None) {
+                if (PyErr_Occurred())
+                    goto err;
+                name = s_empty;
+            }
+            PyObject *key = PyTuple_Pack(2, ns, name);
+            if (!key)
+                goto err;
+            /* only move forward: an informer-delivered event for a
+             * NEWER write must not be clobbered by this older echo.
+             * Pointer shortcut: in steady churn the cache entry IS the
+             * row mirror we adopted last tick (we wrote both), so one
+             * compare replaces the resourceVersion parse. */
+            int write = 1;
+            PyObject *curc = PyDict_GetItemWithError(cache, key);
+            if (!curc && PyErr_Occurred()) {
+                Py_DECREF(key);
+                goto err;
+            }
+            if (curc && curc != old) {
+                PyObject *cm = PyDict_GetItemWithError(curc, s_metadata);
+                if (cm && PyDict_Check(cm)) {
+                    PyObject *crv =
+                        PyDict_GetItemWithError(cm, s_resourceVersion);
+                    int ok = 0;
+                    long long cur_rv = rv_to_ll(crv, &ok);
+                    long long new_rv = PyLong_AsLongLong(rv_obj);
+                    if (new_rv == -1 && PyErr_Occurred())
+                        PyErr_Clear();
+                    else if (ok && cur_rv > new_rv)
+                        write = 0;
+                }
+                if (PyErr_Occurred()) {
+                    Py_DECREF(key);
+                    goto err;
+                }
+            }
+            if (write && PyDict_SetItem(cache, key, new_obj) < 0) {
+                Py_DECREF(key);
+                goto err;
+            }
+            Py_DECREF(key);
+        }
+        if (old == new_obj)
+            continue; /* in-place lane: the row mirror IS the store's */
+        if (old == Py_None)
+            continue;
+        PyObject *om = PyDict_GetItemWithError(old, s_metadata);
+        if (!om || !PyDict_Check(om)) {
+            if (PyErr_Occurred())
+                goto err;
+            om = NULL;
+        }
+        int same = eq_field(PyDict_GetItemWithError(old, s_spec),
+                            PyDict_GetItemWithError(new_obj, s_spec));
+        if (same > 0 && om)
+            same = eq_field(PyDict_GetItemWithError(om, s_labels),
+                            PyDict_GetItemWithError(nm, s_labels));
+        if (same > 0 && om)
+            same = eq_field(PyDict_GetItemWithError(om, s_annotations),
+                            PyDict_GetItemWithError(nm, s_annotations));
+        if (same > 0 && om)
+            same = eq_field(PyDict_GetItemWithError(om, s_ownerReferences),
+                            PyDict_GetItemWithError(nm, s_ownerReferences));
+        if (same > 0 && om)
+            same = eq_field(PyDict_GetItemWithError(om, s_deletionTimestamp),
+                            PyDict_GetItemWithError(nm, s_deletionTimestamp));
+        if (same > 0 && om)
+            same = eq_field(PyDict_GetItemWithError(om, s_finalizers),
+                            PyDict_GetItemWithError(nm, s_finalizers));
+        if (same < 0 || PyErr_Occurred())
+            goto err;
+        if (same && om) {
+            Py_INCREF(new_obj);
+            if (PyList_SetItem(objects, row, new_obj) < 0) { /* steals */
+                Py_DECREF(new_obj);
+                goto err;
+            }
+        } else {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (!idx)
+                goto err;
+            if (PyList_Append(fallbacks, idx) < 0) {
+                Py_DECREF(idx);
+                goto err;
+            }
+            Py_DECREF(idx);
+        }
+    }
+    return Py_BuildValue("(LNN)", n_ok, releases, fallbacks);
+err:
+    Py_XDECREF(releases);
+    Py_XDECREF(fallbacks);
+    return NULL;
+}
+
 /* ---------------------------------------------------------- cache_apply */
 
 static PyObject *
@@ -394,6 +967,16 @@ static PyMethodDef Methods[] = {
      "filter_stale(evs, rows, written) -> fresh events"},
     {"cache_apply", py_cache_apply, METH_VARARGS,
      "cache_apply(cache, evs) -> None"},
+    {"fast_group", py_fast_group, METH_VARARGS,
+     "fast_group(objects, rows, s_idx, comp, bound, vals_cache, "
+     "row_vals_cb, check_noop, has_null, all_top_plain, top_plain, "
+     "merge_cb, fast_rows, fast_items) -> (noops, slow_rows)"},
+    {"confirm_batch", py_confirm_batch, METH_VARARGS,
+     "confirm_batch(results, rows, items, objects, written, cache) -> "
+     "(n_ok, releases, fallback_idx)"},
+    {"status_commit_inplace", py_status_commit_inplace, METH_VARARGS,
+     "status_commit_inplace(objects, items, rv_start, namespaced) -> "
+     "(results, last_rv)"},
     {NULL, NULL, 0, NULL},
 };
 
@@ -415,5 +998,22 @@ PyInit_kwok_fastdrain(void)
     s_empty = PyUnicode_InternFromString("");
     s_type = PyUnicode_InternFromString("type");
     s_object = PyUnicode_InternFromString("object");
-    return PyModule_Create(&moduledef);
+    s_spec = PyUnicode_InternFromString("spec");
+    s_labels = PyUnicode_InternFromString("labels");
+    s_annotations = PyUnicode_InternFromString("annotations");
+    s_ownerReferences = PyUnicode_InternFromString("ownerReferences");
+    s_deletionTimestamp = PyUnicode_InternFromString("deletionTimestamp");
+    s_finalizers = PyUnicode_InternFromString("finalizers");
+    if (PyType_Ready(&FastEventType) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m)
+        return NULL;
+    Py_INCREF(&FastEventType);
+    if (PyModule_AddObject(m, "WatchEvent", (PyObject *)&FastEventType) < 0) {
+        Py_DECREF(&FastEventType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
 }

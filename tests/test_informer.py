@@ -94,3 +94,42 @@ def test_cacheless_watch_forwards_only():
     assert [e.type for e in evs] == [ADDED]
     assert len(cache) == 0  # dummy store: no mirroring
     done.set()
+
+
+def test_cacheless_predicate_leave_surfaces_deleted():
+    """Cache-less watch flavor (the device player's in-process mode):
+    an object leaving the predicate set must still surface as DELETED
+    so controllers release its row."""
+    import threading
+    import time as _t
+
+    from kwok_tpu.cluster.informer import Informer, WatchOptions
+    from kwok_tpu.cluster.store import DELETED, ResourceStore
+    from kwok_tpu.utils.queue import Queue
+
+    store = ResourceStore()
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "p0", "namespace": "default"},
+                  "spec": {"nodeName": "managed"}, "status": {}})
+    inf = Informer(store, "Pod")
+    events = Queue()
+    done = threading.Event()
+    pred = lambda o: (o.get("spec") or {}).get("nodeName") == "managed"
+    inf.watch(WatchOptions(predicate=pred), events, done=done)
+
+    deadline = _t.monotonic() + 5
+    got = []
+    while _t.monotonic() < deadline and not any(e.type == "ADDED" for e in got):
+        got.extend(events.drain())
+        _t.sleep(0.05)
+    assert any(e.type == "ADDED" for e in got), got
+
+    # the pod moves off the managed node -> predicate now fails
+    store.patch("Pod", "p0", {"spec": {"nodeName": "other"}}, "merge",
+                namespace="default")
+    deadline = _t.monotonic() + 5
+    while _t.monotonic() < deadline and not any(e.type == DELETED for e in got):
+        got.extend(events.drain())
+        _t.sleep(0.05)
+    done.set()
+    assert any(e.type == DELETED for e in got), got

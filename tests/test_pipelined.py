@@ -1,0 +1,166 @@
+"""step_pipelined semantics (VERDICT r03 next-#2): the overlapped
+macro-tick path the production tick loop runs when behind cadence and
+the bench measures.  Pins the contract documented on
+DeviceStagePlayer.step_pipelined:
+
+- drain of macro-tick N happens during call N+1 (one-macro-tick-late
+  mutations);
+- rows released mid-flight may fire once more and the drain drops them;
+- flush_pipeline (and stop()) drains the final in-flight batch;
+- mixing step()/step_batch() with step_pipelined() preserves order
+  (the batch flavors flush the in-flight batch first).
+"""
+
+import time
+
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.controllers.device_player import DeviceStagePlayer
+from kwok_tpu.stages import load_builtin
+
+
+def make_pod(name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "uid": f"uid-{name}"},
+        "spec": {"nodeName": "node-0", "containers": [{"name": "app", "image": "x"}]},
+        "status": {},
+    }
+
+
+def make_player(store, n_pods=4, tick_ms=20):
+    from kwok_tpu.controllers.pod_controller import PodEnv
+
+    env = PodEnv()
+    player = DeviceStagePlayer(
+        store,
+        "Pod",
+        load_builtin("pod-fast"),
+        capacity=max(n_pods, 4),
+        tick_ms=tick_ms,
+        funcs_for=env.funcs,
+        on_delete=env.release,
+    )
+    for i in range(n_pods):
+        store.create(make_pod(f"pod-{i}"))
+    return player
+
+
+def admit_all(player, store):
+    from kwok_tpu.cluster.informer import InformerEvent
+
+    objs, _ = store.list("Pod")
+    for obj in objs:
+        player.events.add(InformerEvent("ADDED", obj))
+    player._drain_events()
+
+
+def test_mutations_land_one_macro_tick_late():
+    store = ResourceStore()
+    player = make_player(store)
+    admit_all(player, store)
+    # first pipelined call dispatches but drains nothing (no previous
+    # in-flight batch)
+    fired1 = player.step_pipelined(20, 8)
+    assert fired1 == 0
+    assert player._inflight is not None
+    assert player.transitions == 0, "drain must lag the dispatch by one call"
+    # second call drains the first batch: pod-fast fires immediately
+    fired2 = player.step_pipelined(20, 8)
+    assert fired2 > 0
+    assert player.transitions > 0
+    # ... and the store shows the result
+    pod = store.get("Pod", "pod-0", namespace="default")
+    assert (pod.get("status") or {}).get("phase") == "Running"
+    player.flush_pipeline()
+
+
+def test_flush_pipeline_drains_final_batch():
+    store = ResourceStore()
+    player = make_player(store)
+    admit_all(player, store)
+    player.step_pipelined(20, 8)
+    assert player._inflight is not None
+    fired = player.flush_pipeline()
+    assert fired > 0
+    assert player._inflight is None
+    assert player.transitions > 0
+    # idempotent
+    assert player.flush_pipeline() == 0
+
+
+def test_stop_flushes_in_flight_batch():
+    store = ResourceStore()
+    player = make_player(store)
+    admit_all(player, store)
+    player.step_pipelined(20, 8)
+    assert player._inflight is not None
+    player.stop()  # loop never started; stop still flushes
+    assert player._inflight is None
+    assert player.transitions > 0
+
+
+def test_released_row_refiring_is_dropped():
+    store = ResourceStore()
+    player = make_player(store)
+    admit_all(player, store)
+    player.step_pipelined(20, 8)  # rows fire inside this in-flight batch
+    # the object vanishes while the batch is in flight
+    before = dict(player._rows)
+    for key, row in before.items():
+        with player._mut:
+            player._release_locked(key)
+    fired = player.flush_pipeline()
+    # fired rows are reported by the device but the drain drops them:
+    # no store writes, no transitions for dead rows
+    assert player.transitions == 0
+    assert player.patches == 0
+    for i in range(4):
+        pod = store.get("Pod", f"pod-{i}", namespace="default")
+        assert (pod.get("status") or {}).get("phase") is None
+
+
+def test_flavor_mixing_preserves_order():
+    store = ResourceStore()
+    player = make_player(store)
+    admit_all(player, store)
+    player.step_pipelined(20, 8)
+    assert player._inflight is not None
+    # the batch flavor must flush the in-flight macro-tick before its
+    # own tick so transitions apply in dispatch order
+    player.step_batch(20, 1)
+    assert player._inflight is None
+    assert player.transitions > 0
+    pod = store.get("Pod", "pod-0", namespace="default")
+    assert (pod.get("status") or {}).get("phase") == "Running"
+
+
+def test_unpaced_start_runs_production_loop():
+    store = ResourceStore()
+    player = make_player(store)
+    player.start(paced=False)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and player.transitions < 4:
+            time.sleep(0.05)
+        assert player.transitions >= 4
+        pod = store.get("Pod", "pod-0", namespace="default")
+        assert (pod.get("status") or {}).get("phase") == "Running"
+    finally:
+        player.stop()
+    assert player._inflight is None
+
+
+def test_paced_loop_catches_up_with_macro_ticks():
+    """A paced loop that falls behind covers the missed ticks with one
+    overlapped macro-tick instead of spiraling."""
+    store = ResourceStore()
+    player = make_player(store, tick_ms=5)
+    player.start(paced=True)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and player.transitions < 4:
+            time.sleep(0.05)
+        assert player.transitions >= 4
+    finally:
+        player.stop()

@@ -251,3 +251,134 @@ def test_index_on_non_string_field():
                   "spec": {}, "status": {"capacity": {"pods": 110}}})
     items, _ = store.list("Node", field_selector="status.capacity.pods=110")
     assert [o["metadata"]["name"] for o in items] == ["n0"]
+
+
+# ------------------------------------------------- zero-copy commit lane
+
+
+def _mk_pod(name):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"nodeName": "n"}, "status": {}}
+
+
+def test_status_batch_excluded_only_watcher_takes_inplace_lane():
+    """With the only live watcher excluded, the batch mutates stored
+    objects in place: same instance, bumped rv, gap marker set, nothing
+    appended to history."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    store.create(_mk_pod("p0"))
+    w = store.watch("Pod")
+    st = store._state("Pod")
+    inst_before = st.objects[("default", "p0")]
+    hist_before = len(st.history)
+    out = store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Running"})], exclude=w
+    )
+    rv, obj = out[0]
+    assert obj is inst_before  # mutated in place, not replaced
+    assert obj["status"] == {"phase": "Running"}
+    assert obj["metadata"]["resourceVersion"] == str(rv)
+    assert len(st.history) == hist_before  # no events recorded
+    assert st.inplace_rv == rv
+    assert w.drain() == []  # nothing delivered to the excluded watcher
+    # a GET still serves a fresh copy of the current state
+    got = store.get("Pod", "p0", namespace="default")
+    assert got["status"] == {"phase": "Running"} and got is not obj
+
+
+def test_status_batch_other_watcher_forces_copy_lane():
+    """Any other live watcher needs real event instances: the batch
+    must allocate new objects and deliver events."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    store.create(_mk_pod("p0"))
+    mine = store.watch("Pod")
+    other = store.watch("Pod")
+    st = store._state("Pod")
+    inst_before = st.objects[("default", "p0")]
+    out = store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Running"})], exclude=mine
+    )
+    rv, obj = out[0]
+    assert obj is not inst_before  # copy-on-write commit
+    evs = other.drain()
+    assert len(evs) == 1 and evs[0].object["status"] == {"phase": "Running"}
+    assert mine.drain() == []  # exclusion still honored
+    assert st.inplace_rv == 0
+
+
+def test_watch_resume_below_gap_marker_expires():
+    """A resume at/below the in-place marker would cross the gapped
+    window: Expired, so the informer re-lists (reflector behavior)."""
+    import pytest
+
+    from kwok_tpu.cluster.store import Expired, ResourceStore
+
+    store = ResourceStore()
+    out = store.create(_mk_pod("p0"))
+    rv0 = int(out["metadata"]["resourceVersion"])
+    w = store.watch("Pod")
+    store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Running"})], exclude=w
+    )
+    with pytest.raises(Expired):
+        store.watch("Pod", since_rv=rv0)
+    # at/after the marker a resume is fine
+    marker = store._state("Pod").inplace_rv
+    w2 = store.watch("Pod", since_rv=marker)
+    assert w2.drain() == []
+
+
+def test_inplace_lane_then_external_patch_keeps_semantics():
+    """Interleaving the zero-copy lane with ordinary patches stays
+    consistent: the patch path is copy-on-write on top of the mutated
+    instance and emits a real event."""
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    store.create(_mk_pod("p0"))
+    w = store.watch("Pod")
+    store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Running"})], exclude=w
+    )
+    out = store.patch("Pod", "p0", {"metadata": {"labels": {"a": "b"}}},
+                      "merge", namespace="default")
+    assert out["status"] == {"phase": "Running"}
+    assert out["metadata"]["labels"] == {"a": "b"}
+    evs = w.drain()
+    assert len(evs) == 1 and evs[0].object["metadata"]["labels"] == {"a": "b"}
+
+
+def test_inplace_gap_expired_sets_lane_cooloff():
+    """A consumer racing the zero-copy lane must not be starved: the
+    Expired it receives forces the lane to yield, so its list-then-watch
+    retry succeeds against real history."""
+    import pytest
+
+    from kwok_tpu.cluster.store import Expired, ResourceStore
+
+    store = ResourceStore()
+    out = store.create(_mk_pod("p0"))
+    rv0 = int(out["metadata"]["resourceVersion"])
+    w = store.watch("Pod")
+    store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Running"})], exclude=w
+    )
+    with pytest.raises(Expired):
+        store.watch("Pod", since_rv=rv0)
+    st = store._state("Pod")
+    inst = st.objects[("default", "p0")]
+    # during the cooloff the lane yields: commits go copy-on-write and
+    # land in history, so the consumer's retry can resume
+    _, rv1 = store.list("Pod")
+    out = store.apply_status_batch(
+        "Pod", [("default", "p0", {"phase": "Failed"})], exclude=w
+    )
+    assert out[0][1] is not inst  # copy lane while cooling off
+    w2 = store.watch("Pod", since_rv=rv1)
+    evs = w2.drain()
+    assert len(evs) == 1 and evs[0].object["status"] == {"phase": "Failed"}
