@@ -472,13 +472,9 @@ class APIServer:
             self._httpd.k8s = K8sFacade(store, kubelet_url=kubelet_url)
             self._tls = bool(tls_cert and tls_key)
             if self._tls:
-                import ssl
+                from kwok_tpu.utils.tlsutil import build_server_ssl_context
 
-                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-                ctx.load_cert_chain(tls_cert, tls_key)
-                if client_ca:
-                    ctx.load_verify_locations(client_ca)
-                    ctx.verify_mode = ssl.CERT_OPTIONAL
+                ctx = build_server_ssl_context(tls_cert, tls_key, client_ca)
                 self._httpd.socket = ctx.wrap_socket(
                     self._httpd.socket, server_side=True
                 )

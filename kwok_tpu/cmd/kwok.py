@@ -60,6 +60,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--id", default=None, help="controller identity (lease holder)")
     p.add_argument("--server-address", default="127.0.0.1:10247",
                    help="fake-kubelet server host:port ('' disables)")
+    # kubelet-surface TLS (reference kwok --tls-cert-file /
+    # --tls-private-key-file, server.go:446-533): the one port then
+    # speaks BOTH https and plain http, cmux-style
+    p.add_argument("--tls-cert-file", default="",
+                   help="serve the kubelet port over TLS too (cmux)")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--node-client-ca-file", default="",
+                   help="CA for (optional) client-cert auth on the kubelet port")
     p.add_argument("--wait-timeout", type=float, default=60.0)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("-v", "--verbosity", action="count", default=0)
@@ -385,8 +393,25 @@ def main(argv=None) -> int:
         ]
         srv.set_configs(local_configs)
         srv.add_self_updater(_controller_self_metrics(ctr))
-        bound = srv.serve(port=int(port or 10247), host=host or "127.0.0.1")
-        print(f"fake-kubelet server on {host or '127.0.0.1'}:{bound}", flush=True)
+        if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+            print(
+                "error: --tls-cert-file and --tls-private-key-file must be "
+                "given together",
+                file=sys.stderr,
+            )
+            return 1
+        bound = srv.serve(
+            port=int(port or 10247),
+            host=host or "127.0.0.1",
+            tls_cert=args.tls_cert_file or None,
+            tls_key=args.tls_private_key_file or None,
+            client_ca=args.node_client_ca_file or None,
+        )
+        scheme = "https+http" if args.tls_cert_file else "http"
+        print(
+            f"fake-kubelet server on {host or '127.0.0.1'}:{bound} ({scheme})",
+            flush=True,
+        )
         if conf.enable_crds:
             start_config_watcher(client, srv, done, base_configs=local_configs)
 

@@ -192,6 +192,15 @@ def build_kwok_controller_component(
             os.path.join(pki_dir, "admin.crt"),
             "--client-key",
             os.path.join(pki_dir, "admin.key"),
+            # the kubelet surface serves TLS+plain on one port with the
+            # cluster serving cert (reference kwok_controller.go passes
+            # the generated cert pair the same way)
+            "--tls-cert-file",
+            os.path.join(pki_dir, "server.crt"),
+            "--tls-private-key-file",
+            os.path.join(pki_dir, "server.key"),
+            "--node-client-ca-file",
+            os.path.join(pki_dir, "ca.crt"),
         ]
     for path in config_paths or []:
         args += ["--config", path]

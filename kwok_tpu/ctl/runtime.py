@@ -190,7 +190,7 @@ class BinaryRuntime:
         }
         if tracing_port:
             conf["ports"]["tracing"] = tracing_port
-        self.write_prometheus_config(kubelet_port)
+        self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:
             dry_run.emit(f"write {self.config_path}")
@@ -377,29 +377,39 @@ class BinaryRuntime:
                 collected.append(fn)
         return collected
 
-    def write_prometheus_config(self, kubelet_port: int) -> str:
+    def write_prometheus_config(
+        self, kubelet_port: int, secure: bool = False
+    ) -> str:
         """Generate a scrape config for the cluster (reference
         components/prometheus_config.go + prometheus_config.yaml.tpl:
-        static kwok-controller target + HTTP SD for Metric CR routes)."""
+        static kwok-controller target + HTTP SD for Metric CR routes).
+        Secure clusters scrape the kubelet over https, verified against
+        the cluster CA — the cmux port serves both, and the reference's
+        generated config uses the https scheme the same way."""
         path = self._path("prometheus.yaml")
+        kwok_job = {
+            "job_name": "kwok-controller",
+            "static_configs": [{"targets": [f"127.0.0.1:{kubelet_port}"]}],
+        }
+        sd_job = {
+            "job_name": "kwok-metric-crs",
+            "http_sd_configs": [
+                {"url": f"http://127.0.0.1:{kubelet_port}/discovery/prometheus"}
+            ],
+        }
+        if secure:
+            ca = os.path.join(self._path("pki"), "ca.crt")
+            kwok_job["scheme"] = "https"
+            kwok_job["tls_config"] = {"ca_file": ca}
+            sd_job["http_sd_configs"][0]["url"] = (
+                f"https://127.0.0.1:{kubelet_port}/discovery/prometheus"
+            )
+            sd_job["http_sd_configs"][0]["tls_config"] = {"ca_file": ca}
+            sd_job["scheme"] = "https"
+            sd_job["tls_config"] = {"ca_file": ca}
         doc = {
             "global": {"scrape_interval": "15s"},
-            "scrape_configs": [
-                {
-                    "job_name": "kwok-controller",
-                    "static_configs": [
-                        {"targets": [f"127.0.0.1:{kubelet_port}"]}
-                    ],
-                },
-                {
-                    "job_name": "kwok-metric-crs",
-                    "http_sd_configs": [
-                        {
-                            "url": f"http://127.0.0.1:{kubelet_port}/discovery/prometheus"
-                        }
-                    ],
-                },
-            ],
+            "scrape_configs": [kwok_job, sd_job],
         }
         if dry_run.enabled:
             dry_run.emit(f"write {path}")

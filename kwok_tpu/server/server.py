@@ -771,8 +771,23 @@ class Server:
     # ------------------------------------------------------------------
     # HTTP plumbing
     # ------------------------------------------------------------------
-    def serve(self, port: int = 0, host: str = "127.0.0.1") -> int:
-        """Start serving in a background thread; returns the bound port."""
+    def serve(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        tls_cert: Optional[str] = None,
+        tls_key: Optional[str] = None,
+        client_ca: Optional[str] = None,
+    ) -> int:
+        """Start serving in a background thread; returns the bound port.
+
+        With ``tls_cert``/``tls_key`` the ONE port speaks both TLS and
+        plaintext, cmux-style (reference server.go:446-533 mixes the
+        muxes the same way): the worker thread peeks the first byte of
+        each connection — 0x16 is a TLS handshake record, anything else
+        is plain HTTP.  ``client_ca`` additionally requests (but does
+        not require) client certificates verified against that CA, the
+        kubelet's optional client-auth posture."""
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -803,8 +818,57 @@ class Server:
             def do_POST(self):
                 self._dispatch()
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self._httpd.daemon_threads = True
+        ssl_ctx = None
+        if tls_cert or tls_key:
+            if not (tls_cert and tls_key):
+                raise ValueError(
+                    "kubelet TLS needs BOTH the certificate and the "
+                    "private key (got only one of tls_cert/tls_key)"
+                )
+            from kwok_tpu.utils.tlsutil import build_server_ssl_context
+
+            ssl_ctx = build_server_ssl_context(tls_cert, tls_key, client_ca)
+
+        class CmuxHTTPServer(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def finish_request(self, request, client_address):
+                # runs on the worker thread (ThreadingMixIn), so the
+                # peek + TLS handshake never stall the accept loop
+                if ssl_ctx is None:
+                    self.RequestHandlerClass(request, client_address, self)
+                    return
+                import ssl as _ssl
+
+                try:
+                    request.settimeout(10)
+                    first = request.recv(1, socket.MSG_PEEK)
+                    if first == b"\x16":
+                        request = ssl_ctx.wrap_socket(request, server_side=True)
+                    request.settimeout(None)
+                except (OSError, _ssl.SSLError):
+                    try:
+                        request.close()
+                    except OSError:
+                        pass
+                    return
+                try:
+                    self.RequestHandlerClass(request, client_address, self)
+                finally:
+                    # wrap_socket() detached the fd from the object the
+                    # ThreadingMixIn will shutdown_request(): close the
+                    # live (possibly wrapped) socket ourselves so TLS
+                    # connections end with a proper close_notify
+                    try:
+                        request.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    try:
+                        request.close()
+                    except OSError:
+                        pass
+
+        self._httpd = CmuxHTTPServer((host, port), Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         return self._httpd.server_address[1]

@@ -1,0 +1,24 @@
+"""Shared TLS posture for the cluster's serving surfaces.
+
+One place for the server-side SSLContext so the apiserver
+(cluster/apiserver.py) and the kubelet surface (server/server.py)
+cannot drift: TLS-server protocol, the serving cert pair, and an
+optional client CA with OPTIONAL verification (the kubelet's
+client-auth posture; reference server.go:446-533).
+"""
+
+from __future__ import annotations
+
+import ssl
+from typing import Optional
+
+
+def build_server_ssl_context(
+    cert_file: str, key_file: str, client_ca: Optional[str] = None
+) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    if client_ca:
+        ctx.load_verify_locations(client_ca)
+        ctx.verify_mode = ssl.CERT_OPTIONAL
+    return ctx
