@@ -52,6 +52,10 @@ E2E_BUDGET_S = float(os.environ.get("BENCH_E2E_BUDGET_S", 180))
 #: is bursty per macro-tick, so windows must cover several)
 E2E_WINDOWS = max(1, int(os.environ.get("BENCH_E2E_WINDOWS", 3)))
 E2E_WINDOW_S = float(os.environ.get("BENCH_E2E_WINDOW_S", 30))
+#: run the ownerReference-GC / namespace controller alongside the
+#: measurement (default ON: production clusters always compose the kcm
+#: seat, so the headline number should include it)
+E2E_GC = os.environ.get("BENCH_E2E_GC", "1") not in ("0", "false")
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", 5))
 INIT_RETRY_DELAY = float(os.environ.get("BENCH_INIT_RETRY_DELAY", 60))
 TARGET_TPS = 100_000.0
@@ -192,6 +196,14 @@ def run_e2e_bench() -> dict:
     from kwok_tpu.stages import load_builtin
 
     store = ResourceStore()
+    gc_ctrl = None
+    if E2E_GC:
+        # the kube-controller-manager seat every production cluster
+        # composes: its status-indifferent watches must not disturb the
+        # drain (VERDICT r03 next-#6 asks for <10% tps with GC on)
+        from kwok_tpu.controllers.gc_controller import GCController
+
+        gc_ctrl = GCController(store).start()
     stages = load_builtin("pod-general") + load_builtin("pod-chaos")
     env = PodEnv()
     player = DeviceStagePlayer(
@@ -258,6 +270,8 @@ def run_e2e_bench() -> dict:
         if best is None or sample["tps"] > best["tps"]:
             best = sample
     player.stop()
+    if gc_ctrl is not None:
+        gc_ctrl.stop()
 
     breakdown = best["breakdown_s"]
     bottleneck = max(breakdown, key=breakdown.get).removesuffix("_s")
@@ -265,6 +279,7 @@ def run_e2e_bench() -> dict:
         "pods": admitted,
         "transitions_per_sec": round(best["tps"]),
         "dirty_rows_per_sec": round(best["dirty"]),
+        "gc": bool(gc_ctrl is not None),
         "setup_s": round(setup_s, 1),
         "window_s": round(window_s, 1),
         "windows": E2E_WINDOWS,

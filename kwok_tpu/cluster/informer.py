@@ -52,6 +52,11 @@ class WatchOptions:
     #: managed nodes in the controller, not the informer; this hook keeps
     #: the informer generic)
     predicate: Optional[Callable[[dict], bool]] = None
+    #: False: this consumer does not need status-only batch events
+    #: (Watcher.status_interest) — in-process stores then skip it on
+    #: status commits and keep the zero-copy lane eligible; remote
+    #: stores deliver everything (the wire has no such flag)
+    status_interest: bool = True
 
 
 class CacheGetter:
@@ -147,6 +152,12 @@ class Informer:
             )
         except (TypeError, ValueError):
             self._list_no_copy = False
+        try:
+            self._watch_has_interest = (
+                "status_interest" in inspect.signature(store.watch).parameters
+            )
+        except (TypeError, ValueError):
+            self._watch_has_interest = False
 
     def _list(self, opt: WatchOptions):
         kw = {}
@@ -252,6 +263,9 @@ class Informer:
                 else:
                     for obj in items:
                         events.add(InformerEvent(ADDED, obj))
+                wkw = {}
+                if not opt.status_interest and self._watch_has_interest:
+                    wkw["status_interest"] = False
                 try:
                     w = self._store.watch(
                         self._kind,
@@ -259,6 +273,7 @@ class Informer:
                         since_rv=rv,
                         label_selector=opt.label_selector,
                         field_selector=opt.field_selector,
+                        **wkw,
                     )
                 except Expired:
                     continue

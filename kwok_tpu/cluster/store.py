@@ -252,12 +252,19 @@ class Watcher:
         store: "ResourceStore",
         filt: Callable[[dict], bool],
         trivial: bool = False,
+        status_interest: bool = True,
     ):
         self._store = store
         self._filter = filt
         #: a trivial filter (no namespace/selectors) lets batch pushes
         #: skip the per-event filter call on the store thread
         self._trivial = trivial
+        #: False: this consumer declares it does not need status-only
+        #: batch events (the GC controller's posture — it reads
+        #: ownerReferences/deletionTimestamp, which status writes never
+        #: touch).  Status batches skip it, and it keeps the zero-copy
+        #: commit lane eligible; all other events flow normally.
+        self.status_interest = status_interest
         self._events: deque = deque()
         self._signal = threading.Event()
         self._stopped = threading.Event()
@@ -947,6 +954,7 @@ class ResourceStore:
         since_rv: Optional[int] = None,
         label_selector: Selector = None,
         field_selector: Selector = None,
+        status_interest: bool = True,
     ) -> Watcher:
         with self._mut:
             st = self._state(kind)
@@ -967,9 +975,10 @@ class ResourceStore:
                     and label_selector is None
                     and field_selector is None
                 ),
+                status_interest=status_interest,
             )
             if since_rv is not None and since_rv < self._rv:
-                if since_rv < st.inplace_rv:
+                if since_rv < st.inplace_rv and status_interest:
                     # the zero-copy lane left a gap below this version.
                     # Yield the lane for a while so this consumer's
                     # list-then-watch retry finds real history instead
@@ -1028,7 +1037,10 @@ class ResourceStore:
                 _FAST is not None
                 and not status_indexed
                 and exclude is not None
-                and all(w is exclude or w.stopped for w in st.watchers)
+                and all(
+                    w is exclude or w.stopped or not w.status_interest
+                    for w in st.watchers
+                )
                 and time.monotonic() >= st.lane_cooloff
             ):
                 # zero-copy lane: the only live watcher is the caller's
@@ -1058,7 +1070,7 @@ class ResourceStore:
                         ("patch-status-batch", f"{kind}:{len(evs)}", None)
                     )
                     for w in list(st.watchers):
-                        if w is not exclude:
+                        if w is not exclude and w.status_interest:
                             w._push_batch(evs)
                 return out
             out: List[Optional[Tuple[int, dict]]] = []
@@ -1090,7 +1102,7 @@ class ResourceStore:
                     ("patch-status-batch", f"{kind}:{len(evs)}", None)
                 )
                 for w in list(st.watchers):
-                    if w is not exclude:
+                    if w is not exclude and w.status_interest:
                         w._push_batch(evs)
             return out
 

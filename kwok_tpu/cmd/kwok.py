@@ -304,6 +304,13 @@ def _controller_self_metrics(ctr):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+        print(
+            "error: --tls-cert-file and --tls-private-key-file must be "
+            "given together",
+            file=sys.stderr,
+        )
+        return 1
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
@@ -393,13 +400,6 @@ def main(argv=None) -> int:
         ]
         srv.set_configs(local_configs)
         srv.add_self_updater(_controller_self_metrics(ctr))
-        if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
-            print(
-                "error: --tls-cert-file and --tls-private-key-file must be "
-                "given together",
-                file=sys.stderr,
-            )
-            return 1
         bound = srv.serve(
             port=int(port or 10247),
             host=host or "127.0.0.1",

@@ -116,7 +116,15 @@ class GCController:
                 continue
             self._watched.add(rt.kind)
             inf = Informer(self.store, rt.kind)
-            inf.watch(WatchOptions(), self.events, done=self._done)
+            # status-indifferent: GC reads ownerReferences /
+            # deletionTimestamp / finalizers — never status.  In-process
+            # stores then skip this watcher on status batches, which
+            # keeps the drain's zero-copy commit lane eligible (the
+            # "GC must not become a second drain" contract,
+            # VERDICT r03 next-#6)
+            inf.watch(
+                WatchOptions(status_interest=False), self.events, done=self._done
+            )
             self._informers.append(inf)
 
     # ------------------------------------------------------------------- loop
@@ -165,6 +173,20 @@ class GCController:
         ns = meta.get("namespace") or ""
         name = meta.get("name") or ""
         child: ChildKey = (kind, ns, name)
+
+        # steady-churn fast path: an ADDED/MODIFIED object with no
+        # ownerReferences that we have never indexed, outside any
+        # terminating namespace, is of no GC interest — two lock-free
+        # dict probes and out (all index mutation happens on this loop
+        # thread, so the unlocked reads cannot race a writer)
+        if (
+            ev.type != DELETED
+            and kind != "Namespace"
+            and not meta.get("ownerReferences")
+            and child not in self._child_refs
+            and (not ns or ns not in self._terminating)
+        ):
+            return
 
         if kind == "Namespace":
             self._handle_namespace(ev, obj, name)

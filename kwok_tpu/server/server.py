@@ -856,12 +856,18 @@ class Server:
                     self.RequestHandlerClass(request, client_address, self)
                 finally:
                     # wrap_socket() detached the fd from the object the
-                    # ThreadingMixIn will shutdown_request(): close the
-                    # live (possibly wrapped) socket ourselves so TLS
-                    # connections end with a proper close_notify
+                    # ThreadingMixIn will shutdown_request(): tear the
+                    # live socket down ourselves.  For TLS that means
+                    # unwrap() — the call that actually sends the
+                    # close_notify alert, so clients of length-less
+                    # streamed responses can tell complete from
+                    # truncated — bounded by a short timeout against
+                    # peers that never ACK the alert.
                     try:
-                        request.shutdown(socket.SHUT_WR)
-                    except OSError:
+                        if isinstance(request, _ssl.SSLSocket):
+                            request.settimeout(5)
+                            request = request.unwrap()
+                    except (OSError, _ssl.SSLError, ValueError):
                         pass
                     try:
                         request.close()

@@ -211,3 +211,78 @@ def test_create_time_finalizer_closes_create_delete_race():
         assert wait_for(gone, timeout=10)
     finally:
         gc.stop()
+
+
+def test_live_cluster_owner_cascade_through_kcm_daemon(tmp_path, monkeypatch):
+    """The cascade through a REAL multi-process cluster: an owner
+    ConfigMap and pods referencing it are created through the
+    apiserver; deleting the owner makes the composed kcm daemon
+    (cmd/kcm.py, a separate process) collect the pods
+    (VERDICT r03 next-#6; reference clusters get this from the real
+    kube-controller-manager, components/kube_controller_manager.go:46)."""
+    import time as _time
+
+    from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    name = "gccasc"
+    assert kwokctl_main(["--name", name, "create", "cluster", "--wait", "90"]) == 0
+    client = BinaryRuntime(name).client()
+    try:
+        owner = client.create(
+            {"apiVersion": "v1", "kind": "ConfigMap",
+             "metadata": {"name": "job-like-owner", "namespace": "default"}}
+        )
+        ref = {"apiVersion": "v1", "kind": "ConfigMap",
+               "name": "job-like-owner",
+               "uid": owner["metadata"]["uid"]}
+        for i in range(3):
+            client.create(
+                {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": f"owned-{i}", "namespace": "default",
+                               "ownerReferences": [ref]},
+                 "spec": {"nodeName": "none", "containers": [{"name": "c"}]},
+                 "status": {}}
+            )
+        # settle: the kcm daemon indexes the children
+        deadline = _time.monotonic() + 30
+        while client.count("Pod") != 3 and _time.monotonic() < deadline:
+            _time.sleep(0.2)
+        assert client.count("Pod") == 3
+
+        client.delete("ConfigMap", "job-like-owner")
+        deadline = _time.monotonic() + 60
+        while client.count("Pod") != 0 and _time.monotonic() < deadline:
+            _time.sleep(0.5)
+        assert client.count("Pod") == 0, (
+            f"{client.count('Pod')} owned pods survived the cascade"
+        )
+    finally:
+        # no assert: a cleanup failure must not mask the real one
+        kwokctl_main(["--name", name, "delete", "cluster"])
+
+
+def test_status_indifferent_gc_keeps_zero_copy_lane():
+    """A running GCController must not disable the drain's zero-copy
+    commit lane: its watches declare status indifference, so a status
+    batch excluded to its own writer still takes the in-place lane and
+    delivers nothing to GC."""
+    import time as _time
+
+    store = ResourceStore()
+    gc = GCController(store, resync_s=0.2).start()
+    try:
+        _time.sleep(0.5)  # GC informers subscribe
+        store.create(make_pod("p0"))
+        _time.sleep(0.3)  # the ADDED event reaches GC's watcher
+        w = store.watch("Pod")
+        st = store._state("Pod")
+        inst = st.objects[("default", "p0")]
+        out = store.apply_status_batch(
+            "Pod", [("default", "p0", {"phase": "Running"})], exclude=w
+        )
+        assert out[0][1] is inst, "in-place lane must stay eligible with GC on"
+    finally:
+        gc.stop()
