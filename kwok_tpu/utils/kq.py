@@ -21,11 +21,15 @@ startswith, contains, ...) — with gojq-compatible semantics:
   arrays < objects) backs ``< <= > >=``, sort, min, max;
 - ``true != 1`` (no bool/number coercion).
 
-Constructs outside the implemented grammar raise ``KqCompileError`` at
-parse time — reductions (``reduce``/``foreach``), ``def``, variables
-(``$x``), ``label``/``try-catch`` are the known gaps; everything the
-reference's expression test corpus exercises parses and runs here
-(tests/test_kq.py).
+The full-language tail is in too (r04): variables and ``as`` bindings,
+``reduce``/``foreach``, ``def`` with filter and ``$value`` parameters
+(including recursion), and ``try``/``catch`` — so out-of-subset stages
+run on the host path, and selector expressions using them lower as
+opaque host-evaluated feature columns on the device path.  Constructs
+outside the grammar still raise ``KqCompileError`` at parse time —
+``label``/``break``, ``@format`` strings, and destructuring patterns
+are the remaining (documented) gaps; unbound ``$vars`` are compile
+errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -45,7 +49,16 @@ class KqCompileError(ValueError):
 
 
 class _KqRuntimeError(Exception):
-    """Evaluation error; swallowed by Query.execute (gojq parity)."""
+    """Evaluation error; swallowed by Query.execute (gojq parity).
+
+    ``value`` preserves the original error payload for try/catch
+    (jq: ``try error({a: 1}) catch .`` yields the object, not a
+    stringification)."""
+
+    def __init__(self, message: str, value: Any = None, has_value: bool = False):
+        super().__init__(message)
+        self.value = value if has_value else message
+        self.has_value = has_value
 
 
 # ---------------------------------------------------------------------------
@@ -57,7 +70,8 @@ _TOKEN_RE = re.compile(
     (?P<ws>\s+)
   | (?P<string>"(?:[^"\\]|\\.)*")
   | (?P<number>\d+(?:\.\d+)?)
-  | (?P<op>//|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?)
+  | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op>//|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
     re.VERBOSE,
@@ -191,12 +205,75 @@ class Optional_:
     expr: Any
 
 
+@dataclass(frozen=True)
+class Var:
+    """``$x`` — environment lookup (bound by as/reduce/foreach/def)."""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class As:
+    """``SRC as $x | BODY`` — bind each output of SRC for BODY."""
+
+    source: Any
+    var: str
+    body: Any
+
+
+@dataclass(frozen=True)
+class Reduce:
+    """``reduce SRC as $x (INIT; UPDATE)``."""
+
+    source: Any
+    var: str
+    init: Any
+    update: Any
+
+
+@dataclass(frozen=True)
+class Foreach:
+    """``foreach SRC as $x (INIT; UPDATE[; EXTRACT])``."""
+
+    source: Any
+    var: str
+    init: Any
+    update: Any
+    extract: Any  # None -> emit the accumulator
+
+
+@dataclass(frozen=True)
+class Def:
+    """``def f(p1; p2): BODY; REST`` — REST sees f in scope."""
+
+    name: str
+    params: Tuple[str, ...]  # "$x" value params or bare filter params
+    body: Any
+    rest: Any
+
+
+@dataclass(frozen=True)
+class Call:
+    """Application of a def-defined function."""
+
+    name: str
+    args: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class TryCatch:
+    """``try BODY [catch HANDLER]`` — HANDLER sees the error message."""
+
+    body: Any
+    handler: Any  # None -> swallow
+
+
 #: zero-arg builtins (applied as a filter to each input)
 _FUNCS0 = {
     "length", "keys", "values", "type", "tostring", "tonumber", "not",
     "empty", "add", "any", "all", "first", "last", "min", "max", "sort",
     "unique", "floor", "ceil", "ascii_downcase", "ascii_upcase", "abs",
-    "reverse", "tojson", "fromjson",
+    "reverse", "tojson", "fromjson", "error",
 }
 #: one-arg builtins
 _FUNCS1 = {
@@ -211,6 +288,15 @@ class _Parser:
         self.tokens = tokens
         self.src = src
         self.i = 0
+        #: lexically-scoped $variables (unbound use is a compile error,
+        #: like jq)
+        self.var_scope: List[str] = []
+        #: def-defined functions in scope as (name, arity); bare filter
+        #: params enter with arity 0
+        self.fn_scope: List[Tuple[str, int]] = []
+        #: >0 while parsing a reduce/foreach source, whose own 'as'
+        #: belongs to the construct, not to a Term binding
+        self._no_as = 0
 
     def peek(self) -> Optional[Tuple[str, str]]:
         return self.tokens[self.i] if self.i < len(self.tokens) else None
@@ -314,8 +400,33 @@ class _Parser:
             if t == "?":
                 self.next()
                 node = Optional_(node)
+            elif t == ".":
+                # path suffix on a primary — `$i.name`, `(.a).b.[0]` —
+                # jq sugar for `expr | .path`.  (A directly-parsed Path
+                # never leaves a '.' behind, so this only triggers on
+                # non-path primaries.)
+                suffix = self.parse_path()
+                node = Pipe((node, suffix))
             else:
                 break
+        if self.peek_text() == "as" and not self._no_as:
+            # jq grammar: Term 'as' $x '|' Exp — the source is the
+            # TERM, and the body extends maximally to the right
+            # (`1, 2 as $x | e` is `1, (2 as $x | e)`)
+            self.next()
+            tok = self.next()
+            if tok[0] != "var":
+                raise KqCompileError(
+                    f"'as' needs a $variable, got {tok[1]!r} in {self.src!r}"
+                )
+            var = tok[1][1:]
+            self.expect("|")
+            self.var_scope.append(var)
+            try:
+                body = self.parse_pipe()
+            finally:
+                self.var_scope.pop()
+            return As(node, var, body)
         return node
 
     def parse_primary(self) -> Any:
@@ -346,12 +457,42 @@ class _Parser:
         if kind == "number":
             self.next()
             return Literal(float(text) if "." in text else int(text))
+        if kind == "var":
+            self.next()
+            name = text[1:]
+            if name not in self.var_scope:
+                raise KqCompileError(f"${name} is not defined in {self.src!r}")
+            return Var(name)
         if kind == "ident":
             if text == "if":
                 return self.parse_if()
+            if text == "reduce":
+                return self.parse_reduce()
+            if text == "foreach":
+                return self.parse_foreach()
+            if text == "def":
+                return self.parse_def()
+            if text == "try":
+                return self.parse_try()
             if text in ("true", "false", "null"):
                 self.next()
                 return Literal({"true": True, "false": False, "null": None}[text])
+            # def-defined functions shadow builtins
+            if any(n == text for n, _ in self.fn_scope):
+                self.next()
+                args: List[Any] = []
+                if self.peek_text() == "(":
+                    self.next()
+                    args.append(self.parse_pipe())
+                    while self.peek_text() == ";":
+                        self.next()
+                        args.append(self.parse_pipe())
+                    self.expect(")")
+                if (text, len(args)) not in self.fn_scope:
+                    raise KqCompileError(
+                        f"{text}/{len(args)} is not defined in {self.src!r}"
+                    )
+                return Call(text, tuple(args))
             if text in _FUNCS0 or text in _FUNCS1:
                 self.next()
                 if self.peek_text() == "(":
@@ -372,6 +513,111 @@ class _Parser:
                 return Func(text, ())
             raise KqCompileError(f"unsupported function {text!r} in {self.src!r}")
         raise KqCompileError(f"unexpected token {text!r} in {self.src!r}")
+
+    def _parse_as_binding(self, kw: str) -> Tuple[Any, str]:
+        """Shared ``KW SRC as $x`` prefix of reduce/foreach."""
+        self.expect(kw)
+        self._no_as += 1
+        try:
+            source = self.parse_postfix()
+        finally:
+            self._no_as -= 1
+        self.expect("as")
+        tok = self.next()
+        if tok[0] != "var":
+            raise KqCompileError(
+                f"'{kw} ... as' needs a $variable in {self.src!r}"
+            )
+        return source, tok[1][1:]
+
+    def parse_reduce(self) -> Any:
+        source, var = self._parse_as_binding("reduce")
+        self.expect("(")
+        init = self.parse_pipe()
+        self.expect(";")
+        self.var_scope.append(var)
+        try:
+            update = self.parse_pipe()
+        finally:
+            self.var_scope.pop()
+        self.expect(")")
+        return Reduce(source, var, init, update)
+
+    def parse_foreach(self) -> Any:
+        source, var = self._parse_as_binding("foreach")
+        self.expect("(")
+        init = self.parse_pipe()
+        self.expect(";")
+        self.var_scope.append(var)
+        try:
+            update = self.parse_pipe()
+            extract = None
+            if self.peek_text() == ";":
+                self.next()
+                extract = self.parse_pipe()
+        finally:
+            self.var_scope.pop()
+        self.expect(")")
+        return Foreach(source, var, init, update, extract)
+
+    def parse_def(self) -> Any:
+        self.expect("def")
+        tok = self.next()
+        if tok[0] != "ident":
+            raise KqCompileError(f"bad def name {tok[1]!r} in {self.src!r}")
+        name = tok[1]
+        params: List[str] = []
+        if self.peek_text() == "(":
+            self.next()
+            while True:
+                p = self.next()
+                if p[0] == "var":
+                    params.append(p[1])  # keep the $ to mark value params
+                elif p[0] == "ident":
+                    params.append(p[1])
+                else:
+                    raise KqCompileError(
+                        f"bad def parameter {p[1]!r} in {self.src!r}"
+                    )
+                if self.peek_text() == ";":
+                    self.next()
+                    continue
+                break
+            self.expect(")")
+        self.expect(":")
+        # body scope: $params are variables, bare params are 0-ary
+        # filters, and the function itself is visible (recursion)
+        n_vars = 0
+        n_fns = 1
+        self.fn_scope.append((name, len(params)))
+        for p in params:
+            if p.startswith("$"):
+                self.var_scope.append(p[1:])
+                n_vars += 1
+            else:
+                self.fn_scope.append((p, 0))
+                n_fns += 1
+        try:
+            body = self.parse_pipe()
+        finally:
+            del self.var_scope[len(self.var_scope) - n_vars :]
+            del self.fn_scope[len(self.fn_scope) - n_fns :]
+        self.expect(";")
+        self.fn_scope.append((name, len(params)))
+        try:
+            rest = self.parse_pipe()
+        finally:
+            self.fn_scope.pop()
+        return Def(name, tuple(params), body, rest)
+
+    def parse_try(self) -> Any:
+        self.expect("try")
+        body = self.parse_postfix()
+        handler = None
+        if self.peek_text() == "catch":
+            self.next()
+            handler = self.parse_postfix()
+        return TryCatch(body, handler)
 
     def parse_if(self) -> Any:
         self.expect("if")
@@ -619,7 +865,7 @@ def _deep_merge(a: dict, b: dict) -> dict:
     return out
 
 
-def _eval(node: Any, value: Any) -> Iterator[Any]:
+def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
     if isinstance(node, Literal):
         yield node.value
     elif isinstance(node, Path):
@@ -631,17 +877,17 @@ def _eval(node: Any, value: Any) -> Iterator[Any]:
         else:
             yield from _eval_path(node.ops, 0, value)
     elif isinstance(node, Pipe):
-        yield from _eval_pipe(node.stages, 0, value)
+        yield from _eval_pipe(node.stages, 0, value, env)
     elif isinstance(node, Comma):
         for part in node.parts:
-            yield from _eval(part, value)
+            yield from _eval(part, value, env)
     elif isinstance(node, Select):
-        for out in _eval(node.cond, value):
+        for out in _eval(node.cond, value, env):
             if _truthy(out):
                 yield value
     elif isinstance(node, Compare):
-        for lv in _eval(node.left, value):
-            for rv in _eval(node.right, value):
+        for lv in _eval(node.left, value, env):
+            for rv in _eval(node.right, value, env):
                 if node.op == "==":
                     yield _json_equal(lv, rv)
                 elif node.op == "!=":
@@ -657,79 +903,160 @@ def _eval(node: Any, value: Any) -> Iterator[Any]:
     elif isinstance(node, Alternative):
         got = False
         try:
-            for out in _eval(node.left, value):
+            for out in _eval(node.left, value, env):
                 if _truthy(out):
                     got = True
                     yield out
         except _KqRuntimeError:
             pass
         if not got:
-            yield from _eval(node.right, value)
+            yield from _eval(node.right, value, env)
     elif isinstance(node, BoolOp):
-        for lv in _eval(node.left, value):
+        for lv in _eval(node.left, value, env):
             lt = _truthy(lv)
             if node.op == "and" and not lt:
                 yield False
             elif node.op == "or" and lt:
                 yield True
             else:
-                for rv in _eval(node.right, value):
+                for rv in _eval(node.right, value, env):
                     yield _truthy(rv)
     elif isinstance(node, Arith):
-        for lv in _eval(node.left, value):
-            for rv in _eval(node.right, value):
+        for lv in _eval(node.left, value, env):
+            for rv in _eval(node.right, value, env):
                 yield _arith(node.op, lv, rv)
     elif isinstance(node, Neg):
-        for v in _eval(node.expr, value):
+        for v in _eval(node.expr, value, env):
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 raise _KqRuntimeError(f"cannot negate {_jq_type(v)}")
             yield -v
     elif isinstance(node, If):
-        for c in _eval(node.cond, value):
+        for c in _eval(node.cond, value, env):
             if _truthy(c):
-                yield from _eval(node.then, value)
+                yield from _eval(node.then, value, env)
             elif node.orelse is not None:
-                yield from _eval(node.orelse, value)
+                yield from _eval(node.orelse, value, env)
             else:
                 yield value
     elif isinstance(node, ArrayCons):
         if node.expr is None:
             yield []
         else:
-            yield list(_eval(node.expr, value))
+            yield list(_eval(node.expr, value, env))
     elif isinstance(node, ObjectCons):
-        yield from _eval_object(node.entries, 0, value, {})
+        yield from _eval_object(node.entries, 0, value, {}, env)
     elif isinstance(node, Optional_):
         try:
-            yield from list(_eval(node.expr, value))
+            yield from list(_eval(node.expr, value, env))
         except _KqRuntimeError:
             return
     elif isinstance(node, Func):
-        yield from _eval_func(node, value)
+        yield from _eval_func(node, value, env)
+    elif isinstance(node, Var):
+        try:
+            yield env[node.name]
+        except KeyError:
+            raise _KqRuntimeError(f"${node.name} is not defined")
+    elif isinstance(node, As):
+        for bound in _eval(node.source, value, env):
+            yield from _eval(node.body, value, {**env, node.var: bound})
+    elif isinstance(node, Reduce):
+        for acc0 in _eval(node.init, value, env):
+            acc = acc0
+            for x in _eval(node.source, value, env):
+                acc = _fold_step(node.update, acc, {**env, node.var: x})
+            yield acc
+    elif isinstance(node, Foreach):
+        for acc0 in _eval(node.init, value, env):
+            acc = acc0
+            for x in _eval(node.source, value, env):
+                e2 = {**env, node.var: x}
+                acc = _fold_step(node.update, acc, e2)
+                if node.extract is None:
+                    yield acc
+                else:
+                    yield from _eval(node.extract, acc, e2)
+    elif isinstance(node, Def):
+        env2 = dict(env)
+        env2[("fn", node.name, len(node.params))] = (node.params, node.body, env2)
+        yield from _eval(node.rest, value, env2)
+    elif isinstance(node, Call):
+        yield from _eval_call(node, value, env)
+    elif isinstance(node, TryCatch):
+        it = _eval(node.body, value, env)
+        while True:
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            except _KqRuntimeError as exc:
+                if node.handler is not None:
+                    yield from _eval(node.handler, exc.value, env)
+                return
+            yield out
     else:  # pragma: no cover
         raise _KqRuntimeError(f"unknown node {node!r}")
 
 
-def _eval_object(entries, i, value, acc) -> Iterator[Any]:
+def _fold_step(update: Any, acc: Any, env: dict) -> Any:
+    """One reduce/foreach step: the accumulator becomes the LAST output
+    of the update filter (jq folds this way; empty output -> null,
+    jq 1.6 behavior)."""
+    out = None
+    for out in _eval(update, acc, env):
+        pass
+    return out
+
+
+def _eval_call(node: Call, value: Any, env: dict) -> Iterator[Any]:
+    fn = env.get(("fn", node.name, len(node.args)))
+    if fn is None:
+        raise _KqRuntimeError(f"{node.name}/{len(node.args)} is not defined")
+    params, body, def_env = fn
+
+    def bind(i: int, bound: dict) -> Iterator[Any]:
+        if i == len(params):
+            call_env = dict(def_env)
+            # recursion: the function sees itself
+            call_env[("fn", node.name, len(params))] = fn
+            call_env.update(bound)
+            yield from _eval(body, value, call_env)
+            return
+        p, arg = params[i], node.args[i]
+        if p.startswith("$"):
+            # value parameter: cartesian over the argument's outputs
+            # (jq semantics), evaluated in the CALLER's environment
+            for v in _eval(arg, value, env):
+                bound[p[1:]] = v
+                yield from bind(i + 1, bound)
+            return
+        # bare filter parameter: a 0-ary closure over the caller env
+        bound[("fn", p, 0)] = ((), arg, env)
+        yield from bind(i + 1, bound)
+
+    yield from bind(0, {})
+
+
+def _eval_object(entries, i, value, acc, env) -> Iterator[Any]:
     if i == len(entries):
         yield dict(acc)
         return
     key, val = entries[i]
-    keys = [key] if isinstance(key, str) else list(_eval(key, value))
+    keys = [key] if isinstance(key, str) else list(_eval(key, value, env))
     for k in keys:
         if not isinstance(k, str):
             raise _KqRuntimeError("object key must be a string")
-        for v in _eval(val, value):
+        for v in _eval(val, value, env):
             acc[k] = v
-            yield from _eval_object(entries, i + 1, value, acc)
+            yield from _eval_object(entries, i + 1, value, acc, env)
 
 
-def _eval_func(node: Func, value: Any) -> Iterator[Any]:
+def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
     name = node.name
     if node.args:
         arg = node.args[0]
         if name == "has":
-            for k in _eval(arg, value):
+            for k in _eval(arg, value, env):
                 if isinstance(value, dict) and isinstance(k, str):
                     yield k in value
                 elif isinstance(value, list) and isinstance(k, int):
@@ -741,19 +1068,19 @@ def _eval_func(node: Func, value: Any) -> Iterator[Any]:
                 raise _KqRuntimeError("map over non-array")
             out = []
             for item in value:
-                out.extend(_eval(arg, item))
+                out.extend(_eval(arg, item, env))
             yield out
         elif name in ("any", "all"):
             if not isinstance(value, list):
                 raise _KqRuntimeError(f"{name} over non-array")
             results = []
             for item in value:
-                results.extend(_truthy(v) for v in _eval(arg, item))
+                results.extend(_truthy(v) for v in _eval(arg, item, env))
             yield any(results) if name == "any" else all(results)
         elif name in ("test", "startswith", "endswith", "split"):
             if not isinstance(value, str):
                 raise _KqRuntimeError(f"{name} on non-string")
-            for pat in _eval(arg, value):
+            for pat in _eval(arg, value, env):
                 if not isinstance(pat, str):
                     raise _KqRuntimeError(f"{name} pattern must be a string")
                 if name == "test":
@@ -765,12 +1092,12 @@ def _eval_func(node: Func, value: Any) -> Iterator[Any]:
                 else:
                     yield value.split(pat)
         elif name == "contains":
-            for b in _eval(arg, value):
+            for b in _eval(arg, value, env):
                 yield _contains(value, b)
         elif name == "join":
             if not isinstance(value, list):
                 raise _KqRuntimeError("join over non-array")
-            for sep in _eval(arg, value):
+            for sep in _eval(arg, value, env):
                 if not isinstance(sep, str):
                     raise _KqRuntimeError("join separator must be a string")
                 yield sep.join(
@@ -783,7 +1110,7 @@ def _eval_func(node: Func, value: Any) -> Iterator[Any]:
             import functools
 
             def key_of(item):
-                return list(_eval(arg, item))
+                return list(_eval(arg, item, env))
 
             decorated = [(key_of(x), x) for x in value]
             cmp = functools.cmp_to_key(lambda p, q: _jq_cmp(p[0], q[0]))
@@ -796,7 +1123,7 @@ def _eval_func(node: Func, value: Any) -> Iterator[Any]:
             else:
                 yield max(decorated, key=cmp)[1]
         elif name == "range":
-            for n in _eval(arg, value):
+            for n in _eval(arg, value, env):
                 if isinstance(n, bool) or not isinstance(n, (int, float)):
                     raise _KqRuntimeError("range over non-number")
                 i = 0
@@ -804,13 +1131,17 @@ def _eval_func(node: Func, value: Any) -> Iterator[Any]:
                     yield i
                     i += 1
         elif name == "error":
-            for msg in _eval(arg, value):
-                raise _KqRuntimeError(str(msg))
+            for msg in _eval(arg, value, env):
+                raise _KqRuntimeError(str(msg), msg, True)
         else:  # pragma: no cover
             raise _KqRuntimeError(f"unknown function {name}")
         return
 
     # zero-arg builtins
+    if name == "error":
+        # jq: the input becomes the error (try error catch . round-trip
+        # preserves the VALUE, not a stringification)
+        raise _KqRuntimeError(str(value), value, True)
     if name == "length":
         if value is None:
             yield 0
@@ -955,12 +1286,12 @@ def _contains(a: Any, b: Any) -> bool:
     return _json_equal(a, b)
 
 
-def _eval_pipe(stages: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
+def _eval_pipe(stages: Sequence[Any], i: int, value: Any, env: dict) -> Iterator[Any]:
     if i == len(stages):
         yield value
         return
-    for out in _eval(stages[i], value):
-        yield from _eval_pipe(stages, i + 1, out)
+    for out in _eval(stages[i], value, env):
+        yield from _eval_pipe(stages, i + 1, out, env)
 
 
 def _eval_path(ops: Sequence[Any], i: int, value: Any) -> Iterator[Any]:
@@ -1019,7 +1350,7 @@ class Query:
         """
         out: List[Any] = []
         try:
-            for v in _eval(self._ast, value):
+            for v in _eval(self._ast, value, {}):
                 if v is None:
                     continue
                 out.append(v)

@@ -268,6 +268,40 @@ class TestCompileErrors:
         with pytest.raises(StageCompileError):
             DeviceSimulator([s], capacity=1)
 
+    def test_full_language_jq_lowers_as_opaque_column(self):
+        """reduce/$vars now parse in kq (r04), so the compiler lowers
+        them like any other opaque selector column — the stage runs on
+        the DEVICE backend instead of demoting the kind to host."""
+        s = Stage.from_dict(
+            {
+                "metadata": {"name": "counted"},
+                "spec": {
+                    "resourceRef": {"kind": "Pod"},
+                    "selector": {
+                        "matchExpressions": [
+                            {
+                                "key": "reduce .spec.containers[] as $c (0; . + 1)",
+                                "operator": "In",
+                                "values": ["2"],
+                            }
+                        ]
+                    },
+                    "next": {"statusTemplate": "phase: Counted"},
+                },
+            }
+        )
+        sim = DeviceSimulator([s], capacity=2)
+        row = sim.admit(
+            {
+                "metadata": {"name": "p", "namespace": "default"},
+                "spec": {"containers": [{"name": "a"}, {"name": "b"}]},
+                "status": {},
+            }
+        )
+        for _ in range(3):  # admit-tick arms, next tick fires
+            sim.step(dt_ms=1000)
+        assert (sim.objects[row].get("status") or {}).get("phase") == "Counted"
+
     def test_out_of_subset_jq_rejected(self):
         s = Stage.from_dict(
             {
@@ -276,10 +310,10 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            # reduce/$vars are outside even the widened
-                            # kq grammar -> host fallback
+                            # label/break stays outside the kq grammar
+                            # -> host fallback path must still engage
                             {
-                                "key": "reduce .spec.containers[] as $c (0; . + 1)",
+                                "key": "label $out | .spec | break $out",
                                 "operator": "Exists",
                             }
                         ]

@@ -97,13 +97,16 @@ def test_bool_not_equal_int():
 
 
 def test_compile_error():
-    # genuinely unsupported jq: variables, reduce, def
+    # still-unsupported jq: label/break, @-formats, destructuring
     with pytest.raises(KqCompileError):
-        Query(".a as $x | $x")
+        Query("label $out | break $out")
     with pytest.raises(KqCompileError):
-        Query("reduce .[] as $i (0; . + $i)")
+        Query("@base64")
     with pytest.raises(KqCompileError):
-        Query("def f: .; f")
+        Query(". as [$a, $b] | $a")
+    # unbound variables are compile errors, like jq
+    with pytest.raises(KqCompileError):
+        Query("$nope")
 
 
 def test_field_on_scalar_is_error():
@@ -202,3 +205,110 @@ def test_out_of_subset_stage_works_on_host_engine():
     assert Requirement(
         'if .status.phase == "Running" then "y" else "n" end', "In", ["y"]
     ).matches(pod)
+
+
+# ---------------------------------------------------------------------------
+# r04: the full-language tail — variables/as, reduce, foreach, def,
+# try/catch (reference embeds all of gojq, query.go:33-88; VERDICT r03
+# next-#10: an out-of-subset stage must WORK on the host backend)
+
+
+def test_variables_and_as_binding():
+    assert Query(".spec.replicas as $r | .status.ready == $r").execute(
+        {"spec": {"replicas": 3}, "status": {"ready": 3}}
+    ) == [True]
+    # binding covers the rest of the pipe, input stays the original
+    assert Query(".a as $x | .b | . + $x").execute({"a": 1, "b": 2}) == [3]
+    # cartesian: each output of the source binds once
+    assert Query(".[] as $x | $x * 10").execute([1, 2]) == [10, 20]
+
+
+def test_reduce():
+    assert Query("reduce .[] as $x (0; . + $x)").execute([1, 2, 3, 4]) == [10]
+    assert Query('reduce .items[] as $i (""; . + $i.name)').execute(
+        {"items": [{"name": "a"}, {"name": "b"}]}
+    ) == ["ab"]
+
+
+def test_foreach():
+    assert Query("foreach .[] as $x (0; . + $x)").execute([1, 2, 3]) == [1, 3, 6]
+    assert Query("foreach .[] as $x (0; . + $x; . * 10)").execute(
+        [1, 2, 3]
+    ) == [10, 30, 60]
+
+
+def test_def_functions():
+    assert Query("def double: . * 2; .n | double").execute({"n": 21}) == [42]
+    # recursion
+    assert Query(
+        "def fact: if . <= 1 then 1 else . * (. - 1 | fact) end; fact"
+    ).execute(5) == [120]
+    # filter parameters are closures over the call site
+    assert Query("def twice(f): f | f; .n | twice(. + 1)").execute(
+        {"n": 1}
+    ) == [3]
+    # $value parameters
+    assert Query("def addv($v): . + $v; .n | addv(10)").execute({"n": 5}) == [15]
+    # arity mismatch is a compile error
+    with pytest.raises(KqCompileError):
+        Query("def f(a): a; f")
+
+
+def test_try_catch():
+    # iterate-a-scalar error is caught; handler sees the message
+    assert Query('try (.a | .[]) catch "caught"').execute({"a": 5}) == ["caught"]
+    assert Query("try error catch .").execute("boom") == ["boom"]
+    # bare try swallows
+    assert Query("try (.a | .[])").execute({"a": 5}) == []
+
+
+def test_out_of_subset_stage_expression_works_on_host():
+    """The r02 #4 criterion: a stage selector using $vars/reduce runs
+    (host backend) instead of double-failing."""
+    from kwok_tpu.api.types import Stage
+    from kwok_tpu.engine.lifecycle import Lifecycle
+
+    stage = Stage.from_dict(
+        {
+            "apiVersion": "kwok.x-k8s.io/v1alpha1",
+            "kind": "Stage",
+            "metadata": {"name": "var-stage"},
+            "spec": {
+                "resourceRef": {"kind": "Pod"},
+                "selector": {
+                    "matchExpressions": [
+                        {
+                            "key": (
+                                'reduce .spec.containers[] as $c (0; . + 1)'
+                            ),
+                            "operator": "In",
+                            "values": ["2"],
+                        }
+                    ]
+                },
+                "next": {"statusTemplate": "phase: Counted"},
+            },
+        }
+    )
+    lc = Lifecycle([stage])
+    pod = {
+        "metadata": {"name": "p", "namespace": "default"},
+        "spec": {"containers": [{"name": "a"}, {"name": "b"}]},
+        "status": {},
+    }
+    matches = lc.match({}, {}, pod)
+    assert [m.name for m in matches] == ["var-stage"]
+
+
+def test_as_binds_to_term_like_jq():
+    # `1, 2 as $x | e` is `1, (2 as $x | e)` — not a comma-wide binding
+    assert Query("1, 2 as $x | $x + 1").execute({}) == [1, 3]
+
+
+def test_paren_path_suffix():
+    assert Query("(.a).b").execute({"a": {"b": 7}}) == [7]
+
+
+def test_error_value_round_trips_through_catch():
+    assert Query("try error catch .").execute({"a": 1}) == [{"a": 1}]
+    assert Query('try error({"a": 1}) catch .a').execute(None) == [1]
