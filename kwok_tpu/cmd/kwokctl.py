@@ -320,15 +320,21 @@ def cmd_snapshot_record(args) -> int:
 
     rt = _require_cluster(args)
     client = rt.client()
+    deadline = time.monotonic() + args.duration if args.duration > 0 else None
     with open(args.path, "w", encoding="utf-8") as sink:
         rec = Recorder(client).start(sink, snapshot=not args.no_snapshot)
         print(f"recording to {args.path}; Ctrl-C to stop", flush=True)
         try:
-            if args.duration > 0:
-                time.sleep(args.duration)
-            else:
-                while True:
-                    time.sleep(1)
+            while True:
+                # --stop-file: a deterministic stop trigger for
+                # scripts/tests (duration windows are wall-clock
+                # guesses; the file appears exactly when the driver is
+                # done mutating)
+                if args.stop_file and os.path.exists(args.stop_file):
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
+                time.sleep(0.1)
         except KeyboardInterrupt:
             pass
         rec.stop()
@@ -1106,6 +1112,8 @@ def build_parser() -> argparse.ArgumentParser:
     rec = pns.add_parser("record")
     rec.add_argument("--path", required=True)
     rec.add_argument("--duration", type=float, default=0.0)
+    rec.add_argument("--stop-file", default="",
+                     help="stop recording when this file appears")
     rec.add_argument("--no-snapshot", action="store_true")
     rec.set_defaults(fn=cmd_snapshot_record)
     rep = pns.add_parser("replay")

@@ -27,7 +27,9 @@ from kwok_tpu.snapshot.snapshot import DEFAULT_SKIP_KINDS
 class Recorder:
     """Record a live cluster to a YAML stream."""
 
-    def __init__(self, store, kinds: Optional[Iterable[str]] = None):
+    def __init__(
+        self, store, kinds: Optional[Iterable[str]] = None, clock=None
+    ):
         self._store = store
         if kinds is None:
             kinds = [
@@ -37,6 +39,12 @@ class Recorder:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._write_mut = threading.Lock()
+        #: injectable clock (utils/clock.Clock): ResourcePatch offsets
+        #: ride it, so a FakeClock records deterministic timelines
+        #: (the reference's clock.Clock seam, controller.go:102).
+        #: Default is MONOTONIC time — replay sorts and sleeps on these
+        #: offsets, so a wall-clock step must not reorder them.
+        self._now = clock.now if clock is not None else time.monotonic
         self._t0 = 0.0
 
     def start(self, sink: IO[str], snapshot: bool = True) -> "Recorder":
@@ -55,7 +63,7 @@ class Recorder:
             docs = [o for _, items, _ in per_kind for o in items]
             sink.write(yaml.safe_dump_all(docs, sort_keys=False))
         sink.flush()
-        self._t0 = time.monotonic()
+        self._t0 = self._now()
         for kind, _, rv in per_kind:
             w = self._store.watch(kind, since_rv=rv)
             t = threading.Thread(
@@ -85,7 +93,7 @@ class Recorder:
                         "name": meta.get("name") or "",
                         "namespace": meta.get("namespace") or "",
                     },
-                    duration_nanosecond=int((time.monotonic() - self._t0) * 1e9),
+                    duration_nanosecond=int((self._now() - self._t0) * 1e9),
                     method=method,
                     template=None if method == METHOD_DELETE else obj,
                 )

@@ -438,7 +438,14 @@ class _Parser:
             return self.parse_path()
         if text == "(":
             self.next()
-            node = self.parse_pipe()
+            # parens reset the reduce/foreach 'as'-suppression: an
+            # inner binding like `reduce (.[] as $y | $y) as $x (...)`
+            # is fully parenthesized and unambiguous
+            saved_no_as, self._no_as = self._no_as, 0
+            try:
+                node = self.parse_pipe()
+            finally:
+                self._no_as = saved_no_as
             self.expect(")")
             return node
         if text == "[":
@@ -477,7 +484,7 @@ class _Parser:
             if text in ("true", "false", "null"):
                 self.next()
                 return Literal({"true": True, "false": False, "null": None}[text])
-            # def-defined functions shadow builtins
+            # def-defined functions shadow builtins per (name, arity)
             if any(n == text for n, _ in self.fn_scope):
                 self.next()
                 args: List[Any] = []
@@ -488,11 +495,19 @@ class _Parser:
                         self.next()
                         args.append(self.parse_pipe())
                     self.expect(")")
-                if (text, len(args)) not in self.fn_scope:
-                    raise KqCompileError(
-                        f"{text}/{len(args)} is not defined in {self.src!r}"
-                    )
-                return Call(text, tuple(args))
+                if (text, len(args)) in self.fn_scope:
+                    return Call(text, tuple(args))
+                # arity not defined: fall through to the builtin of
+                # that arity (jq resolves map/1 past a user def map/0)
+                if len(args) == 0 and text in _FUNCS0:
+                    return Func(text, ())
+                if len(args) == 1 and text in _FUNCS1:
+                    if text == "select":
+                        return Select(args[0])
+                    return Func(text, (args[0],))
+                raise KqCompileError(
+                    f"{text}/{len(args)} is not defined in {self.src!r}"
+                )
             if text in _FUNCS0 or text in _FUNCS1:
                 self.next()
                 if self.peek_text() == "(":
@@ -870,10 +885,14 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
         yield node.value
     elif isinstance(node, Path):
         if node.optional:
-            try:
-                yield from list(_eval_path(node.ops, 0, value))
-            except _KqRuntimeError:
-                return
+            # stream-then-swallow, like `try` (jq: `e?` is `try e`)
+            it = _eval_path(node.ops, 0, value)
+            while True:
+                try:
+                    out = next(it)
+                except (StopIteration, _KqRuntimeError):
+                    return
+                yield out
         else:
             yield from _eval_path(node.ops, 0, value)
     elif isinstance(node, Pipe):
@@ -946,10 +965,17 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
     elif isinstance(node, ObjectCons):
         yield from _eval_object(node.entries, 0, value, {}, env)
     elif isinstance(node, Optional_):
-        try:
-            yield from list(_eval(node.expr, value, env))
-        except _KqRuntimeError:
-            return
+        # jq defines `e?` as `try e`: stream outputs until the error,
+        # then swallow it (not discard-the-whole-prefix)
+        it = _eval(node.expr, value, env)
+        while True:
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            except _KqRuntimeError:
+                return
+            yield out
     elif isinstance(node, Func):
         yield from _eval_func(node, value, env)
     elif isinstance(node, Var):

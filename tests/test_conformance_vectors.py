@@ -1,0 +1,210 @@
+"""Real-client wire conformance (VERDICT r03 next-#5): replay recorded
+kubectl request/response vectors against the wire facade.
+
+The vectors (testdata/conformance/kubectl_session.yaml) are the exact
+request shapes stock kubectl puts on the wire — discovery walk, create
+with fieldManager, limit/continue paging, watch+bookmarks, the three
+patch content types, and the server-side-apply conflict/force exchange
+— replayed IN ORDER as one session against a live APIServer.  When a
+real ``kubectl`` binary is on PATH, a second test drives it against
+the same server (auto-skipped otherwise; this image has none)."""
+
+import http.client
+import json
+import os
+import shutil
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+import yaml
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.store import ResourceStore
+
+VECTORS = os.path.join(
+    os.path.dirname(__file__), "testdata", "conformance", "kubectl_session.yaml"
+)
+
+
+def load_vectors():
+    with open(VECTORS, "r", encoding="utf-8") as f:
+        return yaml.safe_load(f)
+
+
+def dotted_get(obj, path):
+    """Dotted lookup with list indexing; trailing ``#`` is len()."""
+    cur = obj
+    for seg in path.split("."):
+        if seg == "#":
+            return len(cur)
+        if isinstance(cur, list):
+            cur = cur[int(seg)]
+        elif isinstance(cur, dict):
+            if seg not in cur:
+                return None
+            cur = cur[seg]
+        else:
+            return None
+    return cur
+
+
+def do_request(host, port, spec, captures):
+    method = spec["method"]
+    path = spec["path"].format(**captures)
+    headers = dict(spec.get("headers") or {})
+    body = None
+    if "body_yaml" in spec:
+        body = spec["body_yaml"].encode()
+    elif "body" in spec:
+        body = json.dumps(spec["body"]).encode()
+        headers.setdefault("Content-Type", "application/json")
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    try:
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        parsed = json.loads(raw) if raw and raw.lstrip()[:1] in (b"{", b"[") else raw
+        return resp.status, parsed
+    finally:
+        conn.close()
+
+
+def do_watch(host, port, spec, captures):
+    """Consume a chunked watch stream; returns (status, [frame, ...])."""
+    path = spec["path"].format(**captures)
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    mut = spec.get("stream_mutation")
+    mut_thread = None
+    if mut is not None:
+        mut_thread = threading.Timer(
+            0.5, lambda: do_request(host, port, mut, captures)
+        )
+        mut_thread.start()
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        frames = []
+        deadline = time.monotonic() + 10
+        buf = b""
+        resp.fp.raw._sock.settimeout(1.0)  # noqa: SLF001 — test plumbing
+        while time.monotonic() < deadline:
+            try:
+                chunk = resp.read1(65536)
+            except (socket.timeout, TimeoutError):
+                continue
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                if line.strip():
+                    frames.append(json.loads(line))
+            if any(f.get("type") == "MODIFIED" for f in frames):
+                break
+        return resp.status, frames
+    finally:
+        if mut_thread is not None:
+            mut_thread.join()
+        conn.close()
+
+
+@pytest.fixture()
+def server():
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        host, port = srv.address
+        yield store, host, port
+
+
+def test_kubectl_session_vectors(server):
+    _, host, port = server
+    captures = {}
+    for vec in load_vectors():
+        name = vec["name"]
+        spec = vec["request"]
+        expect = vec.get("expect") or {}
+        if "watch" in spec["path"] and "watch=true" in spec["path"]:
+            status, frames = do_watch(host, port, spec, captures)
+            assert status == expect.get("status", 200), (name, status)
+            want_types = set(expect.get("watch_types") or [])
+            got_types = {f.get("type") for f in frames}
+            assert want_types <= got_types, (name, want_types, got_types, frames)
+            # every frame is a {type, object} pair like client-go expects
+            for f in frames:
+                assert {"type", "object"} <= set(f), (name, f)
+            continue
+        status, body = do_request(host, port, spec, captures)
+        assert status == expect.get("status", 200), (name, status, body)
+        for path, want in (expect.get("json") or {}).items():
+            got = dotted_get(body, path)
+            if want == "*":
+                assert got not in (None, ""), (name, path, body)
+            else:
+                assert got == want, (name, path, got, want)
+        for cname, cpath in (vec.get("capture") or {}).items():
+            captures[cname] = dotted_get(body, cpath)
+
+
+KUBECTL = shutil.which("kubectl")
+
+
+@pytest.mark.skipif(KUBECTL is None, reason="no kubectl binary on PATH")
+def test_real_kubectl_against_facade(server, tmp_path):
+    """When a genuine kubectl exists, drive it at the facade: the
+    ultimate conformance check (runs automatically wherever the binary
+    is available)."""
+    _, host, port = server
+    kubeconfig = tmp_path / "kubeconfig"
+    kubeconfig.write_text(
+        yaml.safe_dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "clusters": [
+                    {
+                        "name": "kwok-tpu",
+                        "cluster": {"server": f"http://{host}:{port}"},
+                    }
+                ],
+                "contexts": [
+                    {
+                        "name": "kwok-tpu",
+                        "context": {"cluster": "kwok-tpu", "user": "admin"},
+                    }
+                ],
+                "current-context": "kwok-tpu",
+                "users": [{"name": "admin", "user": {}}],
+            }
+        )
+    )
+    env = dict(os.environ, KUBECONFIG=str(kubeconfig))
+
+    def k(*args):
+        return subprocess.run(
+            [KUBECTL, *args], env=env, capture_output=True, text=True, timeout=60
+        )
+
+    assert k("version").returncode == 0
+    pod = tmp_path / "pod.yaml"
+    pod.write_text(
+        yaml.safe_dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": "kc-pod", "namespace": "default"},
+                "spec": {"nodeName": "n", "containers": [{"name": "c", "image": "i"}]},
+            }
+        )
+    )
+    assert k("apply", "--server-side", "-f", str(pod)).returncode == 0
+    out = k("get", "pods", "-n", "default", "-o", "json")
+    assert out.returncode == 0
+    assert "kc-pod" in out.stdout
+    assert k(
+        "patch", "pod", "kc-pod", "-n", "default", "--type=merge",
+        "-p", '{"metadata":{"labels":{"x":"y"}}}'
+    ).returncode == 0
+    assert k("delete", "pod", "kc-pod", "-n", "default").returncode == 0

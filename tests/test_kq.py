@@ -312,3 +312,20 @@ def test_paren_path_suffix():
 def test_error_value_round_trips_through_catch():
     assert Query("try error catch .").execute({"a": 1}) == [{"a": 1}]
     assert Query('try error({"a": 1}) catch .a').execute(None) == [1]
+
+
+def test_optional_streams_prefix_like_try():
+    # jq defines `e?` as `try e`
+    assert Query("try (1, error, 3)").execute(None) == [1]
+    assert Query("(1, error, 3)?").execute(None) == [1]
+
+
+def test_def_shadowing_is_per_arity():
+    assert Query("def map: 7; [1] | map(. + 1)").execute(None) == [[2]]
+    assert Query("def map: 7; map").execute(None) == [7]
+
+
+def test_parenthesized_as_inside_reduce_source():
+    assert Query(
+        "reduce (.[] as $y | $y * 2) as $x (0; . + $x)"
+    ).execute([1, 2, 3]) == [12]
