@@ -255,16 +255,19 @@ def run_e2e_bench() -> dict:
     for _ in range(E2E_WINDOWS):
         tr0, p0 = player.transitions, player.patches
         d0, s0, h0 = player.t_device, player.t_store, player.t_host
+        b0 = player.t_build
         t0 = time.time()
         time.sleep(window_s)
         wall = time.time() - t0
+        build = player.t_build - b0
         sample = {
             "tps": (player.transitions - tr0) / wall,
             "dirty": (player.patches - p0) / wall,
             "breakdown_s": {
                 "device_tick_s": round(player.t_device - d0, 2),
                 "store_bulk_s": round(player.t_store - s0, 2),
-                "host_drain_s": round(player.t_host - h0, 2),
+                "host_build_s": round(build, 2),
+                "host_drain_s": round(player.t_host - h0 - build, 2),
             },
         }
         if best is None or sample["tps"] > best["tps"]:

@@ -74,8 +74,10 @@ class DeviceStagePlayer:
         self.events: Queue = Queue()
         #: (namespace, name) -> row
         self._rows: Dict[Tuple[str, str], int] = {}
-        #: row -> resourceVersion we last wrote (echo suppression)
-        self._written_rv: Dict[int, str] = {}
+        #: row-indexed resourceVersion we last wrote (echo
+        #: suppression); grown alongside sim.capacity — at 1M rows an
+        #: indexed load beats a big-dict probe on every hot path
+        self._written_rv: List[Optional[str]] = [None] * capacity
         self._mut = threading.Lock()
         self._paced = True
         self._done = threading.Event()
@@ -89,6 +91,10 @@ class DeviceStagePlayer:
         self.t_device = 0.0
         self.t_store = 0.0
         self.t_host = 0.0
+        #: subset of t_host spent in the per-row patch build loop
+        #: (native fast_group) — reported separately by the bench so
+        #: the breakdown names the real bottleneck
+        self.t_build = 0.0
         #: recent tick-lag samples in seconds (how far the real-time
         #: loop fell behind its schedule) — the p99 heartbeat-lag
         #: signal from SURVEY §7 step 5
@@ -131,10 +137,10 @@ class DeviceStagePlayer:
                 )
             except (TypeError, ValueError):
                 self._batch_has_exclude = False
-        #: row -> stage_idx -> resolved sentinel values (identity + env
-        #: funcs; both row-stable) — dropped with the render cache on
-        #: any identity change
-        self._vals_cache: Dict[int, Dict[int, Dict]] = {}
+        #: row-indexed {stage_idx -> resolved sentinel values}
+        #: (identity + env funcs; both row-stable) — dropped with the
+        #: render cache on any identity change
+        self._vals_cache: List[Optional[Dict]] = [None] * capacity
         #: in-flight macro-tick (stages device array, t0_ms, dt) for
         #: the overlapped step_pipelined path
         self._inflight = None
@@ -200,6 +206,15 @@ class DeviceStagePlayer:
         except Exception:  # noqa: BLE001 — best effort at shutdown
             pass
 
+    def _grow_row_arrays(self) -> None:
+        """Keep the row-indexed caches sized to the SoA capacity (the
+        sim grows by doubling on admit)."""
+        cap = self.sim.capacity
+        if len(self._written_rv) < cap:
+            self._written_rv.extend([None] * (cap - len(self._written_rv)))
+        if len(self._vals_cache) < cap:
+            self._vals_cache.extend([None] * (cap - len(self._vals_cache)))
+
     # ------------------------------------------------------------ event ingest
 
     def _key(self, obj: dict) -> Tuple[str, str]:
@@ -216,6 +231,7 @@ class DeviceStagePlayer:
         if not evs:
             return
         with self._mut:
+            self._grow_row_arrays()
             if _FAST is not None:
                 evs = _FAST.filter_stale(evs, self._rows, self._written_rv)
             for ev in evs:
@@ -230,13 +246,15 @@ class DeviceStagePlayer:
             if row is not None:
                 self.sim.release(row)
                 del self._rows[key]
-                self._written_rv.pop(row, None)
+                if row < len(self._written_rv):
+                    self._written_rv[row] = None
                 self._drop_render_cache(row)
             if self.on_delete is not None:
                 self.on_delete(obj)
             return
         if row is not None and _rv_stale(
-            meta.get("resourceVersion"), self._written_rv.get(row)
+            meta.get("resourceVersion"),
+            self._written_rv[row] if row < len(self._written_rv) else None,
         ):
             # echo of one of our own patches (possibly an intermediate
             # state of a multi-patch transition — finalizer patch then
@@ -511,6 +529,7 @@ class DeviceStagePlayer:
         use_c = _FAST is not None and self._store_has_batch
         t_host0 = time.perf_counter()
         t_store_before = self.t_store
+        self._grow_row_arrays()
         srow = st[rows]
         sigrow = sigs[rows]
         order = np.lexsort((sigrow, srow))
@@ -587,6 +606,7 @@ class DeviceStagePlayer:
                     )
                     for k in range(0, len(group), chunk or len(group)):
                         sub = group[k : k + chunk] if chunk else group
+                        tb_build = time.perf_counter()
                         noops, slow_rows = _FAST.fast_group(
                             objects,
                             sub,
@@ -603,6 +623,7 @@ class DeviceStagePlayer:
                             fast_rows,
                             fast_items,
                         )
+                        self.t_build += time.perf_counter() - tb_build
                         self.transitions += noops
                         for row in slow_rows:
                             slow.append(self._make_transition(row, s_idx, t_ms))
@@ -618,7 +639,7 @@ class DeviceStagePlayer:
                         if comp is None:
                             patch = bound  # tick-static: shared by rows
                         else:
-                            rowc = vals_cache.get(row)
+                            rowc = vals_cache[row]
                             if rowc is None:
                                 rowc = vals_cache[row] = {}
                             vals = rowc.get(s_idx)
@@ -909,7 +930,8 @@ class DeviceStagePlayer:
 
     def _drop_render_cache(self, row: int) -> None:
         self._render_cache.pop(row, None)
-        self._vals_cache.pop(row, None)
+        if row < len(self._vals_cache):
+            self._vals_cache[row] = None
 
     def _render_identity_same(self, old: Optional[dict], new: dict) -> bool:
         """Whether a row's cached renders survive this object change:
@@ -1178,7 +1200,8 @@ class DeviceStagePlayer:
         row = self._rows.pop(key, None)
         if row is not None:
             self.sim.release(row)
-            self._written_rv.pop(row, None)
+            if row < len(self._written_rv):
+                self._written_rv[row] = None
             self._drop_render_cache(row)
 
     def _refresh(
@@ -1194,6 +1217,7 @@ class DeviceStagePlayer:
                 return
             # store reaped it (deletionTimestamp + no finalizers)?
             mm = obj.get("metadata") or {}
+            self._grow_row_arrays()
             self._written_rv[row] = mm.get("resourceVersion")
             if simple and self.sim.confirm_row(
                 row, obj, ignore_finalizers=own_finalizers

@@ -480,8 +480,12 @@ py_filter_stale(PyObject *self, PyObject *args)
                 PyObject *row = PyDict_GetItemWithError(rows, key);
                 Py_DECREF(key);
                 if (row) {
-                    PyObject *last = PyDict_GetItemWithError(written, row);
-                    if (last) {
+                    Py_ssize_t ridx = PyLong_AsSsize_t(row);
+                    PyObject *last =
+                        (ridx >= 0 && ridx < PyList_GET_SIZE(written))
+                            ? PyList_GET_ITEM(written, ridx)
+                            : NULL;
+                    if (last && last != Py_None) {
                         PyObject *rvs =
                             PyDict_GetItemWithError(meta, s_resourceVersion);
                         if (rvs && PyUnicode_Check(rvs) &&
@@ -558,16 +562,25 @@ py_fast_group(PyObject *self, PyObject *args)
             patch = bound; /* tick-static: shared by rows */
             Py_INCREF(patch);
         } else {
-            PyObject *rowc = PyDict_GetItemWithError(vals_cache, row_obj);
-            if (!rowc) {
-                if (PyErr_Occurred())
-                    goto err;
+            /* vals_cache is row-indexed (caller guarantees length >=
+             * capacity; bounds-checked anyway — an IndexError must not
+             * become a use-after-free) */
+            if (row >= PyList_GET_SIZE(vals_cache)) {
+                PyErr_SetString(PyExc_IndexError,
+                                "vals_cache shorter than row index");
+                goto err;
+            }
+            PyObject *rowc = PyList_GET_ITEM(vals_cache, row);
+            if (rowc == Py_None) {
                 rowc = PyDict_New();
-                if (!rowc || PyDict_SetItem(vals_cache, row_obj, rowc) < 0) {
-                    Py_XDECREF(rowc);
+                if (!rowc)
+                    goto err;
+                Py_INCREF(rowc); /* keep ours across the steal */
+                if (PyList_SetItem(vals_cache, row, rowc) < 0) {
+                    Py_DECREF(rowc);
                     goto err;
                 }
-                Py_DECREF(rowc); /* dict keeps it alive */
+                Py_DECREF(rowc); /* the list holds it now */
             }
             PyObject *vals = PyDict_GetItemWithError(rowc, s_idx);
             if (!vals) {
@@ -787,10 +800,12 @@ py_confirm_batch(PyObject *self, PyObject *args)
                 goto err;
             rvs = Py_None;
         }
-        if (PyDict_SetItem(written, row_obj, rvs) < 0)
-            goto err;
         Py_ssize_t row = PyLong_AsSsize_t(row_obj);
         if (row < 0 && PyErr_Occurred())
+            goto err;
+        /* written is row-indexed (list), like vals_cache */
+        Py_INCREF(rvs);
+        if (PyList_SetItem(written, row, rvs) < 0) /* steals */
             goto err;
         PyObject *old = PyList_GET_ITEM(objects, row);
         if (cache) {
