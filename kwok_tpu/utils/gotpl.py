@@ -180,25 +180,42 @@ def _json_eq(a: Any, b: Any) -> bool:
     return a == b
 
 
+_SPRIG_TABLE: Dict[str, Callable] = {}
+
+
 def default_funcs() -> Dict[str, Callable]:
-    return {
-        "Quote": _fn_quote,
-        "Now": _go_now,
-        "StartTime": lambda: _START_TIME,
-        "YAML": _fn_yaml,
-        "Version": lambda: KWOK_TPU_VERSION,
-        "NodeConditions": lambda: [dict(c) for c in NODE_CONDITIONS],
-        # builtins
-        "printf": _fn_printf,
-        "index": _fn_index,
-        "len": lambda v: len(v) if v is not None else 0,
-        "not": lambda v: not _is_true(v),
-        "eq": _go_eq,
-        "ne": lambda a, b: not _json_eq(a, b),
-        # sprig-isms
-        "dict": _fn_dict,
-        "default": lambda d, v=None: v if _is_true(v) else d,
-    }
+    # sprig at large first (reference funcs.go:42-117 pulls in all of
+    # sprig.TxtFuncMap); the engine's own builtins and kwok funcs
+    # override on name clashes (quote/default keep kwok semantics).
+    # The 165-entry sprig table is built once — default_funcs() is on
+    # the per-render path, and rebuilding the closures per call was a
+    # measured ~34us tax.
+    if not _SPRIG_TABLE:
+        from kwok_tpu.utils.sprig import sprig_funcs
+
+        _SPRIG_TABLE.update(sprig_funcs())
+    funcs = dict(_SPRIG_TABLE)
+    funcs.update(
+        {
+            "Quote": _fn_quote,
+            "Now": _go_now,
+            "StartTime": lambda: _START_TIME,
+            "YAML": _fn_yaml,
+            "Version": lambda: KWOK_TPU_VERSION,
+            "NodeConditions": lambda: [dict(c) for c in NODE_CONDITIONS],
+            # builtins
+            "printf": _fn_printf,
+            "index": _fn_index,
+            "len": lambda v: len(v) if v is not None else 0,
+            "not": lambda v: not _is_true(v),
+            "eq": _go_eq,
+            "ne": lambda a, b: not _json_eq(a, b),
+            # sprig-isms with kwok-pinned semantics
+            "dict": _fn_dict,
+            "default": lambda d, v=None: v if _is_true(v) else d,
+        }
+    )
+    return funcs
 
 
 # ---------------------------------------------------------------------------
@@ -298,9 +315,10 @@ def _tokenize_expr(src: str) -> List[Tuple[str, str]]:
         m = _EXPR_TOKEN_RE.match(src, pos)
         if m is None:
             raise TemplateError(f"bad token at {src[pos:]!r}")
+        start = m.start()
         pos = m.end()
         if m.lastgroup != "ws":
-            tokens.append((m.lastgroup, m.group()))
+            tokens.append((m.lastgroup, m.group(), start))
     return tokens
 
 
@@ -344,12 +362,26 @@ class _ExprParser:
         return ("call", terms)
 
     def parse_term(self):
-        kind, text = self.next()
+        tok = self.next()
+        kind, text = tok[0], tok[1]
         if text == "(":
             pipe = self.parse_pipeline()
             t = self.next()
             if t[1] != ")":
                 raise TemplateError(f"expected ) in {self.src!r}")
+            nxt = self.peek()
+            if (
+                nxt is not None
+                and nxt[0] == "field"
+                and len(nxt) > 2
+                and len(t) > 2
+                and nxt[2] == t[2] + 1
+            ):
+                # Go templates allow field access on a parenthesized
+                # pipeline, but ONLY when adjacent: `(split "$" .s)._1`
+                # is a suffix, `(f .a) .b` is an argument
+                self.next()
+                return ("suffix", pipe, [p for p in nxt[1].split(".") if p])
             return pipe
         if kind == "field":
             path = [p for p in text.split(".") if p]
@@ -619,6 +651,10 @@ class Template:
             return _navigate(base, path)
         if kind == "pipe":
             return self._eval_pipe(term, dot, variables, env)
+        if kind == "suffix":
+            return _navigate(
+                self._eval_pipe(term[1], dot, variables, env), term[2]
+            )
         if kind == "fn":
             name = term[1]
             if name == "or":
@@ -688,6 +724,8 @@ def template_read_paths(tpl: "Template") -> set:
                     uses[t[1]] = uses.get(t[1], 0) + 1
                 elif t[0] == "pipe":
                     count_pipe(t)
+                elif t[0] == "suffix":
+                    count_pipe(t[1])
 
     def count_nodes(nodes):
         for n in nodes:
@@ -734,6 +772,8 @@ def template_read_paths(tpl: "Template") -> set:
                     acc[t[1]] = acc.get(t[1], 0) + 1
                 elif t[0] == "pipe":
                     count_one(t, acc)
+                elif t[0] == "suffix":
+                    count_one(t[1], acc)
 
     while changed:
         changed = False
@@ -774,6 +814,8 @@ def template_read_paths(tpl: "Template") -> set:
                             pass  # range/with-bound: subsumed by source path
                 elif t[0] == "pipe":
                     collect_pipe(t, root_ctx)
+                elif t[0] == "suffix":
+                    collect_pipe(t[1], root_ctx)
 
     def pipe_as_path(pipe):
         """If a pipeline is a bare path term, return its tuple."""

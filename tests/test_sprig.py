@@ -1,0 +1,188 @@
+"""Sprig-at-large coverage for the gotpl engine (VERDICT r03 missing
+#4: reference funcs.go:42-117 pulls in all of sprig.TxtFuncMap, so
+wild user stages may call any of it).  Each case is a template the
+engine renders; expectations follow sprig v3 semantics (argument
+orders with the subject LAST, pipeline-friendly)."""
+
+import re
+
+import pytest
+
+from kwok_tpu.utils.gotpl import Renderer
+
+E = Renderer()
+
+
+def r(tpl, data=None):
+    return E.render(tpl, data if data is not None else {})
+
+
+CASES = [
+    # strings
+    ('{{ upper "abc" }}', "ABC"),
+    ('{{ lower "ABC" }}', "abc"),
+    ('{{ title "hello world" }}', "Hello World"),
+    ('{{ trim "  x  " }}', "x"),
+    ('{{ trimAll "$" "$5.00$" }}', "5.00"),
+    ('{{ trimPrefix "p-" "p-name" }}', "name"),
+    ('{{ trimSuffix "-s" "name-s" }}', "name"),
+    ('{{ repeat 3 "ab" }}', "ababab"),
+    ('{{ substr 0 3 "abcdef" }}', "abc"),
+    ('{{ trunc 3 "abcdef" }}', "abc"),
+    ('{{ trunc -3 "abcdef" }}', "def"),
+    ('{{ abbrev 6 "abcdefghi" }}', "abc..."),
+    ('{{ contains "ell" "hello" }}', "true"),
+    ('{{ hasPrefix "he" "hello" }}', "true"),
+    ('{{ hasSuffix "lo" "hello" }}', "true"),
+    ('{{ replace "o" "0" "foo" }}', "f00"),
+    ('{{ snakecase "FirstName" }}', "first_name"),
+    ('{{ kebabcase "FirstName" }}', "first-name"),
+    ('{{ camelcase "http_server" }}', "HttpServer"),
+    ('{{ nospace "a b  c" }}', "abc"),
+    ('{{ initials "First Try" }}', "FT"),
+    ('{{ cat "a" "b" 1 }}', "a b 1"),
+    ('{{ splitList "," "a,b,c" | len }}', "3"),
+    ('{{ (split "$" "foo$bar")._1 }}', "bar"),
+    ('{{ join "-" (list "a" "b") }}', "a-b"),
+    ('{{ sortAlpha (list "c" "a" "b") | join "" }}', "abc"),
+    ('{{ "line" | indent 2 }}', "  line"),
+    ('{{ "s" | squote }}', "'s'"),
+    # math
+    ("{{ add 1 2 3 }}", "6"),
+    ("{{ add1 41 }}", "42"),
+    ("{{ sub 5 3 }}", "2"),
+    ("{{ mul 2 3 4 }}", "24"),
+    ("{{ div 10 3 }}", "3"),
+    ("{{ mod 10 3 }}", "1"),
+    ("{{ max 1 5 3 }}", "5"),
+    ("{{ min 4 2 8 }}", "2"),
+    ("{{ floor 3.7 }}", "3"),
+    ("{{ ceil 3.1 }}", "4"),
+    ("{{ round 3.14159 2 }}", "3.14"),
+    ("{{ seq 3 }}", "1 2 3"),
+    ("{{ until 3 | len }}", "3"),
+    ('{{ atoi "42" }}', "42"),
+    # lists
+    ("{{ list 1 2 3 | len }}", "3"),
+    ("{{ first (list 1 2 3) }}", "1"),
+    ("{{ last (list 1 2 3) }}", "3"),
+    ("{{ rest (list 1 2 3) | len }}", "2"),
+    ("{{ initial (list 1 2 3) | len }}", "2"),
+    ("{{ append (list 1 2) 3 | len }}", "3"),
+    ("{{ prepend (list 2 3) 1 | first }}", "1"),
+    ("{{ concat (list 1) (list 2 3) | len }}", "3"),
+    ("{{ reverse (list 1 2 3) | first }}", "3"),
+    ("{{ uniq (list 1 1 2) | len }}", "2"),
+    ("{{ without (list 1 2 3) 2 | len }}", "2"),
+    ("{{ has 2 (list 1 2 3) }}", "true"),
+    ('{{ compact (list "" "a" "") | len }}', "1"),
+    # dicts
+    ('{{ get (dict "k" "v") "k" }}', "v"),
+    ('{{ hasKey (dict "k" "v") "k" }}', "true"),
+    ('{{ keys (dict "a" 1) | first }}', "a"),
+    ('{{ pluck "a" (dict "a" 1) (dict "a" 2) | len }}', "2"),
+    ('{{ pick (dict "a" 1 "b" 2) "a" | len }}', "1"),
+    ('{{ omit (dict "a" 1 "b" 2) "a" | len }}', "1"),
+    ('{{ dig "x" "y" "nope" (dict "x" (dict "y" "hit")) }}', "hit"),
+    # encodings
+    ('{{ b64enc "hi" }}', "aGk="),
+    ('{{ b64dec "aGk=" }}', "hi"),
+    ('{{ toJson (dict "a" 1) }}', '{"a":1}'),
+    ('{{ (fromJson "{\\"a\\": 7}").a }}', "7"),
+    ('{{ sha256sum "" }}',
+     "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"),
+    # flow / defaults
+    ('{{ empty "" }}', "true"),
+    ("{{ empty 1 }}", "false"),
+    ('{{ coalesce "" 0 "x" }}', "x"),
+    ('{{ ternary "yes" "no" true }}', "yes"),
+    # regex
+    ('{{ regexMatch "^a.c$" "abc" }}', "true"),
+    ('{{ regexFind "[0-9]+" "ab12cd34" }}', "12"),
+    ('{{ regexFindAll "[0-9]+" "ab12cd34" -1 | len }}', "2"),
+    ('{{ regexReplaceAll "a(x*)b" "ab" "${1}W" }}', "W"),
+    ('{{ regexSplit "," "a,b,c" -1 | len }}', "3"),
+    # type introspection
+    ("{{ kindOf (list 1) }}", "slice"),
+    ('{{ kindIs "map" (dict) }}', "true"),
+    ("{{ deepEqual (list 1 2) (list 1 2) }}", "true"),
+    # paths
+    ('{{ base "/a/b/c.txt" }}', "c.txt"),
+    ('{{ dir "/a/b/c.txt" }}', "/a/b"),
+    ('{{ ext "/a/b/c.txt" }}', ".txt"),
+    # semver
+    ('{{ semverCompare ">=1.2.0" "1.2.3" }}', "true"),
+    ('{{ semverCompare "^1.2.0" "2.0.0" }}', "false"),
+    ('{{ semverCompare "~1.2.0" "1.2.9" }}', "true"),
+    # dates
+    ('{{ date "2006-01-02" "2026-03-04T05:06:07Z" }}', "2026-03-04"),
+    ('{{ unixEpoch "1970-01-01T00:01:00Z" }}', "60"),
+]
+
+
+def test_sprig_table():
+    for tpl, want in CASES:
+        got = r(tpl)
+        assert got == want, f"{tpl}: {got!r} != {want!r}"
+
+
+def test_sprig_merge_semantics():
+    # sprig merge: destination wins on conflicts; deep
+    out = r(
+        '{{ $d := dict "a" 1 }}{{ $s := dict "a" 9 "b" 2 }}'
+        "{{ merge $d $s | toJson }}"
+    )
+    assert out in ('{"a":1,"b":2}', '{"b":2,"a":1}')
+
+
+def test_sprig_in_a_stage_template():
+    """The point of the exercise: a WILD stage template using sprig
+    functions renders through the full engine path."""
+    tpl = (
+        "phase: {{ .metadata.name | trimPrefix \"pod-\" | upper }}\n"
+        "hash: {{ .metadata.name | sha256sum | trunc 8 }}\n"
+        "note: {{ default \"none\" .metadata.annotations }}\n"
+    )
+    out = E.render_to_json(
+        tpl, {"metadata": {"name": "pod-web", "annotations": None}}
+    )
+    assert out["phase"] == "WEB"
+    assert re.fullmatch(r"[0-9a-f]{8}", out["hash"])
+    assert out["note"] == "none"
+
+
+def test_random_and_uuid_shapes():
+    assert re.fullmatch(r"[0-9a-zA-Z]{8}", r("{{ randAlphaNum 8 }}"))
+    assert re.fullmatch(
+        r"[0-9a-f]{8}-[0-9a-f]{4}-4[0-9a-f]{3}-[89ab][0-9a-f]{3}-[0-9a-f]{12}",
+        r("{{ uuidv4 }}"),
+    )
+
+
+def test_must_aliases_present():
+    assert r('{{ mustFromJson "[1,2]" | len }}') == "2"
+
+
+def test_fail_raises():
+    with pytest.raises(Exception):
+        r('{{ fail "boom" }}')
+
+
+def test_suffix_requires_adjacency():
+    """Go: `(expr).f` is a field suffix; `(expr) .f` passes .f as an
+    argument — the tokenizer records adjacency to tell them apart."""
+    assert r('{{ index (dict "a" 1) .k }}', {"k": "a"}) == "1"
+    assert r('{{ printf "%s-%s" (upper .a) .b }}', {"a": "x", "b": "y"}) == "X-y"
+
+
+def test_div_mod_truncate_toward_zero():
+    # Go integer semantics, not Python floor
+    assert r("{{ div -7 2 }}") == "-3"
+    assert r("{{ mod -7 2 }}") == "-1"
+
+
+def test_suffix_reads_visible_to_compiler():
+    from kwok_tpu.utils.gotpl import Template, template_read_paths
+
+    rp = template_read_paths(Template("{{ (index .status.conditions 0).type }}"))
+    assert ("status", "conditions") in rp
