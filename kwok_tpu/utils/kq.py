@@ -21,15 +21,17 @@ startswith, contains, ...) — with gojq-compatible semantics:
   arrays < objects) backs ``< <= > >=``, sort, min, max;
 - ``true != 1`` (no bool/number coercion).
 
-The full-language tail is in too (r04): variables and ``as`` bindings,
+The full-language tail is in too (r04): variables and ``as`` bindings
+(including ``[$a, $b]`` / ``{k: $v}`` destructuring patterns),
 ``reduce``/``foreach``, ``def`` with filter and ``$value`` parameters
-(including recursion), and ``try``/``catch`` — so out-of-subset stages
-run on the host path, and selector expressions using them lower as
-opaque host-evaluated feature columns on the device path.  Constructs
-outside the grammar still raise ``KqCompileError`` at parse time —
-``label``/``break``, ``@format`` strings, and destructuring patterns
-are the remaining (documented) gaps; unbound ``$vars`` are compile
-errors like jq.
+(including recursion), ``try``/``catch``, ``label``/``break``, and the
+``@format`` strings (@text/@json/@base64/@base64d/@uri/@html/@sh/
+@csv/@tsv) — so out-of-subset stages run on the host path, and
+selector expressions using them lower as opaque host-evaluated feature
+columns on the device path.  Remaining (documented) gaps: string
+interpolation ``"\\(e)"``, ``?//`` pattern alternatives, and patterns
+in reduce/foreach sources; unbound ``$vars`` and breaks outside their
+label are compile errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -71,6 +73,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>"(?:[^"\\]|\\.)*")
   | (?P<number>\d+(?:\.\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<format>@[a-z0-9]+)
   | (?P<op>//|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
@@ -268,6 +271,39 @@ class TryCatch:
     handler: Any  # None -> swallow
 
 
+@dataclass(frozen=True)
+class Label:
+    """``label $out | BODY`` — a scope ``break $out`` jumps out of."""
+
+    name: str
+    body: Any
+
+
+@dataclass(frozen=True)
+class Break:
+    """``break $out`` — stop producing outputs up to the label."""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class Format:
+    """``@base64`` etc. — format the input value as a string."""
+
+    name: str
+
+
+@dataclass(frozen=True)
+class AsPattern:
+    """``SRC as [$a, $b] | BODY`` / ``SRC as {k: $v} | BODY`` —
+    destructuring binds; ``pattern`` is nested lists/dicts with leaf
+    ``("$", name)`` markers."""
+
+    source: Any
+    pattern: Any
+    body: Any
+
+
 #: zero-arg builtins (applied as a filter to each input)
 _FUNCS0 = {
     "length", "keys", "values", "type", "tostring", "tonumber", "not",
@@ -297,6 +333,9 @@ class _Parser:
         #: >0 while parsing a reduce/foreach source, whose own 'as'
         #: belongs to the construct, not to a Term binding
         self._no_as = 0
+        #: lexically-scoped labels (break outside its label is a
+        #: compile error, like jq)
+        self.label_scope: List[str] = []
 
     def peek(self) -> Optional[Tuple[str, str]]:
         return self.tokens[self.i] if self.i < len(self.tokens) else None
@@ -410,24 +449,57 @@ class _Parser:
             else:
                 break
         if self.peek_text() == "as" and not self._no_as:
-            # jq grammar: Term 'as' $x '|' Exp — the source is the
+            # jq grammar: Term 'as' Pattern '|' Exp — the source is the
             # TERM, and the body extends maximally to the right
             # (`1, 2 as $x | e` is `1, (2 as $x | e)`)
             self.next()
-            tok = self.next()
-            if tok[0] != "var":
-                raise KqCompileError(
-                    f"'as' needs a $variable, got {tok[1]!r} in {self.src!r}"
-                )
-            var = tok[1][1:]
+            pattern = self.parse_pattern()
+            names = _pattern_vars(pattern)
             self.expect("|")
-            self.var_scope.append(var)
+            self.var_scope.extend(names)
             try:
                 body = self.parse_pipe()
             finally:
-                self.var_scope.pop()
-            return As(node, var, body)
+                del self.var_scope[len(self.var_scope) - len(names) :]
+            if pattern[0] == "$":
+                return As(node, pattern[1], body)
+            return AsPattern(node, pattern, body)
         return node
+
+    def parse_pattern(self) -> Any:
+        """Destructuring pattern: ``$x`` | ``[p, ...]`` | ``{k: p, $x}``."""
+        tok = self.next()
+        if tok[0] == "var":
+            return ("$", tok[1][1:])
+        if tok[1] == "[":
+            elems = [self.parse_pattern()]
+            while self.peek_text() == ",":
+                self.next()
+                elems.append(self.parse_pattern())
+            self.expect("]")
+            return ("arr", tuple(elems))
+        if tok[1] == "{":
+            entries = []
+            while True:
+                k = self.next()
+                if k[0] == "var":
+                    # {$x} shorthand: key "x" binds $x
+                    entries.append((k[1][1:], ("$", k[1][1:])))
+                elif k[0] in ("ident", "string"):
+                    key = _unquote(k[1]) if k[0] == "string" else k[1]
+                    self.expect(":")
+                    entries.append((key, self.parse_pattern()))
+                else:
+                    raise KqCompileError(
+                        f"bad pattern key {k[1]!r} in {self.src!r}"
+                    )
+                if self.peek_text() == ",":
+                    self.next()
+                    continue
+                break
+            self.expect("}")
+            return ("obj", tuple(entries))
+        raise KqCompileError(f"bad pattern {tok[1]!r} in {self.src!r}")
 
     def parse_primary(self) -> Any:
         tok = self.peek()
@@ -470,6 +542,12 @@ class _Parser:
             if name not in self.var_scope:
                 raise KqCompileError(f"${name} is not defined in {self.src!r}")
             return Var(name)
+        if kind == "format":
+            self.next()
+            name = text[1:]
+            if name not in _FORMATS:
+                raise KqCompileError(f"unknown format @{name} in {self.src!r}")
+            return Format(name)
         if kind == "ident":
             if text == "if":
                 return self.parse_if()
@@ -481,6 +559,29 @@ class _Parser:
                 return self.parse_def()
             if text == "try":
                 return self.parse_try()
+            if text == "label":
+                self.next()
+                tok = self.next()
+                if tok[0] != "var":
+                    raise KqCompileError(
+                        f"'label' needs a $name in {self.src!r}"
+                    )
+                lbl = tok[1][1:]
+                self.expect("|")
+                self.label_scope.append(lbl)
+                try:
+                    body = self.parse_pipe()
+                finally:
+                    self.label_scope.pop()
+                return Label(lbl, body)
+            if text == "break":
+                self.next()
+                tok = self.next()
+                if tok[0] != "var" or tok[1][1:] not in self.label_scope:
+                    raise KqCompileError(
+                        f"break outside its label in {self.src!r}"
+                    )
+                return Break(tok[1][1:])
             if text in ("true", "false", "null"):
                 self.next()
                 return Literal({"true": True, "false": False, "null": None}[text])
@@ -752,6 +853,10 @@ class _Parser:
 
 def _unquote(s: str) -> str:
     body = s[1:-1]
+    if re.search(r"(?<!\\)\\\(", body):
+        # silently rendering "\(e)" as a literal would be wrong output,
+        # not a missing feature — fail loudly at compile time
+        raise KqCompileError(f"string interpolation not supported: {s!r}")
     return body.replace('\\"', '"').replace("\\\\", "\\")
 
 
@@ -1020,8 +1125,162 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
                     yield from _eval(node.handler, exc.value, env)
                 return
             yield out
+    elif isinstance(node, Label):
+        it = _eval(node.body, value, env)
+        while True:
+            try:
+                out = next(it)
+            except StopIteration:
+                return
+            except _KqBreak as brk:
+                if brk.name != node.name:
+                    raise
+                return
+            yield out
+    elif isinstance(node, Break):
+        raise _KqBreak(node.name)
+    elif isinstance(node, Format):
+        yield _apply_format(node.name, value)
+    elif isinstance(node, AsPattern):
+        for bound in _eval(node.source, value, env):
+            e2 = dict(env)
+            _bind_pattern(node.pattern, bound, e2)
+            yield from _eval(node.body, value, e2)
     else:  # pragma: no cover
         raise _KqRuntimeError(f"unknown node {node!r}")
+
+
+class _KqBreak(Exception):
+    """Control-flow escape for label/break (never leaves Query.execute:
+    an unmatched break is a compile error)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.name = name
+
+
+def _bind_pattern(pattern, value, env: dict) -> None:
+    kind = pattern[0]
+    if kind == "$":
+        env[pattern[1]] = value
+        return
+    if kind == "arr":
+        if value is None:
+            value = []
+        if not isinstance(value, list):
+            raise _KqRuntimeError(
+                f"cannot destructure {_jq_type(value)} as an array"
+            )
+        for i, sub in enumerate(pattern[1]):
+            _bind_pattern(sub, value[i] if i < len(value) else None, env)
+        return
+    if value is None:
+        value = {}
+    if not isinstance(value, dict):
+        raise _KqRuntimeError(
+            f"cannot destructure {_jq_type(value)} as an object"
+        )
+    for key, sub in pattern[1]:
+        _bind_pattern(sub, value.get(key), env)
+
+
+def _csv_cell(v: Any, quote: str) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return _num_str(v)
+    if isinstance(v, str):
+        return quote + v.replace(quote, quote + quote) + quote
+    raise _KqRuntimeError(f"{_jq_type(v)} is not valid in a csv row")
+
+
+def _num_str(v: Any) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return str(v)
+
+
+def _apply_format(name: str, value: Any) -> Any:
+    import base64 as _b64
+    import json as _json
+    import urllib.parse as _url
+
+    if name == "text":
+        return value if isinstance(value, str) else _json.dumps(value)
+    s = value if isinstance(value, str) else _json.dumps(value)
+    if name == "json":
+        return _json.dumps(value, separators=(",", ":"))
+    if name == "base64":
+        return _b64.b64encode(s.encode()).decode()
+    if name == "base64d":
+        try:
+            return _b64.b64decode(s.encode() + b"==").decode()
+        except Exception:
+            raise _KqRuntimeError(f"{s!r} is not valid base64")
+    if name == "uri":
+        return _url.quote(s, safe="")
+    if name == "html":
+        return (
+            s.replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+            .replace("'", "&#39;").replace('"', "&quot;")
+        )
+    if name == "sh":
+        if isinstance(value, list):
+            return " ".join(_sh_word(x) for x in value)
+        return "'" + s.replace("'", "'\\''") + "'"
+    if name == "csv":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("@csv needs an array input")
+        return ",".join(_csv_cell(v, '"') for v in value)
+    if name == "tsv":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("@tsv needs an array input")
+        out = []
+        for v in value:
+            if isinstance(v, str):
+                out.append(
+                    v.replace("\\", "\\\\").replace("\t", "\\t").replace("\n", "\\n")
+                )
+            elif v is None:
+                out.append("")
+            elif isinstance(v, bool):
+                out.append("true" if v else "false")
+            elif isinstance(v, (int, float)):
+                out.append(_num_str(v))
+            else:
+                raise _KqRuntimeError(
+                    f"{_jq_type(v)} is not valid in a tsv row"
+                )
+        return "\t".join(out)
+    raise _KqRuntimeError(f"unknown format @{name}")
+
+
+def _sh_word(v: Any) -> str:
+    """One @sh shell word: strings quoted, scalars via tostring, and
+    composites are an error (jq parity)."""
+    if isinstance(v, str):
+        return "'" + v.replace("'", "'\\''") + "'"
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return _num_str(v)
+    raise _KqRuntimeError(f"{_jq_type(v)} can not be escaped for shell")
+
+
+_FORMATS = {"text", "json", "base64", "base64d", "uri", "html", "sh", "csv", "tsv"}
+
+
+def _pattern_vars(pattern) -> List[str]:
+    kind = pattern[0]
+    if kind == "$":
+        return [pattern[1]]
+    if kind == "arr":
+        return [n for sub in pattern[1] for n in _pattern_vars(sub)]
+    return [n for _, sub in pattern[1] for n in _pattern_vars(sub)]
 
 
 def _fold_step(update: Any, acc: Any, env: dict) -> Any:

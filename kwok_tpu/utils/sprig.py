@@ -104,7 +104,8 @@ def _as_datetime(t: Any) -> datetime.datetime:
             return datetime.datetime.fromisoformat(s)
         except ValueError:
             pass
-    return datetime.datetime.now(datetime.timezone.utc)
+        raise ValueError(f"cannot parse time {t!r}")
+    raise ValueError(f"cannot interpret {type(t).__name__} as a time")
 
 
 def _fmt_date(layout: str, t: Any) -> str:
@@ -153,12 +154,26 @@ def _semver_compare(constraint: str, version: str) -> bool:
         part = part.strip()
         if not part:
             continue
-        m = re.match(r"^(>=|<=|>|<|=|\^|~)?\s*(.+)$", part)
+        if part in ("*", "x", "X"):
+            _semver_tuple(version)  # still validates the version
+            continue
+        m = re.match(r"^(>=|<=|!=|>|<|=|\^|~)?\s*(.+)$", part)
         op, ref = m.group(1) or "=", m.group(2)
-        try:
-            c = _semver_cmp(version, ref)
-        except ValueError:
-            return False
+        # wildcard ranges: 1.x / 1.2.x act like ~ on the fixed prefix
+        wild = re.fullmatch(r"v?(\d+)(?:\.(\d+))?\.[xX*]", ref)
+        if wild:
+            vt = _semver_tuple(version)
+            if int(wild.group(1)) != vt[0]:
+                return False
+            if wild.group(2) is not None and int(wild.group(2)) != vt[1]:
+                return False
+            continue
+        c = _semver_cmp(version, ref)  # invalid syntax raises (sprig
+        # surfaces constraint errors rather than silently failing)
+        if op == "!=":
+            if c == 0:
+                return False
+            continue
         if op == "=" and c != 0:
             return False
         if op == ">" and c <= 0:
@@ -416,9 +431,9 @@ def sprig_funcs() -> Dict[str, Callable]:
         # dates ---------------------------------------------------------
         "now": lambda: datetime.datetime.now(datetime.timezone.utc),
         "date": _fmt_date,
-        "dateInZone": lambda layout, t, zone: _fmt_date(layout, t),
+        "dateInZone": _date_in_zone,
         "unixEpoch": lambda t: int(_as_datetime(t).timestamp()),
-        "toDate": lambda layout, s: _as_datetime(s),
+        "toDate": _to_date,
         "duration": lambda secs: f"{_to_int(secs)}s",
         "htmlDate": lambda t: _fmt_date("2006-01-02", t),
         # type introspection -------------------------------------------
@@ -426,7 +441,7 @@ def sprig_funcs() -> Dict[str, Callable]:
         "kindIs": lambda k, v: _kind_of(v) == k,
         "typeOf": _kind_of,
         "typeIs": lambda k, v: _kind_of(v) == k,
-        "deepEqual": lambda a, b: a == b,
+        "deepEqual": _deep_equal,
         # paths ---------------------------------------------------------
         "base": posixpath.basename,
         "dir": posixpath.dirname,
@@ -535,3 +550,38 @@ def _dig(*args):
 
 def _fail(msg: str):
     raise ValueError(f"template fail: {msg}")
+
+
+def _to_date(layout: str, s: str) -> datetime.datetime:
+    """sprig toDate: parse with the Go layout (strict, errors surface)."""
+    st = _go_layout_to_strftime(layout).replace("%:z", "%z")
+    try:
+        return datetime.datetime.strptime(_to_str(s), st)
+    except ValueError:
+        return _as_datetime(s)  # ISO fallback; raises when unparseable
+
+
+def _date_in_zone(layout: str, t: Any, zone: str) -> str:
+    import zoneinfo
+
+    dt = _as_datetime(t)
+    if zone and zone.upper() != "UTC":
+        try:
+            dt = dt.astimezone(zoneinfo.ZoneInfo(zone))
+        except (KeyError, zoneinfo.ZoneInfoNotFoundError):
+            raise ValueError(f"unknown time zone {zone!r}")
+    return _fmt_date(layout, dt)
+
+
+def _deep_equal(a: Any, b: Any) -> bool:
+    """Go reflect.DeepEqual semantics: bools never equal ints (the
+    engine's own eq uses the same guard)."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_deep_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _deep_equal(x, y) for x, y in zip(a, b)
+        )
+    return a == b

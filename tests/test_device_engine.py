@@ -310,10 +310,10 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            # label/break stays outside the kq grammar
-                            # -> host fallback path must still engage
+                            # string interpolation stays outside the kq
+                            # grammar -> host fallback path must engage
                             {
-                                "key": "label $out | .spec | break $out",
+                                "key": '"\\(.spec.nodeName)-x"',
                                 "operator": "Exists",
                             }
                         ]

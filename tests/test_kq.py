@@ -97,13 +97,14 @@ def test_bool_not_equal_int():
 
 
 def test_compile_error():
-    # still-unsupported jq: label/break, @-formats, destructuring
+    # still-unsupported jq: string interpolation, ?// alternatives,
+    # functions outside the builtin set
     with pytest.raises(KqCompileError):
-        Query("label $out | break $out")
+        Query('"\\(.a)-suffix"')
     with pytest.raises(KqCompileError):
-        Query("@base64")
+        Query(". as [$a] ?// [$b] | 1")
     with pytest.raises(KqCompileError):
-        Query(". as [$a, $b] | $a")
+        Query("limit(2; .[])")
     # unbound variables are compile errors, like jq
     with pytest.raises(KqCompileError):
         Query("$nope")
@@ -329,3 +330,41 @@ def test_parenthesized_as_inside_reduce_source():
     assert Query(
         "reduce (.[] as $y | $y * 2) as $x (0; . + $x)"
     ).execute([1, 2, 3]) == [12]
+
+
+def test_label_break():
+    # break stops the stream at the label boundary
+    assert Query(
+        "label $out | .[] | if . == 3 then break $out else . end"
+    ).execute([1, 2, 3, 4]) == [1, 2]
+    # try does NOT catch break (jq semantics)
+    assert Query(
+        'label $out | try (1, break $out, 3) catch "caught"'
+    ).execute(None) == [1]
+    with pytest.raises(KqCompileError):
+        Query("break $nope")
+
+
+def test_format_strings():
+    assert Query("@base64").execute("hi") == ["aGk="]
+    assert Query("@base64d").execute("aGk=") == ["hi"]
+    assert Query("@json").execute({"a": 1}) == ['{"a":1}']
+    assert Query("@text").execute("x") == ["x"]
+    assert Query("@uri").execute("a b") == ["a%20b"]
+    assert Query("@csv").execute([1, "a,b", None]) == ['1,"a,b",']
+    assert Query("@tsv").execute(["a\tb", 2]) == ["a\\tb\t2"]
+    assert Query("@sh").execute("it's") == ["'it'\\''s'"]
+    with pytest.raises(KqCompileError):
+        Query("@nope")
+
+
+def test_destructuring_patterns():
+    assert Query(". as [$a, $b] | $a + $b").execute([1, 2, 99]) == [3]
+    assert Query(". as {x: $v} | $v").execute({"x": 7}) == [7]
+    assert Query(". as {a: [$p, $q]} | [$q, $p]").execute(
+        {"a": [1, 2]}
+    ) == [[2, 1]]
+    # shorthand {$x}: key "x" binds $x
+    assert Query(". as {$x} | $x").execute({"x": 5}) == [5]
+    # missing elements bind null
+    assert Query(". as [$a, $b] | $b").execute([1]) == []

@@ -186,3 +186,21 @@ def test_suffix_reads_visible_to_compiler():
 
     rp = template_read_paths(Template("{{ (index .status.conditions 0).type }}"))
     assert ("status", "conditions") in rp
+
+
+def test_review_fidelity_fixes():
+    # semver wildcards and negation (sprig/Masterminds semantics)
+    assert r('{{ semverCompare "*" "1.2.3" }}') == "true"
+    assert r('{{ semverCompare "!=1.0.0" "2.0.0" }}') == "true"
+    assert r('{{ semverCompare "1.x" "1.9.0" }}') == "true"
+    assert r('{{ semverCompare "1.x" "2.0.0" }}') == "false"
+    # Go DeepEqual: bool never equals int
+    assert r("{{ deepEqual true 1 }}") == "false"
+    # dateInZone honors the zone
+    assert (
+        r('{{ dateInZone "15:04" "2026-03-04T12:00:00Z" "America/New_York" }}')
+        == "07:00"
+    )
+    # unparseable times error instead of silently reading the wall clock
+    with pytest.raises(Exception):
+        r('{{ unixEpoch "garbage" }}')
