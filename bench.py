@@ -50,7 +50,7 @@ E2E_MACRO = int(os.environ.get("BENCH_E2E_MACRO", 8))
 E2E_BUDGET_S = float(os.environ.get("BENCH_E2E_BUDGET_S", 180))
 #: measurement: best of N windows of W seconds (the steady-state drain
 #: is bursty per macro-tick, so windows must cover several)
-E2E_WINDOWS = max(1, int(os.environ.get("BENCH_E2E_WINDOWS", 3)))
+E2E_WINDOWS = max(1, int(os.environ.get("BENCH_E2E_WINDOWS", 4)))
 E2E_WINDOW_S = float(os.environ.get("BENCH_E2E_WINDOW_S", 30))
 #: run the ownerReference-GC / namespace controller alongside the
 #: measurement (default ON: production clusters always compose the kcm
@@ -165,9 +165,9 @@ def run_kernel_bench() -> float:
     node_soa, _ = run_ticks(node_params, node_soa, DT_MS, 100)
     c.block_until_ready()
 
-    # 3 measurement windows; report the best (the tunnel TPU is shared
-    # and occasionally throttles — observed 15x wall-clock variance on
-    # identical programs)
+    # several measurement windows; report the best (the tunnel TPU is
+    # shared and occasionally throttles — observed 15x wall-clock
+    # variance on identical programs)
     tps = 0.0
     for _ in range(3):
         t0 = time.time()

@@ -193,7 +193,11 @@ class DeviceStagePlayer:
         # at interpreter exit aborts the process ("exception not
         # rethrown"); a bounded join drains it cleanly
         for t in self._threads:
-            t.join(timeout=max(2.0, 4 * self.tick_ms / 1000.0))
+            # generous: the loop aborts a drain between sub-ticks, but
+            # one 1M-row sub-tick can still take seconds — a daemon
+            # thread killed mid-XLA-dispatch at interpreter exit
+            # aborts the whole process
+            t.join(timeout=max(30.0, 4 * self.tick_ms / 1000.0))
         if any(t.is_alive() for t in self._threads):
             # the tick thread is still draining (a 1M-row macro-tick
             # can outlive the bounded join): it will flush its own
@@ -426,7 +430,15 @@ class DeviceStagePlayer:
 
     def _drain_stages(self, stages_np: np.ndarray, t0_ms: int, dt: int) -> int:
         fired_total = 0
+        t_start = time.perf_counter()
         for k in range(stages_np.shape[0]):
+            if self._done.is_set() and time.perf_counter() - t_start > 5.0:
+                # shutdown mid-macro-tick: small flushes complete, but a
+                # huge drain stops between sub-ticks so it can't outlive
+                # stop()'s bounded join (the abandoned sub-ticks re-fire
+                # after a restart — rows re-admit from the store like
+                # any resume)
+                break
             st = stages_np[k]
             rows = np.nonzero(st >= 0)[0]
             if rows.size:
@@ -466,6 +478,14 @@ class DeviceStagePlayer:
         t0 = time.perf_counter()
         stages_dev, t0_ms = self.sim.tick_many_async(dt, n_ticks)
         self._inflight = (stages_dev, t0_ms, dt)
+        try:
+            # start the device->host copy NOW so it overlaps the drain
+            # below; the next call's device_get then returns instantly
+            # (over the tunnel TPU this transfer was ~20% of the e2e
+            # window when paid synchronously)
+            stages_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # CPU arrays / older jax: device_get pays it instead
         self.t_device += time.perf_counter() - t0
         fired = 0
         if prev is not None:

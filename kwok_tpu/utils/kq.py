@@ -28,10 +28,11 @@ The full-language tail is in too (r04): variables and ``as`` bindings
 ``@format`` strings (@text/@json/@base64/@base64d/@uri/@html/@sh/
 @csv/@tsv) — so out-of-subset stages run on the host path, and
 selector expressions using them lower as opaque host-evaluated feature
-columns on the device path.  Remaining (documented) gaps: string
-interpolation ``"\\(e)"``, ``?//`` pattern alternatives, and patterns
-in reduce/foreach sources; unbound ``$vars`` and breaks outside their
-label are compile errors like jq.
+columns on the device path — plus string interpolation ``"\\(e)"``
+with bindings visible inside.  Remaining (documented) gaps: recursive
+descent ``..``, ``input``/``inputs``, ``?//`` pattern alternatives,
+and patterns in reduce/foreach sources; unbound ``$vars`` and breaks
+outside their label are compile errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -81,10 +82,62 @@ _TOKEN_RE = re.compile(
 )
 
 
+def _scan_string(src: str, start: int) -> int:
+    """End index (past the closing quote) of the string starting at
+    ``src[start] == '"'`` — interpolation-aware: inside ``\\( ... )``
+    nested quotes open full inner strings (recursively), so
+    ``"\\(.a + "x")"`` is ONE token like jq."""
+    i = start + 1
+    n = len(src)
+    while i < n:
+        c = src[i]
+        if c == '"':
+            return i + 1
+        if c == "\\":
+            if i + 1 < n and src[i + 1] == "(":
+                depth = 1
+                i += 2
+                while i < n and depth:
+                    if src[i] == '"':
+                        i = _scan_string(src, i)
+                        continue
+                    if src[i] == "(":
+                        depth += 1
+                    elif src[i] == ")":
+                        depth -= 1
+                    i += 1
+                continue
+            i += 2
+            continue
+        i += 1
+    raise KqCompileError(f"unterminated string in {src!r}")
+
+
+def _has_interp(body: str) -> bool:
+    """Escape-parity-aware: is there an UNESCAPED ``\\(`` in the string
+    body?  (A regex lookbehind cannot count backslashes: ``\\\\\\(``
+    is an escaped backslash followed by a live interpolation.)"""
+    i = 0
+    n = len(body)
+    while i < n:
+        if body[i] == "\\":
+            if i + 1 < n and body[i + 1] == "(":
+                return True
+            i += 2
+            continue
+        i += 1
+    return False
+
+
 def _tokenize(src: str) -> List[Tuple[str, str]]:
     tokens: List[Tuple[str, str]] = []
     pos = 0
     while pos < len(src):
+        if src[pos] == '"':
+            end = _scan_string(src, pos)
+            tokens.append(("string", src[pos:end]))
+            pos = end
+            continue
         m = _TOKEN_RE.match(src, pos)
         if m is None:
             raise KqCompileError(f"unexpected character {src[pos]!r} at {pos} in {src!r}")
@@ -294,6 +347,14 @@ class Format:
 
 
 @dataclass(frozen=True)
+class StrInterp:
+    """``"a\\(expr)b"`` — string interpolation; parts are literal
+    strings and compiled sub-queries (cartesian across parts)."""
+
+    parts: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
 class AsPattern:
     """``SRC as [$a, $b] | BODY`` / ``SRC as {k: $v} | BODY`` —
     destructuring binds; ``pattern`` is nested lists/dicts with leaf
@@ -466,6 +527,53 @@ class _Parser:
             return AsPattern(node, pattern, body)
         return node
 
+    def _parse_interp(self, body: str) -> Any:
+        """Split a string body on ``\\( ... )`` (paren-balanced, string
+        literals inside skipped) and compile the embedded queries with
+        THIS parser's scopes, so ``"\\($x)"`` sees its binding."""
+        parts: List[Any] = []
+        lit: List[str] = []
+        i = 0
+        n = len(body)
+        while i < n:
+            if body[i] == "\\" and i + 1 < n and body[i + 1] == "(":
+                depth = 1
+                j = i + 2
+                while j < n and depth:
+                    c = body[j]
+                    if c == '"':
+                        j += 1
+                        while j < n and body[j] != '"':
+                            j += 2 if body[j] == "\\" else 1
+                    elif c == "(":
+                        depth += 1
+                    elif c == ")":
+                        depth -= 1
+                    j += 1
+                if depth:
+                    raise KqCompileError(
+                        f"unbalanced interpolation in {self.src!r}"
+                    )
+                src = body[i + 2 : j - 1]
+                if lit:
+                    parts.append(_unquote(f'"{"".join(lit)}"'))
+                    lit = []
+                sub = _Parser(_tokenize(src), src)
+                sub.var_scope = self.var_scope
+                sub.fn_scope = self.fn_scope
+                sub.label_scope = self.label_scope
+                parts.append(sub.parse_query())
+                i = j
+            elif body[i] == "\\":
+                lit.append(body[i : i + 2])
+                i += 2
+            else:
+                lit.append(body[i])
+                i += 1
+        if lit:
+            parts.append(_unquote(f'"{"".join(lit)}"'))
+        return StrInterp(tuple(parts))
+
     def parse_pattern(self) -> Any:
         """Destructuring pattern: ``$x`` | ``[p, ...]`` | ``{k: p, $x}``."""
         tok = self.next()
@@ -532,6 +640,9 @@ class _Parser:
             return self.parse_object()
         if kind == "string":
             self.next()
+            body = text[1:-1]
+            if _has_interp(body):
+                return self._parse_interp(body)
             return Literal(_unquote(text))
         if kind == "number":
             self.next()
@@ -853,10 +964,11 @@ class _Parser:
 
 def _unquote(s: str) -> str:
     body = s[1:-1]
-    if re.search(r"(?<!\\)\\\(", body):
-        # silently rendering "\(e)" as a literal would be wrong output,
-        # not a missing feature — fail loudly at compile time
-        raise KqCompileError(f"string interpolation not supported: {s!r}")
+    if _has_interp(body):
+        # silently rendering "\(e)" as a literal would be wrong output
+        # — interpolation is only wired for value position, so fail
+        # loudly where it is not (object keys, path brackets)
+        raise KqCompileError(f"interpolation not supported here: {s!r}")
     return body.replace('\\"', '"').replace("\\\\", "\\")
 
 
@@ -1141,6 +1253,23 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
         raise _KqBreak(node.name)
     elif isinstance(node, Format):
         yield _apply_format(node.name, value)
+    elif isinstance(node, StrInterp):
+
+        def build(i: int, acc: str):
+            if i == len(node.parts):
+                yield acc
+                return
+            part = node.parts[i]
+            if isinstance(part, str):
+                yield from build(i + 1, acc + part)
+                return
+            for out in _eval(part, value, env):
+                yield from build(
+                    i + 1,
+                    acc + (out if isinstance(out, str) else _apply_format("text", out)),
+                )
+
+        yield from build(0, "")
     elif isinstance(node, AsPattern):
         for bound in _eval(node.source, value, env):
             e2 = dict(env)

@@ -310,10 +310,10 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            # string interpolation stays outside the kq
+                            # recursive descent stays outside the kq
                             # grammar -> host fallback path must engage
                             {
-                                "key": '"\\(.spec.nodeName)-x"',
+                                "key": ".. | .name?",
                                 "operator": "Exists",
                             }
                         ]

@@ -97,10 +97,12 @@ def test_bool_not_equal_int():
 
 
 def test_compile_error():
-    # still-unsupported jq: string interpolation, ?// alternatives,
-    # functions outside the builtin set
+    # still-unsupported jq: recursive descent, input/inputs,
+    # ?// alternatives, functions outside the builtin set
     with pytest.raises(KqCompileError):
-        Query('"\\(.a)-suffix"')
+        Query(".. | .a")
+    with pytest.raises(KqCompileError):
+        Query("input")
     with pytest.raises(KqCompileError):
         Query(". as [$a] ?// [$b] | 1")
     with pytest.raises(KqCompileError):
@@ -108,6 +110,19 @@ def test_compile_error():
     # unbound variables are compile errors, like jq
     with pytest.raises(KqCompileError):
         Query("$nope")
+
+
+def test_string_interpolation():
+    assert Query('"\\(.a)-x"').execute({"a": "v"}) == ["v-x"]
+    assert Query('"\\(.a + 1) and \\(.b)"').execute(
+        {"a": 1, "b": True}
+    ) == ["2 and true"]
+    # bindings are visible inside the interpolation
+    assert Query('.xs[] as $x | "n=\\($x)"').execute(
+        {"xs": [1, 2]}
+    ) == ["n=1", "n=2"]
+    # a multi-output interpolation is cartesian
+    assert Query('"\\(1, 2)!"').execute(None) == ["1!", "2!"]
 
 
 def test_field_on_scalar_is_error():
@@ -368,3 +383,10 @@ def test_destructuring_patterns():
     assert Query(". as {$x} | $x").execute({"x": 5}) == [5]
     # missing elements bind null
     assert Query(". as [$a, $b] | $b").execute([1]) == []
+
+
+def test_interpolation_edge_cases():
+    # nested string literal inside the interpolation (one jq token)
+    assert Query('"\\(.a + "x")"').execute({"a": "A"}) == ["Ax"]
+    # escaped backslash followed by a LIVE interpolation
+    assert Query('"\\\\\\(.a)"').execute({"a": "X"}) == ["\\X"]
