@@ -29,10 +29,12 @@ The full-language tail is in too (r04): variables and ``as`` bindings
 @csv/@tsv) — so out-of-subset stages run on the host path, and
 selector expressions using them lower as opaque host-evaluated feature
 columns on the device path — plus string interpolation ``"\\(e)"``
-with bindings visible inside.  Remaining (documented) gaps: recursive
-descent ``..``, ``input``/``inputs``, ``?//`` pattern alternatives,
-and patterns in reduce/foreach sources; unbound ``$vars`` and breaks
-outside their label are compile errors like jq.
+with bindings visible inside, recursive descent ``..``/``recurse``,
+and ``limit``/``range(a;b;c)``/``while``/``until``.  Remaining
+(documented) gaps: ``input``/``inputs`` (no input stream exists here),
+``?//`` pattern alternatives, and patterns in reduce/foreach sources;
+unbound ``$vars`` and breaks outside their label are compile errors
+like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -75,7 +77,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(?:\.\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<format>@[a-z0-9]+)
-  | (?P<op>//|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
+  | (?P<op>//|\.\.|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
     re.VERBOSE,
@@ -370,13 +372,20 @@ _FUNCS0 = {
     "length", "keys", "values", "type", "tostring", "tonumber", "not",
     "empty", "add", "any", "all", "first", "last", "min", "max", "sort",
     "unique", "floor", "ceil", "ascii_downcase", "ascii_upcase", "abs",
-    "reverse", "tojson", "fromjson", "error",
+    "reverse", "tojson", "fromjson", "error", "recurse",
 }
 #: one-arg builtins
 _FUNCS1 = {
     "select", "has", "map", "test", "startswith", "endswith", "contains",
     "split", "join", "any", "all", "sort_by", "min_by", "max_by", "range",
-    "error",
+    "error", "recurse",
+}
+#: multi-arg builtins: name -> allowed arities beyond 0/1
+_FUNCS_N = {
+    "limit": {2},
+    "range": {2, 3},
+    "while": {2},
+    "until": {2},
 }
 
 
@@ -526,6 +535,31 @@ class _Parser:
                 return As(node, pattern[1], body)
             return AsPattern(node, pattern, body)
         return node
+
+    def _parse_call_args(self) -> List[Any]:
+        """``( a; b; ... )`` argument list, empty when no paren."""
+        args: List[Any] = []
+        if self.peek_text() == "(":
+            self.next()
+            args.append(self.parse_pipe())
+            while self.peek_text() == ";":
+                self.next()
+                args.append(self.parse_pipe())
+            self.expect(")")
+        return args
+
+    def _builtin_call(self, text: str, args: List[Any]) -> Optional[Any]:
+        """Builtin node for (name, arity), or None when unknown."""
+        ok = (
+            (len(args) == 0 and text in _FUNCS0)
+            or (len(args) == 1 and text in _FUNCS1)
+            or (len(args) in _FUNCS_N.get(text, ()))
+        )
+        if not ok:
+            return None
+        if text == "select":
+            return Select(args[0])
+        return Func(text, tuple(args))
 
     def _parse_interp(self, body: str) -> Any:
         """Split a string body on ``\\( ... )`` (paren-balanced, string
@@ -696,49 +730,33 @@ class _Parser:
             if text in ("true", "false", "null"):
                 self.next()
                 return Literal({"true": True, "false": False, "null": None}[text])
-            # def-defined functions shadow builtins per (name, arity)
+            # def-defined functions shadow builtins per (name, arity);
+            # an arity not def'd falls through to the builtin of that
+            # arity (jq resolves map/1 past a user def map/0)
             if any(n == text for n, _ in self.fn_scope):
                 self.next()
-                args: List[Any] = []
-                if self.peek_text() == "(":
-                    self.next()
-                    args.append(self.parse_pipe())
-                    while self.peek_text() == ";":
-                        self.next()
-                        args.append(self.parse_pipe())
-                    self.expect(")")
+                args = self._parse_call_args()
                 if (text, len(args)) in self.fn_scope:
                     return Call(text, tuple(args))
-                # arity not defined: fall through to the builtin of
-                # that arity (jq resolves map/1 past a user def map/0)
-                if len(args) == 0 and text in _FUNCS0:
-                    return Func(text, ())
-                if len(args) == 1 and text in _FUNCS1:
-                    if text == "select":
-                        return Select(args[0])
-                    return Func(text, (args[0],))
+                node = self._builtin_call(text, args)
+                if node is not None:
+                    return node
                 raise KqCompileError(
                     f"{text}/{len(args)} is not defined in {self.src!r}"
                 )
-            if text in _FUNCS0 or text in _FUNCS1:
+            if text in _FUNCS0 or text in _FUNCS1 or text in _FUNCS_N:
                 self.next()
-                if self.peek_text() == "(":
-                    if text not in _FUNCS1:
-                        raise KqCompileError(
-                            f"{text} takes no argument in {self.src!r}"
-                        )
-                    self.next()
-                    arg = self.parse_pipe()
-                    self.expect(")")
-                    if text == "select":
-                        return Select(arg)
-                    return Func(text, (arg,))
-                if text not in _FUNCS0:
+                args = self._parse_call_args()
+                node = self._builtin_call(text, args)
+                if node is None:
                     raise KqCompileError(
-                        f"{text} requires an argument in {self.src!r}"
+                        f"{text}/{len(args)} is not defined in {self.src!r}"
                     )
-                return Func(text, ())
+                return node
             raise KqCompileError(f"unsupported function {text!r} in {self.src!r}")
+        if text == "..":
+            self.next()
+            return Func("recurse", ())
         raise KqCompileError(f"unexpected token {text!r} in {self.src!r}")
 
     def _parse_as_binding(self, kw: str) -> Tuple[Any, str]:
@@ -1279,6 +1297,92 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
         raise _KqRuntimeError(f"unknown node {node!r}")
 
 
+def _eval_func_n(node: Func, value: Any, env: dict) -> Iterator[Any]:
+    """Multi-arg builtins: limit/2, range/2-3, while/2, until/2."""
+    name, args = node.name, node.args
+    if name == "limit":
+        for n in _eval(args[0], value, env):
+            if isinstance(n, bool) or not isinstance(n, (int, float)):
+                raise _KqRuntimeError("limit count must be a number")
+            n = int(n)
+            if n <= 0:
+                continue
+            emitted = 0
+            for out in _eval(args[1], value, env):
+                yield out
+                emitted += 1
+                if emitted >= n:
+                    break
+        return
+    if name == "range":
+        exprs = [list(_eval(a, value, env)) for a in args]
+        import itertools
+
+        for combo in itertools.product(*exprs):
+            for v in combo:
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise _KqRuntimeError("range over non-number")
+            start, stop = combo[0], combo[1]
+            step = combo[2] if len(combo) > 2 else 1
+            if step == 0:
+                continue
+            cur = start
+            while (cur < stop) if step > 0 else (cur > stop):
+                yield cur
+                cur += step
+        return
+    if name in ("while", "until"):
+        cond, update = args[0], args[1]
+
+        def gen(x):
+            # jq: def while(c; u): if c then ., (u | while(c; u))
+            #     def until(c; u): if c then . else (u | until(c; u))
+            for c in _eval(cond, x, env):
+                if name == "while":
+                    if _truthy(c):
+                        yield x
+                        for nx in _eval(update, x, env):
+                            yield _Recur(nx)
+                else:
+                    if _truthy(c):
+                        yield x
+                    else:
+                        for nx in _eval(update, x, env):
+                            yield _Recur(nx)
+
+        yield from _trampoline(gen, value)
+        return
+    raise _KqRuntimeError(f"unknown function {name}/{len(args)}")
+
+
+class _Recur:
+    """Trampoline marker: 'descend into this value' (loop builtins run
+    on an explicit stack, not Python recursion — jq's TCO means
+    while/until/recurse must handle unbounded iteration counts)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+def _trampoline(gen, x0) -> Iterator[Any]:
+    """Depth-first preorder over generators that yield values (passed
+    through) and _Recur markers (descend): recursion order without
+    Python stack frames."""
+    stack = [gen(x0)]
+    while stack:
+        try:
+            item = next(stack[-1])
+        except StopIteration:
+            stack.pop()
+            continue
+        if type(item) is _Recur:
+            stack.append(gen(item.value))
+        else:
+            yield item
+
+
 class _KqBreak(Exception):
     """Control-flow escape for label/break (never leaves Query.execute:
     an unmatched break is a compile error)."""
@@ -1467,6 +1571,26 @@ def _eval_object(entries, i, value, acc, env) -> Iterator[Any]:
 
 def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
     name = node.name
+    if len(node.args) >= 2:
+        yield from _eval_func_n(node, value, env)
+        return
+    if name == "recurse":
+        # jq: def recurse(f): ., (f | recurse(f));  `..` is recurse/0
+        # with f = .[]? (children of arrays/objects, never an error)
+        def gen(x):
+            yield x
+            if node.args:
+                for nx in _eval(node.args[0], x, env):
+                    yield _Recur(nx)
+            elif isinstance(x, list):
+                for nx in x:
+                    yield _Recur(nx)
+            elif isinstance(x, dict):
+                for nx in x.values():
+                    yield _Recur(nx)
+
+        yield from _trampoline(gen, value)
+        return
     if node.args:
         arg = node.args[0]
         if name == "has":

@@ -310,10 +310,10 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            # recursive descent stays outside the kq
-                            # grammar -> host fallback path must engage
+                            # input has no meaning without an input
+                            # stream -> host fallback path must engage
                             {
-                                "key": ".. | .name?",
+                                "key": "input",
                                 "operator": "Exists",
                             }
                         ]

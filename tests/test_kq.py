@@ -97,19 +97,31 @@ def test_bool_not_equal_int():
 
 
 def test_compile_error():
-    # still-unsupported jq: recursive descent, input/inputs,
+    # still-unsupported jq: input/inputs (no input stream here),
     # ?// alternatives, functions outside the builtin set
-    with pytest.raises(KqCompileError):
-        Query(".. | .a")
     with pytest.raises(KqCompileError):
         Query("input")
     with pytest.raises(KqCompileError):
         Query(". as [$a] ?// [$b] | 1")
     with pytest.raises(KqCompileError):
-        Query("limit(2; .[])")
+        Query("getpath([\"a\"])")
     # unbound variables are compile errors, like jq
     with pytest.raises(KqCompileError):
         Query("$nope")
+
+
+def test_recurse_limit_range_while_until():
+    assert Query(".. | .name? // empty").execute(
+        {"a": [{"name": "x"}, {"b": {"name": "y"}}]}
+    ) == ["x", "y"]
+    assert Query("limit(2; .[])").execute([1, 2, 3, 4]) == [1, 2]
+    assert Query("[range(2; 5)]").execute(None) == [[2, 3, 4]]
+    assert Query("[range(0; 10; 3)]").execute(None) == [[0, 3, 6, 9]]
+    assert Query("[while(. < 10; . * 2)]").execute(1) == [[1, 2, 4, 8]]
+    assert Query("until(. > 10; . * 2)").execute(1) == [16]
+    assert Query(
+        "[recurse(if . < 4 then . + 1 else empty end)]"
+    ).execute(0) == [[0, 1, 2, 3, 4]]
 
 
 def test_string_interpolation():
@@ -390,3 +402,13 @@ def test_interpolation_edge_cases():
     assert Query('"\\(.a + "x")"').execute({"a": "A"}) == ["Ax"]
     # escaped backslash followed by a LIVE interpolation
     assert Query('"\\\\\\(.a)"').execute({"a": "X"}) == ["\\X"]
+
+
+def test_loop_builtins_unbounded_iterations():
+    # jq's TCO means loops must not hit Python's recursion limit
+    assert Query("[while(. < 2000; . + 1)] | length").execute(0) == [2000]
+    assert Query("until(. > 100000; . + 1)").execute(0) == [100001]
+
+
+def test_builtin_arity_fallthrough_past_user_def():
+    assert Query("def range(a): a; [range(2;5)]").execute(None) == [[2, 3, 4]]
