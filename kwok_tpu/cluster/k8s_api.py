@@ -46,6 +46,7 @@ from kwok_tpu.cluster.store import (
     ResourceStore,
     ResourceType,
 )
+from kwok_tpu.cluster.tables import to_table, wants_table
 
 __all__ = ["K8sFacade", "encode_continue", "decode_continue", "status_body"]
 
@@ -543,6 +544,8 @@ class K8sFacade:
                 return self._proxy_log(handler, r, q)
             obj = self.store.get(r.rtype.kind, r.name, namespace=ns)
             self._stamp(r.rtype, obj)
+            if self._maybe_send_table(handler, r, [obj], q):
+                return True
             self._send(handler, 200, obj)
             return True
         if method == "POST":
@@ -714,7 +717,31 @@ class K8sFacade:
             )
             body["metadata"] = {"resourceVersion": str(rv)}
         body["items"] = [self._stamp(r.rtype, o) for o in items]
+        if self._maybe_send_table(
+            handler, r, body["items"], q, list_meta=body["metadata"]
+        ):
+            return
         self._send(handler, 200, body)
+
+    def _maybe_send_table(
+        self, handler, r: _Route, items, q, list_meta=None
+    ) -> bool:
+        """Answer kubectl's Table accept chain with the real printed
+        columns like the kube-apiserver does; False when the request
+        did not negotiate a Table."""
+        if not wants_table(handler.headers.get("Accept")):
+            return False
+        self._send(
+            handler,
+            200,
+            to_table(
+                r.rtype.kind,
+                items,
+                list_meta=list_meta,
+                include_object=q.get("includeObject") or "Metadata",
+            ),
+        )
+        return True
 
     # ---------------------------------------------------------------- watch
 
@@ -764,6 +791,12 @@ class K8sFacade:
         handler.close_connection = True
         shutdown = getattr(handler.server, "shutting_down", None)
         deadline = time.monotonic() + timeout_s if timeout_s else None
+        # kubectl get -w sends the same Table accept chain on the watch
+        # request: once the list came back as a Table, event objects
+        # must be Table-typed too (single-row tables, like the real
+        # apiserver) or kubectl's table decoder rejects the stream
+        as_table = wants_table(handler.headers.get("Accept"))
+        include_object = q.get("includeObject") or "Metadata"
         try:
             if initial:
                 # incremental chunks, not one giant join: an rv=0 watch
@@ -771,12 +804,18 @@ class K8sFacade:
                 # bytes object in this handler thread (ADVICE r02)
                 chunk: list = []
                 for o in initial:
-                    chunk.append(
-                        json.dumps(
-                            {"type": "ADDED", "object": self._stamp(r.rtype, o)}
-                        ).encode()
-                        + b"\n"
-                    )
+                    if as_table:
+                        payload = {
+                            "type": "ADDED",
+                            "object": to_table(
+                                r.rtype.kind,
+                                [self._stamp(r.rtype, o)],
+                                include_object=include_object,
+                            ),
+                        }
+                    else:
+                        payload = {"type": "ADDED", "object": self._stamp(r.rtype, o)}
+                    chunk.append(json.dumps(payload).encode() + b"\n")
                     if len(chunk) >= 512:
                         handler.wfile.write(b"".join(chunk))
                         chunk.clear()
@@ -809,12 +848,14 @@ class K8sFacade:
                         )
                     continue
                 idle = 0.0
-                buf = [self._encode_event(r.rtype, ev)]
+                buf = [self._encode_event(r.rtype, ev, as_table, include_object)]
                 while len(buf) < 512:
                     ev = w.next(timeout=0)
                     if ev is None:
                         break
-                    buf.append(self._encode_event(r.rtype, ev))
+                    buf.append(
+                        self._encode_event(r.rtype, ev, as_table, include_object)
+                    )
                 handler.wfile.write(b"".join(buf))
                 handler.wfile.flush()
         except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
@@ -822,7 +863,9 @@ class K8sFacade:
         finally:
             w.stop()
 
-    def _encode_event(self, rtype, ev) -> bytes:
+    def _encode_event(
+        self, rtype, ev, as_table: bool = False, include_object: str = "Metadata"
+    ) -> bytes:
         # watch events share the stored instance (store._emit contract):
         # never _stamp it in place — graft missing kind/apiVersion onto
         # a shallow copy instead
@@ -831,6 +874,8 @@ class K8sFacade:
             obj = dict(obj)
             obj.setdefault("kind", rtype.kind)
             obj.setdefault("apiVersion", rtype.api_version)
+        if as_table:
+            obj = to_table(rtype.kind, [obj], include_object=include_object)
         return json.dumps({"type": ev.type, "object": obj}).encode() + b"\n"
 
     @staticmethod

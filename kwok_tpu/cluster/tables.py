@@ -1,0 +1,212 @@
+"""meta.k8s.io Table responses for kubectl ``get``.
+
+The real kube-apiserver (the facade's behavioral reference —
+runtime/binary/cluster.go composes one) answers
+``Accept: application/json;as=Table;v=v1;g=meta.k8s.io`` with a
+``Table`` whose columns mirror kubectl's printed output
+(NAME/READY/STATUS/... for pods, NAME/STATUS/ROLES/... for nodes).
+Until now the facade fell back to plain JSON — which kubectl renders,
+but with generic columns.  This module builds the real thing:
+per-kind column definitions + cell extractors, the k8s humanized AGE
+duration, and PartialObjectMetadata row objects (``includeObject``
+honored).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["wants_table", "to_table"]
+
+
+def wants_table(accept: Optional[str]) -> bool:
+    """Does the Accept header ask for a Table (kubectl get's chain)?"""
+    if not accept:
+        return False
+    for clause in accept.split(","):
+        params = {
+            p.partition("=")[0].strip(): p.partition("=")[2].strip()
+            for p in clause.split(";")[1:]
+        }
+        if params.get("as") == "Table":
+            return True
+    return False
+
+
+def _age(obj: dict, now: datetime.datetime) -> str:
+    ts = (obj.get("metadata") or {}).get("creationTimestamp")
+    if not ts:
+        return "<unknown>"
+    try:
+        created = datetime.datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
+    except ValueError:
+        return "<unknown>"
+    return _human_duration((now - created).total_seconds())
+
+
+def _human_duration(secs: float) -> str:
+    """kubectl's duration.HumanDuration shape: 10s, 5m, 2h30m, 3d..."""
+    s = int(secs)
+    if s < 0:
+        return "0s"
+    if s < 120:
+        return f"{s}s"
+    m = s // 60
+    if m < 10:
+        rem = s % 60
+        return f"{m}m{rem}s" if rem else f"{m}m"
+    if m < 180:
+        return f"{m}m"
+    h = s // 3600
+    if h < 8:
+        rem = m % 60
+        return f"{h}h{rem}m" if rem else f"{h}h"
+    if h < 48:
+        return f"{h}h"
+    d = h // 24
+    if d < 730:
+        rem = h % 24
+        return f"{d}d{rem}h" if d < 8 and rem else f"{d}d"
+    return f"{d // 365}y"
+
+
+def _pod_ready(obj: dict) -> str:
+    statuses = (obj.get("status") or {}).get("containerStatuses") or []
+    total = len((obj.get("spec") or {}).get("containers") or []) or len(statuses)
+    ready = sum(1 for c in statuses if c.get("ready"))
+    return f"{ready}/{total}"
+
+
+def _pod_status(obj: dict) -> str:
+    status = obj.get("status") or {}
+    meta = obj.get("metadata") or {}
+    if meta.get("deletionTimestamp"):
+        return "Terminating"
+    if status.get("reason"):
+        return str(status["reason"])
+    for c in status.get("containerStatuses") or []:
+        state = c.get("state") or {}
+        waiting = state.get("waiting") or {}
+        if waiting.get("reason"):
+            return str(waiting["reason"])
+        terminated = state.get("terminated") or {}
+        if terminated.get("reason") and status.get("phase") != "Running":
+            return str(terminated["reason"])
+    return str(status.get("phase") or "Unknown")
+
+
+def _pod_restarts(obj: dict) -> int:
+    return sum(
+        int(c.get("restartCount") or 0)
+        for c in (obj.get("status") or {}).get("containerStatuses") or []
+    )
+
+
+def _node_status(obj: dict) -> str:
+    conds = (obj.get("status") or {}).get("conditions") or []
+    ready = next((c for c in conds if c.get("type") == "Ready"), None)
+    base = "Ready" if ready and ready.get("status") == "True" else "NotReady"
+    if (obj.get("spec") or {}).get("unschedulable"):
+        base += ",SchedulingDisabled"
+    return base
+
+
+def _node_roles(obj: dict) -> str:
+    prefix = "node-role.kubernetes.io/"
+    roles = sorted(
+        k[len(prefix):]
+        for k in ((obj.get("metadata") or {}).get("labels") or {})
+        if k.startswith(prefix)
+    )
+    return ",".join(roles) or "<none>"
+
+
+def _node_version(obj: dict) -> str:
+    return str(
+        ((obj.get("status") or {}).get("nodeInfo") or {}).get("kubeletVersion")
+        or ""
+    )
+
+
+Column = Tuple[str, str, Callable[[dict, datetime.datetime], Any]]
+
+
+def _name(o: dict, _now) -> str:
+    return (o.get("metadata") or {}).get("name") or ""
+
+
+#: per-kind printed columns (name, type, extractor(obj, now)) — the
+#: shapes kubectl shows for `get pods` / `get nodes`; `now` is computed
+#: ONCE per table (1M-row renders must not call now() per row)
+_COLUMNS: Dict[str, List[Column]] = {
+    "Pod": [
+        ("Name", "string", _name),
+        ("Ready", "string", lambda o, _n: _pod_ready(o)),
+        ("Status", "string", lambda o, _n: _pod_status(o)),
+        ("Restarts", "integer", lambda o, _n: _pod_restarts(o)),
+        ("Age", "string", _age),
+    ],
+    "Node": [
+        ("Name", "string", _name),
+        ("Status", "string", lambda o, _n: _node_status(o)),
+        ("Roles", "string", lambda o, _n: _node_roles(o)),
+        ("Age", "string", _age),
+        ("Version", "string", lambda o, _n: _node_version(o)),
+    ],
+}
+
+_GENERIC: List[Column] = [
+    ("Name", "string", _name),
+    ("Age", "string", _age),
+]
+
+
+def to_table(
+    kind: str,
+    items: List[dict],
+    list_meta: Optional[dict] = None,
+    include_object: str = "Metadata",
+) -> dict:
+    """Build the meta.k8s.io/v1 Table for one kind's objects."""
+    cols = _COLUMNS.get(kind, _GENERIC)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    rows = []
+    for obj in items:
+        cells = []
+        for _, _, extract in cols:
+            try:
+                cells.append(extract(obj, now))
+            except Exception:  # noqa: BLE001 — a bad cell must not 500 the get
+                cells.append("<unknown>")
+        if include_object == "Object":
+            row_obj: Any = obj
+        elif include_object == "None":
+            row_obj = None
+        else:  # Metadata (default)
+            row_obj = {
+                "kind": "PartialObjectMetadata",
+                "apiVersion": "meta.k8s.io/v1",
+                "metadata": obj.get("metadata") or {},
+            }
+        row = {"cells": cells}
+        if row_obj is not None:
+            row["object"] = row_obj
+        rows.append(row)
+    table = {
+        "kind": "Table",
+        "apiVersion": "meta.k8s.io/v1",
+        "metadata": dict(list_meta or {}),
+        "columnDefinitions": [
+            {
+                "name": name,
+                "type": ctype,
+                "format": "name" if name == "Name" else "",
+                "description": "",
+                "priority": 0,
+            }
+            for name, ctype, _ in cols
+        ],
+        "rows": rows,
+    }
+    return table

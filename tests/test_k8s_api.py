@@ -119,9 +119,9 @@ def test_crud_pods_k8s_paths(cluster):
     assert code == 201 and created["metadata"]["uid"]
     assert isinstance(created["metadata"]["resourceVersion"], str)
 
-    # get — with kubectl's Table accept header (fallback path: server
-    # ignores the Table request and returns the plain object)
-    code, got = req(
+    # get — with kubectl's Table accept header: the server answers a
+    # real meta.k8s.io Table with the printed pod columns
+    code, table = req(
         host,
         port,
         "GET",
@@ -131,6 +131,15 @@ def test_crud_pods_k8s_paths(cluster):
             "application/json;as=Table;v=v1beta1;g=meta.k8s.io,application/json"
         },
     )
+    assert code == 200 and table["kind"] == "Table"
+    assert [c["name"] for c in table["columnDefinitions"]] == [
+        "Name", "Ready", "Status", "Restarts", "Age",
+    ]
+    assert table["rows"][0]["cells"][0] == "a"
+    assert table["rows"][0]["object"]["kind"] == "PartialObjectMetadata"
+
+    # the plain-JSON get still serves the object
+    code, got = req(host, port, "GET", "/api/v1/namespaces/default/pods/a")
     assert code == 200 and got["kind"] == "Pod" and got["apiVersion"] == "v1"
 
     # list in namespace + all-namespaces

@@ -1,0 +1,164 @@
+"""meta.k8s.io Table responses (cluster/tables.py): the printed
+columns kubectl shows for `get pods` / `get nodes`, AGE humanization,
+and includeObject handling — what the composed kube-apiserver answers
+in reference clusters."""
+
+import datetime
+
+from kwok_tpu.cluster.tables import _human_duration, to_table, wants_table
+
+
+def test_wants_table_parses_accept_chain():
+    assert wants_table(
+        "application/json;as=Table;v=v1;g=meta.k8s.io,application/json"
+    )
+    assert not wants_table("application/json")
+    assert not wants_table(None)
+    assert not wants_table("application/yaml")
+
+
+def make_pod(name="p", ready=True, restarts=2, phase="Running"):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    created = (now - datetime.timedelta(minutes=5)).isoformat().replace(
+        "+00:00", "Z"
+    )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "creationTimestamp": created},
+        "spec": {"containers": [{"name": "c"}]},
+        "status": {
+            "phase": phase,
+            "containerStatuses": [
+                {"name": "c", "ready": ready, "restartCount": restarts,
+                 "state": {"running": {}}}
+            ],
+        },
+    }
+
+
+def test_pod_table_columns_and_cells():
+    t = to_table("Pod", [make_pod()])
+    assert t["kind"] == "Table" and t["apiVersion"] == "meta.k8s.io/v1"
+    names = [c["name"] for c in t["columnDefinitions"]]
+    assert names == ["Name", "Ready", "Status", "Restarts", "Age"]
+    cells = t["rows"][0]["cells"]
+    assert cells[0] == "p"
+    assert cells[1] == "1/1"
+    assert cells[2] == "Running"
+    assert cells[3] == 2
+    assert cells[4].endswith("m") or "m" in cells[4]
+
+
+def test_pod_status_variants():
+    waiting = make_pod(phase="Pending")
+    waiting["status"]["containerStatuses"][0]["state"] = {
+        "waiting": {"reason": "CrashLoopBackOff"}
+    }
+    t = to_table("Pod", [waiting])
+    assert t["rows"][0]["cells"][2] == "CrashLoopBackOff"
+    terminating = make_pod()
+    terminating["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    t = to_table("Pod", [terminating])
+    assert t["rows"][0]["cells"][2] == "Terminating"
+
+
+def test_node_table():
+    node = {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": {"name": "n0",
+                     "labels": {"node-role.kubernetes.io/worker": ""},
+                     "creationTimestamp": "2026-01-01T00:00:00Z"},
+        "spec": {},
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "nodeInfo": {"kubeletVersion": "v1.29.0-kwok-tpu"},
+        },
+    }
+    t = to_table("Node", [node])
+    names = [c["name"] for c in t["columnDefinitions"]]
+    assert names == ["Name", "Status", "Roles", "Age", "Version"]
+    cells = t["rows"][0]["cells"]
+    assert cells[0] == "n0" and cells[1] == "Ready"
+    assert cells[2] == "worker" and cells[4] == "v1.29.0-kwok-tpu"
+
+
+def test_generic_kind_and_include_object():
+    cm = {"apiVersion": "v1", "kind": "ConfigMap",
+          "metadata": {"name": "c", "creationTimestamp": "2026-01-01T00:00:00Z"}}
+    t = to_table("ConfigMap", [cm], include_object="Object")
+    assert [c["name"] for c in t["columnDefinitions"]] == ["Name", "Age"]
+    assert t["rows"][0]["object"]["kind"] == "ConfigMap"
+    t = to_table("ConfigMap", [cm], include_object="None")
+    assert "object" not in t["rows"][0]
+
+
+def test_human_duration_shapes():
+    assert _human_duration(10) == "10s"
+    assert _human_duration(119) == "119s"
+    assert _human_duration(5 * 60) == "5m"
+    assert _human_duration(125 * 60) == "125m"
+    assert _human_duration(5 * 3600) == "5h"
+    assert _human_duration(30 * 3600) == "30h"
+    assert _human_duration(10 * 86400) == "10d"
+    assert _human_duration(3 * 365 * 86400) == "3y"
+
+
+def test_watch_streams_table_events_when_negotiated():
+    """kubectl get -w: a Table-negotiated watch must carry Table-typed
+    event objects (single-row tables), or kubectl's decoder rejects
+    the stream."""
+    import http.client
+    import json as _json
+    import socket
+    import threading
+    import time as _t
+
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        host, port = srv.address
+        store.create(make_pod("w0"))
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request(
+                "GET",
+                "/api/v1/namespaces/default/pods?watch=true&timeoutSeconds=5",
+                headers={
+                    "Accept": "application/json;as=Table;v=v1;g=meta.k8s.io,"
+                    "application/json"
+                },
+            )
+            resp = conn.getresponse()
+
+            def mutate():
+                _t.sleep(0.4)
+                store.patch("Pod", "w0", {"metadata": {"labels": {"t": "1"}}},
+                            "merge", namespace="default")
+
+            threading.Thread(target=mutate, daemon=True).start()
+            frames = []
+            deadline = _t.monotonic() + 8
+            buf = b""
+            resp.fp.raw._sock.settimeout(1.0)  # noqa: SLF001
+            while _t.monotonic() < deadline and len(frames) < 2:
+                try:
+                    chunk = resp.read1(65536)
+                except (socket.timeout, TimeoutError):
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if line.strip():
+                        frames.append(_json.loads(line))
+            assert frames, "no watch frames received"
+            for f in frames:
+                assert f["object"]["kind"] == "Table", f
+                assert f["object"]["rows"][0]["cells"][0] == "w0"
+        finally:
+            conn.close()
