@@ -30,11 +30,12 @@ The full-language tail is in too (r04): variables and ``as`` bindings
 selector expressions using them lower as opaque host-evaluated feature
 columns on the device path — plus string interpolation ``"\\(e)"``
 with bindings visible inside, recursive descent ``..``/``recurse``,
-and ``limit``/``range(a;b;c)``/``while``/``until``.  Remaining
-(documented) gaps: ``input``/``inputs`` (no input stream exists here),
-``?//`` pattern alternatives, and patterns in reduce/foreach sources;
-unbound ``$vars`` and breaks outside their label are compile errors
-like jq.
+``limit``/``range(a;b;c)``/``while``/``until``, the ``?//`` pattern
+alternative operator, destructuring patterns in ``reduce``/``foreach``
+sources, and ``input``/``inputs`` (``Query.execute(v, inputs=...)``
+feeds the rest-of-stream; the default stream is empty, so ``input``
+errors at end-of-input like jq).  Unbound ``$vars`` and breaks outside
+their label are compile errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -77,7 +78,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(?:\.\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<format>@[a-z0-9]+)
-  | (?P<op>//|\.\.|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
+  | (?P<op>\?//|//|\.\.|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
     re.VERBOSE,
@@ -281,20 +282,23 @@ class As:
 
 @dataclass(frozen=True)
 class Reduce:
-    """``reduce SRC as $x (INIT; UPDATE)``."""
+    """``reduce SRC as PATTERN [?// ALT...] (INIT; UPDATE)``.
+
+    ``patterns`` is a tuple of destructuring-pattern trees (see
+    AsPattern); the common ``$x`` binding is ``(("$", "x"),)``."""
 
     source: Any
-    var: str
+    patterns: Tuple[Any, ...]
     init: Any
     update: Any
 
 
 @dataclass(frozen=True)
 class Foreach:
-    """``foreach SRC as $x (INIT; UPDATE[; EXTRACT])``."""
+    """``foreach SRC as PATTERN [?// ALT...] (INIT; UPDATE[; EXTRACT])``."""
 
     source: Any
-    var: str
+    patterns: Tuple[Any, ...]
     init: Any
     update: Any
     extract: Any  # None -> emit the accumulator
@@ -359,11 +363,15 @@ class StrInterp:
 @dataclass(frozen=True)
 class AsPattern:
     """``SRC as [$a, $b] | BODY`` / ``SRC as {k: $v} | BODY`` —
-    destructuring binds; ``pattern`` is nested lists/dicts with leaf
-    ``("$", name)`` markers."""
+    destructuring binds; each pattern is nested lists/dicts with leaf
+    ``("$", name)`` markers.  ``patterns`` holds the ``?//``
+    alternatives in order (usually just one): jq tries each pattern,
+    and on a destructuring *or body* error moves to the next; every
+    variable named in any alternative is in scope (null when the
+    matching alternative does not bind it)."""
 
     source: Any
-    pattern: Any
+    patterns: Tuple[Any, ...]
     body: Any
 
 
@@ -372,8 +380,13 @@ _FUNCS0 = {
     "length", "keys", "values", "type", "tostring", "tonumber", "not",
     "empty", "add", "any", "all", "first", "last", "min", "max", "sort",
     "unique", "floor", "ceil", "ascii_downcase", "ascii_upcase", "abs",
-    "reverse", "tojson", "fromjson", "error", "recurse",
+    "reverse", "tojson", "fromjson", "error", "recurse", "input", "inputs",
 }
+
+#: env key carrying the shared rest-of-inputs iterator for
+#: ``input``/``inputs`` (a tuple so it can never collide with a $var
+#: name; def closures copy the env, so the iterator is shared)
+_INPUTS_KEY = ("inputs",)
 #: one-arg builtins
 _FUNCS1 = {
     "select", "has", "map", "test", "startswith", "endswith", "contains",
@@ -523,18 +536,26 @@ class _Parser:
             # TERM, and the body extends maximally to the right
             # (`1, 2 as $x | e` is `1, (2 as $x | e)`)
             self.next()
-            pattern = self.parse_pattern()
-            names = _pattern_vars(pattern)
+            patterns = self._parse_patterns()
+            names = [n for p in patterns for n in _pattern_vars(p)]
             self.expect("|")
             self.var_scope.extend(names)
             try:
                 body = self.parse_pipe()
             finally:
                 del self.var_scope[len(self.var_scope) - len(names) :]
-            if pattern[0] == "$":
-                return As(node, pattern[1], body)
-            return AsPattern(node, pattern, body)
+            if len(patterns) == 1 and patterns[0][0] == "$":
+                return As(node, patterns[0][1], body)
+            return AsPattern(node, patterns, body)
         return node
+
+    def _parse_patterns(self) -> Tuple[Any, ...]:
+        """One destructuring pattern plus any ``?//`` alternatives."""
+        patterns = [self.parse_pattern()]
+        while self.peek_text() == "?//":
+            self.next()
+            patterns.append(self.parse_pattern())
+        return tuple(patterns)
 
     def _parse_call_args(self) -> List[Any]:
         """``( a; b; ... )`` argument list, empty when no paren."""
@@ -759,8 +780,10 @@ class _Parser:
             return Func("recurse", ())
         raise KqCompileError(f"unexpected token {text!r} in {self.src!r}")
 
-    def _parse_as_binding(self, kw: str) -> Tuple[Any, str]:
-        """Shared ``KW SRC as $x`` prefix of reduce/foreach."""
+    def _parse_as_binding(self, kw: str) -> Tuple[Any, Tuple[Any, ...]]:
+        """Shared ``KW SRC as PATTERN [?// ALT...]`` prefix of
+        reduce/foreach — full destructuring patterns, like jq's
+        grammar (gojq behind reference query.go:33 accepts them)."""
         self.expect(kw)
         self._no_as += 1
         try:
@@ -768,32 +791,29 @@ class _Parser:
         finally:
             self._no_as -= 1
         self.expect("as")
-        tok = self.next()
-        if tok[0] != "var":
-            raise KqCompileError(
-                f"'{kw} ... as' needs a $variable in {self.src!r}"
-            )
-        return source, tok[1][1:]
+        return source, self._parse_patterns()
 
     def parse_reduce(self) -> Any:
-        source, var = self._parse_as_binding("reduce")
+        source, patterns = self._parse_as_binding("reduce")
+        names = [n for p in patterns for n in _pattern_vars(p)]
         self.expect("(")
         init = self.parse_pipe()
         self.expect(";")
-        self.var_scope.append(var)
+        self.var_scope.extend(names)
         try:
             update = self.parse_pipe()
         finally:
-            self.var_scope.pop()
+            del self.var_scope[len(self.var_scope) - len(names) :]
         self.expect(")")
-        return Reduce(source, var, init, update)
+        return Reduce(source, patterns, init, update)
 
     def parse_foreach(self) -> Any:
-        source, var = self._parse_as_binding("foreach")
+        source, patterns = self._parse_as_binding("foreach")
+        names = [n for p in patterns for n in _pattern_vars(p)]
         self.expect("(")
         init = self.parse_pipe()
         self.expect(";")
-        self.var_scope.append(var)
+        self.var_scope.extend(names)
         try:
             update = self.parse_pipe()
             extract = None
@@ -801,9 +821,9 @@ class _Parser:
                 self.next()
                 extract = self.parse_pipe()
         finally:
-            self.var_scope.pop()
+            del self.var_scope[len(self.var_scope) - len(names) :]
         self.expect(")")
-        return Foreach(source, var, init, update, extract)
+        return Foreach(source, patterns, init, update, extract)
 
     def parse_def(self) -> Any:
         self.expect("def")
@@ -1225,18 +1245,24 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
         for acc0 in _eval(node.init, value, env):
             acc = acc0
             for x in _eval(node.source, value, env):
-                acc = _fold_step(node.update, acc, {**env, node.var: x})
+                acc = _fold_bind_step(node.update, acc, node.patterns, x, env)
             yield acc
     elif isinstance(node, Foreach):
+        pats = node.patterns
         for acc0 in _eval(node.init, value, env):
             acc = acc0
             for x in _eval(node.source, value, env):
-                e2 = {**env, node.var: x}
-                acc = _fold_step(node.update, acc, e2)
-                if node.extract is None:
-                    yield acc
+                if len(pats) == 1:
+                    e2 = dict(env)
+                    _bind_pattern(pats[0], x, e2)
+                    acc = _fold_step(node.update, acc, e2)
+                    if node.extract is None:
+                        yield acc
+                    else:
+                        yield from _eval(node.extract, acc, e2)
                 else:
-                    yield from _eval(node.extract, acc, e2)
+                    acc, outs = _foreach_alt_step(node, acc, x, env)
+                    yield from outs
     elif isinstance(node, Def):
         env2 = dict(env)
         env2[("fn", node.name, len(node.params))] = (node.params, node.body, env2)
@@ -1289,10 +1315,17 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
 
         yield from build(0, "")
     elif isinstance(node, AsPattern):
-        for bound in _eval(node.source, value, env):
-            e2 = dict(env)
-            _bind_pattern(node.pattern, bound, e2)
-            yield from _eval(node.body, value, e2)
+        pats = node.patterns
+        if len(pats) == 1:
+            for bound in _eval(node.source, value, env):
+                e2 = dict(env)
+                _bind_pattern(pats[0], bound, e2)
+                yield from _eval(node.body, value, e2)
+        else:
+            for bound in _eval(node.source, value, env):
+                yield from _alt_bind_outputs(
+                    pats, bound, env, lambda e2: _eval(node.body, value, e2)
+                )
     else:  # pragma: no cover
         raise _KqRuntimeError(f"unknown node {node!r}")
 
@@ -1516,6 +1549,87 @@ def _pattern_vars(pattern) -> List[str]:
     return [n for _, sub in pattern[1] for n in _pattern_vars(sub)]
 
 
+def _alt_bind_outputs(
+    patterns: Tuple[Any, ...], bound: Any, env: dict, run
+) -> Iterator[Any]:
+    """The jq ``?//`` protocol, shared by as/reduce/foreach: try each
+    alternative in order; a destructuring or evaluation error moves to
+    the next (only the last alternative's errors propagate).  Every
+    variable named in any alternative is in scope, null when the
+    matching pattern does not bind it.  ``run(e2)`` returns the body's
+    output iterator; evaluation stays lazy, and — like jq's
+    backtracking — outputs already yielded before a mid-stream error
+    stand while the next alternative re-runs the body from the start."""
+    allvars = [n for p in patterns for n in _pattern_vars(p)]
+    last = len(patterns) - 1
+    for i, pat in enumerate(patterns):
+        e2 = dict(env)
+        for n in allvars:
+            e2[n] = None
+        try:
+            _bind_pattern(pat, bound, e2)
+        except _KqRuntimeError:
+            if i == last:
+                raise
+            continue
+        it = run(e2)
+        erred = False
+        while True:
+            try:
+                out = next(it)
+            except StopIteration:
+                break
+            except _KqRuntimeError:
+                if i == last:
+                    raise
+                erred = True
+                break
+            yield out
+        if not erred:
+            return
+
+
+def _fold_bind_step(
+    update: Any, acc: Any, patterns: Tuple[Any, ...], x: Any, env: dict
+) -> Any:
+    """One reduce step with destructuring: bind ``x`` via the first
+    ``?//`` alternative whose destructuring AND update succeed (errors
+    of the last alternative propagate)."""
+    if len(patterns) == 1:
+        e2 = dict(env)
+        _bind_pattern(patterns[0], x, e2)
+        return _fold_step(update, acc, e2)
+
+    def run(e2):
+        # generator so the update's error raises inside the retry
+        # protocol's next(), not at run() call time
+        yield _fold_step(update, acc, e2)
+
+    out = acc
+    for out in _alt_bind_outputs(patterns, x, env, run):
+        pass
+    return out
+
+
+def _foreach_alt_step(node: "Foreach", acc: Any, x: Any, env: dict):
+    """One foreach step under ``?//`` alternatives: returns the new
+    accumulator and this step's outputs (one step's output set is
+    collected so the accumulator can advance; the *source* stream
+    stays lazy)."""
+    box = {"acc": acc}
+
+    def run(e2):
+        new_acc = _fold_step(node.update, acc, e2)
+        box["acc"] = new_acc
+        if node.extract is None:
+            yield new_acc
+        else:
+            yield from _eval(node.extract, new_acc, e2)
+
+    outs = list(_alt_bind_outputs(node.patterns, x, env, run))
+    return box["acc"], outs
+
+
 def _fold_step(update: Any, acc: Any, env: dict) -> Any:
     """One reduce/foreach step: the accumulator becomes the LAST output
     of the update filter (jq folds this way; empty output -> null,
@@ -1723,6 +1837,18 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
         yield not _truthy(value)
     elif name == "empty":
         return
+    elif name == "input":
+        it = env.get(_INPUTS_KEY)
+        if it is None:
+            raise _KqRuntimeError("No more inputs")
+        try:
+            yield next(it)
+        except StopIteration:
+            raise _KqRuntimeError("No more inputs") from None
+    elif name == "inputs":
+        it = env.get(_INPUTS_KEY)
+        if it is not None:
+            yield from it
     elif name == "add":
         if not isinstance(value, list):
             raise _KqRuntimeError("add over non-array")
@@ -1880,15 +2006,25 @@ class Query:
         self.src = src
         self._ast = _Parser(_tokenize(src), src).parse_query()
 
-    def execute(self, value: Any) -> Optional[List[Any]]:
+    def execute(
+        self, value: Any, inputs: Optional[Sequence[Any]] = None
+    ) -> Optional[List[Any]]:
         """Run the query; returns the non-null output stream.
 
         Mirrors reference query.go:48-68: errors swallow the whole result
         (returns None), null outputs are dropped.
+
+        ``inputs`` is the rest-of-stream for ``input``/``inputs`` (jq
+        reads them from the file stream after the current document; the
+        stage engine evaluates one document, so the default stream is
+        empty — ``input`` then errors like jq at end of input).
         """
         out: List[Any] = []
+        env: dict = {}
+        if inputs is not None:
+            env[_INPUTS_KEY] = iter(inputs)
         try:
-            for v in _eval(self._ast, value, {}):
+            for v in _eval(self._ast, value, env):
                 if v is None:
                     continue
                 out.append(v)

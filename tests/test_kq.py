@@ -97,12 +97,7 @@ def test_bool_not_equal_int():
 
 
 def test_compile_error():
-    # still-unsupported jq: input/inputs (no input stream here),
-    # ?// alternatives, functions outside the builtin set
-    with pytest.raises(KqCompileError):
-        Query("input")
-    with pytest.raises(KqCompileError):
-        Query(". as [$a] ?// [$b] | 1")
+    # functions outside the builtin set are compile errors
     with pytest.raises(KqCompileError):
         Query("getpath([\"a\"])")
     # unbound variables are compile errors, like jq
@@ -412,3 +407,69 @@ def test_loop_builtins_unbounded_iterations():
 
 def test_builtin_arity_fallthrough_past_user_def():
     assert Query("def range(a): a; [range(2;5)]").execute(None) == [[2, 3, 4]]
+
+
+def test_input_and_inputs():
+    # jq: `input` pulls the next document from the stream; exhaustion
+    # errors ("No more inputs") which execute() swallows to None
+    assert Query("input").execute(1, inputs=[2, 3]) == [2]
+    assert Query("[., input, input]").execute(1, inputs=[2, 3]) == [[1, 2, 3]]
+    assert Query("input").execute(1) is None
+    assert Query("input").execute(1, inputs=[]) is None
+    # `inputs` streams the rest; end of stream is not an error
+    assert Query("[inputs]").execute(0, inputs=[1, 2, 3]) == [[1, 2, 3]]
+    assert Query("[inputs]").execute(0) == [[]]
+    # the iterator is shared: input consumes what inputs would see
+    assert Query("[input, inputs]").execute(0, inputs=[1, 2, 3]) == [[1, 2, 3]]
+    # reduce over the stream (jq's canonical summing idiom)
+    assert Query("reduce inputs as $x (.; . + $x)").execute(
+        10, inputs=[1, 2, 3]
+    ) == [16]
+
+
+def test_alternative_destructuring_operator():
+    # jq manual's ?// example: {a} matches first, [$a,$b] as fallback
+    q = Query(". as {a: $a} ?// [$a, $b] | [$a, $b]")
+    # object form: $b is in scope (from the other alternative) as null
+    assert q.execute({"a": 1}) == [[1, None]]
+    # array form
+    assert q.execute([3, 4]) == [[3, 4]]
+    # jq: an error in the BODY retries the next alternative
+    q2 = Query('. as [$a] ?// $a | if $a == null then error("fall") else $a end')
+    assert q2.execute([None]) == [[None]]  # body error -> $a rebinds whole input
+    # last alternative's errors propagate (query result is None)
+    assert Query('. as [$a] ?// $a | error("boom")').execute([1]) is None
+    # destructuring error on the first pattern falls through
+    assert Query(". as [$a] ?// $a | $a").execute("str") == ["str"]
+
+
+def test_patterns_in_reduce_and_foreach():
+    assert Query("reduce .[] as [$a, $b] (0; . + $a * $b)").execute(
+        [[1, 2], [3, 4]]
+    ) == [14]
+    assert Query("reduce .[] as {x: $x} (0; . + $x)").execute(
+        [{"x": 1}, {"x": 2}]
+    ) == [3]
+    assert Query("[foreach .[] as [$a] (0; . + $a; [., $a])]").execute(
+        [[1], [2]]
+    ) == [[[1, 1], [3, 2]]]
+    # ?// alternatives inside reduce: strings destructure via fallback
+    assert Query('reduce .[] as [$x] ?// $x (""; . + ($x | tostring))').execute(
+        [[1], "a", [2]]
+    ) == ["1a2"]
+
+
+def test_alternative_patterns_stay_lazy():
+    # jq streams ?// bodies: limit must terminate an unbounded body
+    assert Query(
+        "limit(1; . as {a: $a} ?// [$a] | range(100000000))"
+    ).execute({"a": 1}) == [0]
+    # first output of the unbounded stream arrives without materializing it
+    assert Query(
+        "[limit(3; . as {a: $a} ?// [$a] | range(100000000) + 1)]"
+    ).execute({"a": 1}) == [[1, 2, 3]]
+    # update errors retry the next alternative inside reduce
+    assert Query(
+        'reduce .[] as [$x] ?// $x (0; . + ($x | if type == "number" then . '
+        "else error end))"
+    ).execute([[1], 5, [2]]) == [8]
