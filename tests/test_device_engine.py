@@ -310,10 +310,12 @@ class TestCompileErrors:
                     "resourceRef": {"kind": "Pod"},
                     "selector": {
                         "matchExpressions": [
-                            # input has no meaning without an input
-                            # stream -> host fallback path must engage
+                            # a function outside kq's builtin set is a
+                            # KqCompileError -> the stage must surface
+                            # StageCompileError so the facade falls
+                            # back to the host backend
                             {
-                                "key": "input",
+                                "key": 'getpath(["a"])',
                                 "operator": "Exists",
                             }
                         ]
