@@ -328,4 +328,12 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    rc = main()
+    # hard exit: the JSON line is out, so a straggler daemon thread
+    # (hung tunnel transfer) must not be allowed to die mid-XLA-dispatch
+    # during interpreter teardown and turn rc into 134 ("terminate
+    # called ... FATAL: exception not rethrown").  os._exit skips
+    # teardown entirely — the kernel reaps the threads.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

@@ -347,6 +347,80 @@ if _FAST is not None and hasattr(_FAST, "WatchEvent"):
 
 
 @dataclass
+class StatusLane:
+    """A granted zero-copy commit lane (see ResourceStore.status_lane):
+    the stored-objects dict to splice into, the resourceVersion counter
+    to advance (written back on exit), and the kind's namespacing (the
+    grantee derives store keys with the store's own convention)."""
+
+    objects: Dict[Tuple[str, str], dict]
+    rv: int
+    namespaced: bool
+
+
+class _LaneGrant:
+    """Context manager behind ResourceStore.status_lane: takes the
+    store mutex, yields a StatusLane when the zero-copy conditions hold
+    (else None), and on exit adopts the advanced resourceVersion plus
+    the history-gap marker.  A plain class (not @contextmanager) — the
+    drain requests a grant per chunk, so construction cost matters."""
+
+    __slots__ = ("store", "kind", "exclude", "lane", "st")
+
+    def __init__(self, store: "ResourceStore", kind: str, exclude):
+        self.store = store
+        self.kind = kind
+        self.exclude = exclude
+        self.lane: Optional[StatusLane] = None
+        self.st: Optional[_TypeState] = None
+
+    def __enter__(self) -> Optional[StatusLane]:
+        store = self.store
+        store._mut.acquire()
+        try:
+            try:
+                st = store._state(self.kind)
+            except NotFound:
+                return None
+            if (
+                self.exclude is None
+                or any(p.startswith("status.") for p in st.indexes)
+                or any(
+                    w is not self.exclude
+                    and not w.stopped
+                    and w.status_interest
+                    for w in st.watchers
+                )
+                or time.monotonic() < st.lane_cooloff
+            ):
+                return None
+            self.st = st
+            self.lane = StatusLane(st.objects, store._rv, st.rtype.namespaced)
+            return self.lane
+        except BaseException:
+            store._mut.release()
+            raise
+
+    def __exit__(self, *exc) -> None:
+        store = self.store
+        try:
+            lane = self.lane
+            # forward only: a reentrant write during the lane (the
+            # store RLock re-enters from the grantee's thread) may have
+            # advanced the counter past the lane's view — never rewind
+            # below an already-issued resourceVersion
+            if lane is not None and lane.rv > store._rv:
+                n = lane.rv - store._rv
+                store._rv = lane.rv
+                self.st.inplace_rv = lane.rv
+                store._audit.append(
+                    ("patch-status-fused", f"{self.kind}:{n}", None)
+                )
+        finally:
+            store._mut.release()
+
+
+@dataclass
 class _TypeState:
     rtype: ResourceType
     history: deque
@@ -1105,6 +1179,26 @@ class ResourceStore:
                     if w is not exclude and w.status_interest:
                         w._push_batch(evs)
             return out
+
+    def status_lane(self, kind: str, exclude: Optional[Watcher]):
+        """Grant the caller the zero-copy status-commit lane for one
+        chunk: a context manager yielding a :class:`StatusLane` (the
+        stored-objects dict plus the resourceVersion counter) with the
+        store mutex held, or ``None`` when the lane conditions do not
+        hold (a live watcher with status interest, a status index, or
+        the post-Expired cooloff).
+
+        This powers the fused native drain
+        (``kwok_fastdrain.fused_group`` via
+        ``DeviceStagePlayer._drain_tick``): build + commit + confirm in
+        one pass over each row.  The contract matches the in-place
+        branch of :meth:`apply_status_batch` — stored objects are
+        mutated in place, no events are delivered, and the history gap
+        marker (``inplace_rv``) expires any watcher resuming from an
+        older resourceVersion.  The grantee must only splice ``status``
+        and ``metadata.resourceVersion`` (from ``lane.rv``, one bump
+        per object) on instances it verified are the stored ones."""
+        return _LaneGrant(self, kind, exclude)
 
     def bulk(self, ops: List[dict]) -> List[dict]:
         """Apply many mutations in one call — the device backend's
