@@ -118,6 +118,58 @@ def cmd_get_components(args) -> int:
     return 0
 
 
+def cmd_get_artifacts(args) -> int:
+    """List the binaries/images a cluster uses (reference
+    pkg/kwokctl/cmd/get/artifacts/artifacts.go:44-120: ListBinaries +
+    ListImages of the selected runtime, sorted, --filter binary|image).
+    For the binary runtime the "binaries" are the component
+    entrypoints (python -m modules — this framework ships as source,
+    not downloaded blobs); the compose runtime adds its base image.
+    An existing cluster's recorded runtime wins over --runtime (like
+    the reference, which loads the cluster's saved config first)."""
+    probe = BinaryRuntime(getattr(args, "name", None) or DEFAULT_CLUSTER)
+    if probe.exists():
+        args.runtime = None  # recorded runtime wins
+    rt = _runtime(args)
+    filt = getattr(args, "filter", None) or ""
+    artifacts: list = []
+    if rt.exists():
+        comps = rt.load_components()
+    else:
+        # no cluster yet: the default component set the runtime would
+        # install (reference SetConfig-then-list behavior)
+        from kwok_tpu.ctl.components import default_components
+
+        comps = default_components(rt.workdir)
+    if filt in ("", "binary"):
+        seen = set()
+        for comp in comps:
+            # argv shape: [python, -m, module, ...flags]
+            mod = None
+            for i, a in enumerate(comp.args):
+                if a == "-m" and i + 1 < len(comp.args):
+                    mod = f"{comp.args[0]} -m {comp.args[i + 1]}"
+                    break
+            mod = mod or (comp.args[0] if comp.args else comp.name)
+            if mod not in seen:
+                seen.add(mod)
+                artifacts.append(mod)
+    if filt in ("", "image"):
+        images = getattr(rt, "images", None)
+        if callable(images):
+            artifacts.extend(images())
+    if not artifacts:
+        print(
+            f"No artifacts found for runtime {getattr(args, 'runtime', None) or 'binary'}"
+            + (f" and filter {filt!r}" if filt else ""),
+            file=sys.stderr,
+        )
+        return 0
+    for a in sorted(artifacts):
+        print(a)
+    return 0
+
+
 def cmd_get_kubeconfig(args) -> int:
     """Emit a standard kubeconfig (``kind: Config``) so stock kubectl
     and client-go tooling can point at the cluster's k8s-protocol
@@ -1072,11 +1124,24 @@ def build_parser() -> argparse.ArgumentParser:
     t = pts.add_parser("cluster")
     t.set_defaults(fn=cmd_stop_cluster)
 
-    pg = sub.add_parser("get", help="list clusters/components/kubeconfig")
+    pg = sub.add_parser(
+        "get", help="list clusters/components/kubeconfig/artifacts"
+    )
     pgs = pg.add_subparsers(dest="what", required=True)
     pgs.add_parser("clusters").set_defaults(fn=cmd_get_clusters)
     pgs.add_parser("components").set_defaults(fn=cmd_get_components)
     pgs.add_parser("kubeconfig").set_defaults(fn=cmd_get_kubeconfig)
+    ga = pgs.add_parser(
+        "artifacts", help="list binaries or images used by a cluster"
+    )
+    ga.add_argument("--filter", choices=["binary", "image"], default=None)
+    ga.add_argument(
+        "--runtime",
+        default=None,
+        help="runtime to list for; ignored when the cluster exists "
+        "(its recorded runtime wins)",
+    )
+    ga.set_defaults(fn=cmd_get_artifacts)
 
     pl = sub.add_parser("logs", help="print a component's log")
     pl.add_argument("component")

@@ -211,3 +211,50 @@ def build_kwok_controller_component(
         ports={"kubelet": kubelet_port},
         depends_on=["apiserver"],
     )
+
+
+def build_core_components(
+    workdir: str,
+    server_url: str,
+    apiserver_port: int,
+    kubelet_port: int,
+    secure: bool = False,
+    pki_dir: Optional[str] = None,
+    config_paths: Optional[List[str]] = None,
+    backend: str = "host",
+    extra_args: Optional[List[str]] = None,
+) -> List[Component]:
+    """The standard control-plane seat list, in dependency order
+    (reference binary/cluster.go:217-314 composes the same set).  The
+    single source of truth for what a cluster runs — install() and
+    ``kwokctl get artifacts`` (on a not-yet-created cluster) both call
+    this, so the two can never drift."""
+    return [
+        build_apiserver_component(
+            workdir,
+            apiserver_port,
+            secure=secure,
+            pki_dir=pki_dir,
+            kubelet_port=kubelet_port,
+        ),
+        build_scheduler_component(server_url, secure=secure, pki_dir=pki_dir),
+        build_kcm_component(server_url, secure=secure, pki_dir=pki_dir),
+        build_kwok_controller_component(
+            workdir,
+            server_url,
+            kubelet_port,
+            config_paths=config_paths,
+            secure=secure,
+            pki_dir=pki_dir,
+            backend=backend,
+            extra_args=extra_args,
+        ),
+    ]
+
+
+def default_components(workdir: str) -> List[Component]:
+    """The component set an install would compose, without installing
+    (for ``kwokctl get artifacts`` on a cluster that does not exist yet
+    — reference artifacts.go:80-100 SetConfig-then-list).  Ports are
+    placeholders; only names/argv matter to callers."""
+    return build_core_components(workdir, "http://127.0.0.1:0", 0, 0)

@@ -39,6 +39,12 @@ class ComposeRuntime(BinaryRuntime):
     def compose_path(self) -> str:
         return self._path("docker-compose.yaml")
 
+    def images(self) -> List[str]:
+        """Container images `compose up` pulls (reference
+        runtime.ListImages, pkg/kwokctl/runtime/compose/cluster.go;
+        surfaced by ``kwokctl get artifacts``)."""
+        return [DEFAULT_IMAGE]
+
     # ------------------------------------------------------------- install
 
     def install(self, **kwargs) -> dict:

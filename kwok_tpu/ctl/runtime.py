@@ -35,10 +35,7 @@ import yaml
 from kwok_tpu.cluster.client import ClusterClient
 from kwok_tpu.ctl.components import (
     Component,
-    build_apiserver_component,
-    build_kwok_controller_component,
-    build_kcm_component,
-    build_scheduler_component,
+    build_core_components,
     build_tracing_component,
     free_port,
 )
@@ -141,31 +138,17 @@ class BinaryRuntime:
                 shutil.copyfile(src, dst)
             stored_paths.append(dst)
 
-        components = [
-            build_apiserver_component(
-                self.workdir,
-                apiserver_port,
-                secure=secure,
-                pki_dir=pki_dir,
-                kubelet_port=kubelet_port,
-            ),
-            build_scheduler_component(
-                server_url, secure=secure, pki_dir=pki_dir
-            ),
-            build_kcm_component(
-                server_url, secure=secure, pki_dir=pki_dir
-            ),
-            build_kwok_controller_component(
-                self.workdir,
-                server_url,
-                kubelet_port,
-                config_paths=stored_paths,
-                secure=secure,
-                pki_dir=pki_dir,
-                backend=backend,
-                extra_args=controller_args,
-            ),
-        ]
+        components = build_core_components(
+            self.workdir,
+            server_url,
+            apiserver_port,
+            kubelet_port,
+            secure=secure,
+            pki_dir=pki_dir,
+            config_paths=stored_paths,
+            backend=backend,
+            extra_args=controller_args,
+        )
         tracing_port = 0
         if enable_tracing:
             # the jaeger seat: collector first, every other component

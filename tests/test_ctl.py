@@ -339,3 +339,33 @@ def test_cluster_lifecycle_end_to_end(home, capsys):
     finally:
         assert kwokctl_main(["--name", name, "delete", "cluster"]) == 0
         assert not os.path.exists(rt.workdir)
+
+
+def test_get_artifacts(home, capsys):
+    """kwokctl get artifacts (reference
+    pkg/kwokctl/cmd/get/artifacts/artifacts.go): binaries for the
+    binary runtime, image added for compose, --filter narrows."""
+    # no cluster: default component set
+    assert kwokctl_main(["get", "artifacts"]) == 0
+    out = capsys.readouterr().out
+    assert "kwok_tpu.cmd.apiserver" in out and "kwok_tpu.cmd.kwok" in out
+    # compose runtime adds the base image
+    assert kwokctl_main(
+        ["get", "artifacts", "--runtime", "compose/docker"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "python:3.12-slim" in out and "kwok_tpu.cmd.scheduler" in out
+    assert kwokctl_main(
+        ["get", "artifacts", "--runtime", "compose/docker", "--filter", "image"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert out.strip() == "python:3.12-slim"
+    # existing cluster: artifacts come from its installed components
+    # (install only — no need to boot the processes to list artifacts)
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    BinaryRuntime("arts").install()
+    assert kwokctl_main(["--name", "arts", "get", "artifacts"]) == 0
+    out = capsys.readouterr().out
+    assert "kwok_tpu.cmd.apiserver" in out
+    BinaryRuntime("arts").uninstall()

@@ -421,3 +421,39 @@ def test_started_containers_metric(server):
     status, data = get(port, "/metrics/nodes/node-0/metrics/starts")
     assert status == 200
     assert b"kubelet_started_containers_total 5" in data
+
+
+def test_debug_profile_samples_all_threads(server):
+    """/debug/pprof/profile?seconds=N (reference profiling.go:26): a
+    real sampling CPU profile across threads, collapsed-stack format."""
+    _, port = server
+    stop = threading.Event()
+
+    def spin():
+        # a busy thread with a recognizable frame name
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=spin, name="spinner", daemon=True)
+    t.start()
+    try:
+        status, data = get(port, "/debug/pprof/profile?seconds=0.4")
+    finally:
+        stop.set()
+        t.join()
+    assert status == 200
+    text = data.decode()
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    assert lines, "empty profile"
+    # collapsed format: frame;frame;... count
+    head, n = lines[0].rsplit(" ", 1)
+    assert int(n) >= 1 and (";" in head or ":" in head)
+    assert "spin" in text  # the busy thread was sampled
+    # on-CPU filter: the server's parked accept loop must not appear
+    assert "serve_forever" not in text
+
+
+def test_debug_pprof_goroutine_alias(server):
+    _, port = server
+    status, data = get(port, "/debug/pprof/goroutine")
+    assert status == 200 and b"--- thread" in data
