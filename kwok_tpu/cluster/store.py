@@ -578,7 +578,11 @@ class ResourceStore:
     # --------------------------------------------------------------------- CRUD
 
     def create(
-        self, obj: dict, namespace: Optional[str] = None, as_user: Optional[str] = None
+        self,
+        obj: dict,
+        namespace: Optional[str] = None,
+        as_user: Optional[str] = None,
+        copy_result: bool = True,
     ) -> dict:
         obj = copy_json(obj)
         kind = obj.get("kind") or ""
@@ -604,7 +608,7 @@ class ResourceStore:
             st.objects[key] = obj
             self._index_update(st, key, None, obj)
             self._emit(st, ADDED, obj, rv)
-            return copy_json(obj)
+            return obj if not copy_result else copy_json(obj)
 
     def get(self, kind: str, name: str, namespace: Optional[str] = None) -> dict:
         with self._mut:
@@ -794,6 +798,7 @@ class ResourceStore:
         subresource: str = "",
         as_user: Optional[str] = None,
         expect: Optional[Dict[str, Any]] = None,
+        copy_result: bool = True,
     ) -> dict:
         with self._mut:
             st = self._state(kind)
@@ -817,8 +822,14 @@ class ResourceStore:
                         )
             new = apply_patch(cur, data, patch_type, kind=st.rtype.kind)
             if subresource:
-                # subresource patches may only change that one field
-                scoped = copy_json(cur)
+                # subresource patches may only change that one field.
+                # Shallow rebase: untouched subtrees are SHARED with the
+                # stored instance (handed-out-by-reference contract —
+                # apply_merge_patch itself already shares unchanged
+                # children); metadata is fresh because _bump writes into
+                # it and history/caches hold the old instance.
+                scoped = dict(cur)
+                scoped["metadata"] = dict(cur["metadata"])
                 scoped[subresource] = new.get(subresource)
                 new = scoped
             else:
@@ -836,7 +847,7 @@ class ResourceStore:
                 if cur["metadata"].get("deletionTimestamp") is not None:
                     new["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
             self._audit.append(("patch", f"{kind}:{key}", as_user))
-            return self._store_mutation(st, key, new)
+            return self._store_mutation(st, key, new, copy_result=copy_result)
 
     def apply(
         self,
@@ -967,9 +978,21 @@ class ResourceStore:
             self._audit.append(("apply", f"{kind}:{key}", as_user))
             return self._store_mutation(st, key, new), False
 
-    def _store_mutation(self, st: _TypeState, key: Tuple[str, str], new: dict) -> dict:
+    def _store_mutation(
+        self,
+        st: _TypeState,
+        key: Tuple[str, str],
+        new: dict,
+        copy_result: bool = True,
+    ) -> dict:
         """Commit an updated object; reap it if it is terminating with no
-        finalizers left (the apiserver's finalizer GC)."""
+        finalizers left (the apiserver's finalizer GC).
+
+        ``copy_result=False`` returns the stored instance itself (the
+        handed-out-by-reference contract: treat as immutable) — the
+        device drain's bulk path adopts results into its row mirrors,
+        where the instance is exactly what the fused commit wants and
+        a 1M-row create wave spends most of its time deep-copying."""
         meta = new.setdefault("metadata", {})
         old = st.objects.get(key)
         if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
@@ -977,12 +1000,12 @@ class ResourceStore:
             del st.objects[key]
             self._index_update(st, key, old, None)
             self._emit(st, DELETED, new, rv)
-            return copy_json(new)
+            return new if not copy_result else copy_json(new)
         rv = self._bump(new)
         st.objects[key] = new
         self._index_update(st, key, old, new)
         self._emit(st, MODIFIED, new, rv)
-        return copy_json(new)
+        return new if not copy_result else copy_json(new)
 
     def delete(
         self,
@@ -990,6 +1013,7 @@ class ResourceStore:
         name: str,
         namespace: Optional[str] = None,
         as_user: Optional[str] = None,
+        copy_result: bool = True,
     ) -> Optional[dict]:
         """Graceful delete: objects holding finalizers get a
         deletionTimestamp and live on until the finalizers clear."""
@@ -1012,7 +1036,7 @@ class ResourceStore:
                     rv = self._bump(cur)
                     st.objects[key] = cur
                     self._emit(st, MODIFIED, cur, rv)
-                return copy_json(cur)
+                return cur if not copy_result else copy_json(cur)
             rv = self._bump(cur)
             del st.objects[key]
             self._index_update(st, key, cur, None)
@@ -1200,7 +1224,7 @@ class ResourceStore:
         per object) on instances it verified are the stored ones."""
         return _LaneGrant(self, kind, exclude)
 
-    def bulk(self, ops: List[dict]) -> List[dict]:
+    def bulk(self, ops: List[dict], copy_results: bool = True) -> List[dict]:
         """Apply many mutations in one call — the device backend's
         dirty-row drain (SURVEY §2.9: only dirty rows cross the
         device↔apiserver boundary; batching amortizes the per-op HTTP
@@ -1214,6 +1238,12 @@ class ResourceStore:
         Per-op failures do not abort the batch; results align with ops:
         ``{"status": "ok", "object": ...}`` (object None for a
         completed delete) or ``{"status": "error", "reason", "error"}``.
+
+        ``copy_results=False`` hands back stored instances (immutable
+        by contract) — the in-process drain adopts them into its row
+        mirrors, and deep-copying a 1M-row create wave was most of its
+        cost.  The HTTP facade keeps the default (it serializes results
+        outside the store lock).
         """
         results: List[dict] = []
         for op in ops:
@@ -1229,6 +1259,7 @@ class ResourceStore:
                         subresource=op.get("subresource", ""),
                         as_user=op.get("as_user"),
                         expect=op.get("expect"),
+                        copy_result=copy_results,
                     )
                 elif verb == "delete":
                     out = self.delete(
@@ -1236,12 +1267,14 @@ class ResourceStore:
                         op["name"],
                         namespace=op.get("namespace"),
                         as_user=op.get("as_user"),
+                        copy_result=copy_results,
                     )
                 elif verb == "create":
                     out = self.create(
                         op["data"],
                         namespace=op.get("namespace"),
                         as_user=op.get("as_user"),
+                        copy_result=copy_results,
                     )
                 else:
                     raise ValueError(f"unknown bulk verb {verb!r}")

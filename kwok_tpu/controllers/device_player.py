@@ -167,6 +167,21 @@ class DeviceStagePlayer:
                 )
             except (TypeError, ValueError):
                 self._batch_has_exclude = False
+        # in-process stores hand back stored instances from bulk
+        # (immutable by contract): the slow-path drain adopts them into
+        # row mirrors, so skipping the deep copy of every result is the
+        # create wave's single biggest win — and instance adoption is
+        # what re-arms the fused path's pointer-equality check
+        self._bulk_no_copy = False
+        if hasattr(store, "bulk"):
+            import inspect
+
+            try:
+                self._bulk_no_copy = (
+                    "copy_results" in inspect.signature(store.bulk).parameters
+                )
+            except (TypeError, ValueError):
+                self._bulk_no_copy = False
         #: row-indexed {stage_idx -> resolved sentinel values}
         #: (identity + env funcs; both row-stable) — dropped with the
         #: render cache on any identity change
@@ -492,6 +507,12 @@ class DeviceStagePlayer:
     def _drain_stages(self, stages_np: np.ndarray, t0_ms: int, dt: int) -> int:
         fired_total = 0
         t_start = time.perf_counter()
+        # shared grace anchor for the abort checks at every granularity
+        # (sub-tick here, group/chunk in _drain_tick, rows in
+        # _drain_slow): a stop() during a SMALL flush must still
+        # complete it (stop's contract: the in-flight batch is not
+        # stranded), while a huge drain aborts within ~a second
+        self._drain_t0 = t_start
         for k in range(stages_np.shape[0]):
             if self._done.is_set() and time.perf_counter() - t_start > 1.0:
                 # shutdown mid-macro-tick: small flushes complete, but a
@@ -590,6 +611,9 @@ class DeviceStagePlayer:
             self._plans[key] = plan
         return plan
 
+    def _past_abort_grace(self) -> bool:
+        return time.perf_counter() - getattr(self, "_drain_t0", 0.0) > 1.0
+
     def _drain_tick(self, rows: np.ndarray, st: np.ndarray, t_ms: int) -> None:
         """Drain one sub-tick's fired rows: fast rows through the
         columnar status batch, the rest through the legacy group path.
@@ -651,7 +675,7 @@ class DeviceStagePlayer:
         with self._mut:
             i = 0
             while i < n:
-                if self._done.is_set():
+                if self._done.is_set() and self._past_abort_grace():
                     # shutdown mid-sub-tick: stop between (stage, sig)
                     # groups; committed chunks stand, the rest re-fires
                     # after a restart
@@ -704,7 +728,7 @@ class DeviceStagePlayer:
                         and plan.all_top_plain
                     )
                     for k in range(0, len(group), chunk or len(group)):
-                        if self._done.is_set() and k:
+                        if k and self._done.is_set() and self._past_abort_grace():
                             break
                         sub = group[k : k + chunk] if chunk else group
                         if fused_ok and self._fused_chunk(
@@ -986,7 +1010,11 @@ class DeviceStagePlayer:
         can_bulk = hasattr(self.store, "bulk")
         groups: List[Tuple[Tuple[str, str], List[dict]]] = []
         for j, tr in enumerate(transitions):
-            if self._done.is_set() and (j & 0xFF) == 0xFF:
+            if (
+                (j & 0xFF) == 0xFF
+                and self._done.is_set()
+                and self._past_abort_grace()
+            ):
                 break  # shutdown: unplayed transitions re-fire on restart
             try:
                 g = self._collect_ops(tr) if can_bulk else None
@@ -1008,7 +1036,10 @@ class DeviceStagePlayer:
             ]
             tb = time.perf_counter()
             try:
-                results = self.store.bulk(flat)
+                if self._bulk_no_copy:
+                    results = self.store.bulk(flat, copy_results=False)
+                else:
+                    results = self.store.bulk(flat)
             except Exception:  # noqa: BLE001 — drop to per-op on bulk failure
                 results = None
             t_store_this = time.perf_counter() - tb

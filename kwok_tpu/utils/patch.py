@@ -117,13 +117,18 @@ def list_meta(kind: Optional[str], path: tuple, field_name: str):
 
 
 def apply_json_patch(obj: Any, ops: List[Dict[str, Any]]) -> Any:
-    """Apply an RFC 6902 patch (add/remove/replace subset)."""
-    out = _copy_json(obj)
+    """Apply an RFC 6902 patch (add/remove/replace subset).
+
+    Copy-on-write along each op's path only: untouched subtrees are
+    SHARED with the input (the store's handed-out-by-reference contract
+    makes inputs immutable; deep-copying a whole 60-node pod to flip
+    one finalizer list was a top cost of the 1M-row create wave)."""
+    out = _shallow(obj)
     for op in ops:
         path = op["path"]
         parts = [p.replace("~1", "/").replace("~0", "~") for p in path.split("/")[1:]]
         action = op["op"]
-        parent, last = _traverse(out, parts)
+        parent, last = _traverse_cow(out, parts)
         if action == "add":
             value = _copy_json(op["value"])
             if isinstance(parent, list):
@@ -158,6 +163,31 @@ def _traverse(obj: Any, parts: List[str]):
             cur = cur[int(p)]
         else:
             cur = cur[p]
+    return cur, parts[-1]
+
+
+def _shallow(x: Any) -> Any:
+    if isinstance(x, dict):
+        return dict(x)
+    if isinstance(x, list):
+        return list(x)
+    return x
+
+
+def _traverse_cow(obj: Any, parts: List[str]):
+    """Like _traverse, but shallow-copies each container on the walk
+    and re-links it into the (already copied) parent, so mutating the
+    returned parent never touches the original's subtrees."""
+    cur = obj
+    for p in parts[:-1]:
+        if isinstance(cur, list):
+            i = int(p)
+            child = _shallow(cur[i])
+            cur[i] = child
+        else:
+            child = _shallow(cur[p])
+            cur[p] = child
+        cur = child
     return cur, parts[-1]
 
 

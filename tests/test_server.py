@@ -449,8 +449,13 @@ def test_debug_profile_samples_all_threads(server):
     head, n = lines[0].rsplit(" ", 1)
     assert int(n) >= 1 and (";" in head or ":" in head)
     assert "spin" in text  # the busy thread was sampled
-    # on-CPU filter: the server's parked accept loop must not appear
-    assert "serve_forever" not in text
+    # on-CPU filter: the server's parked accept loop must not appear —
+    # only assertable where the per-thread CPU accounting exists (the
+    # profiler's documented wall-clock fallback samples parked threads)
+    import os as _os
+
+    if _os.path.exists("/proc/self/task"):
+        assert "serve_forever" not in text
 
 
 def test_debug_pprof_goroutine_alias(server):
