@@ -1,0 +1,305 @@
+"""Reference-format etcd snapshot import (VERDICT r04 next-#9).
+
+A stock kwok cluster's ``kwokctl snapshot save`` is an *etcd* snapshot:
+a bbolt database file written by ``etcdctl snapshot save`` (reference
+pkg/kwokctl/runtime/binary/cluster_snapshot.go:28-36), whose ``key``
+bucket holds the MVCC keyspace — revision-ordered entries of protobuf
+``mvccpb.KeyValue`` records pointing at ``/registry/...`` storage
+values.  Each storage value is either JSON (``{``-prefixed) or the
+``k8s\\x00`` protobuf envelope (``runtime.Unknown``), mirroring
+reference pkg/kwokctl/etcd/etcd.go:31-117 (DetectMediaType/Convert).
+
+This module reads that container format natively:
+
+- a read-only bbolt page walker (meta page validation, highest-txid
+  meta wins, branch/leaf traversal, nested + inline buckets),
+- an MVCC decoder (latest revision-key wins — etcd's own big-endian
+  sort order; tombstoned keys dropped, the same collapse etcd's own
+  compaction performs),
+- storage-value decoding: JSON objects fully; protobuf storage values
+  have their ``runtime.Unknown`` envelope parsed so the object's
+  apiVersion/kind can be reported, but the inner per-kind protobuf is
+  not decoded (the reference links the whole k8s scheme for that,
+  etcd/scheme.go) — those objects are surfaced in ``skipped`` with
+  actionable identity rather than silently lost.
+
+``load_etcd_snapshot(path)`` returns ``(objects, skipped)`` where
+``objects`` are JSON-shaped k8s objects ready for the store and
+``skipped`` is ``[(registry_key, apiVersion, kind), ...]``.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional, Tuple
+
+BOLT_MAGIC = 0xED0CDAED
+_PAGE_HEADER = 16  # id(8) flags(2) count(2) overflow(4)
+_BRANCH_FLAG = 0x01
+_LEAF_FLAG = 0x02
+_META_FLAG = 0x04
+_BUCKET_LEAF = 0x01  # leaf element flags: value is a sub-bucket
+_PROTO_PREFIX = b"k8s\x00"
+
+
+class EtcdSnapshotError(ValueError):
+    """Not a readable bolt/etcd snapshot."""
+
+
+class _Bolt:
+    """Minimal read-only bbolt reader."""
+
+    def __init__(self, data: bytes):
+        if len(data) < 0x2000:
+            raise EtcdSnapshotError("file too small for a bolt database")
+        # meta 0 sits at offset 0 regardless of page size; meta 1 sits
+        # one page in, so probe its offset with the page size meta 0
+        # declares (falling back to common sizes when meta 0 is gone)
+        metas = []
+        m0 = self._read_meta(data, 0)
+        if m0 is not None:
+            metas.append(m0)
+        sizes = [m0["page_size"]] if m0 else [4096, 8192, 16384, 32768, 65536]
+        for ps in sizes:
+            m1 = self._read_meta(data, ps)
+            if m1 is not None:
+                metas.append(m1)
+                break
+        if not metas:
+            raise EtcdSnapshotError("no valid bolt meta page (bad magic)")
+        meta = max(metas, key=lambda m: m["txid"])
+        self.data = data
+        self.page_size = meta["page_size"]
+        self.root_pgid = meta["root_pgid"]
+
+    @staticmethod
+    def _read_meta(data: bytes, off: int):
+        if off + 80 > len(data):
+            return None
+        base = off + _PAGE_HEADER
+        magic, _version, psize = struct.unpack_from("<IIi", data, base)
+        if magic != BOLT_MAGIC:
+            return None
+        if psize <= 0 or psize & (psize - 1):
+            return None  # page size must be a positive power of two
+        # meta layout after magic/version/pageSize/flags: root bucket
+        # (root pgid u64 + sequence u64) at +16, freelist u64 at +32,
+        # high-water pgid u64 at +40, txid u64 at +48
+        (root_pgid, _root_seq) = struct.unpack_from("<QQ", data, base + 16)
+        (txid,) = struct.unpack_from("<Q", data, base + 48)
+        return {"page_size": psize, "root_pgid": root_pgid, "txid": txid}
+
+    def _page(self, pgid: int) -> Tuple[int, int, int, int]:
+        """(offset, flags, count, overflow) of a page."""
+        off = pgid * self.page_size
+        if off + _PAGE_HEADER > len(self.data):
+            raise EtcdSnapshotError(f"page {pgid} out of range")
+        _pid, flags, count, overflow = struct.unpack_from(
+            "<QHHI", self.data, off
+        )
+        return off, flags, count, overflow
+
+    def _walk(self, pgid: int, out: List[Tuple[bytes, bytes, int]]) -> None:
+        """Collect (key, value, leaf_flags) under a page (branch or leaf)."""
+        off, flags, count, _ = self._page(pgid)
+        base = off + _PAGE_HEADER
+        if flags & _LEAF_FLAG:
+            for i in range(count):
+                ebase = base + i * 16
+                eflags, pos, ksize, vsize = struct.unpack_from(
+                    "<IIII", self.data, ebase
+                )
+                kstart = ebase + pos
+                key = self.data[kstart : kstart + ksize]
+                val = self.data[kstart + ksize : kstart + ksize + vsize]
+                out.append((key, val, eflags))
+        elif flags & _BRANCH_FLAG:
+            for i in range(count):
+                ebase = base + i * 16
+                _pos, _ksize, child = struct.unpack_from(
+                    "<IIQ", self.data, ebase
+                )
+                self._walk(child, out)
+        else:
+            raise EtcdSnapshotError(f"page {pgid} is neither branch nor leaf")
+
+    def _bucket_items(
+        self, root_pgid: int, inline: Optional[bytes] = None
+    ) -> List[Tuple[bytes, bytes, int]]:
+        out: List[Tuple[bytes, bytes, int]] = []
+        if root_pgid == 0 and inline is not None:
+            # inline bucket: a fake page lives right after the 16-byte
+            # bucket header inside the parent's value bytes
+            data = inline
+            _pid, flags, count, _ov = struct.unpack_from("<QHHI", data, 0)
+            base = _PAGE_HEADER
+            if not flags & _LEAF_FLAG:
+                raise EtcdSnapshotError("inline bucket with non-leaf page")
+            for i in range(count):
+                ebase = base + i * 16
+                eflags, pos, ksize, vsize = struct.unpack_from(
+                    "<IIII", data, ebase
+                )
+                kstart = ebase + pos
+                out.append(
+                    (
+                        data[kstart : kstart + ksize],
+                        data[kstart + ksize : kstart + ksize + vsize],
+                        eflags,
+                    )
+                )
+            return out
+        self._walk(root_pgid, out)
+        return out
+
+    def bucket(self, name: bytes) -> List[Tuple[bytes, bytes]]:
+        """All (key, value) pairs in a top-level bucket ([] if absent)."""
+        try:
+            for key, val, eflags in self._bucket_items(self.root_pgid):
+                if key != name or not eflags & _BUCKET_LEAF:
+                    continue
+                if len(val) < 16:
+                    raise EtcdSnapshotError("truncated bucket header")
+                root = struct.unpack_from("<Q", val, 0)[0]
+                items = self._bucket_items(
+                    root, inline=val[16:] if root == 0 else None
+                )
+                return [(k, v) for k, v, _f in items]
+        except (struct.error, IndexError) as exc:
+            raise EtcdSnapshotError(f"corrupt bolt data: {exc}") from exc
+        return []
+
+
+def _varint(data: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = data[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _proto_fields(data: bytes) -> Dict[int, list]:
+    """Flat protobuf field map: number -> [values] (varints as int,
+    length-delimited as bytes; fixed64/32 as raw bytes)."""
+    out: Dict[int, list] = {}
+    i = 0
+    n = len(data)
+    while i < n:
+        tag, i = _varint(data, i)
+        field, wire = tag >> 3, tag & 0x7
+        if wire == 0:
+            v, i = _varint(data, i)
+        elif wire == 2:
+            ln, i = _varint(data, i)
+            v = data[i : i + ln]
+            i += ln
+        elif wire == 1:
+            v = data[i : i + 8]
+            i += 8
+        elif wire == 5:
+            v = data[i : i + 4]
+            i += 4
+        else:
+            raise EtcdSnapshotError(f"unsupported protobuf wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _decode_mvcc_kv(value: bytes) -> Tuple[bytes, int, bytes]:
+    """mvccpb.KeyValue: (key, mod_revision, value)."""
+    f = _proto_fields(value)
+    key = f.get(1, [b""])[0]
+    mod = f.get(3, [0])[0]
+    val = f.get(5, [b""])[0]
+    return key, int(mod), val
+
+
+def decode_unknown_envelope(value: bytes) -> Tuple[str, str, bytes]:
+    """Parse the ``k8s\\x00`` runtime.Unknown envelope: returns
+    (apiVersion, kind, raw) — reference etcd.go:187-210 decodeUnknown."""
+    if not value.startswith(_PROTO_PREFIX):
+        raise EtcdSnapshotError("not a k8s protobuf storage value")
+    f = _proto_fields(value[len(_PROTO_PREFIX) :])
+    api_version = kind = ""
+    tm = f.get(1, [b""])[0]
+    if isinstance(tm, bytes) and tm:
+        tf = _proto_fields(tm)
+        api_version = (tf.get(1, [b""])[0] or b"").decode("utf-8", "replace")
+        kind = (tf.get(2, [b""])[0] or b"").decode("utf-8", "replace")
+    raw = f.get(2, [b""])[0]
+    return api_version, kind, raw if isinstance(raw, bytes) else b""
+
+
+def latest_registry_values(db: "_Bolt") -> Dict[bytes, bytes]:
+    """Collapse the MVCC ``key`` bucket to the latest live value per
+    registry key.
+
+    Ordering uses the BUCKET KEY (big-endian revision bytes — etcd's
+    own sort order), NOT the decoded mod_revision: etcd's delete path
+    stores tombstones as ``mvccpb.KeyValue{Key: key}`` with
+    ModRevision unset, so a tombstone would never win a
+    mod_revision-ordered merge and deleted objects would resurrect.
+    A tombstone is exactly the 17-byte revision key (8B main + '_' +
+    8B sub) plus a trailing ``t`` — suffix alone would misread a live
+    record whose sub-revision's low byte is 0x74."""
+    latest: Dict[bytes, Tuple[bytes, Optional[bytes]]] = {}
+    for rev_key, value in db.bucket(b"key"):
+        tombstone = len(rev_key) == 18 and rev_key.endswith(b"t")
+        rev = rev_key[:17]
+        try:
+            ukey, _mod, uval = _decode_mvcc_kv(value)
+        except (EtcdSnapshotError, IndexError, struct.error):
+            if not tombstone:
+                raise EtcdSnapshotError("undecodable mvcc record")
+            continue  # tombstone records may hold only the key
+        if not ukey:
+            continue
+        cur = latest.get(ukey)
+        if cur is None or rev >= cur[0]:
+            latest[ukey] = (rev, None if tombstone else uval)
+    return {k: v for k, (_r, v) in latest.items() if v is not None}
+
+
+def load_etcd_snapshot(
+    path: Optional[str] = None,
+    data: Optional[bytes] = None,
+) -> Tuple[List[dict], List[Tuple[str, str, str]]]:
+    """Read a reference-format etcd snapshot (``path`` or already-read
+    ``data`` bytes); returns ``(objects, skipped)``.  JSON storage
+    values load fully; protobuf storage values are identified via
+    their envelope and reported in ``skipped`` (decoding arbitrary
+    per-kind k8s protobuf needs the full scheme the reference links,
+    etcd/scheme.go)."""
+    if data is None:
+        with open(path, "rb") as f:
+            data = f.read()
+    db = _Bolt(data)
+    objects: List[dict] = []
+    skipped: List[Tuple[str, str, str]] = []
+    for key, value in sorted(latest_registry_values(db).items()):
+        ks = key.decode("utf-8", "replace")
+        if not ks.startswith("/registry"):
+            continue
+        if value.startswith(_PROTO_PREFIX):
+            try:
+                api_version, kind, _raw = decode_unknown_envelope(value)
+            except EtcdSnapshotError:
+                api_version = kind = "?"
+            skipped.append((ks, api_version, kind))
+            continue
+        if not value.startswith(b"{"):
+            skipped.append((ks, "?", "?"))
+            continue
+        try:
+            obj = json.loads(value)
+        except ValueError:
+            skipped.append((ks, "?", "?"))
+            continue
+        if isinstance(obj, dict) and obj.get("kind"):
+            objects.append(obj)
+    return objects, skipped
