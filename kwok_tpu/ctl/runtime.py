@@ -244,6 +244,10 @@ class BinaryRuntime:
             f.write(str(proc.pid))
 
     def stop_component(self, name: str, timeout: float = 10.0) -> None:
+        self._signal_component(name)
+        self._await_component_exit(name, timeout)
+
+    def _signal_component(self, name: str) -> None:
         if dry_run.enabled:
             dry_run.emit(f"kill {name}")
             return
@@ -253,7 +257,12 @@ class BinaryRuntime:
         try:
             os.kill(pid, signal.SIGTERM)
         except OSError:
+            pass
+
+    def _await_component_exit(self, name: str, timeout: float = 10.0) -> None:
+        if dry_run.enabled:
             return
+        pid = self._pid(name)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if not self._alive(pid):
@@ -310,10 +319,14 @@ class BinaryRuntime:
             return
         if not os.path.isdir(self._path("pids")):
             return
-        # reverse dependency order
+        # reverse dependency order; signal everything first so slow
+        # shutdowns overlap (total wait ~= slowest component, not the
+        # sum — a loaded box was paying 4x10s sequentially)
         comps = self.load_components() if self.exists() else []
         for comp in reversed(comps):
-            self.stop_component(comp.name)
+            self._signal_component(comp.name)
+        for comp in reversed(comps):
+            self._await_component_exit(comp.name)
 
     def running_components(self) -> Dict[str, bool]:
         out = {}

@@ -60,6 +60,7 @@ from kwok_tpu.metrics.collectors import Gauge, Registry
 from kwok_tpu.metrics.evaluator import MetricsUpdateHandler
 from kwok_tpu.metrics.usage import UsageEvaluator
 from kwok_tpu.server.router import Router
+from kwok_tpu.server import spdy as spdy_mod
 from kwok_tpu.server.websocket import (
     CHAN_ERROR,
     CHAN_STDERR,
@@ -526,6 +527,9 @@ class Server:
         if ws_is_upgrade(req.headers):
             self._attach_ws(req, entry.logs_file)
             return
+        if spdy_mod.is_spdy_upgrade(req.headers):
+            self._attach_spdy(req, entry.logs_file)
+            return
         with open(entry.logs_file, "rb") as f:
             req.reply(200, f.read())
 
@@ -537,6 +541,25 @@ class Server:
             return
         ws, _proto = accepted
         req.started = True
+        self._attach_stream(req, logs_file, ws)
+
+    def _attach_spdy(self, req: "_Request", logs_file: str) -> None:
+        """kubectl attach over SPDY/3.1 (reference debugging_attach.go
+        — the same remotecommand upgrade family as exec)."""
+        accepted = spdy_mod.accept_upgrade(
+            req.handler, spdy_mod.REMOTE_COMMAND_PROTOCOLS
+        )
+        if accepted is None:
+            return
+        session, _proto = accepted
+        req.started = True
+        expect = ["error", "stdout"]
+        if _ws_flag(req.query, "input", "stdin"):
+            expect.append("stdin")
+        adapter = spdy_mod.SpdyChannelAdapter(session, expect)
+        self._attach_stream(req, logs_file, adapter)
+
+    def _attach_stream(self, req: "_Request", logs_file: str, ws) -> None:
         detached = threading.Event()
 
         def watch_client():
@@ -607,6 +630,9 @@ class Server:
         if ws_is_upgrade(req.headers):
             self._exec_ws(req, cmd, kwargs)
             return
+        if spdy_mod.is_spdy_upgrade(req.headers):
+            self._exec_spdy(req, cmd, kwargs)
+            return
         stdin_data = req.body if req.body else None
         if stdin_data is not None:
             kwargs["stdin"] = subprocess.PIPE
@@ -630,6 +656,35 @@ class Server:
             return
         ws, proto = accepted
         req.started = True
+        self._exec_stream(req, cmd, kwargs, ws, proto)
+
+    def _exec_spdy(self, req: "_Request", cmd: List[str], kwargs: Dict[str, Any]) -> None:
+        """The same exec over an SPDY/3.1 upgrade (reference
+        debugging_exec.go:148-165 — remotecommand.ServeExec negotiates
+        SPDY alongside WebSocket; kubectl ≤1.28 and client-go default
+        here).  The client opens one stream per channel; the adapter
+        presents them as WebSocket-style channel frames so the command
+        body below is shared, and stdin half-close arrives as the
+        close-channel frame (hence the v5 proto tag)."""
+        accepted = spdy_mod.accept_upgrade(
+            req.handler, spdy_mod.REMOTE_COMMAND_PROTOCOLS
+        )
+        if accepted is None:
+            return
+        session, _proto = accepted
+        req.started = True
+        expect = ["error", "stdout", "stderr"]
+        if _ws_flag(req.query, "input", "stdin"):
+            expect.append("stdin")
+        if _ws_flag(req.query, "tty"):
+            expect.append("resize")
+        adapter = spdy_mod.SpdyChannelAdapter(session, expect)
+        self._exec_stream(req, cmd, kwargs, adapter, "v5.channel.k8s.io")
+
+    def _exec_stream(self, req: "_Request", cmd, kwargs, ws, proto) -> None:
+        """Transport-agnostic exec body: ``ws`` is any object with the
+        channel duck-type (send_channel/recv/close) — the WebSocket
+        connection or the SPDY adapter."""
         want_stdin = _ws_flag(req.query, "input", "stdin")
         if want_stdin:
             kwargs["stdin"] = subprocess.PIPE
@@ -729,6 +784,9 @@ class Server:
         if ws_is_upgrade(req.headers):
             self._port_forward_ws(req, rule)
             return
+        if spdy_mod.is_spdy_upgrade(req.headers):
+            self._port_forward_spdy(req, rule)
+            return
         port_q = req.query.get("port")
         port = int(port_q[0]) if port_q else 0
         fwd = rule.find(port) if rule is not None else None
@@ -774,6 +832,106 @@ class Server:
             req.reply(502, f"dial failed: {exc}")
             return
         req.reply(200, b"".join(chunks))
+
+    def _port_forward_spdy(self, req: "_Request", rule) -> None:
+        """kubectl port-forward over SPDY/3.1 (reference
+        debugging_port_forword.go:39-85 via the kubelet portforward
+        package): per forwarded connection the client opens a
+        data/error stream PAIR sharing ``port`` + ``requestID``
+        headers; data pumps bidirectionally, the error stream reports
+        dial failures (empty close = success)."""
+        accepted = spdy_mod.accept_upgrade(
+            req.handler, spdy_mod.PORT_FORWARD_PROTOCOLS
+        )
+        if accepted is None:
+            return
+        session, _proto = accepted
+        req.started = True
+        error_streams: Dict[str, Any] = {}
+        threads: List[threading.Thread] = []
+        try:
+            while True:
+                st = session.accept_stream(timeout=30.0)
+                if st is None:
+                    if session.closed:
+                        break
+                    continue  # idle: kubectl waits for local connections
+                stype = st.stream_type
+                rid = st.headers.get("requestid", "")
+                try:
+                    port = int(st.headers.get("port") or 0)
+                except ValueError:
+                    port = 0
+                if stype == "error":
+                    error_streams[rid] = st
+                    continue
+                if stype != "data":
+                    st.close()
+                    continue
+                threads = [t for t in threads if t.is_alive()]
+                fwd = rule.find(port) if rule is not None else None
+                err_st = error_streams.pop(rid, None)
+                if fwd is None or fwd.target is None:
+                    if err_st is not None:
+                        err_st.write(
+                            f"no port forward found for port {port}".encode()
+                        )
+                        err_st.close()
+                    st.close()
+                    continue
+                try:
+                    sock = socket.create_connection(
+                        (fwd.target.address, fwd.target.port), timeout=10
+                    )
+                except OSError as exc:
+                    if err_st is not None:
+                        err_st.write(f"dial failed: {exc}".encode())
+                        err_st.close()
+                    st.close()
+                    continue
+
+                def serve(st=st, err_st=err_st, sock=sock):
+                    def to_client():
+                        try:
+                            while True:
+                                chunk = sock.recv(65536)
+                                if not chunk:
+                                    break
+                                if not st.write(chunk):
+                                    break
+                        except OSError:
+                            pass
+                        st.close()
+
+                    t = threading.Thread(target=to_client, daemon=True)
+                    t.start()
+                    try:
+                        while True:
+                            data = st.read()
+                            if data is None:
+                                break
+                            sock.sendall(data)
+                    except OSError:
+                        pass
+                    try:
+                        sock.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    t.join(timeout=10)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    if err_st is not None:
+                        err_st.close()  # empty error stream = success
+
+                t = threading.Thread(target=serve, daemon=True)
+                t.start()
+                threads.append(t)
+        finally:
+            for t in threads:
+                t.join(timeout=10)
+            session.close()
 
     def _port_forward_ws(self, req: "_Request", rule) -> None:
         """kubectl port-forward over WebSocket (portforward.k8s.io

@@ -1,0 +1,88 @@
+"""SPDY/3.1 client for the kubelet streaming endpoints — the test and
+tooling counterpart of ``kwok_tpu.server.spdy`` (what client-go's
+``spdy.RoundTripper`` + remotecommand do for kubectl ≤1.28; reference
+serves it via debugging_exec.go:148-165).
+
+``connect()`` performs the HTTP Upgrade handshake and returns the
+framed session; the kubelet conventions are then one ``open_stream``
+per channel with a ``streamType`` header (exec/attach) or
+``data``/``error`` pairs keyed by ``port``/``requestID``
+(port-forward).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+from kwok_tpu.server.spdy import SpdySession
+
+
+class SpdyUpgradeError(ConnectionError):
+    """The server did not complete the SPDY/3.1 upgrade."""
+
+
+def connect(
+    url: str,
+    protocols: Tuple[str, ...] = ("v4.channel.k8s.io",),
+    timeout: float = 10.0,
+    method: str = "POST",
+    headers: Optional[Dict[str, str]] = None,
+) -> Tuple[SpdySession, str]:
+    """Upgrade ``url`` (http://host:port/path?query) to an SPDY/3.1
+    session; returns (session, negotiated_protocol)."""
+    parts = urlsplit(url)
+    host = parts.hostname or "127.0.0.1"
+    port = parts.port or 80
+    path = parts.path + (f"?{parts.query}" if parts.query else "")
+    sock = socket.create_connection((host, port), timeout=timeout)
+    req = [
+        f"{method} {path} HTTP/1.1",
+        f"Host: {host}:{port}",
+        "Connection: Upgrade",
+        "Upgrade: SPDY/3.1",
+    ]
+    if protocols:
+        req.append(f"X-Stream-Protocol-Version: {', '.join(protocols)}")
+    for k, v in (headers or {}).items():
+        req.append(f"{k}: {v}")
+    req.append("Content-Length: 0")
+    sock.sendall(("\r\n".join(req) + "\r\n\r\n").encode())
+
+    # read the 101 response head
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            sock.close()
+            raise SpdyUpgradeError("connection closed during upgrade")
+        buf += chunk
+        if len(buf) > 65536:
+            sock.close()
+            raise SpdyUpgradeError("oversized upgrade response")
+    head, rest = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode("latin-1").split("\r\n")
+    status = lines[0].split(" ", 2)
+    if len(status) < 2 or status[1] != "101":
+        sock.close()
+        raise SpdyUpgradeError(f"upgrade refused: {lines[0]}")
+    resp_headers = {}
+    for ln in lines[1:]:
+        if ":" in ln:
+            k, v = ln.split(":", 1)
+            resp_headers[k.strip().lower()] = v.strip()
+    chosen = resp_headers.get("x-stream-protocol-version", "")
+    # the handshake timeout must not apply to the framed session: the
+    # reader treats a socket timeout as connection death, and streams
+    # legitimately sit silent (a command producing no output)
+    sock.settimeout(None)
+    session = SpdySession(sock, client=True)
+    if rest:
+        # frames that arrived glued to the 101: hand them to the reader
+        # by replaying through a shim — in practice servers never write
+        # before the client opens a stream, so reject loudly instead of
+        # silently dropping bytes
+        session.close()
+        raise SpdyUpgradeError("unexpected data before first stream")
+    return session, chosen

@@ -1,0 +1,232 @@
+"""SPDY/3.1 streaming conformance against the fake-kubelet server
+(reference pkg/kwok/server/debugging_exec.go:148-165 serves SPDY
+alongside WebSocket via remotecommand.ServeExec; kubectl ≤1.28 and
+client-go default to SPDY).  The client side is
+kwok_tpu/utils/spdyclient.py — real frames over a real socket, zlib
+header blocks, flow-control credits: the frame-level conformance
+vector VERDICT r04 next-#5 asks for."""
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+from kwok_tpu.api.extra_types import from_document
+from kwok_tpu.server import Server, ServerConfig
+from kwok_tpu.utils import spdyclient
+
+PODS = [
+    {
+        "metadata": {"name": "pod-0", "namespace": "default", "annotations": {}},
+        "spec": {"nodeName": "node-0", "containers": [{"name": "app"}]},
+        "status": {"phase": "Running"},
+    },
+]
+
+
+@pytest.fixture()
+def server(tmp_path):
+    logf = tmp_path / "pod.log"
+    logf.write_text("spdy attach line\n")
+    cfg = ServerConfig(
+        get_node=lambda n: None,
+        get_pod=lambda ns, n: next(
+            (p for p in PODS if p["metadata"]["name"] == n), None
+        ),
+        list_pods=lambda node: PODS,
+        list_nodes=lambda: ["node-0"],
+    )
+    srv = Server(cfg)
+    srv.set_configs(
+        [
+            from_document(
+                {
+                    "kind": "ClusterExec",
+                    "metadata": {"name": "all"},
+                    "spec": {"execs": [{"local": {}}]},
+                }
+            ),
+            from_document(
+                {
+                    "kind": "ClusterAttach",
+                    "metadata": {"name": "all"},
+                    "spec": {"attaches": [{"logsFile": str(logf)}]},
+                }
+            ),
+        ]
+    )
+    port = srv.serve(0)
+    yield srv, port
+    srv.close()
+
+
+def open_channels(session, *types):
+    out = {}
+    for t in types:
+        out[t] = session.open_stream({"streamType": t})
+    return out
+
+
+def read_all(stream, timeout=15.0):
+    chunks = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            data = stream.read(timeout=deadline - time.monotonic())
+        except TimeoutError:
+            break
+        if data is None:
+            break
+        chunks.append(data)
+    return b"".join(chunks)
+
+
+def test_spdy_exec_stdin_roundtrip(server):
+    _, port = server
+    url = (
+        f"http://127.0.0.1:{port}/exec/default/pod-0/app"
+        "?command=cat&stdin=true&stdout=true&stderr=true"
+    )
+    session, proto = spdyclient.connect(url)
+    assert proto == "v4.channel.k8s.io"
+    ch = open_channels(session, "error", "stdout", "stderr", "stdin")
+    ch["stdin"].write(b"ping through spdy\n")
+    ch["stdin"].close()  # half-close = stdin EOF (cat exits)
+    out = read_all(ch["stdout"])
+    assert out == b"ping through spdy\n"
+    status = json.loads(read_all(ch["error"]) or b"{}")
+    assert status.get("status") == "Success", status
+    session.close()
+
+
+def test_spdy_exec_failure_reports_exit_code(server):
+    _, port = server
+    url = (
+        f"http://127.0.0.1:{port}/exec/default/pod-0/app"
+        "?command=false&stdout=true&stderr=true"
+    )
+    session, _ = spdyclient.connect(url)
+    ch = open_channels(session, "error", "stdout", "stderr")
+    status = json.loads(read_all(ch["error"]) or b"{}")
+    assert status.get("status") == "Failure"
+    causes = (status.get("details") or {}).get("causes") or []
+    assert any(c.get("message") == "1" for c in causes), status
+    session.close()
+
+
+def test_spdy_protocol_negotiation_rejects_unknown(server):
+    _, port = server
+    url = (
+        f"http://127.0.0.1:{port}/exec/default/pod-0/app"
+        "?command=true&stdout=true"
+    )
+    with pytest.raises(spdyclient.SpdyUpgradeError):
+        spdyclient.connect(url, protocols=("v9.nope.k8s.io",))
+
+
+def test_spdy_attach_streams_log(server):
+    _, port = server
+    url = (
+        f"http://127.0.0.1:{port}/attach/default/pod-0/app?stdout=true"
+    )
+    session, _ = spdyclient.connect(url)
+    ch = open_channels(session, "error", "stdout")
+    deadline = time.monotonic() + 10
+    got = b""
+    while b"spdy attach line" not in got and time.monotonic() < deadline:
+        try:
+            data = ch["stdout"].read(timeout=1.0)
+        except TimeoutError:
+            continue
+        if data is None:
+            break
+        got += data
+    assert b"spdy attach line" in got
+    session.close()
+
+
+class _Echo(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+
+
+def test_spdy_port_forward_roundtrip(server, tmp_path):
+    srv, port = server
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            while True:
+                data = self.request.recv(65536)
+                if not data:
+                    break
+                self.request.sendall(b"echo:" + data)
+
+    echo = _Echo(("127.0.0.1", 0), Handler)
+    echo_port = echo.server_address[1]
+    threading.Thread(target=echo.serve_forever, daemon=True).start()
+    srv.set_configs(
+        [
+            from_document(
+                {
+                    "kind": "ClusterPortForward",
+                    "metadata": {"name": "all"},
+                    "spec": {
+                        "forwards": [
+                            {"target": {"address": "127.0.0.1", "port": echo_port}}
+                        ]
+                    },
+                }
+            )
+        ]
+    )
+    try:
+        url = f"http://127.0.0.1:{port}/portForward/default/pod-0"
+        session, proto = spdyclient.connect(
+            url, protocols=("portforward.k8s.io",)
+        )
+        assert proto == "portforward.k8s.io"
+        err = session.open_stream(
+            {"streamType": "error", "port": "9999", "requestID": "1"}
+        )
+        data = session.open_stream(
+            {"streamType": "data", "port": "9999", "requestID": "1"}
+        )
+        data.write(b"hello")
+        got = data.read(timeout=10.0)
+        assert got == b"echo:hello"
+        data.close()
+        # success = error stream closes empty
+        assert read_all(err, timeout=10.0) == b""
+        session.close()
+    finally:
+        echo.shutdown()
+        echo.server_close()
+
+
+def test_spdy_large_transfer_respects_flow_control(server):
+    """>64 KiB through one stream forces WINDOW_UPDATE exchange both
+    ways (the 64 KiB initial window would stall either side
+    otherwise)."""
+    _, port = server
+    url = (
+        f"http://127.0.0.1:{port}/exec/default/pod-0/app"
+        "?command=cat&stdin=true&stdout=true&stderr=true"
+    )
+    session, _ = spdyclient.connect(url)
+    ch = open_channels(session, "error", "stdout", "stderr", "stdin")
+    blob = bytes(range(256)) * 1024  # 256 KiB
+    collected = []
+
+    def drain():
+        collected.append(read_all(ch["stdout"], timeout=30.0))
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    ch["stdin"].write(blob)
+    ch["stdin"].close()
+    t.join(timeout=40)
+    assert not t.is_alive(), "stdout drain stalled (flow control deadlock?)"
+    assert b"".join(collected) == blob
+    session.close()
