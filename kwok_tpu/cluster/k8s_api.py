@@ -831,20 +831,29 @@ class K8sFacade:
                     idle += 0.25
                     if bookmarks and idle >= _BOOKMARK_EVERY:
                         idle = 0.0
+                        bm_meta = {
+                            "resourceVersion": str(
+                                self.store.resource_version
+                            )
+                        }
+                        if as_table:
+                            # a Table-negotiated watch must be
+                            # uniformly Table-typed: kubectl's table
+                            # decoder rejects mixed streams, so the
+                            # bookmark rides an EMPTY-row Table whose
+                            # metadata carries the resourceVersion —
+                            # what the real apiserver emits
+                            bm_obj = to_table(r.rtype.kind, [])
+                            bm_obj["metadata"] = bm_meta
+                        else:
+                            bm_obj = {
+                                "kind": r.rtype.kind,
+                                "apiVersion": r.rtype.api_version,
+                                "metadata": bm_meta,
+                            }
                         self._write_frame(
                             handler,
-                            {
-                                "type": "BOOKMARK",
-                                "object": {
-                                    "kind": r.rtype.kind,
-                                    "apiVersion": r.rtype.api_version,
-                                    "metadata": {
-                                        "resourceVersion": str(
-                                            self.store.resource_version
-                                        )
-                                    },
-                                },
-                            },
+                            {"type": "BOOKMARK", "object": bm_obj},
                         )
                     continue
                 idle = 0.0

@@ -650,7 +650,18 @@ class ResourceStore:
         the read-only handed-out-by-reference contract (_emit /
         apply_status_batch); used by the informer reflector, whose
         consumers never mutate (a deep copy of 1M pods per re-list was
-        most of the e2e setup cost).  Default stays deep-copied."""
+        most of the e2e setup cost).  Default stays deep-copied.
+
+        Tearing caveat (ADVICE r04 #4): the zero-copy commit lane
+        (status_lane / the in-place branch of apply_status_batch)
+        replaces a stored object's ``status`` and ``resourceVersion``
+        as two separate dict writes.  A ``copy=False`` snapshot read
+        OUTSIDE the store mutex can therefore observe the new status
+        paired with the old resourceVersion (each field is internally
+        consistent; the pair is not).  The lane only activates when no
+        status-interested watcher exists, so the exposed readers are
+        the rare debug/catch-up consumers — use the default deep copy
+        anywhere the status/resourceVersion pairing matters."""
         out = copy_json if copy else (lambda o: o)
         with self._mut:
             st = self._state(kind)
@@ -928,7 +939,12 @@ class ResourceStore:
                 cur,
             )
             if conflicts and not force:
-                causes = [(m, ssa.dotted(p)) for m, p in conflicts]
+                # dedup: one claimed ancestor can conflict with several
+                # of a manager's descendant paths — kubectl should see
+                # each (manager, claimed-path) cause once
+                causes = sorted(
+                    {(m, ssa.dotted(ours)) for m, _theirs, ours in conflicts}
+                )
                 managers = sorted({m for m, _ in causes})
                 raise ApplyConflict(
                     f"Apply failed with {len(causes)} conflict"
@@ -949,7 +965,13 @@ class ResourceStore:
             new = apply_patch(new, applied, "merge", kind=st.rtype.kind)
 
             new_mf = []
-            taken = {(m, p) for m, p in conflicts} if force else set()
+            # dispossession strips the OTHER manager's own entry —
+            # which may be an ancestor of what we claimed
+            taken = (
+                {(m, theirs) for m, theirs, _ours in conflicts}
+                if force
+                else set()
+            )
             for e, fs in others:
                 m = e.get("manager") or ""
                 keep = {p for p in fs if (m, p) not in taken}

@@ -21,7 +21,12 @@ __all__ = ["wants_table", "to_table"]
 
 
 def wants_table(accept: Optional[str]) -> bool:
-    """Does the Accept header ask for a Table (kubectl get's chain)?"""
+    """Does the Accept header ask for a Table this server can emit
+    (kubectl get's chain)?  Requires g=meta.k8s.io and — when a
+    version is named — v=v1: answering a v1beta1 (or foreign-group)
+    negotiation with a meta.k8s.io/v1 Table would hand the client a
+    type it did not ask for; those clauses fall through to plain JSON
+    like an apiserver that cannot satisfy them."""
     if not accept:
         return False
     for clause in accept.split(","):
@@ -29,8 +34,13 @@ def wants_table(accept: Optional[str]) -> bool:
             p.partition("=")[0].strip(): p.partition("=")[2].strip()
             for p in clause.split(";")[1:]
         }
-        if params.get("as") == "Table":
-            return True
+        if params.get("as") != "Table":
+            continue
+        if params.get("g", "meta.k8s.io") != "meta.k8s.io":
+            continue
+        if params.get("v", "v1") != "v1":
+            continue
+        return True
     return False
 
 

@@ -103,27 +103,34 @@ def find_conflicts(
     others: Iterable[Tuple[str, FieldSet]],
     applied: dict,
     current: dict,
-) -> List[Tuple[str, Path]]:
-    """(manager, path) pairs where another manager owns a desired leaf
-    AND the applied value differs from the current one — equal values
-    become co-ownership, not a conflict (upstream SSA semantics).
-    Ancestor/descendant overlap (owning ``spec.foo`` vs claiming
-    ``spec.foo.bar``) is structural and always conflicts."""
-    out: List[Tuple[str, Path]] = []
+) -> List[Tuple[str, Path, Path]]:
+    """(manager, their_path, our_path) triples where another manager
+    owns a desired leaf AND the applied value differs from the current
+    one — equal values become co-ownership, not a conflict (upstream
+    SSA semantics).  Ancestor/descendant overlap (owning ``spec.foo``
+    vs claiming ``spec.foo.bar``) is structural and always conflicts.
+
+    Both paths are reported because they serve different consumers: a
+    forced apply dispossesses the OTHER manager's entry (their_path —
+    the one actually present in their field set), while the Status
+    cause names what the APPLIER claimed (our_path).  Collapsing to
+    the longer of the two left forced applies unable to strip an
+    ancestor claim (ADVICE r04 #2)."""
+    out: List[Tuple[str, Path, Path]] = []
     for manager, fs in others:
-        hits: FieldSet = set()
+        hits = set()
         for p in fs & desired:
             if path_get(applied, p) != path_get(current, p):
-                hits.add(p)
+                hits.add((p, p))
         for theirs in fs:
             for ours in desired:
                 if theirs == ours:
                     continue
                 shorter, longer = sorted((theirs, ours), key=len)
                 if longer[: len(shorter)] == shorter:
-                    hits.add(longer)
-        for p in sorted(hits):
-            out.append((manager, p))
+                    hits.add((theirs, ours))
+        for theirs, ours in sorted(hits):
+            out.append((manager, theirs, ours))
     return out
 
 

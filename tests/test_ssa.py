@@ -240,3 +240,41 @@ def test_apply_on_subresource_degrades_to_scoped_merge(cluster):
     assert out["status"]["phase"] == "Running"
     # and the main resource was not touched
     assert store.get("Pod", "sp")["spec"] == {"nodeName": "n"}
+
+
+def test_forced_apply_strips_ancestor_claim():
+    """ADVICE r04 #2: manager A owns spec.foo (the ancestor); a forced
+    apply claiming spec.foo.bar must dispossess A's OWN entry, not a
+    path A never held."""
+    from kwok_tpu.cluster.store import ResourceStore, ResourceType
+
+    store = ResourceStore()
+    store.register_type(ResourceType("v1", "Widget", "widgets"))
+    # alpha owns the LEAF spec.foo (a scalar)
+    store.apply(
+        "Widget", "w", {"kind": "Widget", "spec": {"foo": 1}},
+        field_manager="alpha",
+    )
+    # beta claims the DESCENDANT spec.foo.bar: structural conflict where
+    # alpha's own path (the ancestor) is the shorter one
+    import pytest as _pytest
+
+    from kwok_tpu.cluster.store import ApplyConflict
+
+    with _pytest.raises(ApplyConflict) as ei:
+        store.apply(
+            "Widget", "w", {"kind": "Widget", "spec": {"foo": {"bar": 2}}},
+            field_manager="beta",
+        )
+    # the cause names what BETA claimed (the descendant)
+    assert any(f.endswith("spec.foo.bar") for _m, f in ei.value.causes), (
+        ei.value.causes
+    )
+    # forced: alpha's ANCESTOR entry must be dispossessed (the r04 bug
+    # looked for the longer path in alpha's set and stripped nothing)
+    obj, _ = store.apply(
+        "Widget", "w", {"kind": "Widget", "spec": {"foo": {"bar": 2}}},
+        field_manager="beta", force=True,
+    )
+    mf = {e["manager"] for e in obj["metadata"]["managedFields"]}
+    assert "beta" in mf and "alpha" not in mf, mf

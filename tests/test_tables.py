@@ -162,3 +162,86 @@ def test_watch_streams_table_events_when_negotiated():
                 assert f["object"]["rows"][0]["cells"][0] == "w0"
         finally:
             conn.close()
+
+
+def test_wants_table_requires_meta_group_v1():
+    from kwok_tpu.cluster.tables import wants_table
+
+    # kubectl's actual clause
+    assert wants_table(
+        "application/json;as=Table;v=v1;g=meta.k8s.io, application/json"
+    )
+    # bare as=Table (no g/v) keeps working
+    assert wants_table("application/json;as=Table")
+    # a v1beta1 or foreign-group negotiation must fall through to JSON
+    assert not wants_table(
+        "application/json;as=Table;v=v1beta1;g=meta.k8s.io"
+    )
+    assert not wants_table("application/json;as=Table;v=v1;g=other.io")
+
+
+def test_table_watch_bookmarks_are_table_typed(monkeypatch):
+    """ADVICE r04 #1: on a Table-negotiated watch with
+    allowWatchBookmarks, BOOKMARK frames must be Table-typed like every
+    other event (kubectl's table decoder rejects mixed streams) — an
+    empty-row Table carrying only metadata.resourceVersion, as the real
+    apiserver emits."""
+    import http.client
+    import json as _json
+    import socket
+    import time as _t
+
+    from kwok_tpu.cluster import k8s_api
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.store import ResourceStore
+
+    monkeypatch.setattr(k8s_api, "_BOOKMARK_EVERY", 0.5)
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        host, port = srv.address
+        store.create(make_pod("bm0"))
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        try:
+            conn.request(
+                "GET",
+                "/api/v1/namespaces/default/pods"
+                "?watch=true&timeoutSeconds=6&allowWatchBookmarks=true",
+                headers={
+                    "Accept": "application/json;as=Table;v=v1;g=meta.k8s.io,"
+                    "application/json"
+                },
+            )
+            resp = conn.getresponse()
+            frames = []
+            buf = b""
+            deadline = _t.monotonic() + 8
+            resp.fp.raw._sock.settimeout(1.0)  # noqa: SLF001
+            bookmark = None
+            while _t.monotonic() < deadline and bookmark is None:
+                try:
+                    chunk = resp.read1(65536)
+                except (socket.timeout, TimeoutError):
+                    continue
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    fr = _json.loads(line)
+                    frames.append(fr)
+                    if fr["type"] == "BOOKMARK":
+                        bookmark = fr
+                        break
+            assert bookmark is not None, [f["type"] for f in frames]
+            obj = bookmark["object"]
+            assert obj["kind"] == "Table", obj
+            assert obj.get("rows") in (None, []), obj
+            assert obj["metadata"].get("resourceVersion"), obj
+            # every non-bookmark frame is Table-typed too
+            assert all(
+                f["object"]["kind"] == "Table" for f in frames
+            ), [f["object"].get("kind") for f in frames]
+        finally:
+            conn.close()
