@@ -14,9 +14,12 @@ to a Go library:
 - :class:`SimulatedCNI` — the default: wraps the same IPPool allocator
   the pod controller uses; no privileges, works everywhere.
 - :class:`HostCNI` — EXPERIMENTAL: invokes a real CNI plugin binary
-  (e.g. host-local) per ADD/DEL.  Needs a plugin on disk; no netns is
-  created (kwok pods have no processes), so CNI_NETNS is passed as the
-  placeholder the plugin tolerates for pure-IPAM plugins.
+  (e.g. host-local) per ADD/DEL.  Needs a plugin on disk.  When the
+  process is privileged and ``ip netns`` is available, a REAL network
+  namespace is created per pod and its path passed as CNI_NETNS —
+  the reference's NewNS/UnmountNS flow (cni_linux.go:26+, NS helpers
+  in pkg/kwok/cni) — and deleted on DEL; otherwise a placeholder path
+  is passed, which pure-IPAM plugins tolerate.
 
 Both expose ``add(pod) -> ip`` / ``delete(pod)``, the two verbs the
 pod controller needs.
@@ -88,12 +91,24 @@ class HostCNI:
         ifname: str = "eth0",
         netns: str = "/var/run/netns/kwok-placeholder",
         extra_conf: Optional[dict] = None,
+        create_netns: Optional[bool] = None,
     ):
         if not os.path.exists(plugin_path):
             raise CNIError(f"CNI plugin not found: {plugin_path}")
         self.plugin_path = plugin_path
         self.ifname = ifname
         self.netns = netns
+        #: real per-pod namespaces (reference NewNS): auto-detected —
+        #: root + the iproute2 tool present — but an EXPLICIT netns=
+        #: argument always wins (the caller points at an existing
+        #: namespace; creating our own would configure the wrong one)
+        if create_netns is None:
+            create_netns = (
+                netns == "/var/run/netns/kwok-placeholder"
+                and os.geteuid() == 0
+                and _ip_netns_available()
+            )
+        self.create_netns = create_netns
         self.conf = {
             "cniVersion": "0.4.0",
             "name": "kwok-net",
@@ -106,14 +121,66 @@ class HostCNI:
         if extra_conf:
             self.conf.update(extra_conf)
 
-    def _invoke(self, command: str, pod: dict) -> dict:
-        uid = (pod.get("metadata") or {}).get("uid") or "no-uid"
+    @staticmethod
+    def _uid(pod: dict) -> str:
+        return (pod.get("metadata") or {}).get("uid") or "no-uid"
+
+    @staticmethod
+    def _netns_name(uid: str) -> str:
+        """Unique, always-valid netns name: uids are caller-supplied
+        strings (not necessarily UUIDs), so hash rather than truncate —
+        truncation collided 32-char-prefix twins, and characters like
+        '/' broke `ip netns add`.  A readable prefix of the uid rides
+        along for debuggability."""
+        import hashlib
+        import re
+
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", uid)[:16]
+        digest = hashlib.sha1(uid.encode()).hexdigest()[:12]
+        return f"kwok-{safe}-{digest}"
+
+    def _netns_path(self, uid: str) -> str:
+        return f"/var/run/netns/{self._netns_name(uid)}"
+
+    def _ensure_netns(self, uid: str) -> str:
+        """Create (idempotently) the pod's network namespace; returns
+        its bind path (reference NewNS, pkg/kwok/cni)."""
+        name = self._netns_name(uid)
+        path = f"/var/run/netns/{name}"
+        if not os.path.exists(path):
+            try:
+                proc = subprocess.run(
+                    ["ip", "netns", "add", name],
+                    capture_output=True,
+                    timeout=10,
+                )
+            except subprocess.SubprocessError as exc:
+                raise CNIError(f"netns create failed: {exc}") from exc
+            if proc.returncode != 0 and not os.path.exists(path):
+                raise CNIError(
+                    f"netns create failed: {proc.stderr.decode(errors='replace')[:200]}"
+                )
+        return path
+
+    def _delete_netns(self, uid: str) -> None:
+        name = self._netns_name(uid)
+        if os.path.exists(f"/var/run/netns/{name}"):
+            try:
+                subprocess.run(
+                    ["ip", "netns", "delete", name],
+                    capture_output=True,
+                    timeout=10,
+                )
+            except subprocess.SubprocessError:
+                pass  # best effort; the DEL error (if any) wins
+
+    def _invoke(self, command: str, uid: str, netns: str) -> dict:
         env = dict(os.environ)
         env.update(
             {
                 "CNI_COMMAND": command,
                 "CNI_CONTAINERID": uid,
-                "CNI_NETNS": self.netns,
+                "CNI_NETNS": netns,
                 "CNI_IFNAME": self.ifname,
                 "CNI_PATH": os.path.dirname(self.plugin_path),
             }
@@ -137,12 +204,33 @@ class HostCNI:
         return json.loads(out) if out.strip() else {}
 
     def add(self, pod: dict) -> str:
-        result = self._invoke("ADD", pod)
-        for ip_entry in result.get("ips") or []:
-            addr = (ip_entry.get("address") or "").split("/")[0]
-            if addr:
-                return addr
-        raise CNIError(f"CNI ADD returned no IP: {result}")
+        uid = self._uid(pod)
+        netns = self._ensure_netns(uid) if self.create_netns else self.netns
+        try:
+            result = self._invoke("ADD", uid, netns)
+            for ip_entry in result.get("ips") or []:
+                addr = (ip_entry.get("address") or "").split("/")[0]
+                if addr:
+                    return addr
+            raise CNIError(f"CNI ADD returned no IP: {result}")
+        except CNIError:
+            # a failed setup must not leak the namespace it pre-created
+            # (the reference unmounts the NS on Setup error too)
+            if self.create_netns:
+                self._delete_netns(uid)
+            raise
 
     def delete(self, pod: dict) -> None:
-        self._invoke("DEL", pod)
+        uid = self._uid(pod)
+        netns = self._netns_path(uid) if self.create_netns else self.netns
+        try:
+            self._invoke("DEL", uid, netns)
+        finally:
+            if self.create_netns:
+                self._delete_netns(uid)
+
+
+def _ip_netns_available() -> bool:
+    import shutil
+
+    return shutil.which("ip") is not None and os.path.isdir("/var/run")

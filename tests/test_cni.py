@@ -4,6 +4,7 @@ pkg/kwok/cni/cni_linux.go + --experimental-enable-cni)."""
 
 import json
 import os
+import shutil
 import stat
 
 import pytest
@@ -58,6 +59,7 @@ def test_host_cni_speaks_plugin_protocol(tmp_path):
     assert cni.add(make_pod("u1")) == "10.9.0.1"
     assert cni.add(make_pod("u7")) == "10.9.0.7"
     cni.delete(make_pod("u1"))
+    cni.delete(make_pod("u7"))
 
 
 def test_host_cni_missing_plugin():
@@ -72,6 +74,9 @@ def test_host_cni_plugin_failure(tmp_path):
     cni = HostCNI(str(plugin))
     with pytest.raises(CNIError, match="exited 3"):
         cni.add(make_pod("u1"))
+    # a failed ADD must not leak a pre-created namespace
+    if cni.create_netns:
+        assert not os.path.exists(cni._netns_path("u1"))
 
 
 def test_pod_env_uses_cni_backend():
@@ -84,3 +89,38 @@ def test_pod_env_uses_cni_backend():
     assert env.pod_ip_for(make_pod("u2", host_network=True)) == env.node_ip
     env.release(pod)
     assert env.pod_ip_for(make_pod("u3")) == ip  # recycled through CNI
+
+
+@pytest.mark.skipif(
+    os.geteuid() != 0 or shutil.which("ip") is None,
+    reason="needs root + iproute2 for real netns",
+)
+def test_host_cni_creates_real_netns(tmp_path):
+    """Privileged HostCNI creates a REAL network namespace per pod,
+    passes its path as CNI_NETNS, and deletes it on DEL (reference
+    cni_linux.go:26+ NewNS/UnmountNS)."""
+    plugin = tmp_path / "host-local"
+    plugin.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, os, sys\n"
+        "json.load(sys.stdin)\n"
+        "netns = os.environ['CNI_NETNS']\n"
+        "if os.environ['CNI_COMMAND'] == 'ADD':\n"
+        "    assert os.path.exists(netns), netns\n"
+        "    json.dump({'cniVersion': '0.4.0',\n"
+        "               'ips': [{'address': '10.9.0.5/24'}]}, sys.stdout)\n"
+    )
+    plugin.chmod(plugin.stat().st_mode | stat.S_IEXEC)
+    cni = HostCNI(str(plugin), cidr="10.9.0.0/24")
+    assert cni.create_netns, "root + ip present: netns mode must auto-enable"
+    pod = make_pod("nsuid1")
+    assert cni.add(pod) == "10.9.0.5"
+    ns_path = cni._netns_path("nsuid1")
+    assert os.path.exists(ns_path), "netns not created"
+    cni.delete(pod)
+    assert not os.path.exists(ns_path), "netns not deleted on DEL"
+    # an EXPLICIT netns argument disables auto-creation (the caller
+    # points at an existing namespace)
+    explicit = HostCNI(cni.plugin_path, cidr="10.9.0.0/24",
+                       netns="/proc/self/ns/net")
+    assert not explicit.create_netns
