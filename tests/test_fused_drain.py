@@ -190,8 +190,7 @@ def test_fused_drain_converges_under_external_interleaving():
                     deleted.discard(name)
             except Exception:  # noqa: BLE001 — racing the drain is the point
                 pass
-        player._drain_events()
-        player.step_batch(100, 10)
+        drive(player, 1)
     # let everything settle
     drive(player, 6)
     pods, _ = store.list("Pod")
