@@ -32,10 +32,18 @@ columns on the device path — plus string interpolation ``"\\(e)"``
 with bindings visible inside, recursive descent ``..``/``recurse``,
 ``limit``/``range(a;b;c)``/``while``/``until``, the ``?//`` pattern
 alternative operator, destructuring patterns in ``reduce``/``foreach``
-sources, and ``input``/``inputs`` (``Query.execute(v, inputs=...)``
+sources, ``input``/``inputs`` (``Query.execute(v, inputs=...)``
 feeds the rest-of-stream; the default stream is empty, so ``input``
-errors at end-of-input like jq).  Unbound ``$vars`` and breaks outside
-their label are compile errors like jq.
+errors at end-of-input like jq), the regex family (``test``/``match``
+flags, ``sub``/``gsub`` with filter replacements and named captures in
+Oniguruma ``(?<name>)`` syntax, ``capture``, ``splits``,
+``split/2``), the entries family
+(``to_entries``/``from_entries``/``with_entries``), paths
+(``paths``/``leaf_paths``/``getpath``/``del``), and the collection
+tail (``group_by``/``unique_by``/``flatten``/``map_values``/
+``in``/``inside``/``index``/``rindex``/``indices``/``ltrimstr``/
+``rtrimstr``/``explode``/``implode``/``utf8bytelength``).  Unbound
+``$vars`` and breaks outside their label are compile errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -75,7 +83,7 @@ _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<string>"(?:[^"\\]|\\.)*")
-  | (?P<number>\d+(?:\.\d+)?)
+  | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<format>@[a-z0-9]+)
   | (?P<op>\?//|//|\.\.|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
@@ -381,6 +389,9 @@ _FUNCS0 = {
     "empty", "add", "any", "all", "first", "last", "min", "max", "sort",
     "unique", "floor", "ceil", "ascii_downcase", "ascii_upcase", "abs",
     "reverse", "tojson", "fromjson", "error", "recurse", "input", "inputs",
+    "to_entries", "from_entries", "paths", "leaf_paths", "flatten",
+    "explode", "implode", "infinite", "nan", "isnan",
+    "isinfinite", "isnormal", "utf8bytelength",
 }
 
 #: env key carrying the shared rest-of-inputs iterator for
@@ -391,7 +402,10 @@ _INPUTS_KEY = ("inputs",)
 _FUNCS1 = {
     "select", "has", "map", "test", "startswith", "endswith", "contains",
     "split", "join", "any", "all", "sort_by", "min_by", "max_by", "range",
-    "error", "recurse",
+    "error", "recurse", "with_entries", "group_by", "unique_by",
+    "ltrimstr", "rtrimstr", "getpath", "flatten", "in", "inside",
+    "splits", "index", "rindex", "indices", "capture", "match", "del",
+    "map_values", "paths",
 }
 #: multi-arg builtins: name -> allowed arities beyond 0/1
 _FUNCS_N = {
@@ -399,6 +413,13 @@ _FUNCS_N = {
     "range": {2, 3},
     "while": {2},
     "until": {2},
+    "test": {2},
+    "match": {2},
+    "split": {2},
+    "splits": {2},
+    "sub": {2, 3},
+    "gsub": {2, 3},
+    "capture": {2},
 }
 
 
@@ -701,7 +722,8 @@ class _Parser:
             return Literal(_unquote(text))
         if kind == "number":
             self.next()
-            return Literal(float(text) if "." in text else int(text))
+            is_float = "." in text or "e" in text or "E" in text
+            return Literal(float(text) if is_float else int(text))
         if kind == "var":
             self.next()
             name = text[1:]
@@ -1331,8 +1353,54 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
 
 
 def _eval_func_n(node: Func, value: Any, env: dict) -> Iterator[Any]:
-    """Multi-arg builtins: limit/2, range/2-3, while/2, until/2."""
+    """Multi-arg builtins: limit/2, range/2-3, while/2, until/2, plus
+    the regex family (test/split/splits with flags, sub/gsub with a
+    filter replacement, capture)."""
     name, args = node.name, node.args
+    if name in ("test", "capture", "match", "split", "splits") and len(args) == 2:
+        if not isinstance(value, str):
+            raise _KqRuntimeError(f"{name} on non-string")
+        for pat in _eval(args[0], value, env):
+            for fl in _eval(args[1], value, env):
+                if fl is not None and not isinstance(fl, str):
+                    raise _KqRuntimeError("regex flags must be a string")
+                rx, g = _regex(pat, fl)
+                if name == "test":
+                    yield rx.search(value) is not None
+                elif name in ("capture", "match"):
+                    shape = _capture_obj if name == "capture" else _match_obj
+                    pos = 0
+                    while pos <= len(value):
+                        m = rx.search(value, pos)
+                        if m is None:
+                            break
+                        yield shape(m)
+                        if not g:
+                            break
+                        pos = m.end() if m.end() > m.start() else m.start() + 1
+                elif name == "split":
+                    yield _regex_split(value, rx)
+                else:
+                    yield from _regex_split(value, rx)
+        return
+    if name in ("sub", "gsub"):
+        for pat in _eval(args[0], value, env):
+            flags_out = (
+                [None]
+                if len(args) < 3
+                else list(_eval(args[2], value, env))
+            )
+            for fl in flags_out:
+                if fl is not None and not isinstance(fl, str):
+                    raise _KqRuntimeError("regex flags must be a string")
+                yield from _sub_impl(
+                    value,
+                    pat,
+                    fl,
+                    lambda cap: _eval(args[1], cap, env),
+                    name == "gsub",
+                )
+        return
     if name == "limit":
         for n in _eval(args[0], value, env):
             if isinstance(n, bool) or not isinstance(n, (int, float)):
@@ -1538,6 +1606,333 @@ def _sh_word(v: Any) -> str:
 
 
 _FORMATS = {"text", "json", "base64", "base64d", "uri", "html", "sh", "csv", "tsv"}
+
+
+def _to_entries(value: Any) -> list:
+    if not isinstance(value, dict):
+        raise _KqRuntimeError("to_entries over non-object")
+    return [{"key": k, "value": v} for k, v in value.items()]
+
+
+def _from_entries(value: Any) -> dict:
+    if not isinstance(value, list):
+        raise _KqRuntimeError("from_entries over non-array")
+    out: dict = {}
+    for e in value:
+        if not isinstance(e, dict):
+            raise _KqRuntimeError("from_entries element is not an object")
+        # jq: key = .key // .k // .name // .Name (null/false FALL
+        # THROUGH, unlike presence checks); value uses has()
+        k = None
+        for kk in ("key", "k", "name", "Name"):
+            cand = e.get(kk)
+            if cand is not None and cand is not False:
+                k = cand
+                break
+        v = None
+        for vk in ("value", "v"):
+            if vk in e:
+                v = e[vk]
+                break
+        if k is None:
+            raise _KqRuntimeError("from_entries element has no key")
+        if isinstance(k, bool):
+            k = "true" if k else "false"
+        elif isinstance(k, (int, float)):
+            k = _num_str(k)
+        elif not isinstance(k, str):
+            raise _KqRuntimeError("from_entries key is not a scalar")
+        out[k] = v
+    return out
+
+
+def _all_paths_vals(value: Any, prefix: tuple = ()):
+    """Yield (path, sub-value) pairs, jq paths order (document order,
+    parents before children; the root [] excluded)."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            yield list(prefix) + [k], v
+            yield from _all_paths_vals(v, prefix + (k,))
+    elif isinstance(value, list):
+        for i, v in enumerate(value):
+            yield list(prefix) + [i], v
+            yield from _all_paths_vals(v, prefix + (i,))
+
+
+def _all_paths(value: Any):
+    for p, _v in _all_paths_vals(value):
+        yield p
+
+
+def _getpath(value: Any, path: list) -> Any:
+    cur = value
+    for seg in path:
+        if cur is None:
+            return None
+        if isinstance(cur, dict):
+            if not isinstance(seg, str):
+                raise _KqRuntimeError("cannot index object with number")
+            cur = cur.get(seg)
+        elif isinstance(cur, list):
+            if isinstance(seg, bool) or not isinstance(seg, (int, float)):
+                raise _KqRuntimeError("cannot index array with string")
+            i = int(seg)
+            n = len(cur)
+            if i < 0:
+                i += n
+            cur = cur[i] if 0 <= i < n else None
+        else:
+            raise _KqRuntimeError(
+                f"cannot index {_jq_type(cur)} with path segment"
+            )
+    return cur
+
+
+def _flatten(value: Any, depth: float) -> list:
+    if not isinstance(value, list):
+        raise _KqRuntimeError("flatten over non-array")
+    out: list = []
+    for v in value:
+        if isinstance(v, list) and depth > 0:
+            out.extend(_flatten(v, depth - 1))
+        else:
+            out.append(v)
+    return out
+
+
+def _collect_ast_paths(node: Any, value: Any):
+    """Paths addressed by a path expression (the subset del()/paths-of
+    use: ``.a.b``, ``.a[0]``, ``.a[]``, comma of those).  Raises for
+    non-path expressions like jq's "Invalid path expression"."""
+    if isinstance(node, Comma):
+        for part in node.parts:
+            yield from _collect_ast_paths(part, value)
+        return
+    if not isinstance(node, Path):
+        raise _KqRuntimeError("invalid path expression")
+    prefixes: List[tuple] = [()]
+    cur_vals: List[Any] = [value]
+    for op in node.ops:
+        nxt_p: List[tuple] = []
+        nxt_v: List[Any] = []
+        for pref, cur in zip(prefixes, cur_vals):
+            if isinstance(op, Field):
+                nxt_p.append(pref + (op.name,))
+                nxt_v.append(cur.get(op.name) if isinstance(cur, dict) else None)
+            elif isinstance(op, Index):
+                nxt_p.append(pref + (op.i,))
+                nxt_v.append(
+                    cur[op.i]
+                    if isinstance(cur, list) and -len(cur) <= op.i < len(cur)
+                    else None
+                )
+            elif isinstance(op, Iterate):
+                if isinstance(cur, dict):
+                    for k, v in cur.items():
+                        nxt_p.append(pref + (k,))
+                        nxt_v.append(v)
+                elif isinstance(cur, list):
+                    for i, v in enumerate(cur):
+                        nxt_p.append(pref + (i,))
+                        nxt_v.append(v)
+                elif cur is None:
+                    continue
+                else:
+                    raise _KqRuntimeError(
+                        f"cannot iterate over {_jq_type(cur)}"
+                    )
+            else:
+                raise _KqRuntimeError("invalid path expression")
+        prefixes, cur_vals = nxt_p, nxt_v
+    for pref in prefixes:
+        yield list(pref)
+
+
+def _kq_deep_copy(x: Any) -> Any:
+    t = type(x)
+    if t is dict:
+        return {k: _kq_deep_copy(v) for k, v in x.items()}
+    if t is list:
+        return [_kq_deep_copy(v) for v in x]
+    return x
+
+
+def _delpaths(value: Any, paths: List[list]) -> Any:
+    """Delete paths (longest/rightmost first so indices stay valid)."""
+    out = _kq_deep_copy(value)
+    for path in sorted(paths, key=lambda p: (len(p), p_key(p)), reverse=True):
+        cur = out
+        ok = True
+        for seg in path[:-1]:
+            if isinstance(cur, dict) and isinstance(seg, str) and seg in cur:
+                cur = cur[seg]
+            elif isinstance(cur, list) and isinstance(seg, int) and 0 <= seg < len(cur):
+                cur = cur[seg]
+            else:
+                ok = False
+                break
+        if not ok or not path:
+            continue
+        last = path[-1]
+        if isinstance(cur, dict) and isinstance(last, str):
+            cur.pop(last, None)
+        elif isinstance(cur, list) and isinstance(last, int):
+            if -len(cur) <= last < len(cur):
+                del cur[last]
+    return out
+
+
+def p_key(path: list):
+    # sortable key across str/int segments
+    return tuple((0, seg) if isinstance(seg, int) else (1, seg) for seg in path)
+
+
+_RE_FLAG_MAP = {"i": re.IGNORECASE, "x": re.VERBOSE, "s": re.DOTALL, "m": re.MULTILINE}
+
+#: map_values' "empty output deletes" sentinel
+_MISSING_V = object()
+
+
+def _indices(value: Any, needle: Any) -> list:
+    """jq indices: substring starts (string), element or subsequence
+    starts (array)."""
+    out: list = []
+    if isinstance(value, str):
+        if not isinstance(needle, str) or not needle:
+            raise _KqRuntimeError("indices needle must be a non-empty string")
+        i = value.find(needle)
+        while i != -1:
+            out.append(i)
+            i = value.find(needle, i + 1)
+        return out
+    if isinstance(value, list):
+        if isinstance(needle, list):
+            if not needle:
+                return []
+            n = len(needle)
+            for i in range(len(value) - n + 1):
+                if all(_json_equal(value[i + j], needle[j]) for j in range(n)):
+                    out.append(i)
+            return out
+        for i, v in enumerate(value):
+            if _json_equal(v, needle):
+                out.append(i)
+        return out
+    if value is None:
+        return []
+    raise _KqRuntimeError(f"cannot get indices of {_jq_type(value)}")
+
+
+def _regex(pattern: Any, flags: Any):
+    """Compile a jq regex + flag string; returns (compiled, global)."""
+    if not isinstance(pattern, str):
+        raise _KqRuntimeError("regex must be a string")
+    g = False
+    f = 0
+    for ch in flags or "":
+        if ch == "g":
+            g = True
+        elif ch in _RE_FLAG_MAP:
+            f |= _RE_FLAG_MAP[ch]
+        elif ch == "n":
+            pass  # ignore-empty-matches: harmless to ignore
+        else:
+            raise _KqRuntimeError(f"unsupported regex flag {ch!r}")
+    # jq speaks Oniguruma: named groups are (?<name>...), which Python
+    # spells (?P<name>...).  Leave lookbehinds (?<=, (?<! alone.
+    translated = re.sub(r"\(\?<(?![=!])", "(?P<", pattern)
+    try:
+        return re.compile(translated, f), g
+    except re.error as exc:
+        raise _KqRuntimeError(f"bad regex: {exc}") from exc
+
+
+def _capture_obj(m: "re.Match") -> dict:
+    out = {}
+    for name, idx in (m.re.groupindex or {}).items():
+        out[name] = m.group(idx)
+    return out
+
+
+def _sub_impl(value, pat, flags, repl_eval, global_) -> Iterator[str]:
+    """sub/gsub: the replacement is a FILTER evaluated with the capture
+    object as input (jq lets it interpolate named groups).  Iterative —
+    multi-output replacements fan out via itertools.product like jq's
+    stream semantics, without one generator frame per match."""
+    import itertools
+
+    if not isinstance(value, str):
+        raise _KqRuntimeError("sub on non-string")
+    rx, g2 = _regex(pat, flags)
+    global_ = global_ or g2
+    matches = []
+    pos = 0
+    while pos <= len(value):
+        m = rx.search(value, pos)
+        if m is None:
+            break
+        matches.append(m)
+        if not global_:
+            break
+        pos = m.end() if m.end() > m.start() else m.start() + 1
+    if not matches:
+        yield value
+        return
+    option_sets = []
+    for m in matches:
+        opts = list(repl_eval(_capture_obj(m)))
+        if not all(isinstance(o, str) for o in opts):
+            raise _KqRuntimeError("sub replacement must be a string")
+        if not opts:
+            return  # empty replacement stream -> no outputs (jq)
+        option_sets.append(opts)
+    for combo in itertools.product(*option_sets):
+        out = []
+        last = 0
+        for m, rep in zip(matches, combo):
+            out.append(value[last:m.start()])
+            out.append(rep)
+            last = max(m.end(), last)
+        out.append(value[last:])
+        yield "".join(out)
+
+
+def _regex_split(value: str, rx) -> list:
+    """Split on regex matches WITHOUT interleaving capture groups
+    (Python re.split would; jq never does)."""
+    out = []
+    last = 0
+    pos = 0
+    while pos <= len(value):
+        m = rx.search(value, pos)
+        if m is None:
+            break
+        out.append(value[last:m.start()])
+        last = m.end()
+        pos = m.end() if m.end() > m.start() else m.start() + 1
+    out.append(value[last:])
+    return out
+
+
+def _match_obj(m: "re.Match") -> dict:
+    names = {idx: name for name, idx in (m.re.groupindex or {}).items()}
+    captures = []
+    for i in range(1, (m.re.groups or 0) + 1):
+        g = m.group(i)
+        captures.append(
+            {
+                "offset": m.start(i) if g is not None else -1,
+                "length": len(g) if g is not None else 0,
+                "string": g,
+                "name": names.get(i),
+            }
+        )
+    return {
+        "offset": m.start(),
+        "length": len(m.group(0)),
+        "string": m.group(0),
+        "captures": captures,
+    }
 
 
 def _pattern_vars(pattern) -> List[str]:
@@ -1785,6 +2180,124 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
         elif name == "error":
             for msg in _eval(arg, value, env):
                 raise _KqRuntimeError(str(msg), msg, True)
+        elif name == "with_entries":
+            # to_entries | map(f) | from_entries
+            entries = _to_entries(value)
+            mapped = []
+            for e in entries:
+                mapped.extend(_eval(arg, e, env))
+            yield _from_entries(mapped)
+        elif name == "group_by":
+            if not isinstance(value, list):
+                raise _KqRuntimeError("group_by over non-array")
+            import functools
+
+            keyed = [(list(_eval(arg, v, env)), v) for v in value]
+            keyed.sort(
+                key=functools.cmp_to_key(lambda p, q: _jq_cmp(p[0], q[0]))
+            )
+            out = []
+            for i, (k, v) in enumerate(keyed):
+                if i and _json_equal(k, keyed[i - 1][0]):
+                    out[-1].append(v)
+                else:
+                    out.append([v])
+            yield out
+        elif name == "unique_by":
+            if not isinstance(value, list):
+                raise _KqRuntimeError("unique_by over non-array")
+            import functools
+
+            keyed = [(list(_eval(arg, v, env)), v) for v in value]
+            keyed.sort(
+                key=functools.cmp_to_key(lambda p, q: _jq_cmp(p[0], q[0]))
+            )
+            out = []
+            for i, (k, v) in enumerate(keyed):
+                if not (i and _json_equal(k, keyed[i - 1][0])):
+                    out.append(v)
+            yield out
+        elif name == "map_values":
+            # .[] |= f : first output of f per value; empty deletes
+            if isinstance(value, dict):
+                out = {}
+                for k, v in value.items():
+                    res = next(iter(_eval(arg, v, env)), _MISSING_V)
+                    if res is not _MISSING_V:
+                        out[k] = res
+                yield out
+            elif isinstance(value, list):
+                outl = []
+                for v in value:
+                    res = next(iter(_eval(arg, v, env)), _MISSING_V)
+                    if res is not _MISSING_V:
+                        outl.append(res)
+                yield outl
+            else:
+                raise _KqRuntimeError("map_values over non-iterable")
+        elif name in ("ltrimstr", "rtrimstr"):
+            for pre in _eval(arg, value, env):
+                if not isinstance(value, str) or not isinstance(pre, str):
+                    yield value
+                elif name == "ltrimstr":
+                    yield value[len(pre):] if value.startswith(pre) else value
+                else:
+                    yield value[: -len(pre)] if pre and value.endswith(pre) else value
+        elif name == "getpath":
+            for pth in _eval(arg, value, env):
+                if not isinstance(pth, list):
+                    raise _KqRuntimeError("getpath arg must be an array")
+                yield _getpath(value, pth)
+        elif name == "flatten":
+            for d in _eval(arg, value, env):
+                if isinstance(d, bool) or not isinstance(d, (int, float)) or d < 0:
+                    raise _KqRuntimeError("flatten depth must be a number >= 0")
+                yield _flatten(value, d)
+        elif name == "in":
+            for xs in _eval(arg, value, env):
+                if isinstance(xs, dict):
+                    yield isinstance(value, str) and value in xs
+                elif isinstance(xs, list):
+                    yield (
+                        not isinstance(value, bool)
+                        and isinstance(value, int)
+                        and 0 <= value < len(xs)
+                    )
+                else:
+                    raise _KqRuntimeError(f"cannot check in() on {_jq_type(xs)}")
+        elif name == "inside":
+            for b in _eval(arg, value, env):
+                yield _contains(b, value)
+        elif name == "splits":
+            if not isinstance(value, str):
+                raise _KqRuntimeError("splits on non-string")
+            for pat in _eval(arg, value, env):
+                rx, _g = _regex(pat, "")
+                yield from _regex_split(value, rx)
+        elif name in ("index", "rindex", "indices"):
+            for needle in _eval(arg, value, env):
+                idxs = _indices(value, needle)
+                if name == "indices":
+                    yield idxs
+                elif name == "index":
+                    yield idxs[0] if idxs else None
+                else:
+                    yield idxs[-1] if idxs else None
+        elif name in ("capture", "match"):
+            if not isinstance(value, str):
+                raise _KqRuntimeError(f"{name} on non-string")
+            for pat in _eval(arg, value, env):
+                rx, _g = _regex(pat, "")
+                m = rx.search(value)
+                if m is not None:
+                    yield (_capture_obj if name == "capture" else _match_obj)(m)
+        elif name == "del":
+            pths = list(_collect_ast_paths(arg, value))
+            yield _delpaths(value, pths)
+        elif name == "paths":
+            for p, node_val in _all_paths_vals(value):
+                if any(_truthy(x) for x in _eval(arg, node_val, env)):
+                    yield p
         else:  # pragma: no cover
             raise _KqRuntimeError(f"unknown function {name}")
         return
@@ -1849,6 +2362,49 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
         it = env.get(_INPUTS_KEY)
         if it is not None:
             yield from it
+    elif name == "to_entries":
+        yield _to_entries(value)
+    elif name == "from_entries":
+        yield _from_entries(value)
+    elif name == "paths":
+        yield from _all_paths(value)
+    elif name == "leaf_paths":
+        for p, v in _all_paths_vals(value):
+            if not isinstance(v, (dict, list)):
+                yield p
+    elif name == "flatten":
+        yield _flatten(value, float("inf"))
+    elif name == "explode":
+        if not isinstance(value, str):
+            raise _KqRuntimeError("explode on non-string")
+        yield [ord(c) for c in value]
+    elif name == "implode":
+        if not isinstance(value, list):
+            raise _KqRuntimeError("implode on non-array")
+        try:
+            yield "".join(chr(int(c)) for c in value)
+        except (TypeError, ValueError) as exc:
+            raise _KqRuntimeError(f"implode: {exc}") from exc
+    elif name == "infinite":
+        yield float("inf")
+    elif name == "nan":
+        yield float("nan")
+    elif name == "isnan":
+        yield isinstance(value, float) and math.isnan(value)
+    elif name == "isinfinite":
+        yield isinstance(value, float) and math.isinf(value)
+    elif name == "isnormal":
+        yield (
+            isinstance(value, (int, float))
+            and not isinstance(value, bool)
+            and not math.isnan(value)
+            and not math.isinf(value)
+            and value != 0
+        )
+    elif name == "utf8bytelength":
+        if not isinstance(value, str):
+            raise _KqRuntimeError("utf8bytelength on non-string")
+        yield len(value.encode("utf-8"))
     elif name == "add":
         if not isinstance(value, list):
             raise _KqRuntimeError("add over non-array")

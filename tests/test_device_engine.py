@@ -315,7 +315,7 @@ class TestCompileErrors:
                             # StageCompileError so the facade falls
                             # back to the host backend
                             {
-                                "key": 'getpath(["a"])',
+                                "key": "halt_error",
                                 "operator": "Exists",
                             }
                         ]

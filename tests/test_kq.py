@@ -99,7 +99,7 @@ def test_bool_not_equal_int():
 def test_compile_error():
     # functions outside the builtin set are compile errors
     with pytest.raises(KqCompileError):
-        Query("getpath([\"a\"])")
+        Query("halt_error")
     # unbound variables are compile errors, like jq
     with pytest.raises(KqCompileError):
         Query("$nope")
@@ -473,3 +473,111 @@ def test_alternative_patterns_stay_lazy():
         'reduce .[] as [$x] ?// $x (0; . + ($x | if type == "number" then . '
         "else error end))"
     ).execute([[1], 5, [2]]) == [8]
+
+
+def test_entries_family():
+    assert Query("to_entries").execute({"a": 1, "b": 2}) == [
+        [{"key": "a", "value": 1}, {"key": "b", "value": 2}]
+    ]
+    assert Query("from_entries").execute(
+        [{"key": "a", "value": 1}, {"k": "b", "v": 2}, {"name": "c", "value": 3}]
+    ) == [{"a": 1, "b": 2, "c": 3}]
+    assert Query(
+        "with_entries({key: .key, value: (.value + 1)})"
+    ).execute({"a": 1}) == [{"a": 2}]
+    # numeric keys stringify (jq)
+    assert Query("from_entries").execute([{"key": 1, "value": "x"}]) == [{"1": "x"}]
+
+
+def test_paths_getpath_del():
+    assert Query("[paths]").execute({"a": {"b": 1}}) == [[["a"], ["a", "b"]]]
+    assert Query("[leaf_paths]").execute({"a": {"b": 1}, "c": [2]}) == [
+        [["a", "b"], ["c", 0]]
+    ]
+    assert Query('[paths(type == "number")]').execute(
+        {"a": {"b": 1}, "c": "x"}
+    ) == [[["a", "b"]]]
+    assert Query('getpath(["a", "b"])').execute({"a": {"b": 5}}) == [5]
+    assert Query('getpath(["a", "x"])').execute({"a": {}}) == []  # null dropped
+    assert Query("del(.a.b)").execute({"a": {"b": 1, "c": 2}}) == [
+        {"a": {"c": 2}}
+    ]
+    assert Query("del(.xs[0])").execute({"xs": [1, 2, 3]}) == [{"xs": [2, 3]}]
+    assert Query("del(.xs[])").execute({"xs": [1, 2]}) == [{"xs": []}]
+
+
+def test_collection_tail():
+    assert Query("group_by(.k)").execute(
+        [{"k": 2}, {"k": 1, "i": 0}, {"k": 1, "i": 1}]
+    ) == [[[{"k": 1, "i": 0}, {"k": 1, "i": 1}], [{"k": 2}]]]
+    assert Query("unique_by(.k) | map(.k)").execute(
+        [{"k": 2}, {"k": 1}, {"k": 2}]
+    ) == [[1, 2]]
+    assert Query("flatten").execute([1, [2, [3]]]) == [[1, 2, 3]]
+    assert Query("flatten(1)").execute([1, [2, [3]]]) == [[1, 2, [3]]]
+    assert Query("map_values(. * 2)").execute({"a": 1}) == [{"a": 2}]
+    assert Query("map_values(empty)").execute({"a": 1}) == [{}]
+    assert Query('in({"foo": 1})').execute("foo") == [True]
+    assert Query("in([9, 9])").execute(1) == [True]
+    assert Query("inside([1, 2, 3])").execute([1, 3]) == [True]
+    assert Query('inside("foobar")').execute("bar") == [True]
+    assert Query('index("a"), rindex("a"), indices("a")').execute(
+        "banana"
+    ) == [1, 5, [1, 3, 5]]
+    assert Query("indices([1, 2])").execute([0, 1, 2, 1, 2]) == [[1, 3]]
+
+
+def test_string_tail():
+    assert Query('ltrimstr("ab")').execute("abcd") == ["cd"]
+    assert Query('ltrimstr("x")').execute("abcd") == ["abcd"]
+    assert Query('rtrimstr("cd")').execute("abcd") == ["ab"]
+    assert Query("explode").execute("ab") == [[97, 98]]
+    assert Query("implode").execute([104, 105]) == ["hi"]
+    assert Query("utf8bytelength").execute("héllo") == [6]
+
+
+def test_regex_family():
+    assert Query('test("AB"; "i")').execute("xaby") == [True]
+    assert Query('sub("a"; "X")').execute("banana") == ["bXnana"]
+    assert Query('gsub("a"; "X")').execute("banana") == ["bXnXnX"]
+    # named captures interpolate into the replacement filter (jq)
+    assert Query('gsub("(?<c>[aeiou])"; "<\\(.c)>")').execute("hat") == ["h<a>t"]
+    assert Query('capture("(?<first>\\\\w+) (?<last>\\\\w+)") | .last').execute(
+        "john doe"
+    ) == ["doe"]
+    assert Query('[splits(", *")]').execute("a, b,c") == [["a", "b", "c"]]
+    assert Query('split(","; "")').execute("a,b") == [["a", "b"]]
+    assert Query('sub("A"; "x"; "i")').execute("abc") == ["xbc"]
+    # no match: value unchanged
+    assert Query('gsub("z"; "X")').execute("hat") == ["hat"]
+
+
+def test_numeric_predicates():
+    assert Query("infinite > 1e308").execute(None) == [True]
+    assert Query("nan | isnan").execute(None) == [True]
+    assert Query("isinfinite").execute(1.0) == [False]
+    assert Query("isnormal").execute(1.5) == [True]
+    assert Query("isnormal").execute(0) == [False]
+
+
+def test_regex_review_regressions():
+    # gsub must not recurse per match (large inputs)
+    assert Query('gsub("a"; "b")').execute("a" * 2000) == ["b" * 2000]
+    # capture groups never interleave into split output
+    assert Query('split("(,)"; "")').execute("a,b") == [["a", "b"]]
+    assert Query('[splits("(, *)")]').execute("a, b") == [["a", "b"]]
+    # capture/match honor the g flag
+    assert Query('[capture("(?<l>[a-z])"; "g") | .l]').execute("a1 b2") == [
+        ["a", "b"]
+    ]
+    assert Query('[match("a"; "g") | .offset]').execute("banana") == [[1, 3, 5]]
+    # match objects carry jq's shape
+    m = Query('match("(?<x>a)b")').execute("zab")[0]
+    assert m == {
+        "offset": 1, "length": 2, "string": "ab",
+        "captures": [{"offset": 1, "length": 1, "string": "a", "name": "x"}],
+    }
+    # from_entries: null/false keys fall through to the next alias (jq //)
+    assert Query("from_entries").execute(
+        [{"key": None, "k": "b", "value": 1}]
+    ) == [{"b": 1}]
