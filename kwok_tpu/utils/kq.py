@@ -391,7 +391,7 @@ _FUNCS0 = {
     "reverse", "tojson", "fromjson", "error", "recurse", "input", "inputs",
     "to_entries", "from_entries", "paths", "leaf_paths", "flatten",
     "explode", "implode", "infinite", "nan", "isnan",
-    "isinfinite", "isnormal", "utf8bytelength",
+    "isinfinite", "isnormal", "utf8bytelength", "trim", "ltrim", "rtrim",
 }
 
 #: env key carrying the shared rest-of-inputs iterator for
@@ -405,7 +405,7 @@ _FUNCS1 = {
     "error", "recurse", "with_entries", "group_by", "unique_by",
     "ltrimstr", "rtrimstr", "getpath", "flatten", "in", "inside",
     "splits", "index", "rindex", "indices", "capture", "match", "del",
-    "map_values", "paths",
+    "map_values", "paths", "delpaths",
 }
 #: multi-arg builtins: name -> allowed arities beyond 0/1
 _FUNCS_N = {
@@ -420,6 +420,7 @@ _FUNCS_N = {
     "sub": {2, 3},
     "gsub": {2, 3},
     "capture": {2},
+    "setpath": {2},
 }
 
 
@@ -1367,21 +1368,17 @@ def _eval_func_n(node: Func, value: Any, env: dict) -> Iterator[Any]:
                 rx, g = _regex(pat, fl)
                 if name == "test":
                     yield rx.search(value) is not None
-                elif name in ("capture", "match"):
-                    shape = _capture_obj if name == "capture" else _match_obj
-                    pos = 0
-                    while pos <= len(value):
-                        m = rx.search(value, pos)
-                        if m is None:
-                            break
-                        yield shape(m)
-                        if not g:
-                            break
-                        pos = m.end() if m.end() > m.start() else m.start() + 1
                 elif name == "split":
                     yield _regex_split(value, rx)
                 else:
-                    yield from _regex_split(value, rx)
+                    yield from _regex_stream(name, value, pat, fl)
+        return
+    if name == "setpath" and len(args) == 2:
+        for pth in _eval(args[0], value, env):
+            if not isinstance(pth, list):
+                raise _KqRuntimeError("setpath path must be an array")
+            for v in _eval(args[1], value, env):
+                yield _setpath(value, pth, v)
         return
     if name in ("sub", "gsub"):
         for pat in _eval(args[0], value, env):
@@ -1757,10 +1754,63 @@ def _kq_deep_copy(x: Any) -> Any:
     return x
 
 
+def _setpath(value: Any, path: list, newval: Any) -> Any:
+    """jq setpath: copy-on-write along the path, creating objects/array
+    slots as needed (null-padded like jq)."""
+    if not path:
+        return newval
+    seg = _norm_seg(path[0])
+    if isinstance(seg, str):
+        if value is None:
+            base: Any = {}
+        elif isinstance(value, dict):
+            base = dict(value)
+        else:
+            raise _KqRuntimeError(
+                f"cannot set field of {_jq_type(value)}"
+            )
+        base[seg] = _setpath(base.get(seg), path[1:], newval)
+        return base
+    i = seg
+    if value is None:
+        lst: list = []
+    elif isinstance(value, list):
+        lst = list(value)
+    else:
+        raise _KqRuntimeError(f"cannot index {_jq_type(value)} with number")
+    if i < 0:
+        i += len(lst)
+        if i < 0:
+            raise _KqRuntimeError("out of bounds negative array index")
+    while len(lst) <= i:
+        lst.append(None)
+    lst[i] = _setpath(lst[i], path[1:], newval)
+    return lst
+
+
+def _norm_seg(seg: Any) -> Any:
+    """Validate/normalize a path segment: strings stay, numbers
+    truncate to int (jq numbers are doubles), anything else —
+    including bools — is an invalid path segment."""
+    if isinstance(seg, str):
+        return seg
+    if not isinstance(seg, bool) and isinstance(seg, (int, float)):
+        return int(seg)
+    raise _KqRuntimeError(f"invalid path segment {_jq_type(seg)}")
+
+
+def _p_key(path: list):
+    # total-order sortable key across str/int segments
+    return tuple(
+        (0, seg, "") if isinstance(seg, int) else (1, 0, seg) for seg in path
+    )
+
+
 def _delpaths(value: Any, paths: List[list]) -> Any:
     """Delete paths (longest/rightmost first so indices stay valid)."""
+    norm = [[_norm_seg(seg) for seg in path] for path in paths]
     out = _kq_deep_copy(value)
-    for path in sorted(paths, key=lambda p: (len(p), p_key(p)), reverse=True):
+    for path in sorted(norm, key=lambda p: (len(p), _p_key(p)), reverse=True):
         cur = out
         ok = True
         for seg in path[:-1]:
@@ -1780,11 +1830,6 @@ def _delpaths(value: Any, paths: List[list]) -> Any:
             if -len(cur) <= last < len(cur):
                 del cur[last]
     return out
-
-
-def p_key(path: list):
-    # sortable key across str/int segments
-    return tuple((0, seg) if isinstance(seg, int) else (1, seg) for seg in path)
 
 
 _RE_FLAG_MAP = {"i": re.IGNORECASE, "x": re.VERBOSE, "s": re.DOTALL, "m": re.MULTILINE}
@@ -1912,6 +1957,26 @@ def _regex_split(value: str, rx) -> list:
         pos = m.end() if m.end() > m.start() else m.start() + 1
     out.append(value[last:])
     return out
+
+
+def _regex_stream(name: str, value: str, pat: Any, fl: Any):
+    """Shared machinery for capture/match (per-match objects, honoring
+    the g flag) and splits (group-free splitting) — both arities route
+    here so their semantics cannot drift apart."""
+    rx, g = _regex(pat, fl)
+    if name == "splits":
+        yield from _regex_split(value, rx)
+        return
+    shape = _capture_obj if name == "capture" else _match_obj
+    pos = 0
+    while pos <= len(value):
+        m = rx.search(value, pos)
+        if m is None:
+            break
+        yield shape(m)
+        if not g:
+            break
+        pos = m.end() if m.end() > m.start() else m.start() + 1
 
 
 def _match_obj(m: "re.Match") -> dict:
@@ -2260,8 +2325,8 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
                 elif isinstance(xs, list):
                     yield (
                         not isinstance(value, bool)
-                        and isinstance(value, int)
-                        and 0 <= value < len(xs)
+                        and isinstance(value, (int, float))
+                        and 0 <= int(value) < len(xs)
                     )
                 else:
                     raise _KqRuntimeError(f"cannot check in() on {_jq_type(xs)}")
@@ -2272,8 +2337,7 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
             if not isinstance(value, str):
                 raise _KqRuntimeError("splits on non-string")
             for pat in _eval(arg, value, env):
-                rx, _g = _regex(pat, "")
-                yield from _regex_split(value, rx)
+                yield from _regex_stream("splits", value, pat, None)
         elif name in ("index", "rindex", "indices"):
             for needle in _eval(arg, value, env):
                 idxs = _indices(value, needle)
@@ -2287,13 +2351,17 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
             if not isinstance(value, str):
                 raise _KqRuntimeError(f"{name} on non-string")
             for pat in _eval(arg, value, env):
-                rx, _g = _regex(pat, "")
-                m = rx.search(value)
-                if m is not None:
-                    yield (_capture_obj if name == "capture" else _match_obj)(m)
+                yield from _regex_stream(name, value, pat, None)
         elif name == "del":
             pths = list(_collect_ast_paths(arg, value))
             yield _delpaths(value, pths)
+        elif name == "delpaths":
+            for plist in _eval(arg, value, env):
+                if not isinstance(plist, list) or not all(
+                    isinstance(pp, list) for pp in plist
+                ):
+                    raise _KqRuntimeError("delpaths arg must be an array of paths")
+                yield _delpaths(value, plist)
         elif name == "paths":
             for p, node_val in _all_paths_vals(value):
                 if any(_truthy(x) for x in _eval(arg, node_val, env)):
@@ -2405,6 +2473,14 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
         if not isinstance(value, str):
             raise _KqRuntimeError("utf8bytelength on non-string")
         yield len(value.encode("utf-8"))
+    elif name in ("trim", "ltrim", "rtrim"):
+        if not isinstance(value, str):
+            raise _KqRuntimeError(f"{name} on non-string")
+        yield (
+            value.strip()
+            if name == "trim"
+            else value.lstrip() if name == "ltrim" else value.rstrip()
+        )
     elif name == "add":
         if not isinstance(value, list):
             raise _KqRuntimeError("add over non-array")

@@ -581,3 +581,36 @@ def test_regex_review_regressions():
     assert Query("from_entries").execute(
         [{"key": None, "k": "b", "value": 1}]
     ) == [{"b": 1}]
+
+
+def test_setpath_delpaths_trim():
+    assert Query('setpath(["a", "b"]; 5)').execute({"a": {"c": 1}}) == [
+        {"a": {"c": 1, "b": 5}}
+    ]
+    # jq null-pads array growth
+    assert Query('setpath(["xs", 2]; 9)').execute({"xs": [1]}) == [
+        {"xs": [1, None, 9]}
+    ]
+    assert Query("setpath([]; 7)").execute({"a": 1}) == [7]
+    assert Query('delpaths([["a", "b"], ["c"]])').execute(
+        {"a": {"b": 1, "z": 2}, "c": 3}
+    ) == [{"a": {"z": 2}}]
+    # deleting overlapping/ordered paths stays index-safe (jq sorts)
+    assert Query("delpaths([[0], [2]])").execute([1, 2, 3]) == [[2]]
+    assert Query("trim, ltrim, rtrim").execute(" x ") == ["x", "x ", " x"]
+    # getpath/setpath round-trip
+    assert Query('setpath(["a"]; getpath(["a"]) + 1)').execute({"a": 1}) == [
+        {"a": 2}
+    ]
+
+
+def test_path_segment_normalization():
+    # invalid segments error (swallowed to None), never TypeError
+    assert Query('delpaths([["a"], [null]])').execute({"a": 1}) is None
+    assert Query("delpaths([[true]])").execute([1, 2]) is None
+    # computed (float) indices truncate like jq doubles
+    assert Query("delpaths([[4/2]])").execute([1, 2, 3]) == [[1, 2]]
+    assert Query('setpath(["xs", 2.0]; 9)').execute({"xs": []}) == [
+        {"xs": [None, None, 9]}
+    ]
+    assert Query("in([9, 9])").execute(1.0) == [True]
