@@ -42,8 +42,12 @@ Oniguruma ``(?<name>)`` syntax, ``capture``, ``splits``,
 (``paths``/``leaf_paths``/``getpath``/``del``), and the collection
 tail (``group_by``/``unique_by``/``flatten``/``map_values``/
 ``in``/``inside``/``index``/``rindex``/``indices``/``ltrimstr``/
-``rtrimstr``/``explode``/``implode``/``utf8bytelength``).  Unbound
-``$vars`` and breaks outside their label are compile errors like jq.
+``rtrimstr``/``trim``/``explode``/``implode``/``utf8bytelength``),
+``setpath``/``delpaths``, and the assignment family
+(``=``/``|=``/``+=``/``-=``/``*=``/``/=``/``%=``/``//=`` over path
+expressions, jq's original-input rhs and first-output update
+semantics; ``|= empty`` deletes).  Unbound ``$vars`` and breaks
+outside their label are compile errors like jq.
 
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
@@ -86,7 +90,7 @@ _TOKEN_RE = re.compile(
   | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<format>@[a-z0-9]+)
-  | (?P<op>\?//|//|\.\.|==|!=|<=|>=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
+  | (?P<op>\?//|//=|//|\.\.|==|!=|<=|>=|\|=|\+=|-=|\*=|/=|%=|=|<|>|\+|-|\*|/|%|\||\(|\)|\[|\]|\{|\}|\.|,|:|\?|;)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     """,
     re.VERBOSE,
@@ -369,6 +373,17 @@ class StrInterp:
 
 
 @dataclass(frozen=True)
+class Assign:
+    """``PATHEXPR op EXPR`` — jq's update/assignment family.  ``op`` is
+    one of = |= += -= *= /= %= //=.  The left side must be a path
+    expression (jq "Invalid path expression" otherwise)."""
+
+    op: str
+    target: Any
+    expr: Any
+
+
+@dataclass(frozen=True)
 class AsPattern:
     """``SRC as [$a, $b] | BODY`` / ``SRC as {k: $v} | BODY`` —
     destructuring binds; each pattern is nested lists/dicts with leaf
@@ -488,10 +503,26 @@ class _Parser:
         return Comma(tuple(parts))
 
     def parse_alt(self) -> Any:
-        node = self.parse_or()
+        node = self.parse_assign()
         while self.peek_text() == "//":
             self.next()
-            node = Alternative(node, self.parse_or())
+            node = Alternative(node, self.parse_assign())
+        return node
+
+    _ASSIGN_OPS = ("=", "|=", "+=", "-=", "*=", "/=", "%=", "//=")
+
+    def parse_assign(self) -> Any:
+        node = self.parse_or()
+        t = self.peek_text()
+        if t in self._ASSIGN_OPS:
+            self.next()
+            rhs = self.parse_or()
+            # %nonassoc in jq.y: `.a = .b = 1` is a syntax error
+            if self.peek_text() in self._ASSIGN_OPS:
+                raise KqCompileError(
+                    f"chained assignment in {self.src!r}"
+                )
+            return Assign(t, node, rhs)
         return node
 
     def parse_or(self) -> Any:
@@ -1337,6 +1368,46 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
                 )
 
         yield from build(0, "")
+    elif isinstance(node, Assign):
+        pths = list(_collect_ast_paths(node.target, value))
+        if node.op == "=":
+            # rhs is evaluated against the ORIGINAL input; one output
+            # per rhs output, all paths set to the same value (jq)
+            for v in _eval(node.expr, value, env):
+                out = value
+                for pth in pths:
+                    out = _setpath(out, pth, v)
+                yield out
+        elif node.op == "|=":
+            # per-path update with the FIRST output of the filter on
+            # the current value; an empty update deletes the path.
+            # Deletions are batched (index-safe) — GOJQ semantics, the
+            # engine the reference embeds (query.go:33); jq 1.7 itself
+            # shifts indices mid-reduce, a documented jq bug gojq fixed.
+            out = value
+            dels = []
+            for pth in pths:
+                cur = _getpath(out, pth)
+                nv = next(iter(_eval(node.expr, cur, env)), _MISSING_V)
+                if nv is _MISSING_V:
+                    dels.append(pth)
+                else:
+                    out = _setpath(out, pth, nv)
+            if dels:
+                out = _delpaths(out, dels)
+            yield out
+        else:
+            arith_op = node.op[:-1]  # "+", "-", "*", "/", "%", "//"
+            for v in _eval(node.expr, value, env):
+                out = value
+                for pth in pths:
+                    cur = _getpath(out, pth)
+                    if arith_op == "//":
+                        nv = cur if cur is not None and cur is not False else v
+                    else:
+                        nv = _arith(arith_op, cur, v)
+                    out = _setpath(out, pth, nv)
+                yield out
     elif isinstance(node, AsPattern):
         pats = node.patterns
         if len(pats) == 1:
@@ -1698,12 +1769,25 @@ def _flatten(value: Any, depth: float) -> list:
 
 
 def _collect_ast_paths(node: Any, value: Any):
-    """Paths addressed by a path expression (the subset del()/paths-of
-    use: ``.a.b``, ``.a[0]``, ``.a[]``, comma of those).  Raises for
-    non-path expressions like jq's "Invalid path expression"."""
+    """Paths addressed by a path expression (the subset del() and the
+    assignment family use: ``.a.b``, ``.a[0]``, ``.a[]``, commas and
+    pipes of those).  Raises for non-path expressions like jq's
+    "Invalid path expression"."""
     if isinstance(node, Comma):
         for part in node.parts:
             yield from _collect_ast_paths(part, value)
+        return
+    if isinstance(node, Pipe):
+        def rec(stages, prefix, val):
+            if not stages:
+                yield list(prefix)
+                return
+            for sub in _collect_ast_paths(stages[0], val):
+                yield from rec(
+                    stages[1:], list(prefix) + sub, _getpath(val, sub)
+                )
+
+        yield from rec(list(node.stages), [], value)
         return
     if not isinstance(node, Path):
         raise _KqRuntimeError("invalid path expression")

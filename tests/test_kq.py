@@ -614,3 +614,45 @@ def test_path_segment_normalization():
         {"xs": [None, None, 9]}
     ]
     assert Query("in([9, 9])").execute(1.0) == [True]
+
+
+def test_assignment_family():
+    assert Query(".a = 5").execute({"a": 1, "b": 2}) == [{"a": 5, "b": 2}]
+    # rhs sees the ORIGINAL input (jq)
+    assert Query(".a.b = .x").execute({"x": 9}) == [{"x": 9, "a": {"b": 9}}]
+    # multi-output rhs fans out
+    assert Query(".a = (1, 2) | .a").execute({}) == [1, 2]
+    # multiple target paths all get the same value
+    assert Query("(.a, .b) = 0").execute({"a": 1, "b": 2}) == [{"a": 0, "b": 0}]
+    assert Query(".a += 1").execute({"a": 1}) == [{"a": 2}]
+    assert Query(".a -= 1").execute({"a": 1}) == [{"a": 0}]
+    assert Query(".a *= 3").execute({"a": 2}) == [{"a": 6}]
+    assert Query(".a |= . * 10").execute({"a": 3}) == [{"a": 30}]
+    assert Query(".xs[] |= . + 1").execute({"xs": [1, 2]}) == [{"xs": [2, 3]}]
+    # |= empty deletes the path (jq 1.7)
+    assert Query(".a |= empty").execute({"a": 1, "b": 2}) == [{"b": 2}]
+    # //= only fills null/false
+    assert Query(".a //= 7").execute({"a": None}) == [{"a": 7}]
+    assert Query(".a //= 7").execute({"a": 3}) == [{"a": 3}]
+    # paths are created on assignment
+    assert Query(".a.b.c = 1").execute({}) == [{"a": {"b": {"c": 1}}}]
+    # non-path lhs is an error, swallowed to None like other errors
+    assert Query("(1 + 1) = 5").execute({}) is None
+    # chained assignment is a compile error (nonassoc, like jq)
+    with pytest.raises(KqCompileError):
+        Query(".a = .b = 1")
+
+
+def test_pipe_path_expressions():
+    # pipes are valid jq path expressions on an assignment lhs / in del
+    assert Query("(.a | .b) = 1").execute({"a": {}}) == [{"a": {"b": 1}}]
+    assert Query("(.xs[] | .k) = 0").execute({"xs": [{"k": 1}, {"k": 2}]}) == [
+        {"xs": [{"k": 0}, {"k": 0}]}
+    ]
+    assert Query("del(.a | .b)").execute({"a": {"b": 1, "c": 2}}) == [
+        {"a": {"c": 2}}
+    ]
+    # multi-path |= empty: batched index-safe delete — GOJQ semantics
+    # (the engine the reference embeds), which fixed jq 1.7's mid-reduce
+    # index shifting
+    assert Query(".xs[] |= empty").execute({"xs": [1, 2, 3]}) == [{"xs": []}]
