@@ -407,6 +407,7 @@ _FUNCS0 = {
     "to_entries", "from_entries", "paths", "leaf_paths", "flatten",
     "explode", "implode", "infinite", "nan", "isnan",
     "isinfinite", "isnormal", "utf8bytelength", "trim", "ltrim", "rtrim",
+    "now", "todate", "fromdate", "todateiso8601", "fromdateiso8601",
 }
 
 #: env key carrying the shared rest-of-inputs iterator for
@@ -420,7 +421,7 @@ _FUNCS1 = {
     "error", "recurse", "with_entries", "group_by", "unique_by",
     "ltrimstr", "rtrimstr", "getpath", "flatten", "in", "inside",
     "splits", "index", "rindex", "indices", "capture", "match", "del",
-    "map_values", "paths", "delpaths",
+    "map_values", "paths", "delpaths", "path",
 }
 #: multi-arg builtins: name -> allowed arities beyond 0/1
 _FUNCS_N = {
@@ -2439,6 +2440,9 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
         elif name == "del":
             pths = list(_collect_ast_paths(arg, value))
             yield _delpaths(value, pths)
+        elif name == "path":
+            for pth in _collect_ast_paths(arg, value):
+                yield pth
         elif name == "delpaths":
             for plist in _eval(arg, value, env):
                 if not isinstance(plist, list) or not all(
@@ -2565,6 +2569,34 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
             if name == "trim"
             else value.lstrip() if name == "ltrim" else value.rstrip()
         )
+    elif name == "now":
+        import time as _time
+
+        yield _time.time()
+    elif name in ("todate", "todateiso8601"):
+        import datetime as _dt
+
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise _KqRuntimeError("todate requires a number")
+        try:
+            t = _dt.datetime.fromtimestamp(value, _dt.timezone.utc)
+        except (ValueError, OverflowError, OSError) as exc:
+            raise _KqRuntimeError(f"todate: {exc}") from exc
+        yield t.strftime("%Y-%m-%dT%H:%M:%SZ")
+    elif name in ("fromdate", "fromdateiso8601"):
+        import datetime as _dt
+
+        if not isinstance(value, str):
+            raise _KqRuntimeError("fromdate requires a string")
+        try:
+            t = _dt.datetime.strptime(value, "%Y-%m-%dT%H:%M:%SZ")
+        except ValueError:
+            # tolerate fractional seconds (k8s timestamps carry them)
+            try:
+                t = _dt.datetime.strptime(value, "%Y-%m-%dT%H:%M:%S.%fZ")
+            except ValueError as exc:
+                raise _KqRuntimeError(f"fromdate: {exc}") from exc
+        yield int(t.replace(tzinfo=_dt.timezone.utc).timestamp())
     elif name == "add":
         if not isinstance(value, list):
             raise _KqRuntimeError("add over non-array")

@@ -656,3 +656,27 @@ def test_pipe_path_expressions():
     # (the engine the reference embeds), which fixed jq 1.7's mid-reduce
     # index shifting
     assert Query(".xs[] |= empty").execute({"xs": [1, 2, 3]}) == [{"xs": []}]
+
+
+def test_path_and_date_builtins():
+    assert Query("[path(.a.b, .c[])]").execute({"a": {}, "c": [1, 2]}) == [
+        [["a", "b"], ["c", 0], ["c", 1]]
+    ]
+    # round-trip at second precision, fractional-second tolerance
+    assert Query("fromdate").execute("2026-01-01T00:00:00Z") == [1767225600]
+    assert Query("todate").execute(1767225600) == ["2026-01-01T00:00:00Z"]
+    assert Query("fromdate").execute("2026-01-01T00:00:00.500Z") == [1767225600]
+    assert Query("fromdate | todate").execute("2026-01-01T00:00:00Z") == [
+        "2026-01-01T00:00:00Z"
+    ]
+    assert Query("now | . > 1e9").execute(None) == [True]
+    assert Query("fromdateiso8601").execute("2026-01-01T00:00:00Z") == [
+        1767225600
+    ]
+
+
+def test_todate_error_contract():
+    # out-of-range/NaN timestamps follow the swallow-to-None contract
+    assert Query("nan | todate").execute(None) is None
+    assert Query("todate").execute(1e18) is None
+    assert Query("todate").execute(253402300800) is None
