@@ -253,7 +253,9 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 self._send_json(201, {"status": "registered"})
             elif head == "bulk":
-                results = self.store.bulk((body or {}).get("ops") or [])
+                results = self.store.bulk(
+                    (body or {}).get("ops") or [], as_user=self._user()
+                )
                 self._send_json(200, {"results": results})
             elif head == "r" and len(rest) == 1:
                 out = self.store.create(

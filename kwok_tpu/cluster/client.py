@@ -467,6 +467,26 @@ class ClusterClient:
             headers=headers,
         )
 
+    def scale(
+        self,
+        kind: str,
+        name: str,
+        replicas: int,
+        namespace: Optional[str] = None,
+        as_user: Optional[str] = None,
+    ) -> dict:
+        """Set a workload's ``spec.replicas`` — the client side of the
+        k8s ``/scale`` subresource (same end state: one merge patch on
+        the parent, fanned out by the workload controllers)."""
+        return self.patch(
+            kind,
+            name,
+            {"spec": {"replicas": int(replicas)}},
+            patch_type="merge",
+            namespace=namespace,
+            as_user=as_user,
+        )
+
     def delete(
         self, kind: str, name: str, namespace: Optional[str] = None, as_user: Optional[str] = None
     ) -> Optional[dict]:
@@ -490,10 +510,19 @@ class ClusterClient:
 
     # ---------------------------------------------------------------- bulk
 
-    def bulk(self, ops) -> list:
+    def bulk(self, ops, as_user: Optional[str] = None) -> list:
         """One round-trip for many mutations (the device backend's
-        dirty-row drain; see ResourceStore.bulk for the op format)."""
-        data = self._request("POST", "/bulk", body={"ops": list(ops)})
+        dirty-row drain; see ResourceStore.bulk for the op format).
+        ``as_user`` stamps the HTTP audit line (each op's own
+        ``as_user`` still attributes the in-store audit entries), so
+        log consumers can tell a workload-controller wave from the
+        device drain."""
+        data = self._request(
+            "POST",
+            "/bulk",
+            body={"ops": list(ops)},
+            headers=self._user_hdr(as_user),
+        )
         return data.get("results", [])
 
     # --------------------------------------------------------------- watch

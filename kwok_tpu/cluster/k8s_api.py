@@ -22,6 +22,9 @@ service discovery — can connect to a kwok-tpu cluster:
   optional BOOKMARK events, ``limit``/``continue`` paging, and
   ``labelSelector``/``fieldSelector``/``resourceVersion`` params
 - ``POST .../pods/{name}/binding``         scheduler binding subresource
+- ``GET/PUT/PATCH .../deployments/{name}/scale`` (and replicasets) —
+  the autoscaling/v1 Scale subresource kubectl scale drives; writes
+  land as one merge patch of ``spec.replicas`` on the parent
 - ``POST /apis/apiextensions.k8s.io/v1/customresourcedefinitions``
   registers new resource types from a CRD manifest
 
@@ -45,6 +48,7 @@ from kwok_tpu.cluster.store import (
     NotFound,
     ResourceStore,
     ResourceType,
+    selector_to_string,
 )
 from kwok_tpu.cluster.tables import to_table, wants_table
 
@@ -61,7 +65,34 @@ PATCH_CONTENT_TYPES = {
 
 APPLY_CONTENT_TYPE = "application/apply-patch+yaml"
 
+#: kinds serving the ``/scale`` subresource (what a real apiserver
+#: registers it for among the kinds this store carries)
+SCALABLE_KINDS = frozenset({"Deployment", "ReplicaSet"})
+
 _BOOKMARK_EVERY = 15.0
+
+
+def scale_of(obj: dict) -> dict:
+    """Project a scalable workload object into an autoscaling/v1
+    Scale (the subresource's wire shape)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    replicas = spec.get("replicas")
+    return {
+        "kind": "Scale",
+        "apiVersion": "autoscaling/v1",
+        "metadata": {
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace"),
+            "uid": meta.get("uid"),
+            "resourceVersion": meta.get("resourceVersion"),
+        },
+        "spec": {"replicas": 1 if replicas is None else int(replicas)},
+        "status": {
+            "replicas": int((obj.get("status") or {}).get("replicas") or 0),
+            "selector": selector_to_string(spec.get("selector")) or "",
+        },
+    }
 
 
 def encode_continue(token) -> str:
@@ -533,6 +564,8 @@ class K8sFacade:
             "POST",
         ):
             return self._proxy_streaming(handler, r)
+        if r.name and r.subresource == "scale":
+            return self._scale_subresource(handler, method, r, ns)
         if method == "GET":
             if r.name is None:
                 if q.get("watch") in ("true", "1"):
@@ -653,6 +686,39 @@ class K8sFacade:
                 self._send(handler, 200, status_body(200, "", "deleted"))
             else:
                 self._send(handler, 200, self._stamp(r.rtype, out))
+            return True
+        return self._method_not_allowed(handler, method)
+
+    def _scale_subresource(self, handler, method, r: _Route, ns) -> bool:
+        """``/scale`` over the scalable workload kinds — kubectl
+        scale's wire path (a real apiserver registers the
+        autoscaling/v1 Scale subresource for deployments and
+        replicasets the same way).  GET projects the parent into a
+        Scale; PUT/PATCH of a Scale-shaped body lands as one merge
+        patch of ``spec.replicas`` on the parent, which the workload
+        controllers then fan out through the bulk lane."""
+        if r.rtype.kind not in SCALABLE_KINDS:
+            raise NotFound(
+                f"{r.rtype.plural} does not have a scale subresource"
+            )
+        if method == "GET":
+            obj = self.store.get(r.rtype.kind, r.name, namespace=ns)
+            self._send(handler, 200, scale_of(obj))
+            return True
+        if method in ("PUT", "PATCH"):
+            body = self._read_body(handler) or {}
+            replicas = (body.get("spec") or {}).get("replicas")
+            if replicas is None:
+                raise ValueError("Scale.spec.replicas is required")
+            out = self.store.patch(
+                r.rtype.kind,
+                r.name,
+                {"spec": {"replicas": int(replicas)}},
+                patch_type="merge",
+                namespace=ns,
+                as_user=self._user(handler),
+            )
+            self._send(handler, 200, scale_of(out))
             return True
         return self._method_not_allowed(handler, method)
 

@@ -3,8 +3,15 @@
 The kube-controller-manager seat in the cluster composition (reference
 pkg/kwokctl/components/kube_controller_manager.go:46 builds it;
 runtime/binary/cluster.go:316-728 starts it after the apiserver).
-Connects to the cluster apiserver and runs ownerReference garbage
-collection + namespace lifecycle (controllers/gc_controller.py).
+Connects to the cluster apiserver and runs the selected controller
+groups (``--controllers``):
+
+- ``gc`` — ownerReference garbage collection + namespace lifecycle
+  (controllers/gc_controller.py),
+- ``workloads`` — the app-level loops a real kcm hosts: ReplicaSet /
+  Deployment / Job / HorizontalPodAutoscaler (kwok_tpu.workloads),
+  reconciling over the REST client exactly as they do over an
+  in-process store.
 """
 
 from __future__ import annotations
@@ -24,6 +31,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ca-cert", default="")
     p.add_argument("--client-cert", default="")
     p.add_argument("--client-key", default="")
+    p.add_argument(
+        "--controllers",
+        default="gc,workloads",
+        help="comma list of controller groups to run (gc, workloads)",
+    )
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
@@ -42,7 +54,18 @@ def main(argv=None) -> int:
     if not client.wait_ready(timeout=60):
         print("apiserver not ready", file=sys.stderr)
         return 1
-    gc = GCController(client).start()
+    groups = {g.strip() for g in args.controllers.split(",") if g.strip()}
+    unknown = groups - {"gc", "workloads"}
+    if unknown:
+        print(f"unknown controller groups: {sorted(unknown)}", file=sys.stderr)
+        return 2
+    running = []
+    if "gc" in groups:
+        running.append(GCController(client).start())
+    if "workloads" in groups:
+        from kwok_tpu.workloads import WorkloadManager
+
+        running.append(WorkloadManager(client).start())
     print("controller-manager running", flush=True)
 
     done = threading.Event()
@@ -53,7 +76,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     done.wait()
-    gc.stop()
+    for ctrl in running:
+        ctrl.stop()
     return 0
 
 

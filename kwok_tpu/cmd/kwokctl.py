@@ -4,7 +4,8 @@ Command tree mirrors the reference (reference pkg/kwokctl/cmd/
 root.go:61-76): create/delete/start/stop cluster, get clusters/
 components/kubeconfig, scale, snapshot save/restore/export/record/
 replay, logs, hack get/put/del, config view, and a built-in kubectl
-subset (get/apply/delete) speaking to the cluster's apiserver.
+subset (get/apply/delete/scale/rollout status/logs/top/exec/attach/
+port-forward) speaking to the cluster's apiserver.
 """
 
 from __future__ import annotations
@@ -19,7 +20,8 @@ from typing import List, Optional
 
 import yaml
 
-from kwok_tpu.cluster.store import Conflict
+from kwok_tpu.cluster.k8s_api import SCALABLE_KINDS
+from kwok_tpu.cluster.store import Conflict, NotFound
 from kwok_tpu.ctl.dryrun import dry_run
 from kwok_tpu.ctl.runtime import BinaryRuntime, cluster_dir, list_clusters
 
@@ -957,12 +959,66 @@ def cmd_config_reset(args) -> int:
     return 0
 
 
+#: kubectl short names → registered kind (full kind and plural names
+#: already resolve through client.resource_type, case-insensitively)
+_KIND_SHORTNAMES = {
+    "deploy": "Deployment",
+    "rs": "ReplicaSet",
+    "hpa": "HorizontalPodAutoscaler",
+    "po": "Pod",
+    "no": "Node",
+    "ns": "Namespace",
+    "cm": "ConfigMap",
+    "svc": "Service",
+}
+
+
+def _split_kind_name(kind: str, name: str):
+    """kubectl accepts both ``TYPE NAME`` and ``TYPE/NAME``; short
+    names (deploy, rs, hpa, …) resolve like kubectl's."""
+    if not name and "/" in kind:
+        kind, name = kind.split("/", 1)
+    return _KIND_SHORTNAMES.get(kind.lower(), kind), name
+
+
 def cmd_kubectl(args) -> int:
     """Built-in kubectl subset (the reference shells out to a real
     kubectl; ours speaks the REST client directly)."""
     rt = _require_cluster(args)
     client = rt.client()
     verb = args.kubectl_verb
+    if verb in ("get", "delete", "scale", "rollout"):
+        args.kind, args.object_name = _split_kind_name(
+            args.kind, args.object_name
+        )
+        try:
+            # canonicalize full/plural/lowercase spellings (deployment,
+            # deployments, …) the way kubectl resolves resource args
+            args.kind = client.resource_type(args.kind).kind
+        except NotFound:
+            pass  # unknown kind: let the verb 404 with the raw name
+    if verb == "scale":
+        if args.kind not in SCALABLE_KINDS:
+            print(
+                f"cannot scale {args.kind}: only deployments and "
+                "replicasets serve the scale subresource",
+                file=sys.stderr,
+            )
+            return 1
+        try:
+            client.scale(
+                args.kind,
+                args.object_name,
+                args.replicas,
+                namespace=args.namespace or "default",
+            )
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(f"{args.kind.lower()}/{args.object_name} scaled")
+        return 0
+    if verb == "rollout":
+        return _rollout_status(client, args)
     if verb == "get":
         # kubectl's namespace defaulting: namespaced kinds read from
         # "default" unless -n or --all-namespaces says otherwise
@@ -1044,11 +1100,110 @@ def cmd_kubectl(args) -> int:
                 print(f"{kind}/{name} configured")
         return 0
     if verb == "delete":
-        out = client.delete(args.kind, args.object_name, namespace=args.namespace)
+        if not args.object_name:
+            print("error: a resource name is required", file=sys.stderr)
+            return 1
+        try:
+            out = client.delete(
+                args.kind, args.object_name, namespace=args.namespace
+            )
+        except NotFound as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         state = "deleted" if out is None else "terminating (finalizers)"
         print(f"{args.kind}/{args.object_name} {state}")
         return 0
     return 1
+
+
+def _rollout_status(client, args) -> int:
+    """``kubectl rollout status deployment/NAME``: poll the
+    deployment's published status until the new ReplicaSet holds all
+    replicas and they are available (kubectl's completion predicate),
+    printing kubectl's progress lines along the way."""
+    name = args.object_name
+    if args.kind != "Deployment" or not name:
+        print(
+            "rollout status supports deployments (deployment/NAME)",
+            file=sys.stderr,
+        )
+        return 1
+    ns = args.namespace or "default"
+    deadline = time.monotonic() + args.timeout
+    last = ""
+    while True:
+        try:
+            d = client.get("Deployment", name, namespace=ns)
+        except NotFound:
+            print(
+                f'error: deployment "{name}" not found in namespace {ns}',
+                file=sys.stderr,
+            )
+            return 1
+        spec = d.get("spec") or {}
+        st = d.get("status") or {}
+        desired = spec.get("replicas")
+        desired = 1 if desired is None else int(desired)
+        gen = int((d.get("metadata") or {}).get("generation") or 0)
+        observed = int(st.get("observedGeneration") or 0)
+        updated = int(st.get("updatedReplicas") or 0)
+        total = int(st.get("replicas") or 0)
+        avail = int(st.get("availableReplicas") or 0)
+        if observed < gen:
+            msg = "Waiting for deployment spec update to be observed..."
+        elif updated < desired:
+            msg = (
+                f'Waiting for deployment "{name}" rollout to finish: '
+                f"{updated} out of {desired} new replicas have been "
+                "updated..."
+            )
+        elif total > updated:
+            msg = (
+                f'Waiting for deployment "{name}" rollout to finish: '
+                f"{total - updated} old replicas are pending "
+                "termination..."
+            )
+        elif avail < updated:
+            msg = (
+                f'Waiting for deployment "{name}" rollout to finish: '
+                f"{avail} of {updated} updated replicas are available..."
+            )
+        else:
+            print(f'deployment "{name}" successfully rolled out')
+            return 0
+        if msg != last:
+            print(msg, flush=True)
+            last = msg
+        if time.monotonic() > deadline:
+            print("error: timed out waiting for the condition", file=sys.stderr)
+            return 1
+        time.sleep(0.25)
+
+
+def _workload_status(o: dict) -> str:
+    """kubectl-style READY/status summary for the workload kinds."""
+    kind = o.get("kind") or ""
+    spec = o.get("spec") or {}
+    st = o.get("status") or {}
+    if kind in ("Deployment", "ReplicaSet"):
+        desired = spec.get("replicas")
+        desired = 1 if desired is None else int(desired)
+        return f"{int(st.get('readyReplicas') or 0)}/{desired}"
+    if kind == "Job":
+        comps = spec.get("completions")
+        done = int(st.get("succeeded") or 0)
+        if any(
+            c.get("type") == "Failed" and c.get("status") == "True"
+            for c in st.get("conditions") or []
+        ):
+            return "Failed"
+        return f"{done}/{comps if comps is not None else 1}"
+    if kind == "HorizontalPodAutoscaler":
+        return (
+            f"{int(st.get('currentReplicas') or 0)}->"
+            f"{int(st.get('desiredReplicas') or 0)}"
+        )
+    return ""
 
 
 def _print_table(items: List[dict]) -> None:
@@ -1056,7 +1211,7 @@ def _print_table(items: List[dict]) -> None:
     for o in items:
         meta = o.get("metadata") or {}
         status = o.get("status") or {}
-        phase = status.get("phase") or ""
+        phase = _workload_status(o) or status.get("phase") or ""
         if not phase:
             conds = status.get("conditions") or []
             ready = next((c for c in conds if c.get("type") == "Ready"), None)
@@ -1269,10 +1424,24 @@ def build_parser() -> argparse.ArgumentParser:
     ka.add_argument("-f", "--file", required=True)
     ka.set_defaults(fn=cmd_kubectl)
     kd = pks.add_parser("delete")
-    kd.add_argument("kind")
-    kd.add_argument("object_name")
+    kd.add_argument("kind", help="TYPE (with NAME) or TYPE/NAME")
+    kd.add_argument("object_name", nargs="?", default="")
     kd.add_argument("-n", "--namespace", default=None)
     kd.set_defaults(fn=cmd_kubectl)
+    ksc = pks.add_parser("scale", help="set spec.replicas on a workload")
+    ksc.add_argument("kind", help="deployment|replicaset (or TYPE/NAME)")
+    ksc.add_argument("object_name", nargs="?", default="")
+    ksc.add_argument("--replicas", type=int, required=True)
+    ksc.add_argument("-n", "--namespace", default=None)
+    ksc.set_defaults(fn=cmd_kubectl)
+    kro = pks.add_parser("rollout", help="rollout status of a deployment")
+    kros = kro.add_subparsers(dest="rollout_verb", required=True)
+    krs = kros.add_parser("status")
+    krs.add_argument("kind", help="deployment (or deployment/NAME)")
+    krs.add_argument("object_name", nargs="?", default="")
+    krs.add_argument("-n", "--namespace", default=None)
+    krs.add_argument("--timeout", type=float, default=300.0)
+    krs.set_defaults(fn=cmd_kubectl, kubectl_verb="rollout")
     klg = pks.add_parser("logs")
     klg.add_argument("object_name")
     klg.add_argument("-n", "--namespace", default=None)

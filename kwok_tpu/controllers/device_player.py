@@ -852,6 +852,15 @@ class DeviceStagePlayer:
                 rv_start,
                 self._written_rv,
             )
+            # feed the actual consumption back: the C pass returned
+            # normally, so exactly new_rv - rv_start rows were stamped
+            # (the full reservation only matters on the exception
+            # path).  A fully-skipped chunk (n_ok == 0, all rows
+            # stale/slow/released) thus no longer advances store._rv
+            # or sets the inplace_rv history-gap marker — which would
+            # spuriously Expire watchers over a commit that wrote
+            # nothing (ADVICE r5 #1).
+            lane.rv = new_rv
             self.t_build += time.perf_counter() - tb
         self.transitions += n_ok
         self.patches += n_ok

@@ -134,8 +134,10 @@ def build_kcm_component(
     secure: bool = False,
     pki_dir: Optional[str] = None,
 ) -> Component:
-    """Controller-manager seat: ownerRef GC + namespace lifecycle
-    (reference components/kube_controller_manager.go:46
+    """Controller-manager seat: ownerRef GC + namespace lifecycle +
+    the workload loops (ReplicaSet/Deployment/Job/HPA — the app-level
+    controllers a real kcm hosts) (reference
+    components/kube_controller_manager.go:46
     BuildKubeControllerManagerComponent)."""
     args = [
         sys.executable,
@@ -143,6 +145,8 @@ def build_kcm_component(
         "kwok_tpu.cmd.kcm",
         "--server",
         server_url,
+        "--controllers",
+        "gc,workloads",
     ]
     if secure and pki_dir:
         args += [

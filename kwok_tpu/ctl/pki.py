@@ -2,7 +2,8 @@
 
 Mirrors the reference's PKI generation for the binary runtime
 (reference pkg/kwokctl/pki/pki.go:49-91 GeneratePki: CA + admin cert
-with SANs for localhost), using the ``cryptography`` package.  The
+with SANs for localhost), using the ``cryptography`` package when
+available and falling back to the ``openssl`` CLI otherwise.  The
 apiserver serves TLS with the serving cert; clients verify against the
 CA and may present the admin cert (the reference wires the same trio
 into each component's kubeconfig).
@@ -13,12 +14,19 @@ from __future__ import annotations
 import datetime
 import ipaddress
 import os
+import subprocess
+import tempfile
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - depends on environment
+    _HAVE_CRYPTOGRAPHY = False
 
 __all__ = ["generate_pki", "PKIPaths"]
 
@@ -81,6 +89,59 @@ def _sans(hosts: List[str]) -> x509.SubjectAlternativeName:
     return x509.SubjectAlternativeName(alt)
 
 
+def _openssl(*args: str) -> None:
+    subprocess.run(
+        ("openssl",) + args,
+        check=True,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _generate_pki_openssl(paths: PKIPaths, hosts: List[str]) -> PKIPaths:
+    """openssl-CLI fallback used when ``cryptography`` is unavailable."""
+    _openssl(
+        "req", "-x509", "-newkey", "rsa:2048", "-nodes", "-sha256",
+        "-days", "3650", "-keyout", paths.ca_key, "-out", paths.ca_crt,
+        "-subj", "/CN=kwok-tpu-ca/O=kwok-tpu",
+    )
+    os.chmod(paths.ca_key, 0o600)
+
+    sans = []
+    for h in hosts:
+        try:
+            ipaddress.ip_address(h)
+            sans.append("IP:%s" % h)
+        except ValueError:
+            sans.append("DNS:%s" % h)
+
+    def issue(crt: str, key: str, subj: str, server: bool) -> None:
+        ext_lines = ["extendedKeyUsage=%s" % ("serverAuth" if server else "clientAuth")]
+        if server:
+            ext_lines.append("subjectAltName=%s" % ",".join(sans))
+        with tempfile.TemporaryDirectory() as td:
+            csr = os.path.join(td, "req.csr")
+            ext = os.path.join(td, "ext.cnf")
+            with open(ext, "w") as f:
+                f.write("\n".join(ext_lines) + "\n")
+            _openssl(
+                "req", "-new", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key, "-out", csr, "-subj", subj,
+            )
+            _openssl(
+                "x509", "-req", "-in", csr, "-CA", paths.ca_crt,
+                "-CAkey", paths.ca_key, "-CAcreateserial", "-sha256",
+                "-days", "3650", "-extfile", ext, "-out", crt,
+            )
+        os.chmod(key, 0o600)
+
+    issue(paths.server_crt, paths.server_key,
+          "/CN=kwok-tpu-apiserver/O=kwok-tpu", server=True)
+    issue(paths.admin_crt, paths.admin_key,
+          "/CN=kubernetes-admin/O=system:masters", server=False)
+    return paths
+
+
 def generate_pki(
     base: str, extra_sans: Optional[List[str]] = None
 ) -> PKIPaths:
@@ -89,6 +150,9 @@ def generate_pki(
     if paths.exists():
         return paths
     os.makedirs(base, exist_ok=True)
+    if not _HAVE_CRYPTOGRAPHY:
+        hosts = ["localhost", "127.0.0.1", "::1"] + list(extra_sans or [])
+        return _generate_pki_openssl(paths, hosts)
     now = datetime.datetime.now(datetime.timezone.utc)
     not_after = now + _TEN_YEARS
 

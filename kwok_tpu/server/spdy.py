@@ -226,9 +226,18 @@ class SpdySession:
     # ------------------------------------------------------------- send side
 
     def _send(self, frame: bytes) -> bool:
+        with self._wlock:
+            return self._send_locked(frame)
+
+    def _send_locked(self, frame: bytes) -> bool:
+        """Write with ``_wlock`` already held.  Header-bearing frames
+        MUST compress and send under one continuous hold: the deflate
+        stream is stateful, so the order blocks pass through
+        ``self._deflate`` must equal the order they hit the wire, or a
+        concurrent ``open_stream``/``syn_reply`` desyncs the peer's
+        shared inflater (ADVICE r5 #2)."""
         try:
-            with self._wlock:
-                self.sock.sendall(frame)
+            self.sock.sendall(frame)
             return True
         except OSError:
             self._mark_closed()
@@ -258,8 +267,8 @@ class SpdySession:
     def syn_reply(self, stream_id: int, headers: Dict[str, str]) -> bool:
         with self._wlock:
             block = _encode_headers(headers, self._deflate)
-        payload = struct.pack(">I", stream_id & 0x7FFFFFFF) + block
-        return self._send(self._control(SYN_REPLY, 0, payload))
+            payload = struct.pack(">I", stream_id & 0x7FFFFFFF) + block
+            return self._send_locked(self._control(SYN_REPLY, 0, payload))
 
     def rst_stream(self, stream_id: int, status: int = 1) -> bool:
         payload = struct.pack(">II", stream_id & 0x7FFFFFFF, status)
@@ -284,12 +293,12 @@ class SpdySession:
         self.streams[sid] = stream
         with self._wlock:
             block = _encode_headers(headers, self._deflate)
-        payload = (
-            struct.pack(">II", sid & 0x7FFFFFFF, 0) + b"\x00\x00" + block
-        )
-        self._send(
-            self._control(SYN_STREAM, FLAG_FIN if fin else 0, payload)
-        )
+            payload = (
+                struct.pack(">II", sid & 0x7FFFFFFF, 0) + b"\x00\x00" + block
+            )
+            self._send_locked(
+                self._control(SYN_STREAM, FLAG_FIN if fin else 0, payload)
+            )
         return stream
 
     # ------------------------------------------------------------- recv side

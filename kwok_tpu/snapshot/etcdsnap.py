@@ -288,7 +288,12 @@ def load_etcd_snapshot(
         if value.startswith(_PROTO_PREFIX):
             try:
                 api_version, kind, _raw = decode_unknown_envelope(value)
-            except EtcdSnapshotError:
+            except (EtcdSnapshotError, IndexError, struct.error):
+                # a corrupt/truncated envelope (varint walking off the
+                # end raises IndexError/struct.error, not just the
+                # typed error) still lands in ``skipped`` instead of
+                # escaping ``kwokctl snapshot restore`` as a traceback
+                # (ADVICE r5 #5)
                 api_version = kind = "?"
             skipped.append((ks, api_version, kind))
             continue

@@ -49,6 +49,16 @@ expressions, jq's original-input rhs and first-output update
 semantics; ``|= empty`` deletes).  Unbound ``$vars`` and breaks
 outside their label are compile errors like jq.
 
+Lhs path-expression subset (assignment targets, ``del``, ``path``):
+field/index/iterate navigation (``.a.b``, ``.a[0]``, ``.a[]``),
+commas and pipes of those, ``select(cond)`` stages, and the ``?``
+suppressor (``.a? = x`` on a scalar yields the input unchanged, like
+jq's empty-paths semantics).  Array slices (``.a[1:2]``) are not in
+the grammar at all — a slice lhs is a parse error, not a silent
+no-op.  Anything else in path position raises jq's "invalid path
+expression" (swallowed to an empty result like every other runtime
+error).
+
 The AST node classes (Path/Field/Iterate/Pipe/Select/Compare/Literal)
 are public shape contracts: the device compiler pattern-matches them to
 lower selector expressions (engine/features.py).
@@ -1370,7 +1380,7 @@ def _eval(node: Any, value: Any, env: dict) -> Iterator[Any]:
 
         yield from build(0, "")
     elif isinstance(node, Assign):
-        pths = list(_collect_ast_paths(node.target, value))
+        pths = list(_collect_ast_paths(node.target, value, env))
         if node.op == "=":
             # rhs is evaluated against the ORIGINAL input; one output
             # per rhs output, all paths set to the same value (jq)
@@ -1769,29 +1779,51 @@ def _flatten(value: Any, depth: float) -> list:
     return out
 
 
-def _collect_ast_paths(node: Any, value: Any):
+def _collect_ast_paths(node: Any, value: Any, env: Optional[dict] = None):
     """Paths addressed by a path expression (the subset del() and the
     assignment family use: ``.a.b``, ``.a[0]``, ``.a[]``, commas and
-    pipes of those).  Raises for non-path expressions like jq's
-    "Invalid path expression"."""
+    pipes of those, ``select(cond)`` stages, and the ``?`` suppressor
+    — ``.a?``/``(expr)?`` drops error branches instead of aborting, so
+    ``.a? = x`` on a scalar input yields the input unchanged like jq).
+    Raises for non-path expressions like jq's "Invalid path
+    expression"; slices are not in the grammar (see the module
+    docstring's lhs-subset note)."""
+    env = env or {}
     if isinstance(node, Comma):
         for part in node.parts:
-            yield from _collect_ast_paths(part, value)
+            yield from _collect_ast_paths(part, value, env)
         return
     if isinstance(node, Pipe):
         def rec(stages, prefix, val):
             if not stages:
                 yield list(prefix)
                 return
-            for sub in _collect_ast_paths(stages[0], val):
+            for sub in _collect_ast_paths(stages[0], val, env):
                 yield from rec(
                     stages[1:], list(prefix) + sub, _getpath(val, sub)
                 )
 
         yield from rec(list(node.stages), [], value)
         return
+    if isinstance(node, Optional_):
+        # `(expr)?` — suppress path-collection errors: the erroring
+        # branches contribute no paths (jq: `paths(.a?)` on 5 is empty)
+        try:
+            yield from list(_collect_ast_paths(node.expr, value, env))
+        except _KqRuntimeError:
+            return
+        return
+    if isinstance(node, Select):
+        # `select(cond)` in path position addresses the identity path
+        # for every truthy cond output — the lhs shape
+        # `(.a | select(. == null)) = x` uses
+        for out in _eval(node.cond, value, env):
+            if out is not None and out is not False:
+                yield []
+        return
     if not isinstance(node, Path):
         raise _KqRuntimeError("invalid path expression")
+    optional = node.optional
     prefixes: List[tuple] = [()]
     cur_vals: List[Any] = [value]
     for op in node.ops:
@@ -1799,9 +1831,15 @@ def _collect_ast_paths(node: Any, value: Any):
         nxt_v: List[Any] = []
         for pref, cur in zip(prefixes, cur_vals):
             if isinstance(op, Field):
+                if cur is not None and not isinstance(cur, dict):
+                    if optional:
+                        continue  # `?`: drop the erroring branch
+                    # keep the path: _setpath raises the jq error
                 nxt_p.append(pref + (op.name,))
                 nxt_v.append(cur.get(op.name) if isinstance(cur, dict) else None)
             elif isinstance(op, Index):
+                if cur is not None and not isinstance(cur, list) and optional:
+                    continue
                 nxt_p.append(pref + (op.i,))
                 nxt_v.append(
                     cur[op.i]
@@ -1819,6 +1857,8 @@ def _collect_ast_paths(node: Any, value: Any):
                         nxt_v.append(v)
                 elif cur is None:
                     continue
+                elif optional:
+                    continue  # `.a[]?` over a non-iterable: no paths
                 else:
                     raise _KqRuntimeError(
                         f"cannot iterate over {_jq_type(cur)}"
@@ -2438,10 +2478,10 @@ def _eval_func(node: Func, value: Any, env: dict) -> Iterator[Any]:
             for pat in _eval(arg, value, env):
                 yield from _regex_stream(name, value, pat, None)
         elif name == "del":
-            pths = list(_collect_ast_paths(arg, value))
+            pths = list(_collect_ast_paths(arg, value, env))
             yield _delpaths(value, pths)
         elif name == "path":
-            for pth in _collect_ast_paths(arg, value):
+            for pth in _collect_ast_paths(arg, value, env):
                 yield pth
         elif name == "delpaths":
             for plist in _eval(arg, value, env):

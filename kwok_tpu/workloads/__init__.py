@@ -1,0 +1,35 @@
+"""Workload controller subsystem: the app-level control loops a real
+cluster gets from kube-controller-manager — ReplicaSet, Deployment
+(rolling updates over RS revisions), Job, and HorizontalPodAutoscaler
+driven by the simulated-usage engine.  See manager.WorkloadManager for
+the composition; every loop is store-duck-typed and reconciles through
+the store's bulk-mutation lane.
+"""
+
+from kwok_tpu.workloads.common import (
+    BULK_CHUNK,
+    CONTROLLER_USER,
+    POD_TEMPLATE_HASH,
+    REVISION_ANN,
+    pod_template_hash,
+    selector_to_string,
+)
+from kwok_tpu.workloads.deployment import DeploymentController
+from kwok_tpu.workloads.hpa import HPAController
+from kwok_tpu.workloads.job import JobController
+from kwok_tpu.workloads.manager import WorkloadManager
+from kwok_tpu.workloads.replicaset import ReplicaSetController
+
+__all__ = [
+    "BULK_CHUNK",
+    "CONTROLLER_USER",
+    "POD_TEMPLATE_HASH",
+    "REVISION_ANN",
+    "DeploymentController",
+    "HPAController",
+    "JobController",
+    "ReplicaSetController",
+    "WorkloadManager",
+    "pod_template_hash",
+    "selector_to_string",
+]

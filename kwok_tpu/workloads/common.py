@@ -1,0 +1,273 @@
+"""Shared machinery for the workload controllers.
+
+The reference gets ReplicaSet/Deployment/Job/HPA behavior for free by
+composing a real kube-controller-manager into every cluster (reference
+pkg/kwokctl/components/kube_controller_manager.go:46); this package is
+the rebuild's seat for those app-level control loops.  This module
+holds what every loop shares:
+
+- the pod-template revision hash (the ``pod-template-hash`` label a
+  Deployment stamps on each ReplicaSet generation — k8s's
+  ControllerRevision hash, upstream pkg/controller/deployment/util),
+- label-selector handling (``matchLabels`` + ``matchExpressions``
+  rendered to the store's selector grammar, so listing a workload's
+  pods is one indexed store query),
+- controller ownerReferences and owned-by checks (feeding the existing
+  GC cascade in controllers/gc_controller.py),
+- pod stamping from a workload's ``spec.template`` (the in-cluster
+  analog of ctl/scale.py's per-index rendering: same generateName
+  uniqueness, no per-pod YAML round-trip),
+- ``BulkWriter``: the bulk-mutation lane.  Reconciliation never issues
+  per-pod requests — creates/deletes accumulate and flush through
+  ``store.bulk`` in large chunks, so scaling a Deployment by 100k
+  replicas costs O(replicas / chunk) round-trips (each marked in the
+  store's audit log as one ``bulk`` entry), not 100k PATCHes.
+
+Store-duck-typed like every controller here: a ResourceStore or a
+ClusterClient both work (the separate-daemon topology rides
+``python -m kwok_tpu.cmd.kcm --controllers gc,workloads``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import json
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from kwok_tpu.cluster.store import selector_to_string  # noqa: F401 — re-export
+from kwok_tpu.utils.log import get_logger
+
+logger = get_logger("workloads")
+
+#: the label a Deployment stamps on every ReplicaSet generation and its
+#: pods (upstream apps/v1 convention; `kubectl get rs --show-labels`
+#: surfaces the same key on real clusters)
+POD_TEMPLATE_HASH = "pod-template-hash"
+
+#: revision annotation on Deployment-owned ReplicaSets (upstream key)
+REVISION_ANN = "deployment.kubernetes.io/revision"
+
+#: impersonation identity the workload loops mutate under — audit log
+#: lines attribute workload writes to this user
+CONTROLLER_USER = "system:kwok-workloads"
+
+#: ops per store.bulk round-trip.  Large on purpose: the O(round-trips)
+#: ≪ O(replicas) contract means a 100k-replica scale is ~10 calls.
+BULK_CHUNK = 10_000
+
+
+def now_string(now_s: Optional[float] = None) -> str:
+    import time as _time
+
+    t = datetime.datetime.fromtimestamp(
+        now_s if now_s is not None else _time.time(), datetime.timezone.utc
+    )
+    return t.isoformat(timespec="seconds").replace("+00:00", "Z")
+
+
+# ------------------------------------------------------------------ selectors
+
+
+def pod_template_hash(template: dict) -> str:
+    """Stable 10-hex revision hash of a pod template (process- and
+    run-independent, so a restarted controller adopts the same RS)."""
+    canon = json.dumps(template or {}, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(canon.encode()).hexdigest()[:10]
+
+
+def owner_reference(obj: dict, controller: bool = True) -> dict:
+    meta = obj.get("metadata") or {}
+    ref = {
+        "apiVersion": obj.get("apiVersion") or "",
+        "kind": obj.get("kind") or "",
+        "name": meta.get("name") or "",
+        "uid": meta.get("uid") or "",
+    }
+    if controller:
+        ref["controller"] = True
+        ref["blockOwnerDeletion"] = True
+    return ref
+
+
+def owned_by(obj: dict, owner: dict) -> bool:
+    """Is ``obj`` controlled by ``owner``?  uid wins when both sides
+    carry one (a re-created owner must not adopt the old generation's
+    pods); kind+name otherwise."""
+    ometa = owner.get("metadata") or {}
+    want_uid = ometa.get("uid")
+    for ref in (obj.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("kind") != owner.get("kind"):
+            continue
+        ref_uid = ref.get("uid")
+        if want_uid and ref_uid:
+            if ref_uid == want_uid:
+                return True
+            continue
+        if ref.get("name") == ometa.get("name"):
+            return True
+    return False
+
+
+def resolve_int_or_percent(value: Any, total: int, round_up: bool) -> int:
+    """k8s intstr semantics: ints pass through, "25%" resolves against
+    ``total`` (ceil for maxSurge, floor for maxUnavailable)."""
+    if value is None:
+        return 0
+    if isinstance(value, str) and value.endswith("%"):
+        frac = float(value[:-1] or 0) / 100.0
+        return (
+            math.ceil(frac * total) if round_up else math.floor(frac * total)
+        )
+    return int(value)
+
+
+# ------------------------------------------------------------------ pod state
+
+
+def pod_is_terminal(pod: dict) -> bool:
+    return ((pod.get("status") or {}).get("phase")) in ("Succeeded", "Failed")
+
+
+def pod_is_active(pod: dict) -> bool:
+    """Counts toward a workload's replicas: not terminal, not already
+    terminating (a deletionTimestamp'd pod is on its way out through
+    the stage machinery and must be replaced now, like k8s)."""
+    if (pod.get("metadata") or {}).get("deletionTimestamp"):
+        return False
+    return not pod_is_terminal(pod)
+
+
+def pod_is_ready(pod: dict) -> bool:
+    status = pod.get("status") or {}
+    if status.get("phase") != "Running":
+        return False
+    for c in status.get("conditions") or []:
+        if c.get("type") == "Ready":
+            return c.get("status") == "True"
+    return False
+
+
+def _deletion_class(pod: dict) -> int:
+    """Scale-down victim class (the spirit of k8s's
+    ActivePodsWithRanks: unscheduled < unready < ready)."""
+    if not (pod.get("spec") or {}).get("nodeName"):
+        return 0
+    if not pod_is_ready(pod):
+        return 1
+    return 2
+
+
+def rank_for_deletion(pods: List[dict]) -> List[dict]:
+    """Victims-first ordering (take the first N to scale down by N):
+    unscheduled, then unready, then ready pods; youngest first within
+    a class.  creationTimestamps share a second at bulk-create rates,
+    so the monotonic uid breaks ties deterministically."""
+
+    def age_key(pod: dict) -> Tuple[str, str]:
+        meta = pod.get("metadata") or {}
+        return (meta.get("creationTimestamp") or "", meta.get("uid") or "")
+
+    # youngest-first within class: descending age key, then a stable
+    # ascending sort on the class
+    by_age = sorted(pods, key=age_key, reverse=True)
+    return sorted(by_age, key=_deletion_class)
+
+
+def stamp_pod(
+    template: dict,
+    namespace: str,
+    owner: dict,
+    generate_name: str,
+    extra_labels: Optional[Dict[str, str]] = None,
+) -> dict:
+    """One pod from a workload's ``spec.template``: metadata rebuilt
+    (generateName uniqueness rides the store's uid counter, the same
+    mechanism ctl/scale.py's streamed creates use), labels from the
+    template plus ``extra_labels``, controller ownerReference set."""
+    from kwok_tpu.utils.patch import copy_json
+
+    tmeta = template.get("metadata") or {}
+    labels = dict(tmeta.get("labels") or {})
+    labels.update(extra_labels or {})
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "generateName": generate_name,
+            "namespace": namespace,
+            "labels": labels,
+            "ownerReferences": [owner_reference(owner)],
+        },
+        "spec": copy_json(template.get("spec") or {}),
+    }
+    if tmeta.get("annotations"):
+        pod["metadata"]["annotations"] = copy_json(tmeta["annotations"])
+    return pod
+
+
+# ---------------------------------------------------------------- bulk writes
+
+
+class BulkWriter:
+    """Accumulate mutations, flush through ``store.bulk`` in
+    ``BULK_CHUNK``-sized round-trips.  Per-op errors are collected, not
+    raised (reconcile loops are retried by the resync tick; a half
+    successful wave still moved toward the goal)."""
+
+    def __init__(self, store, chunk: int = BULK_CHUNK):
+        self.store = store
+        self.chunk = chunk
+        self._ops: List[dict] = []
+        self.results: List[dict] = []
+        self.errors: List[dict] = []
+        self.round_trips = 0
+
+    def create(self, obj: dict, namespace: Optional[str] = None) -> None:
+        self._ops.append(
+            {
+                "verb": "create",
+                "data": obj,
+                "namespace": namespace,
+                "as_user": CONTROLLER_USER,
+            }
+        )
+        if len(self._ops) >= self.chunk:
+            self.flush()
+
+    def delete(self, kind: str, name: str, namespace: Optional[str]) -> None:
+        self._ops.append(
+            {
+                "verb": "delete",
+                "kind": kind,
+                "name": name,
+                "namespace": namespace,
+                "as_user": CONTROLLER_USER,
+            }
+        )
+        if len(self._ops) >= self.chunk:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._ops:
+            return
+        ops, self._ops = self._ops, []
+        # as_user doubles as the HTTP audit-line attribution when the
+        # store is a ClusterClient (each op carries it for the in-store
+        # audit either way)
+        res = self.store.bulk(ops, as_user=CONTROLLER_USER)
+        self.round_trips += 1
+        self.results.extend(res)
+        fresh = 0
+        for op, r in zip(ops, res):
+            if r.get("status") != "ok" and r.get("reason") != "NotFound":
+                # NotFound deletes are fine (raced the GC cascade)
+                self.errors.append({"op": op, "result": r})
+                fresh += 1
+        if fresh:
+            logger.info(
+                "bulk flush had errors",
+                n=fresh,
+                first=str(self.errors[-fresh]["result"].get("error", ""))[:120],
+            )

@@ -1,0 +1,355 @@
+"""Deployment reconciliation: ReplicaSet revisions + rolling updates
+(the kube-controller-manager deployment loop; upstream
+pkg/controller/deployment — behavioral reference only).
+
+Revision model: each distinct ``spec.template`` hashes to a
+``pod-template-hash`` (common.pod_template_hash); the Deployment owns
+one ReplicaSet per hash, named ``{deployment}-{hash}``, carrying the
+``deployment.kubernetes.io/revision`` annotation.  A template edit
+creates the next revision's RS and the rolling logic walks replicas
+across:
+
+- **RollingUpdate** (default): the new RS may scale up while total
+  replicas stay ≤ desired + maxSurge; old RSes scale down while total
+  available stays ≥ desired - maxUnavailable (percentages resolve
+  ceil/floor against ``spec.replicas``, k8s intstr semantics).  Each
+  reconcile moves one step; RS/pod status events re-trigger it until
+  the new RS holds all replicas.
+- **Recreate**: old RSes drop to 0 first; the new RS scales only once
+  no old pods remain.
+
+Old all-zero ReplicaSets beyond ``revisionHistoryLimit`` (default 10)
+are deleted.  Deployment deletion is not handled here at all: the GC
+cascade (RS ownerReferences → pod ownerReferences) tears the tree
+down.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from kwok_tpu.cluster.store import AlreadyExists, NotFound
+from kwok_tpu.utils.patch import copy_json
+from kwok_tpu.workloads.common import (
+    CONTROLLER_USER,
+    POD_TEMPLATE_HASH,
+    REVISION_ANN,
+    owned_by,
+    owner_reference,
+    pod_template_hash,
+    resolve_int_or_percent,
+    selector_to_string,
+)
+
+__all__ = ["DeploymentController"]
+
+DEFAULT_HISTORY_LIMIT = 10
+
+
+def _rs_available(rs: dict) -> int:
+    return int((rs.get("status") or {}).get("availableReplicas") or 0)
+
+
+def _rs_spec_replicas(rs: dict) -> int:
+    r = (rs.get("spec") or {}).get("replicas")
+    return 1 if r is None else int(r)
+
+
+def _revision(rs: dict) -> int:
+    try:
+        return int(
+            ((rs.get("metadata") or {}).get("annotations") or {}).get(
+                REVISION_ANN
+            )
+            or 0
+        )
+    except (TypeError, ValueError):
+        return 0
+
+
+class DeploymentController:
+    def __init__(self, store, recorder=None):
+        self.store = store
+        self.recorder = recorder
+
+    # ------------------------------------------------------------- helpers
+
+    def _owned_replicasets(self, deploy: dict) -> List[dict]:
+        meta = deploy.get("metadata") or {}
+        sel = selector_to_string((deploy.get("spec") or {}).get("selector"))
+        items, _ = self.store.list(
+            "ReplicaSet",
+            namespace=meta.get("namespace") or "default",
+            label_selector=sel,
+        )
+        return [rs for rs in items if owned_by(rs, deploy)]
+
+    def _scale_rs(self, rs: dict, replicas: int) -> None:
+        meta = rs.get("metadata") or {}
+        if _rs_spec_replicas(rs) == replicas:
+            return
+        try:
+            self.store.patch(
+                "ReplicaSet",
+                meta.get("name") or "",
+                {"spec": {"replicas": replicas}},
+                patch_type="merge",
+                namespace=meta.get("namespace"),
+                as_user=CONTROLLER_USER,
+            )
+        except NotFound:
+            return
+        # keep the in-memory view current for this pass's math
+        rs.setdefault("spec", {})["replicas"] = replicas
+        if self.recorder is not None:
+            self.recorder.event(
+                rs,
+                "Normal",
+                "ScalingReplicaSet",
+                f"Scaled replica set {meta.get('name')} to {replicas}",
+            )
+
+    def _new_replicaset(
+        self, deploy: dict, tpl_hash: str, all_rs: List[dict]
+    ) -> Optional[dict]:
+        """Create (or fetch, on a name race) the revision RS for the
+        current template."""
+        meta = deploy.get("metadata") or {}
+        spec = deploy.get("spec") or {}
+        name = f"{meta.get('name')}-{tpl_hash}"
+        ns = meta.get("namespace") or "default"
+        revision = max([_revision(rs) for rs in all_rs], default=0) + 1
+        template = copy_json(spec.get("template") or {})
+        tmeta = template.setdefault("metadata", {})
+        tmeta.setdefault("labels", {})[POD_TEMPLATE_HASH] = tpl_hash
+        selector = copy_json(spec.get("selector") or {"matchLabels": {}})
+        selector.setdefault("matchLabels", {})[POD_TEMPLATE_HASH] = tpl_hash
+        rs = {
+            "apiVersion": "apps/v1",
+            "kind": "ReplicaSet",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": dict(tmeta["labels"]),
+                "annotations": {REVISION_ANN: str(revision)},
+                "ownerReferences": [owner_reference(deploy)],
+            },
+            "spec": {
+                "replicas": 0,
+                "selector": selector,
+                "template": template,
+            },
+        }
+        try:
+            return self.store.create(rs, namespace=ns, as_user=CONTROLLER_USER)
+        except AlreadyExists:
+            try:
+                return self.store.get("ReplicaSet", name, namespace=ns)
+            except NotFound:
+                return None
+
+    @staticmethod
+    def _surge_unavailable(spec: dict, desired: int) -> Tuple[int, int]:
+        strategy = spec.get("strategy") or {}
+        ru = strategy.get("rollingUpdate") or {}
+        surge = resolve_int_or_percent(
+            ru.get("maxSurge", "25%"), desired, round_up=True
+        )
+        unavail = resolve_int_or_percent(
+            ru.get("maxUnavailable", "25%"), desired, round_up=False
+        )
+        if surge == 0 and unavail == 0:
+            unavail = 1  # k8s validation forbids both zero; stay live
+        return surge, unavail
+
+    # ----------------------------------------------------------- reconcile
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        try:
+            deploy = self.store.get("Deployment", name, namespace=namespace)
+        except NotFound:
+            return
+        meta = deploy.get("metadata") or {}
+        if meta.get("deletionTimestamp"):
+            return
+        spec = deploy.get("spec") or {}
+        desired = spec.get("replicas")
+        desired = 1 if desired is None else int(desired)
+        tpl_hash = pod_template_hash(spec.get("template") or {})
+
+        all_rs = self._owned_replicasets(deploy)
+        new_rs = next(
+            (
+                rs
+                for rs in all_rs
+                if ((rs.get("metadata") or {}).get("labels") or {}).get(
+                    POD_TEMPLATE_HASH
+                )
+                == tpl_hash
+            ),
+            None,
+        )
+        paused = bool(spec.get("paused"))
+        if new_rs is None and not paused:
+            new_rs = self._new_replicaset(deploy, tpl_hash, all_rs)
+            if new_rs is not None:
+                all_rs.append(new_rs)
+        old_rs = [rs for rs in all_rs if rs is not new_rs]
+
+        if not paused and new_rs is not None:
+            strategy_type = (spec.get("strategy") or {}).get(
+                "type", "RollingUpdate"
+            )
+            if strategy_type == "Recreate":
+                self._reconcile_recreate(deploy, desired, new_rs, old_rs)
+            else:
+                self._reconcile_rolling(deploy, desired, new_rs, old_rs)
+
+        self._cleanup_history(spec, old_rs)
+        self._sync_status(deploy, desired, new_rs, all_rs)
+
+    def _reconcile_rolling(
+        self, deploy: dict, desired: int, new_rs: dict, old_rs: List[dict]
+    ) -> None:
+        surge, unavail = self._surge_unavailable(
+            deploy.get("spec") or {}, desired
+        )
+        total = _rs_spec_replicas(new_rs) + sum(
+            _rs_spec_replicas(rs) for rs in old_rs
+        )
+        # scale up the new RS within the surge ceiling
+        cur_new = _rs_spec_replicas(new_rs)
+        if cur_new < desired:
+            headroom = desired + surge - total
+            if headroom > 0:
+                self._scale_rs(
+                    new_rs, min(desired, cur_new + headroom)
+                )
+        elif cur_new > desired:
+            # direct downscale (kubectl scale) bypasses the budget:
+            # the surplus was never part of availability guarantees
+            self._scale_rs(new_rs, desired)
+
+        # scale down old RSes within the availability floor
+        live_old = [rs for rs in old_rs if _rs_spec_replicas(rs) > 0]
+        if not live_old:
+            return
+        total_available = _rs_available(new_rs) + sum(
+            _rs_available(rs) for rs in live_old
+        )
+        budget = total_available - (desired - unavail)
+        # pods an old RS runs beyond its available count are already
+        # unavailable — removing them cannot violate the floor
+        for rs in sorted(live_old, key=_revision):
+            if budget <= 0:
+                break
+            cur = _rs_spec_replicas(rs)
+            unavailable_here = max(0, cur - _rs_available(rs))
+            take = min(cur, budget + unavailable_here)
+            if take > 0:
+                self._scale_rs(rs, cur - take)
+                budget -= max(0, take - unavailable_here)
+
+    def _reconcile_recreate(
+        self, deploy: dict, desired: int, new_rs: dict, old_rs: List[dict]
+    ) -> None:
+        live_old = [rs for rs in old_rs if _rs_spec_replicas(rs) > 0]
+        for rs in live_old:
+            self._scale_rs(rs, 0)
+        old_pods_left = sum(
+            int((rs.get("status") or {}).get("replicas") or 0)
+            for rs in old_rs
+        )
+        if not live_old and old_pods_left == 0:
+            self._scale_rs(new_rs, desired)
+
+    def _cleanup_history(self, spec: dict, old_rs: List[dict]) -> None:
+        limit = spec.get("revisionHistoryLimit")
+        limit = DEFAULT_HISTORY_LIMIT if limit is None else int(limit)
+        dead = [
+            rs
+            for rs in old_rs
+            if _rs_spec_replicas(rs) == 0
+            and int((rs.get("status") or {}).get("replicas") or 0) == 0
+        ]
+        dead.sort(key=_revision)  # oldest first
+        for rs in dead[: max(0, len(dead) - limit)]:
+            meta = rs.get("metadata") or {}
+            try:
+                self.store.delete(
+                    "ReplicaSet",
+                    meta.get("name") or "",
+                    namespace=meta.get("namespace"),
+                    as_user=CONTROLLER_USER,
+                )
+            except NotFound:
+                pass
+
+    def _sync_status(
+        self,
+        deploy: dict,
+        desired: int,
+        new_rs: Optional[dict],
+        all_rs: List[dict],
+    ) -> None:
+        meta = deploy.get("metadata") or {}
+        replicas = sum(
+            int((rs.get("status") or {}).get("replicas") or 0) for rs in all_rs
+        )
+        ready = sum(
+            int((rs.get("status") or {}).get("readyReplicas") or 0)
+            for rs in all_rs
+        )
+        available = sum(_rs_available(rs) for rs in all_rs)
+        updated = (
+            int((new_rs.get("status") or {}).get("replicas") or 0)
+            if new_rs is not None
+            else 0
+        )
+        _, unavail = self._surge_unavailable(deploy.get("spec") or {}, desired)
+        conditions = [
+            {
+                "type": "Available",
+                "status": (
+                    "True" if available >= desired - unavail else "False"
+                ),
+                "reason": (
+                    "MinimumReplicasAvailable"
+                    if available >= desired - unavail
+                    else "MinimumReplicasUnavailable"
+                ),
+            },
+            {
+                "type": "Progressing",
+                "status": "True",
+                "reason": (
+                    "NewReplicaSetAvailable"
+                    if updated == desired and available == desired
+                    else "ReplicaSetUpdated"
+                ),
+            },
+        ]
+        status = {
+            "replicas": replicas,
+            "updatedReplicas": updated,
+            "readyReplicas": ready,
+            "availableReplicas": available,
+            "unavailableReplicas": max(0, desired - available),
+            "observedGeneration": meta.get("generation") or 0,
+            "conditions": conditions,
+        }
+        cur = deploy.get("status") or {}
+        if all(cur.get(k) == v for k, v in status.items()):
+            return
+        try:
+            self.store.patch(
+                "Deployment",
+                meta.get("name") or "",
+                {"status": status},
+                patch_type="merge",
+                namespace=meta.get("namespace"),
+                subresource="status",
+                as_user=CONTROLLER_USER,
+            )
+        except NotFound:
+            pass

@@ -1,0 +1,266 @@
+"""Job reconciliation: completions / parallelism / backoffLimit (the
+kube-controller-manager job loop; upstream pkg/controller/job —
+behavioral reference only).
+
+The pod-state model is the same one the stage FSM drives: a job pod
+that reaches ``status.phase: Succeeded`` counts toward completions, a
+``Failed`` one toward the backoff budget.  One reconcile pass:
+
+1. read the Job; terminating → GC's problem; already finished
+   (Complete/Failed condition) → nothing to do,
+2. list owned pods, bucket into active/succeeded/failed,
+3. terminal states: succeeded ≥ completions ⇒ ``Complete`` (actives
+   are torn down through the bulk lane); failed > backoffLimit ⇒
+   ``Failed`` (likewise),
+4. otherwise converge on parallelism: surplus workers (a reduced
+   ``spec.parallelism``) are reaped victims-first, missing ones are
+   topped up to min(parallelism, completions - succeeded - active),
+   stamped from ``spec.template`` — both through the bulk lane,
+5. publish ``status`` (active/succeeded/failed/startTime/
+   completionTime/conditions) when changed.
+
+``spec.completions`` unset follows k8s's "any pod succeeding completes
+the job" mode with parallelism workers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kwok_tpu.cluster.store import NotFound
+from kwok_tpu.workloads.common import (
+    BulkWriter,
+    CONTROLLER_USER,
+    now_string,
+    owned_by,
+    pod_is_terminal,
+    rank_for_deletion,
+    selector_to_string,
+    stamp_pod,
+)
+
+__all__ = ["JobController"]
+
+DEFAULT_BACKOFF_LIMIT = 6
+
+
+def _condition(job: dict, ctype: str) -> Optional[dict]:
+    for c in (job.get("status") or {}).get("conditions") or []:
+        if c.get("type") == ctype and c.get("status") == "True":
+            return c
+    return None
+
+
+class JobController:
+    def __init__(self, store, recorder=None, bulk_chunk: Optional[int] = None):
+        self.store = store
+        self.recorder = recorder
+        self.bulk_chunk = bulk_chunk
+
+    def _writer(self) -> BulkWriter:
+        if self.bulk_chunk:
+            return BulkWriter(self.store, chunk=self.bulk_chunk)
+        return BulkWriter(self.store)
+
+    def _owned_pods(self, job: dict) -> List[dict]:
+        meta = job.get("metadata") or {}
+        spec = job.get("spec") or {}
+        sel = selector_to_string(spec.get("selector"))
+        if sel is None:
+            # jobs usually run selector-less; match by template labels
+            # when present, else scan the namespace (owned_by filters)
+            sel = selector_to_string(
+                {
+                    "matchLabels": (
+                        (spec.get("template") or {}).get("metadata") or {}
+                    ).get("labels")
+                    or {}
+                }
+            )
+        pods, _ = self.store.list(
+            "Pod",
+            namespace=meta.get("namespace") or "default",
+            label_selector=sel,
+        )
+        return [p for p in pods if owned_by(p, job)]
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        try:
+            job = self.store.get("Job", name, namespace=namespace)
+        except NotFound:
+            return
+        meta = job.get("metadata") or {}
+        if meta.get("deletionTimestamp"):
+            return
+        spec = job.get("spec") or {}
+        parallelism = spec.get("parallelism")
+        parallelism = 1 if parallelism is None else int(parallelism)
+        completions = spec.get("completions")
+        completions = None if completions is None else int(completions)
+        backoff_limit = spec.get("backoffLimit")
+        backoff_limit = (
+            DEFAULT_BACKOFF_LIMIT if backoff_limit is None else int(backoff_limit)
+        )
+
+        pods = self._owned_pods(job)
+        active = [
+            p
+            for p in pods
+            if not pod_is_terminal(p)
+            and not (p.get("metadata") or {}).get("deletionTimestamp")
+        ]
+        succeeded = sum(
+            1
+            for p in pods
+            if (p.get("status") or {}).get("phase") == "Succeeded"
+        )
+        failed = sum(
+            1 for p in pods if (p.get("status") or {}).get("phase") == "Failed"
+        )
+
+        finished = _condition(job, "Complete") or _condition(job, "Failed")
+        complete = (
+            succeeded >= completions
+            if completions is not None
+            else (succeeded > 0 and not active)
+        )
+        failed_out = failed > backoff_limit
+
+        writer = self._writer()
+        if finished or complete or failed_out:
+            # terminal: reap still-running workers through the bulk lane
+            for p in active:
+                pmeta = p.get("metadata") or {}
+                writer.delete("Pod", pmeta.get("name") or "", namespace)
+            writer.flush()
+            active = []
+        elif len(active) > parallelism:
+            # parallelism was reduced: reap the surplus workers like
+            # upstream (victims-first ranking, through the bulk lane)
+            victims = rank_for_deletion(active)[: len(active) - parallelism]
+            victim_names = set()
+            for p in victims:
+                pmeta = p.get("metadata") or {}
+                victim_names.add(pmeta.get("name") or "")
+                writer.delete("Pod", pmeta.get("name") or "", namespace)
+            writer.flush()
+            active = [
+                p
+                for p in active
+                if (p.get("metadata") or {}).get("name") not in victim_names
+            ]
+            if self.recorder is not None and victims:
+                self.recorder.event(
+                    job,
+                    "Normal",
+                    "SuccessfulDelete",
+                    f"Deleted {len(victims)} surplus pods",
+                )
+        else:
+            if completions is None:
+                # "any success completes" mode: keep `parallelism`
+                # workers — but once any pod has succeeded, no new pods
+                # are created (upstream semantics); the job completes
+                # when the remaining actives drain
+                missing = 0 if succeeded > 0 else parallelism - len(active)
+            else:
+                remaining = completions - succeeded - len(active)
+                missing = min(parallelism - len(active), remaining)
+            if missing > 0:
+                template = spec.get("template") or {}
+                for _ in range(missing):
+                    writer.create(
+                        stamp_pod(
+                            template,
+                            namespace,
+                            job,
+                            generate_name=f"{name}-",
+                        ),
+                        namespace=namespace,
+                    )
+                writer.flush()
+                if self.recorder is not None:
+                    self.recorder.event(
+                        job,
+                        "Normal",
+                        "SuccessfulCreate",
+                        f"Created {missing} pods",
+                    )
+
+        self._sync_status(
+            job, active, succeeded, failed, complete, failed_out
+        )
+
+    def _sync_status(
+        self,
+        job: dict,
+        active: List[dict],
+        succeeded: int,
+        failed: int,
+        complete: bool,
+        failed_out: bool,
+    ) -> None:
+        meta = job.get("metadata") or {}
+        cur = job.get("status") or {}
+        status = {
+            "active": len(active),
+            "succeeded": succeeded,
+            "failed": failed,
+            "startTime": cur.get("startTime") or now_string(),
+        }
+        conditions = [
+            dict(c)
+            for c in cur.get("conditions") or []
+            if c.get("type") not in ("Complete", "Failed")
+        ]
+        if complete and not _condition(job, "Complete"):
+            conditions.append(
+                {
+                    "type": "Complete",
+                    "status": "True",
+                    "lastTransitionTime": now_string(),
+                }
+            )
+            status["completionTime"] = cur.get("completionTime") or now_string()
+            if self.recorder is not None:
+                self.recorder.event(
+                    job, "Normal", "Completed", "Job completed"
+                )
+        elif _condition(job, "Complete"):
+            conditions.append(_condition(job, "Complete"))
+            if cur.get("completionTime"):
+                status["completionTime"] = cur["completionTime"]
+        if failed_out and not _condition(job, "Failed") and not complete:
+            conditions.append(
+                {
+                    "type": "Failed",
+                    "status": "True",
+                    "reason": "BackoffLimitExceeded",
+                    "lastTransitionTime": now_string(),
+                }
+            )
+            if self.recorder is not None:
+                self.recorder.event(
+                    job,
+                    "Warning",
+                    "BackoffLimitExceeded",
+                    "Job has reached the specified backoff limit",
+                )
+        elif _condition(job, "Failed"):
+            conditions.append(_condition(job, "Failed"))
+        if conditions:
+            status["conditions"] = conditions
+        if all(cur.get(k) == v for k, v in status.items()):
+            return
+        try:
+            self.store.patch(
+                "Job",
+                meta.get("name") or "",
+                {"status": status},
+                patch_type="merge",
+                namespace=meta.get("namespace"),
+                subresource="status",
+                as_user=CONTROLLER_USER,
+            )
+        except NotFound:
+            pass

@@ -1,0 +1,247 @@
+"""WorkloadManager: the controller-manager loop hosting the workload
+controllers (ReplicaSet / Deployment / Job / HorizontalPodAutoscaler).
+
+Shape mirrors the other controller seats in this tree (gc_controller,
+scheduler): informers feed one event queue; a mapper turns events into
+reconcile keys; a keyed work queue (client-go workqueue semantics —
+dedup while queued, serialization while in flight, re-queue when
+dirtied during processing) feeds a small worker pool; a deadline-based
+resync sweep re-enqueues everything so drift and missed events heal.
+
+Event → key mapping:
+
+- Deployment/ReplicaSet/Job/HPA events reconcile themselves,
+- a ReplicaSet event also reconciles its owner Deployment (status
+  roll-up + the next rolling step),
+- a Pod event reconciles its controller ownerReference (ReplicaSet or
+  Job) — at device-drain rates this path is just dict probes and a
+  set-dedup insert,
+- HPAs additionally reconcile every resync tick (metrics move without
+  any object event).
+
+Store-duck-typed: pass a ResourceStore (in-process composition, tests)
+or a ClusterClient (the kcm daemon topology).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional, Set, Tuple
+
+from kwok_tpu.cluster.informer import Informer, WatchOptions
+from kwok_tpu.cluster.store import EventRecorder
+from kwok_tpu.utils.log import get_logger
+from kwok_tpu.utils.queue import Queue
+from kwok_tpu.workloads.deployment import DeploymentController
+from kwok_tpu.workloads.hpa import HPAController
+from kwok_tpu.workloads.job import JobController
+from kwok_tpu.workloads.replicaset import ReplicaSetController
+
+__all__ = ["WorkloadManager"]
+
+logger = get_logger("workloads")
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+_WATCHED = ("Deployment", "ReplicaSet", "Job", "HorizontalPodAutoscaler", "Pod")
+
+
+class _KeyedQueue:
+    """Dedup + in-flight serialization (client-go workqueue): a key is
+    queued at most once; while a worker holds it, new adds mark it
+    dirty and it re-queues on done()."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready: deque = deque()
+        self._queued: Set[Key] = set()
+        self._dirty: Set[Key] = set()
+        self._active: Set[Key] = set()
+        self._stopped = False
+
+    def add(self, key: Key) -> None:
+        with self._cv:
+            if key in self._active:
+                self._dirty.add(key)
+                return
+            if key in self._queued:
+                return
+            self._queued.add(key)
+            self._ready.append(key)
+            self._cv.notify()
+
+    def get(self, timeout: float = 0.2) -> Optional[Key]:
+        with self._cv:
+            if not self._ready:
+                self._cv.wait(timeout)
+            if not self._ready or self._stopped:
+                return None
+            key = self._ready.popleft()
+            self._queued.discard(key)
+            self._active.add(key)
+            return key
+
+    def done(self, key: Key) -> None:
+        with self._cv:
+            self._active.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._queued:
+                    self._queued.add(key)
+                    self._ready.append(key)
+                    self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+
+class WorkloadManager:
+    """Runs the four workload reconcilers over one store/client."""
+
+    RESYNC_S = 5.0
+
+    def __init__(
+        self,
+        store,
+        resync_s: Optional[float] = None,
+        workers: int = 2,
+        recorder: Optional[EventRecorder] = None,
+        bulk_chunk: Optional[int] = None,
+        hpa_downscale_stabilization_s: Optional[float] = None,
+    ):
+        self.store = store
+        self.resync_s = resync_s if resync_s is not None else self.RESYNC_S
+        self.recorder = recorder or EventRecorder(
+            store, source="workload-controller"
+        )
+        self.replicasets = ReplicaSetController(
+            store, recorder=self.recorder, bulk_chunk=bulk_chunk
+        )
+        self.deployments = DeploymentController(store, recorder=self.recorder)
+        self.jobs = JobController(
+            store, recorder=self.recorder, bulk_chunk=bulk_chunk
+        )
+        self.hpas = HPAController(
+            store,
+            recorder=self.recorder,
+            downscale_stabilization_s=hpa_downscale_stabilization_s,
+        )
+        self._dispatch: Dict[str, object] = {
+            "Deployment": self.deployments,
+            "ReplicaSet": self.replicasets,
+            "Job": self.jobs,
+            "HorizontalPodAutoscaler": self.hpas,
+        }
+        self._events: Queue = Queue()
+        self._queue = _KeyedQueue()
+        self._done = threading.Event()
+        self._threads = []
+        self._workers = max(1, workers)
+        self.reconciles = 0  # observability
+
+    # -------------------------------------------------------------- wiring
+
+    def start(self) -> "WorkloadManager":
+        for kind in _WATCHED:
+            inf = Informer(self.store, kind)
+            inf.watch(WatchOptions(), self._events, done=self._done)
+        t = threading.Thread(
+            target=self._mapper_loop, daemon=True, name="workloads-mapper"
+        )
+        t.start()
+        self._threads.append(t)
+        for i in range(self._workers):
+            t = threading.Thread(
+                target=self._worker_loop,
+                daemon=True,
+                name=f"workloads-worker-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._done.set()
+        self._queue.stop()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # -------------------------------------------------------------- mapping
+
+    def _map_event(self, obj: dict) -> None:
+        kind = obj.get("kind") or ""
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace") or "default"
+        name = meta.get("name") or ""
+        if kind == "Pod":
+            for ref in meta.get("ownerReferences") or []:
+                rkind = ref.get("kind")
+                if rkind in ("ReplicaSet", "Job"):
+                    self._queue.add((rkind, ns, ref.get("name") or ""))
+            return
+        if kind in self._dispatch:
+            self._queue.add((kind, ns, name))
+            if kind == "ReplicaSet":
+                for ref in meta.get("ownerReferences") or []:
+                    if ref.get("kind") == "Deployment":
+                        self._queue.add(
+                            ("Deployment", ns, ref.get("name") or "")
+                        )
+
+    def _resync(self) -> None:
+        for kind in ("Deployment", "ReplicaSet", "Job", "HorizontalPodAutoscaler"):
+            try:
+                items, _ = self.store.list(kind)
+            except Exception:  # noqa: BLE001 — apiserver hiccup; next tick
+                continue
+            for obj in items:
+                meta = obj.get("metadata") or {}
+                self._queue.add(
+                    (kind, meta.get("namespace") or "default", meta.get("name") or "")
+                )
+
+    def _mapper_loop(self) -> None:
+        import time as _time
+
+        next_resync = _time.monotonic()  # first pass adopts existing objects
+        while not self._done.is_set():
+            ev, ok = self._events.get_or_wait(timeout=0.2, done=self._done)
+            if ok and ev is not None:
+                try:
+                    self._map_event(ev.object)
+                except Exception:  # noqa: BLE001 — one event must not kill it
+                    import traceback
+
+                    traceback.print_exc()
+            if _time.monotonic() < next_resync:
+                continue
+            next_resync = _time.monotonic() + self.resync_s
+            try:
+                self._resync()
+            except Exception:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+
+    # -------------------------------------------------------------- workers
+
+    def _worker_loop(self) -> None:
+        while not self._done.is_set():
+            key = self._queue.get(timeout=0.2)
+            if key is None:
+                continue
+            kind, ns, name = key
+            try:
+                ctrl = self._dispatch.get(kind)
+                if ctrl is not None:
+                    ctrl.reconcile(ns, name)
+                    self.reconciles += 1
+            except Exception:  # noqa: BLE001 — a bad object must not kill
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                self._queue.done(key)

@@ -1,0 +1,142 @@
+"""ReplicaSet reconciliation (the kube-controller-manager replicaset
+loop; upstream pkg/controller/replicaset — behavioral reference only).
+
+One reconcile pass:
+
+1. read the ReplicaSet; a terminating one is left to the GC cascade
+   (its pods carry controller ownerReferences, so
+   controllers/gc_controller.py reaps them when the RS goes),
+2. list its pods by label selector (one indexed store query) and keep
+   the ones this RS controls (ownerReference uid),
+3. diff against ``spec.replicas``: surplus pods are deleted
+   youngest-and-least-ready first, missing pods are stamped from
+   ``spec.template`` — both through the bulk-mutation lane, so the
+   wave costs O(replicas / BULK_CHUNK) round-trips,
+4. publish ``status`` (replicas / fullyLabeledReplicas / readyReplicas
+   / availableReplicas / observedGeneration), only when it changed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kwok_tpu.cluster.store import NotFound
+from kwok_tpu.workloads.common import (
+    BulkWriter,
+    CONTROLLER_USER,
+    owned_by,
+    pod_is_active,
+    pod_is_ready,
+    rank_for_deletion,
+    selector_to_string,
+    stamp_pod,
+)
+
+__all__ = ["ReplicaSetController"]
+
+
+class ReplicaSetController:
+    def __init__(self, store, recorder=None, bulk_chunk: Optional[int] = None):
+        self.store = store
+        self.recorder = recorder
+        self.bulk_chunk = bulk_chunk
+
+    def _writer(self) -> BulkWriter:
+        if self.bulk_chunk:
+            return BulkWriter(self.store, chunk=self.bulk_chunk)
+        return BulkWriter(self.store)
+
+    def list_owned_pods(self, owner: dict) -> List[dict]:
+        spec = owner.get("spec") or {}
+        sel = selector_to_string(spec.get("selector")) or selector_to_string(
+            {
+                "matchLabels": (
+                    (spec.get("template") or {}).get("metadata") or {}
+                ).get("labels")
+                or {}
+            }
+        )
+        ns = (owner.get("metadata") or {}).get("namespace") or "default"
+        pods, _ = self.store.list("Pod", namespace=ns, label_selector=sel)
+        return [p for p in pods if owned_by(p, owner)]
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        try:
+            rs = self.store.get("ReplicaSet", name, namespace=namespace)
+        except NotFound:
+            return
+        meta = rs.get("metadata") or {}
+        if meta.get("deletionTimestamp"):
+            return
+        spec = rs.get("spec") or {}
+        desired = spec.get("replicas")
+        desired = 1 if desired is None else int(desired)
+        pods = self.list_owned_pods(rs)
+        active = [p for p in pods if pod_is_active(p)]
+
+        diff = desired - len(active)
+        writer = self._writer()
+        if diff > 0:
+            template = spec.get("template") or {}
+            for _ in range(diff):
+                writer.create(
+                    stamp_pod(
+                        template,
+                        namespace,
+                        rs,
+                        generate_name=f"{name}-",
+                    ),
+                    namespace=namespace,
+                )
+            writer.flush()
+            if self.recorder is not None and writer.round_trips:
+                self.recorder.event(
+                    rs,
+                    "Normal",
+                    "SuccessfulCreate",
+                    f"Created {diff} pods in {writer.round_trips} bulk "
+                    "round-trips",
+                )
+        elif diff < 0:
+            victims = rank_for_deletion(active)[: -diff]
+            for pod in victims:
+                pmeta = pod.get("metadata") or {}
+                writer.delete("Pod", pmeta.get("name") or "", namespace)
+            writer.flush()
+            if self.recorder is not None and victims:
+                self.recorder.event(
+                    rs,
+                    "Normal",
+                    "SuccessfulDelete",
+                    f"Deleted {len(victims)} pods in {writer.round_trips} "
+                    "bulk round-trips",
+                )
+
+        self.sync_status(rs, pods)
+
+    def sync_status(self, rs: dict, pods: List[dict]) -> None:
+        meta = rs.get("metadata") or {}
+        active = [p for p in pods if pod_is_active(p)]
+        ready = sum(1 for p in active if pod_is_ready(p))
+        status = {
+            "replicas": len(active),
+            "fullyLabeledReplicas": len(active),
+            "readyReplicas": ready,
+            "availableReplicas": ready,
+            "observedGeneration": meta.get("generation") or 0,
+        }
+        cur = rs.get("status") or {}
+        if all(cur.get(k) == v for k, v in status.items()):
+            return
+        try:
+            self.store.patch(
+                "ReplicaSet",
+                meta.get("name") or "",
+                {"status": status},
+                patch_type="merge",
+                namespace=meta.get("namespace"),
+                subresource="status",
+                as_user=CONTROLLER_USER,
+            )
+        except NotFound:
+            pass

@@ -378,6 +378,18 @@ def test_bulk_mutations_roundtrip(cluster):
     with pytest.raises(NotFound):
         store.get("Pod", "b")
 
+    # a malformed (non-dict) op is a per-op error, not a failed call —
+    # the valid op beside it still applies
+    results = client.bulk(
+        [
+            {"verb": "create", "kind": "Pod",
+             "data": make_pod("d"), "namespace": "default"},
+            "oops",
+        ]
+    )
+    assert [r["status"] for r in results] == ["ok", "error"]
+    assert store.get("Pod", "d")["metadata"]["name"] == "d"
+
 
 def test_odd_object_names_roundtrip(cluster):
     """The store accepts any name; the wire path must escape it."""

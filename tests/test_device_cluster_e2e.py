@@ -85,6 +85,205 @@ N_PODS = 5000 // _SCALE
 POD_SHARDS = 10
 
 
+def _wait(pred, timeout, poll=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return pred()
+
+
+# divide the 500/2000-replica scenario for quick local iteration
+_WL_SCALE = max(1, int(os.environ.get("KWOK_E2E_SCALE", "1")))
+WL_BASE = 500 // _WL_SCALE
+WL_SCALED = 2000 // _WL_SCALE
+
+
+@pytest.mark.slow
+def test_workload_controllers_e2e(home, tmp_path):
+    """ISSUE 1 acceptance scenario: kubectl apply of a Deployment
+    materializes Running pods through the scheduler + device stage FSM,
+    a rolling update completes under rollout status, kubectl scale
+    converges through the bulk-mutation lane (O(round-trips) ≪
+    O(replicas), asserted against the apiserver audit log), an HPA
+    driven by the simulated-usage engine scales the Deployment up, and
+    deleting the Deployment cascades through the GC."""
+    import yaml as _yaml
+
+    name = "wl"
+    assert kwokctl_main(
+        ["--name", name, "create", "cluster", "--backend", "device", "--wait", "90"]
+    ) == 0
+    rt = BinaryRuntime(name)
+    client = rt.client()
+    try:
+        # 25 nodes x 110 pods (and x 32 cpu vs 100m requests) ≥ the
+        # 2200-replica ceiling
+        assert kwokctl_main(
+            ["--name", name, "scale", "node", "--replicas", "25"]
+        ) == 0
+
+        deploy = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {
+                "replicas": WL_BASE,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {
+                    "metadata": {
+                        "labels": {"app": "web"},
+                        "annotations": {"kwok.x-k8s.io/usage-cpu": "80m"},
+                    },
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "image": "img:v1",
+                                "resources": {"requests": {"cpu": "100m"}},
+                            }
+                        ]
+                    },
+                },
+            },
+        }
+        manifest = tmp_path / "deploy.yaml"
+        manifest.write_text(_yaml.safe_dump(deploy))
+        assert kwokctl_main(
+            ["--name", name, "kubectl", "apply", "-f", str(manifest)]
+        ) == 0
+
+        def running_pods():
+            pods, _ = client.list("Pod", label_selector="app=web")
+            return sum(
+                1
+                for p in pods
+                if (p.get("status") or {}).get("phase") == "Running"
+                and not (p.get("metadata") or {}).get("deletionTimestamp")
+            )
+
+        assert _wait(lambda: running_pods() >= WL_BASE, 240), (
+            f"only {running_pods()}/{WL_BASE} Running"
+        )
+
+        # ---- rolling update, observed through kubectl rollout status
+        client.patch(
+            "Deployment",
+            "web",
+            {"spec": {"template": {"spec": {"containers": [
+                {
+                    "name": "c",
+                    "image": "img:v2",
+                    "resources": {"requests": {"cpu": "100m"}},
+                }
+            ]}}}},
+            patch_type="merge",
+        )
+        assert kwokctl_main(
+            ["--name", name, "kubectl", "rollout", "status",
+             "deployment/web", "--timeout", "300"]
+        ) == 0
+        rs, _ = client.list("ReplicaSet", label_selector="app=web")
+        assert len([r for r in rs if (r["spec"].get("replicas") or 0) > 0]) == 1
+
+        # ---- bulk scale-out: few round-trips, no per-pod POSTs
+        audit_path = os.path.join(rt.workdir, "logs", "audit.log")
+
+        def workload_lines():
+            import json as _json
+
+            out = []
+            with open(audit_path, "r", encoding="utf-8") as f:
+                for line in f:
+                    try:
+                        rec = _json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("user") == "system:kwok-workloads":
+                        out.append(rec)
+            return out
+
+        before = len(workload_lines())
+        assert kwokctl_main(
+            ["--name", name, "kubectl", "scale", "deployment/web",
+             "--replicas", str(WL_SCALED)]
+        ) == 0
+        assert _wait(lambda: running_pods() >= WL_SCALED, 300), (
+            f"only {running_pods()}/{WL_SCALED} Running after scale"
+        )
+        wave = workload_lines()[before:]
+        pod_creates = [
+            r for r in wave
+            if r["verb"] == "POST" and r["path"].startswith("/r/pods")
+        ]
+        bulk_trips = [r for r in wave if r["path"] == "/bulk"]
+        grew = WL_SCALED - WL_BASE
+        assert not pod_creates, "controller issued per-pod creates"
+        assert bulk_trips, "scale wave did not go through the bulk lane"
+        assert len(bulk_trips) * 20 <= grew, (
+            f"{len(bulk_trips)} bulk round-trips for {grew} pods"
+        )
+
+        # ---- HPA over the simulated-usage engine (80% vs 50% target)
+        for doc in (
+            {
+                "apiVersion": "kwok.x-k8s.io/v1alpha1",
+                "kind": "ClusterResourceUsage",
+                "metadata": {"name": "annotation-usage"},
+                "spec": {"usages": [{"usage": {"cpu": {"expression": (
+                    '"kwok.x-k8s.io/usage-cpu" in pod.metadata.annotations'
+                    ' ? Quantity(pod.metadata.annotations'
+                    '["kwok.x-k8s.io/usage-cpu"]) : Quantity("0")'
+                )}}}]},
+            },
+            {
+                "apiVersion": "autoscaling/v2",
+                "kind": "HorizontalPodAutoscaler",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "scaleTargetRef": {
+                        "apiVersion": "apps/v1",
+                        "kind": "Deployment",
+                        "name": "web",
+                    },
+                    "minReplicas": 1,
+                    "maxReplicas": WL_SCALED + 200,
+                    "metrics": [{
+                        "type": "Resource",
+                        "resource": {
+                            "name": "cpu",
+                            "target": {
+                                "type": "Utilization",
+                                "averageUtilization": 50,
+                            },
+                        },
+                    }],
+                },
+            },
+        ):
+            client.create(doc)
+
+        def hpa_scaled_up():
+            d = client.get("Deployment", "web")
+            return (d["spec"].get("replicas") or 0) > WL_SCALED
+
+        assert _wait(hpa_scaled_up, 120), client.get(
+            "HorizontalPodAutoscaler", "web"
+        ).get("status")
+
+        # ---- cascade: Deployment → ReplicaSets → pods through the GC
+        client.delete("Deployment", "web")
+        assert _wait(
+            lambda: client.count("ReplicaSet") == 0, 120
+        ), f"{client.count('ReplicaSet')} replicasets left"
+        assert _wait(
+            lambda: client.count("Pod") == 0, 300
+        ), f"{client.count('Pod')} pods left"
+    finally:
+        assert kwokctl_main(["--name", name, "delete", "cluster"]) == 0
+
+
 def test_device_backend_cluster_at_ci_scale(home):
     name = "devscale"
     assert kwokctl_main(

@@ -1,0 +1,821 @@
+"""Workload controller subsystem (kwok_tpu/workloads/): ReplicaSet /
+Deployment / Job / HorizontalPodAutoscaler reconcile loops, the
+bulk-mutation round-trip contract, the k8s ``/scale`` subresource, and
+the event-driven WorkloadManager composition — all in-process over a
+ResourceStore (the daemon topology rides the same code over a
+ClusterClient; test_gc.py proves that duck-type for controllers)."""
+
+import http.client
+import json
+import math
+import time
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.workloads import (
+    POD_TEMPLATE_HASH,
+    WorkloadManager,
+    pod_template_hash,
+)
+from kwok_tpu.workloads.common import resolve_int_or_percent
+from kwok_tpu.workloads.deployment import DeploymentController
+from kwok_tpu.workloads.hpa import HPAController
+from kwok_tpu.workloads.job import JobController
+from kwok_tpu.workloads.replicaset import ReplicaSetController
+
+
+def make_deployment(name="web", replicas=3, image="img:v1", **spec_extra):
+    spec = {
+        "replicas": replicas,
+        "selector": {"matchLabels": {"app": name}},
+        "template": {
+            "metadata": {"labels": {"app": name}},
+            "spec": {"containers": [{"name": "c", "image": image}]},
+        },
+    }
+    spec.update(spec_extra)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def make_replicaset(name="rs", replicas=3):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"containers": [{"name": "c", "image": "i"}]},
+            },
+        },
+    }
+
+
+def make_job(name="j", parallelism=2, completions=4, backoff=2):
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "parallelism": parallelism,
+            "completions": completions,
+            "backoffLimit": backoff,
+            "template": {
+                "metadata": {"labels": {"job-name": name}},
+                "spec": {"containers": [{"name": "c", "image": "i"}]},
+            },
+        },
+    }
+
+
+def mark_pods(store, phase="Running", ready=True, only=None, limit=None):
+    """Drive owned pods' status like the stage FSM would."""
+    pods, _ = store.list("Pod", namespace="default")
+    n = 0
+    for p in pods:
+        if only is not None and not only(p):
+            continue
+        if limit is not None and n >= limit:
+            break
+        status = {"phase": phase}
+        if ready and phase == "Running":
+            status["conditions"] = [{"type": "Ready", "status": "True"}]
+        store.patch(
+            "Pod",
+            p["metadata"]["name"],
+            {"status": status},
+            patch_type="merge",
+            namespace="default",
+            subresource="status",
+        )
+        n += 1
+
+
+# ----------------------------------------------------------- replicaset
+
+
+def test_replicaset_creates_owned_pods_and_status():
+    store = ResourceStore()
+    store.create(make_replicaset(replicas=3))
+    rsc = ReplicaSetController(store)
+    rsc.reconcile("default", "rs")
+    pods, _ = store.list("Pod", namespace="default")
+    assert len(pods) == 3
+    for p in pods:
+        refs = p["metadata"]["ownerReferences"]
+        assert refs[0]["kind"] == "ReplicaSet"
+        assert refs[0]["name"] == "rs"
+        assert refs[0]["controller"] is True
+        assert p["metadata"]["labels"]["app"] == "rs"
+    mark_pods(store)
+    rsc.reconcile("default", "rs")
+    rs = store.get("ReplicaSet", "rs", namespace="default")
+    assert rs["status"]["replicas"] == 3
+    assert rs["status"]["readyReplicas"] == 3
+    assert rs["status"]["observedGeneration"] == 1
+
+
+def test_replicaset_scale_down_prefers_unscheduled_then_unready():
+    store = ResourceStore()
+    store.create(make_replicaset(replicas=4))
+    rsc = ReplicaSetController(store)
+    rsc.reconcile("default", "rs")
+    pods, _ = store.list("Pod", namespace="default")
+    # schedule all but one; make exactly two of the scheduled Ready
+    scheduled = [p["metadata"]["name"] for p in pods[:3]]
+    for name in scheduled:
+        store.patch(
+            "Pod", name, {"spec": {"nodeName": "n1"}},
+            patch_type="merge", namespace="default",
+        )
+    mark_pods(store, only=lambda p: p["metadata"]["name"] in scheduled[:2])
+    store.patch(
+        "ReplicaSet", "rs", {"spec": {"replicas": 2}},
+        patch_type="merge", namespace="default",
+    )
+    rsc.reconcile("default", "rs")
+    left = {p["metadata"]["name"] for p in store.list("Pod", namespace="default")[0]}
+    # victims: the unscheduled pod, then the scheduled-but-unready one
+    assert left == set(scheduled[:2])
+
+
+def test_replicaset_ignores_foreign_pods():
+    store = ResourceStore()
+    store.create(make_replicaset(replicas=1))
+    # same labels, no ownerReference: not adopted — kwok-tpu workload
+    # loops only count controlled pods (uid match)
+    store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "stray", "namespace": "default",
+                "labels": {"app": "rs"},
+            },
+            "spec": {"containers": [{"name": "c", "image": "i"}]},
+        }
+    )
+    ReplicaSetController(store).reconcile("default", "rs")
+    pods, _ = store.list("Pod", namespace="default")
+    assert len(pods) == 2  # stray + 1 owned
+    owned = [p for p in pods if p["metadata"].get("ownerReferences")]
+    assert len(owned) == 1
+
+
+# ------------------------------------------------------------ bulk lane
+
+
+def test_scale_wave_is_bulk_not_per_pod():
+    """The O(round-trips) ≪ O(replicas) contract: a 1000-replica wave
+    through a 100-op bulk lane is exactly 10 store round-trips (the
+    audit log carries one ``bulk`` summary per round-trip)."""
+    store = ResourceStore()
+    store.create(make_replicaset(replicas=1000))
+    rsc = ReplicaSetController(store, bulk_chunk=100)
+    rsc.reconcile("default", "rs")
+    assert store.count("Pod") == 1000
+    create_trips = [
+        e for e in store.audit_log() if e[0] == "bulk" and e[1] == "Pod:100"
+    ]
+    assert len(create_trips) == 10
+    # scale down is bulk too
+    store.patch(
+        "ReplicaSet", "rs", {"spec": {"replicas": 0}},
+        patch_type="merge", namespace="default",
+    )
+    rsc.reconcile("default", "rs")
+    assert store.count("Pod") == 0
+    trips = [e for e in store.audit_log() if e[0] == "bulk"]
+    assert len(trips) == 20  # 10 create waves + 10 delete waves
+
+
+# ------------------------------------------------------------ deployment
+
+
+def step_until_stable(store, dc, rsc, name="web", rounds=50):
+    """Drive deployment+replicaset reconciles with instant pod
+    readiness until nothing changes, collecting rolling invariants."""
+    spec = store.get("Deployment", name, namespace="default")["spec"]
+    desired = spec.get("replicas", 1)
+    surge = resolve_int_or_percent(
+        ((spec.get("strategy") or {}).get("rollingUpdate") or {}).get(
+            "maxSurge", "25%"
+        ),
+        desired,
+        round_up=True,
+    )
+    for _ in range(rounds):
+        dc.reconcile("default", name)
+        all_rs, _ = store.list("ReplicaSet", namespace="default")
+        total_spec = sum(
+            (rs["spec"].get("replicas") or 0) for rs in all_rs
+        )
+        assert total_spec <= desired + surge, (
+            f"surge ceiling violated: {total_spec} > {desired} + {surge}"
+        )
+        for rs in all_rs:
+            rsc.reconcile("default", rs["metadata"]["name"])
+        mark_pods(store)
+        for rs in all_rs:
+            rsc.reconcile("default", rs["metadata"]["name"])
+        d = store.get("Deployment", name, namespace="default")
+        st = d.get("status") or {}
+        if (
+            st.get("updatedReplicas") == desired
+            and st.get("replicas") == desired
+            and st.get("availableReplicas") == desired
+        ):
+            return d
+    raise AssertionError("rollout did not converge")
+
+
+def test_deployment_creates_revision_rs_and_rolls():
+    store = ResourceStore()
+    store.create(make_deployment(replicas=4))
+    dc = DeploymentController(store)
+    rsc = ReplicaSetController(store)
+    step_until_stable(store, dc, rsc)
+    all_rs, _ = store.list("ReplicaSet", namespace="default")
+    assert len(all_rs) == 1
+    first_hash = all_rs[0]["metadata"]["labels"][POD_TEMPLATE_HASH]
+    assert all_rs[0]["metadata"]["name"] == f"web-{first_hash}"
+    assert all_rs[0]["metadata"]["annotations"][
+        "deployment.kubernetes.io/revision"
+    ] == "1"
+
+    # template edit → second revision, rolled to completion under the
+    # surge/unavailable budget (invariants asserted inside the stepper)
+    store.patch(
+        "Deployment", "web",
+        {"spec": {"template": {"spec": {"containers": [
+            {"name": "c", "image": "img:v2"}]}}}},
+        patch_type="merge", namespace="default",
+    )
+    d = step_until_stable(store, dc, rsc)
+    assert d["status"]["observedGeneration"] == 2
+    all_rs, _ = store.list("ReplicaSet", namespace="default")
+    by_replicas = {rs["spec"]["replicas"] for rs in all_rs}
+    assert by_replicas == {0, 4}
+    new_hash = pod_template_hash(
+        store.get("Deployment", "web", namespace="default")["spec"]["template"]
+    )
+    assert new_hash != first_hash
+    pods, _ = store.list("Pod", namespace="default")
+    live = [p for p in pods if not p["metadata"].get("deletionTimestamp")]
+    assert all(
+        p["metadata"]["labels"][POD_TEMPLATE_HASH] == new_hash for p in live
+    )
+
+
+def test_deployment_surge_and_unavailable_budget_first_step():
+    """First rolling step from a settled 4-replica deployment with
+    maxSurge=1/maxUnavailable=1: the new RS may only grow to 1 and old
+    scale-down may only take 1 (k8s rolling math)."""
+    store = ResourceStore()
+    store.create(
+        make_deployment(
+            replicas=4,
+            strategy={
+                "type": "RollingUpdate",
+                "rollingUpdate": {"maxSurge": 1, "maxUnavailable": 1},
+            },
+        )
+    )
+    dc = DeploymentController(store)
+    rsc = ReplicaSetController(store)
+    step_until_stable(store, dc, rsc)
+    store.patch(
+        "Deployment", "web",
+        {"spec": {"template": {"spec": {"containers": [
+            {"name": "c", "image": "img:v2"}]}}}},
+        patch_type="merge", namespace="default",
+    )
+    dc.reconcile("default", "web")  # one step, pods not yet ready
+    all_rs, _ = store.list("ReplicaSet", namespace="default")
+    by_hash = {
+        rs["metadata"]["labels"][POD_TEMPLATE_HASH]: rs for rs in all_rs
+    }
+    new_hash = pod_template_hash(
+        store.get("Deployment", "web", namespace="default")["spec"]["template"]
+    )
+    assert by_hash[new_hash]["spec"]["replicas"] == 1  # 4 + surge(1) - 4
+    old = next(rs for h, rs in by_hash.items() if h != new_hash)
+    assert old["spec"]["replicas"] == 3  # available floor 4-1=3
+
+
+def test_deployment_recreate_strategy():
+    store = ResourceStore()
+    store.create(make_deployment(replicas=3, strategy={"type": "Recreate"}))
+    dc = DeploymentController(store)
+    rsc = ReplicaSetController(store)
+    step_until_stable(store, dc, rsc)
+    store.patch(
+        "Deployment", "web",
+        {"spec": {"template": {"spec": {"containers": [
+            {"name": "c", "image": "img:v2"}]}}}},
+        patch_type="merge", namespace="default",
+    )
+    dc.reconcile("default", "web")
+    all_rs, _ = store.list("ReplicaSet", namespace="default")
+    # every old RS is told to drop to 0 before the new one scales
+    new_hash = pod_template_hash(
+        store.get("Deployment", "web", namespace="default")["spec"]["template"]
+    )
+    for rs in all_rs:
+        assert rs["spec"]["replicas"] == 0, rs["metadata"]["name"]
+    d = step_until_stable(store, dc, rsc)
+    assert d["status"]["availableReplicas"] == 3
+    live = [
+        p
+        for p in store.list("Pod", namespace="default")[0]
+        if not p["metadata"].get("deletionTimestamp")
+    ]
+    assert all(
+        p["metadata"]["labels"][POD_TEMPLATE_HASH] == new_hash for p in live
+    )
+
+
+def test_deployment_history_limit_prunes_old_replicasets():
+    store = ResourceStore()
+    store.create(make_deployment(replicas=1, revisionHistoryLimit=1))
+    dc = DeploymentController(store)
+    rsc = ReplicaSetController(store)
+    step_until_stable(store, dc, rsc)
+    for v in ("v2", "v3", "v4"):
+        store.patch(
+            "Deployment", "web",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "c", "image": f"img:{v}"}]}}}},
+            patch_type="merge", namespace="default",
+        )
+        step_until_stable(store, dc, rsc)
+    all_rs, _ = store.list("ReplicaSet", namespace="default")
+    # live revision + at most revisionHistoryLimit dead ones
+    assert len(all_rs) <= 2
+
+
+def test_intstr_percent_resolution():
+    assert resolve_int_or_percent("25%", 10, round_up=True) == 3
+    assert resolve_int_or_percent("25%", 10, round_up=False) == 2
+    assert resolve_int_or_percent(2, 10, round_up=True) == 2
+    assert resolve_int_or_percent(None, 10, round_up=False) == 0
+
+
+# ------------------------------------------------------------------ job
+
+
+def test_job_parallelism_and_completions():
+    store = ResourceStore()
+    store.create(make_job(parallelism=2, completions=4))
+    jc = JobController(store)
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 2  # parallelism cap
+    mark_pods(store, phase="Succeeded")
+    jc.reconcile("default", "j")
+    pods, _ = store.list("Pod", namespace="default")
+    running = [
+        p for p in pods if (p.get("status") or {}).get("phase") != "Succeeded"
+    ]
+    assert len(running) == 2  # topped back up
+    mark_pods(store, phase="Succeeded")
+    jc.reconcile("default", "j")
+    job = store.get("Job", "j", namespace="default")
+    assert job["status"]["succeeded"] == 4
+    conds = {c["type"] for c in job["status"]["conditions"]}
+    assert "Complete" in conds
+    assert job["status"].get("completionTime")
+    # a finished job spawns nothing more
+    jc.reconcile("default", "j")
+    pods, _ = store.list("Pod", namespace="default")
+    assert all(
+        (p.get("status") or {}).get("phase") == "Succeeded" for p in pods
+    )
+
+
+def test_job_parallelism_reduction_reaps_surplus():
+    store = ResourceStore()
+    store.create(make_job(parallelism=5, completions=10))
+    jc = JobController(store)
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 5
+    store.patch(
+        "Job",
+        "j",
+        {"spec": {"parallelism": 2}},
+        patch_type="merge",
+        namespace="default",
+    )
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 2  # surplus workers reaped
+    job = store.get("Job", "j", namespace="default")
+    assert job["status"]["active"] == 2
+
+
+def test_job_backoff_limit_fails_job_and_reaps_workers():
+    store = ResourceStore()
+    store.create(make_job(parallelism=3, completions=6, backoff=1))
+    jc = JobController(store)
+    jc.reconcile("default", "j")
+    mark_pods(store, phase="Failed")
+    jc.reconcile("default", "j")  # failed=3 > backoffLimit=1 → Failed
+    job = store.get("Job", "j", namespace="default")
+    conds = {
+        c["type"]: c for c in job["status"]["conditions"]
+    }
+    assert conds["Failed"]["reason"] == "BackoffLimitExceeded"
+    pods, _ = store.list("Pod", namespace="default")
+    live = [
+        p
+        for p in pods
+        if (p.get("status") or {}).get("phase") not in ("Failed", "Succeeded")
+        and not p["metadata"].get("deletionTimestamp")
+    ]
+    assert live == []
+
+
+def test_job_any_success_mode():
+    store = ResourceStore()
+    job = make_job(parallelism=3)
+    del job["spec"]["completions"]
+    store.create(job)
+    jc = JobController(store)
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 3
+    # one worker succeeds; the rest are reaped once no active remain
+    pods, _ = store.list("Pod", namespace="default")
+    mark_pods(store, phase="Succeeded", limit=1)
+    mark_pods(
+        store,
+        phase="Failed",
+        only=lambda p: (p.get("status") or {}).get("phase") != "Succeeded",
+    )
+    jc.reconcile("default", "j")
+    job = store.get("Job", "j", namespace="default")
+    assert any(
+        c["type"] == "Complete" and c["status"] == "True"
+        for c in job["status"]["conditions"]
+    )
+
+
+def test_job_any_success_mode_stops_creating_after_first_success():
+    """Upstream work-queue semantics: once any pod has succeeded, no
+    replacement pods are created; the job completes when the remaining
+    actives drain on their own."""
+    store = ResourceStore()
+    job = make_job(parallelism=3)
+    del job["spec"]["completions"]
+    store.create(job)
+    jc = JobController(store)
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 3
+    # one succeeds, one fails — the failure must NOT be replaced
+    mark_pods(store, phase="Succeeded", limit=1)
+    mark_pods(
+        store,
+        phase="Failed",
+        only=lambda p: (p.get("status") or {}).get("phase") != "Succeeded",
+        limit=1,
+    )
+    jc.reconcile("default", "j")
+    assert store.count("Pod") == 3  # no new pods stamped
+    job = store.get("Job", "j", namespace="default")
+    assert not any(
+        c["type"] == "Complete" and c["status"] == "True"
+        for c in (job["status"].get("conditions") or [])
+    )
+    # the last active finishes → complete
+    mark_pods(
+        store,
+        phase="Succeeded",
+        only=lambda p: (p.get("status") or {}).get("phase")
+        not in ("Succeeded", "Failed"),
+    )
+    jc.reconcile("default", "j")
+    job = store.get("Job", "j", namespace="default")
+    assert any(
+        c["type"] == "Complete" and c["status"] == "True"
+        for c in job["status"]["conditions"]
+    )
+
+
+# ------------------------------------------------------------------ hpa
+
+
+USAGE_CR = {
+    "apiVersion": "kwok.x-k8s.io/v1alpha1",
+    "kind": "ClusterResourceUsage",
+    "metadata": {"name": "annotation-usage"},
+    "spec": {
+        "usages": [
+            {
+                "usage": {
+                    "cpu": {
+                        "expression": (
+                            '"kwok.x-k8s.io/usage-cpu" in '
+                            "pod.metadata.annotations ? "
+                            "Quantity(pod.metadata.annotations"
+                            '["kwok.x-k8s.io/usage-cpu"]) : Quantity("0")'
+                        )
+                    }
+                }
+            }
+        ]
+    },
+}
+
+
+def make_hpa(target="web", min_r=1, max_r=10, util=50):
+    return {
+        "apiVersion": "autoscaling/v2",
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": "hpa", "namespace": "default"},
+        "spec": {
+            "scaleTargetRef": {
+                "apiVersion": "apps/v1", "kind": "Deployment", "name": target,
+            },
+            "minReplicas": min_r,
+            "maxReplicas": max_r,
+            "metrics": [
+                {
+                    "type": "Resource",
+                    "resource": {
+                        "name": "cpu",
+                        "target": {
+                            "type": "Utilization",
+                            "averageUtilization": util,
+                        },
+                    },
+                }
+            ],
+        },
+    }
+
+
+def hpa_fixture(replicas=2, usage="800m", request="1"):
+    """Deployment + settled pods annotated with simulated usage +
+    usage CR + HPA, over one store."""
+    store = ResourceStore()
+    deploy = make_deployment(replicas=replicas)
+    tmeta = deploy["spec"]["template"]["metadata"]
+    tmeta["annotations"] = {"kwok.x-k8s.io/usage-cpu": usage}
+    deploy["spec"]["template"]["spec"]["containers"][0]["resources"] = {
+        "requests": {"cpu": request}
+    }
+    store.create(deploy)
+    dc = DeploymentController(store)
+    rsc = ReplicaSetController(store)
+    step_until_stable(store, dc, rsc)
+    store.create(USAGE_CR)
+    store.create(make_hpa())
+    return store, dc, rsc
+
+
+def test_hpa_scales_up_when_usage_above_target():
+    store, dc, rsc = hpa_fixture(replicas=2, usage="800m", request="1")
+    clock = {"t": 1000.0}
+    hc = HPAController(store, now=lambda: clock["t"])
+    hc.reconcile("default", "hpa")
+    d = store.get("Deployment", "web", namespace="default")
+    # utilization 80% vs target 50% → ceil(2 * 1.6) = 4
+    assert d["spec"]["replicas"] == 4
+    hpa = store.get("HorizontalPodAutoscaler", "hpa", namespace="default")
+    assert hpa["status"]["desiredReplicas"] == 4
+    assert hpa["status"]["currentMetrics"][0]["resource"]["current"][
+        "averageUtilization"
+    ] == 80
+    assert hpa["status"].get("lastScaleTime")
+
+
+def test_hpa_within_tolerance_does_not_scale():
+    store, dc, rsc = hpa_fixture(replicas=2, usage="520m", request="1")
+    hc = HPAController(store)
+    hc.reconcile("default", "hpa")  # ratio 1.04 < 1.1 tolerance
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["spec"]["replicas"] == 2
+
+
+def test_hpa_downscale_waits_for_stabilization_window():
+    store, dc, rsc = hpa_fixture(replicas=4, usage="100m", request="1")
+    clock = {"t": 1000.0}
+    hc = HPAController(store, now=lambda: clock["t"])
+    # seed the window with the current size (a recommendation made
+    # while load was still high)
+    hc._recommendations[("default", "hpa")] = [(1000.0, 4)]
+    hc.reconcile("default", "hpa")
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["spec"]["replicas"] == 4  # held up by the window max
+    clock["t"] += 301.0  # stabilization window (300s default) passes
+    hc.reconcile("default", "hpa")
+    d = store.get("Deployment", "web", namespace="default")
+    # utilization 10% vs 50% → ceil(4 * 0.2) = 1
+    assert d["spec"]["replicas"] == 1
+
+
+def test_hpa_respects_max_replicas():
+    store, dc, rsc = hpa_fixture(replicas=8, usage="4", request="1")
+    hc = HPAController(store)
+    hc.reconcile("default", "hpa")
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["spec"]["replicas"] == 10  # clamped to maxReplicas
+
+
+# --------------------------------------------------- scale subresource
+
+
+@pytest.fixture()
+def api_cluster():
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        host, port = srv.address
+        yield store, host, port
+
+
+def _req(host, port, method, path, body=None, ctype="application/json"):
+    conn = http.client.HTTPConnection(host, port, timeout=10)
+    try:
+        payload = json.dumps(body).encode() if body is not None else None
+        conn.request(
+            method, path, body=payload, headers={"Content-Type": ctype}
+        )
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, (json.loads(raw) if raw else None)
+    finally:
+        conn.close()
+
+
+def test_scale_subresource_get_put(api_cluster):
+    store, host, port = api_cluster
+    store.create(make_deployment(replicas=3))
+    base = "/apis/apps/v1/namespaces/default/deployments/web/scale"
+    code, scale = _req(host, port, "GET", base)
+    assert code == 200
+    assert scale["kind"] == "Scale"
+    assert scale["apiVersion"] == "autoscaling/v1"
+    assert scale["spec"]["replicas"] == 3
+    assert scale["status"]["selector"] == "app=web"
+    scale["spec"]["replicas"] = 7
+    code, out = _req(host, port, "PUT", base, body=scale)
+    assert code == 200
+    assert out["spec"]["replicas"] == 7
+    assert (
+        store.get("Deployment", "web", namespace="default")["spec"]["replicas"]
+        == 7
+    )
+    # kubectl scale's PATCH flavor
+    code, out = _req(
+        host, port, "PATCH", base,
+        body={"spec": {"replicas": 9}},
+        ctype="application/merge-patch+json",
+    )
+    assert code == 200
+    assert out["spec"]["replicas"] == 9
+
+
+def test_scale_subresource_unscalable_kind_404(api_cluster):
+    store, host, port = api_cluster
+    store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "cm", "namespace": "default"},
+            "data": {},
+        }
+    )
+    code, body = _req(
+        host, port, "GET",
+        "/api/v1/namespaces/default/configmaps/cm/scale",
+    )
+    assert code == 404
+    assert body["reason"] == "NotFound"
+
+
+def test_generation_bumps_on_spec_change_only(api_cluster):
+    store, _, _ = api_cluster
+    store.create(make_deployment(replicas=3))
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["metadata"]["generation"] == 1
+    store.patch(
+        "Deployment", "web", {"status": {"replicas": 3}},
+        patch_type="merge", namespace="default", subresource="status",
+    )
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["metadata"]["generation"] == 1  # status writes don't bump
+    store.patch(
+        "Deployment", "web", {"spec": {"replicas": 5}},
+        patch_type="merge", namespace="default",
+    )
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["metadata"]["generation"] == 2
+
+
+# -------------------------------------------------------------- manager
+
+
+def test_manager_event_driven_end_to_end():
+    """The composed loop: Deployment → RS → pods, a template roll, a
+    kubectl-style scale — driven only by watch events + resync."""
+    store = ResourceStore()
+    mgr = WorkloadManager(store, resync_s=0.2).start()
+    try:
+        store.create(make_deployment(replicas=5))
+
+        def settle(want, gen):
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                mark_pods(store)
+                d = store.get("Deployment", "web", namespace="default")
+                st = d.get("status") or {}
+                if (
+                    st.get("availableReplicas") == want
+                    and st.get("updatedReplicas") == want
+                    and st.get("replicas") == want
+                    and st.get("observedGeneration") == gen
+                ):
+                    return d
+                time.sleep(0.05)
+            raise AssertionError(
+                f"did not settle at {want}: "
+                f"{store.get('Deployment', 'web', namespace='default').get('status')}"
+            )
+
+        settle(5, 1)
+        store.patch(
+            "Deployment", "web",
+            {"spec": {"template": {"spec": {"containers": [
+                {"name": "c", "image": "img:v2"}]}}}},
+            patch_type="merge", namespace="default",
+        )
+        settle(5, 2)
+        all_rs, _ = store.list("ReplicaSet", namespace="default")
+        assert {rs["spec"]["replicas"] for rs in all_rs} == {0, 5}
+        store.patch(
+            "Deployment", "web", {"spec": {"replicas": 8}},
+            patch_type="merge", namespace="default",
+        )
+        settle(8, 3)
+    finally:
+        mgr.stop()
+
+
+def test_manager_gc_cascade_on_deployment_delete():
+    """Deleting the Deployment tears the whole tree down through the
+    existing ownerReference GC (no workload-loop involvement)."""
+    from kwok_tpu.controllers.gc_controller import GCController
+
+    store = ResourceStore()
+    mgr = WorkloadManager(store, resync_s=0.2).start()
+    gc = GCController(store, resync_s=0.2).start()
+    try:
+        store.create(make_deployment(replicas=3))
+        deadline = time.monotonic() + 10
+        while store.count("Pod") != 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert store.count("Pod") == 3
+        store.delete("Deployment", "web", namespace="default")
+        deadline = time.monotonic() + 10
+        while (
+            store.count("Pod") or store.count("ReplicaSet")
+        ) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert store.count("ReplicaSet") == 0
+        assert store.count("Pod") == 0
+    finally:
+        gc.stop()
+        mgr.stop()
+
+
+def test_manager_runs_hpa_loop_on_resync():
+    store = ResourceStore()
+    deploy = make_deployment(replicas=2)
+    deploy["spec"]["template"]["metadata"]["annotations"] = {
+        "kwok.x-k8s.io/usage-cpu": "900m"
+    }
+    deploy["spec"]["template"]["spec"]["containers"][0]["resources"] = {
+        "requests": {"cpu": "1"}
+    }
+    store.create(deploy)
+    store.create(USAGE_CR)
+    store.create(make_hpa(util=50))
+    mgr = WorkloadManager(store, resync_s=0.2).start()
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            mark_pods(store)
+            d = store.get("Deployment", "web", namespace="default")
+            if (d["spec"].get("replicas") or 0) > 2:
+                break
+            time.sleep(0.05)
+        # usage 90% vs target 50% → the HPA grew the deployment
+        assert d["spec"]["replicas"] > 2
+    finally:
+        mgr.stop()
