@@ -1,0 +1,132 @@
+"""kwoklint: repo-native static analysis for the kwok_tpu codebase.
+
+The repo's architectural invariants — the SURVEY layer map, the
+"ClusterClient is duck-typed to ResourceStore" store boundary
+(CLAUDE.md:49-51), the lock discipline the store/spdy fixes
+established, tracer purity inside the device kernels, and the
+"every module docstring cites the reference file:line it mirrors"
+parity convention (CLAUDE.md:47-48) — were previously enforced only by
+prose and review.  This package encodes them as AST checks, the
+correctness-tooling analogue of the reference's ``go vet`` / CI lint
+jobs (the reference gates every PR on golangci-lint + verify scripts;
+see PARITY.md §4).
+
+Layout: :mod:`kwok_tpu.analysis.driver` owns the shared file walker,
+per-file AST cache, suppression comments (``# kwoklint:
+disable=<rule>``) and the checked-in baseline; each ``<rule>.py``
+module contributes one analyzer over the parsed files.  The CLI lives
+in ``kwok_tpu.analysis.__main__`` (``python -m kwok_tpu.analysis``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import List, Optional
+
+#: severity vocabulary: ``error`` findings gate CI (non-zero exit);
+#: ``warning`` findings are reported but do not fail the run.
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit, addressed by rule + repo-relative path + line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    severity: str = ERROR
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def baseline_key(self) -> dict:
+        """Line-number-free identity used by the baseline file: line
+        numbers drift on every edit, so baselined findings match on
+        (rule, path, message) instead."""
+        return {"rule": self.rule, "path": self.path, "message": self.message}
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed file shared by every analyzer (parse-once cache)."""
+
+    path: str  # repo-relative, forward slashes
+    abspath: str
+    source: str
+    tree: "object"  # ast.Module
+    lines: "list[str]"
+    #: line number -> set of rule names disabled on that line (the
+    #: comment's own line plus the immediately following line, so a
+    #: standalone ``# kwoklint: disable=...`` comment covers the
+    #: statement below it)
+    suppressions: "dict[int, set]"
+    #: rules disabled for the whole file via a ``# kwoklint:
+    #: disable-file=<rule>`` comment anywhere in the file (comment
+    #: tokens only — the same text inside a string literal is inert)
+    file_suppressions: "set"
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        rules = self.suppressions.get(finding.line)
+        return bool(rules and (finding.rule in rules or "all" in rules))
+
+
+def terminal_name(node: ast.AST) -> str:
+    """The last identifier of a Name/Attribute receiver chain
+    (``self._store`` -> ``_store``; ``mgr.store`` -> ``store``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Full dotted text of a Name/Attribute chain (``jax.random.split``
+    -> that string); empty when the chain roots in anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def all_rules() -> "dict[str, object]":
+    """rule name -> analyze(files, config) callable, import deferred so
+    ``python -m kwok_tpu.analysis --rules layering`` never pays for the
+    rest."""
+    from kwok_tpu.analysis import (
+        layering,
+        lock_discipline,
+        parity_citations,
+        store_boundary,
+        tracer_safety,
+    )
+
+    return {
+        "layering": layering.analyze,
+        "store-boundary": store_boundary.analyze,
+        "lock-discipline": lock_discipline.analyze,
+        "tracer-safety": tracer_safety.analyze,
+        "parity-citations": parity_citations.analyze,
+    }
+
+
+__all__ = [
+    "Finding",
+    "SourceFile",
+    "all_rules",
+    "dotted_name",
+    "terminal_name",
+    "ERROR",
+    "WARNING",
+]

@@ -1,0 +1,138 @@
+"""kwoklint CLI: ``python -m kwok_tpu.analysis``.
+
+The repo's equivalent of the reference's ``make lint`` CI job
+(PARITY.md §4; invariants in CLAUDE.md:47-51): runs every analyzer
+over the kwok_tpu tree, prints findings as text or JSON, and exits
+non-zero when any unsuppressed, non-baselined finding remains — the
+contract ``tests/test_analysis.py`` wires into tier-1.
+
+Usage::
+
+    python -m kwok_tpu.analysis                      # text, exit 1 on findings
+    python -m kwok_tpu.analysis --format json        # machine-readable
+    python -m kwok_tpu.analysis --baseline           # subtract tools/kwoklint-baseline.json
+    python -m kwok_tpu.analysis --update-baseline    # rewrite the baseline from current findings
+    python -m kwok_tpu.analysis --rules layering,lock-discipline
+    python -m kwok_tpu.analysis --reference /path/to/kwok   # full citation resolution
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from kwok_tpu.analysis import Finding, all_rules
+from kwok_tpu.analysis.driver import (
+    Config,
+    load_baseline,
+    run,
+    save_baseline,
+    subtract_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join("tools", "kwoklint-baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kwok_tpu.analysis",
+        description="kwoklint: repo-native static analysis for kwok_tpu",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root containing kwok_tpu/ (default: auto-detect)",
+    )
+    parser.add_argument(
+        "--reference",
+        default="/root/reference",
+        help="reference checkout for citation resolution (absent: "
+        "reference-shaped citations are skipped as unverifiable)",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated subset of: " + ", ".join(sorted(all_rules())),
+    )
+    parser.add_argument(
+        "--baseline",
+        nargs="?",
+        const=DEFAULT_BASELINE,
+        default=None,
+        help=f"subtract a baseline file (default path: {DEFAULT_BASELINE})",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--cache",
+        default=None,
+        help="per-file findings cache (JSON), keyed by content hash",
+    )
+    args = parser.parse_args(argv)
+
+    config = Config(
+        root=args.root,
+        reference_root=args.reference,
+        rules=args.rules.split(",") if args.rules else None,
+    )
+    try:
+        findings = run(config, cache_path=args.cache)
+    except ValueError as exc:
+        print(f"kwoklint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(config.root, baseline_path)
+
+    if args.update_baseline:
+        os.makedirs(os.path.dirname(baseline_path) or ".", exist_ok=True)
+        save_baseline(baseline_path, findings)
+        print(f"kwoklint: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    if args.baseline is not None and os.path.exists(baseline_path):
+        findings = subtract_baseline(findings, load_baseline(baseline_path))
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [
+                        {
+                            "rule": f.rule,
+                            "path": f.path,
+                            "line": f.line,
+                            "message": f.message,
+                            "severity": f.severity,
+                        }
+                        for f in findings
+                    ],
+                    "count": len(findings),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        print(
+            f"kwoklint: {len(findings)} finding(s), {n_err} error(s)"
+            if findings
+            else "kwoklint: clean"
+        )
+    return 1 if any(f.severity == "error" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,273 @@
+"""Shared analyzer driver: file walk, parse-once AST cache, suppression
+comments, baseline subtraction.
+
+The shape mirrors how the reference repo runs its static gates — one
+``make lint`` entrypoint fanning out to golangci-lint's per-analyzer
+passes over a shared package load (PARITY.md §4; CLAUDE.md:47-51 states
+the prose invariants this package mechanizes).  Python has no package
+loader to share, so the shared artifact here is the parsed
+:class:`~kwok_tpu.analysis.SourceFile` list, built once per run and
+handed to every analyzer; an optional on-disk JSON cache keyed by
+content hash short-circuits re-analysis of unchanged files across runs
+(``--cache``).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kwok_tpu.analysis import Finding, SourceFile, all_rules
+
+#: ``# kwoklint: disable=rule-a,rule-b`` — trailing or standalone
+_SUPPRESS_RE = re.compile(r"#\s*kwoklint:\s*disable=([\w\-,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*kwoklint:\s*disable-file=([\w\-,\s]+)")
+
+#: rules whose findings depend only on one file's AST (cacheable per
+#: content hash).  parity-citations is deliberately NOT here: its
+#: findings depend on the files a docstring CITES (their existence and
+#: line counts), so caching on the citing file's hash would replay a
+#: clean verdict after the cited file rots — the exact drift the rule
+#: exists to catch.  Layering needs the whole import graph.
+PER_FILE_RULES = frozenset(
+    ["store-boundary", "lock-discipline", "tracer-safety"]
+)
+
+#: bump when any rule's semantics change — invalidates the on-disk cache
+CACHE_VERSION = 2
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """The directory containing the ``kwok_tpu`` package."""
+    here = os.path.abspath(
+        start or os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    )
+    return here
+
+
+def _parse_suppressions(source: str) -> Tuple[Dict[int, set], set]:
+    """Suppressions come from real COMMENT tokens only — the same text
+    inside a docstring or string literal (e.g. documentation quoting
+    the syntax) must not disable anything."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return per_line, file_wide
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_FILE_RE.search(tok.string)
+        if m:
+            file_wide.update(r.strip() for r in m.group(1).split(",") if r.strip())
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        row = tok.start[0]
+        per_line.setdefault(row, set()).update(rules)
+        # a standalone suppression comment covers the next line's
+        # statement; a trailing one covers its own line (both recorded —
+        # rule granularity keeps the extra coverage harmless)
+        if tok.line[: tok.start[1]].strip() == "":
+            per_line.setdefault(row + 1, set()).update(rules)
+    return per_line, file_wide
+
+
+def load_file(abspath: str, rel: str) -> Optional[SourceFile]:
+    try:
+        with open(abspath, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source)
+    except (OSError, SyntaxError):
+        return None
+    lines = source.splitlines()
+    per_line, file_wide = _parse_suppressions(source)
+    return SourceFile(
+        path=rel.replace(os.sep, "/"),
+        abspath=abspath,
+        source=source,
+        tree=tree,
+        lines=lines,
+        suppressions=per_line,
+        file_suppressions=file_wide,
+    )
+
+
+def collect_files(root: str, package: str = "kwok_tpu") -> List[SourceFile]:
+    """Parse every ``.py`` under ``root/package`` (sorted, stable)."""
+    out: List[SourceFile] = []
+    base = os.path.join(root, package)
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames.sort()
+        if "__pycache__" in dirnames:
+            dirnames.remove("__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            abspath = os.path.join(dirpath, name)
+            rel = os.path.relpath(abspath, root)
+            sf = load_file(abspath, rel)
+            if sf is not None:
+                out.append(sf)
+    return out
+
+
+class Config:
+    """Run configuration shared by every analyzer."""
+
+    def __init__(
+        self,
+        root: Optional[str] = None,
+        reference_root: str = "/root/reference",
+        rules: Optional[Iterable[str]] = None,
+    ):
+        self.root = repo_root() if root is None else os.path.abspath(root)
+        self.reference_root = reference_root
+        self.rules = list(rules) if rules is not None else None
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule,
+        "path": f.path,
+        "line": f.line,
+        "message": f.message,
+        "severity": f.severity,
+    }
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(
+        rule=d["rule"],
+        path=d["path"],
+        line=int(d["line"]),
+        message=d["message"],
+        severity=d.get("severity", "error"),
+    )
+
+
+def _cache_key(sf: SourceFile, rule_names: Sequence[str]) -> str:
+    h = hashlib.sha256()
+    h.update(str(CACHE_VERSION).encode())
+    h.update(",".join(rule_names).encode())
+    h.update(sf.source.encode())
+    return h.hexdigest()
+
+
+def run(
+    config: Config,
+    files: Optional[List[SourceFile]] = None,
+    cache_path: Optional[str] = None,
+) -> List[Finding]:
+    """Run the selected analyzers; returns unsuppressed findings sorted
+    by (path, line, rule).
+
+    ``cache_path``: optional JSON file mapping a file's content hash to
+    its per-file-rule findings, so unchanged files skip re-analysis
+    across runs.  Cross-file rules (layering) always recompute — the
+    import graph is global, and one already-parsed walk is cheap."""
+    rules = all_rules()
+    if config.rules is not None:
+        unknown = [r for r in config.rules if r not in rules]
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+        rules = {k: v for k, v in rules.items() if k in config.rules}
+    if files is None:
+        files = collect_files(config.root)
+    by_path = {sf.path: sf for sf in files}
+
+    per_file_rules = sorted(r for r in rules if r in PER_FILE_RULES)
+    cross_rules = sorted(r for r in rules if r not in PER_FILE_RULES)
+
+    cache: Dict[str, List[dict]] = {}
+    if cache_path and os.path.exists(cache_path):
+        try:
+            with open(cache_path, "r", encoding="utf-8") as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            cache = {}
+
+    findings: List[Finding] = []
+
+    # per-file rules: replay cached results for unchanged files, run the
+    # analyzers only over the misses
+    if per_file_rules:
+        misses: List[SourceFile] = []
+        keys = {sf.path: _cache_key(sf, per_file_rules) for sf in files}
+        for sf in files:
+            cached = cache.get(keys[sf.path]) if cache_path else None
+            if cached is not None:
+                findings.extend(_finding_from_dict(d) for d in cached)
+            else:
+                misses.append(sf)
+        fresh: Dict[str, List[Finding]] = {sf.path: [] for sf in misses}
+        for name in per_file_rules:
+            for f in rules[name](misses, config):
+                fresh.setdefault(f.path, []).append(f)
+                findings.append(f)
+        if cache_path:
+            for sf in misses:
+                cache[keys[sf.path]] = [
+                    _finding_to_dict(f) for f in fresh.get(sf.path, [])
+                ]
+            try:
+                with open(cache_path, "w", encoding="utf-8") as f:
+                    json.dump(cache, f)
+            except OSError:
+                pass
+
+    for name in cross_rules:
+        findings.extend(rules[name](files, config))
+
+    findings = [
+        f
+        for f in findings
+        if not (by_path.get(f.path) is not None and by_path[f.path].suppressed(f))
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("findings", []))
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {"findings": [f.baseline_key() for f in findings]}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def subtract_baseline(
+    findings: Sequence[Finding], baseline: Sequence[dict]
+) -> List[Finding]:
+    """Drop findings present in the baseline.  Multiset semantics per
+    (rule, path, message): N baselined duplicates absorb at most N
+    live duplicates, so a *new* second instance of a baselined finding
+    still surfaces."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for b in baseline:
+        k = (b.get("rule", ""), b.get("path", ""), b.get("message", ""))
+        budget[k] = budget.get(k, 0) + 1
+    out: List[Finding] = []
+    for f in findings:
+        k = (f.rule, f.path, f.message)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            continue
+        out.append(f)
+    return out

@@ -1,0 +1,330 @@
+"""Layering analyzer: enforce the SURVEY layer map on the import graph.
+
+The layer stack (SURVEY.md §1 for the reference's version; CLAUDE.md
+and the package layout carry the kwok_tpu mapping) is, bottom to top::
+
+    utils, analysis        (0)  generic infra — imports nothing above
+    api, stages            (1)  types/config + default stage assets
+    engine, ops, parallel  (2)  FSM compiler + device kernels + mesh
+    native                 (3)  optional C/C++ accelerators
+    cluster                (4)  store/apiserver/client/informer
+    controllers, workloads,
+    metrics, snapshot, cni (5)  reconcilers over the cluster bus
+    server, tools          (6)  kubelet-surface HTTP + dev tooling
+    ctl, cmd               (7)  cluster lifecycle CLI + entrypoints
+
+Two rules:
+
+- **no upward imports**: a module may import same-layer or lower-layer
+  subpackages only.  Exception: an import *inside a function body and
+  guarded by try/except* is an optional-dependency probe (the
+  ``utils.queue`` → ``native.queue`` accelerator pattern) and does not
+  constitute an architectural edge — the importer works when the
+  target is absent.
+- **no import cycles** between kwok_tpu modules at module granularity
+  (module-scope imports only; deferred imports legitimately break
+  cycles at runtime).
+
+PR 1's review caught a cluster→workloads inversion by hand
+(CHANGES.md:5); this check is that review, mechanized.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kwok_tpu.analysis import ERROR, Finding, SourceFile
+
+RULE = "layering"
+
+#: bottom-to-top layer groups; index = layer number
+LAYERS: List[Tuple[str, ...]] = [
+    ("utils", "analysis"),
+    ("api", "stages"),
+    ("engine", "ops", "parallel"),
+    ("native",),
+    ("cluster",),
+    ("controllers", "workloads", "metrics", "snapshot", "cni"),
+    ("server", "tools"),
+    ("ctl", "cmd"),
+]
+
+LAYER_OF: Dict[str, int] = {
+    pkg: i for i, group in enumerate(LAYERS) for pkg in group
+}
+
+
+def _subpackage(module: str) -> Optional[str]:
+    """``kwok_tpu.cluster.store`` -> ``cluster``; None for externals."""
+    parts = module.split(".")
+    if len(parts) >= 2 and parts[0] == "kwok_tpu":
+        return parts[1]
+    return None
+
+
+def _module_name(path: str) -> Optional[str]:
+    """Repo-relative path -> dotted module, None outside kwok_tpu."""
+    if not path.startswith("kwok_tpu/") or not path.endswith(".py"):
+        return None
+    mod = path[: -len(".py")].replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+class _ImportEdge:
+    __slots__ = ("target", "names", "line", "deferred", "guarded")
+
+    def __init__(
+        self,
+        target: str,
+        line: int,
+        deferred: bool,
+        guarded: bool,
+        names: Tuple[str, ...] = (),
+    ):
+        self.target = target  # dotted kwok_tpu module (as written)
+        self.names = names  # imported names (ImportFrom only)
+        self.line = line
+        self.deferred = deferred  # inside a function body
+        self.guarded = guarded  # inside a try with an except handler
+
+
+#: handler exception names that make a try-guard an import guard
+_IMPORT_CATCHERS = {
+    "ImportError",
+    "ModuleNotFoundError",
+    "Exception",
+    "BaseException",
+}
+
+
+def _catches_import_error(handlers: List[ast.ExceptHandler]) -> bool:
+    for h in handlers:
+        if h.type is None:  # bare except
+            return True
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for t in types:
+            name = t.attr if isinstance(t, ast.Attribute) else getattr(t, "id", "")
+            if name in _IMPORT_CATCHERS:
+                return True
+    return False
+
+
+def _collect_edges(tree: ast.Module) -> List[_ImportEdge]:
+    edges: List[_ImportEdge] = []
+
+    def walk(node: ast.AST, deferred: bool, guarded: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            d, g = deferred, guarded
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                d = True
+            if isinstance(node, ast.Try):
+                # only the try BODY is guarded, and only when a handler
+                # can actually absorb the ImportError — an import in a
+                # handler/orelse/finally, or under `except ValueError`,
+                # still propagates when the target is absent
+                g = guarded or (
+                    child in node.body and _catches_import_error(node.handlers)
+                )
+            if isinstance(child, ast.Import):
+                for alias in child.names:
+                    if alias.name.startswith("kwok_tpu"):
+                        edges.append(_ImportEdge(alias.name, child.lineno, d, g))
+            elif isinstance(child, ast.ImportFrom):
+                if child.module and child.module.startswith("kwok_tpu"):
+                    edges.append(
+                        _ImportEdge(
+                            child.module,
+                            child.lineno,
+                            d,
+                            g,
+                            names=tuple(a.name for a in child.names),
+                        )
+                    )
+            walk(child, d, g)
+
+    walk(tree, deferred=False, guarded=False)
+    return edges
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    #: module -> set of module-scope kwok_tpu targets (cycle graph)
+    graph: Dict[str, Set[str]] = {}
+    modules: Set[str] = set()
+    file_of: Dict[str, SourceFile] = {}
+
+    files = list(files)
+    for sf in files:
+        mod = _module_name(sf.path)
+        if mod is None:
+            continue
+        modules.add(mod)
+        file_of[mod] = sf
+
+    for sf in files:
+        mod = _module_name(sf.path)
+        if mod is None:
+            continue
+        src_pkg = _subpackage(mod)
+        src_layer = LAYER_OF.get(src_pkg) if src_pkg else None
+        if src_pkg is not None and src_layer is None:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=1,
+                    message=(
+                        f"subpackage '{src_pkg}' is not in the layer map — "
+                        "add it to kwok_tpu/analysis/layering.py LAYERS"
+                    ),
+                    severity=ERROR,
+                )
+            )
+            continue
+        for edge in _collect_edges(sf.tree):
+            tgt_pkg = _subpackage(edge.target)
+            if tgt_pkg is None or tgt_pkg == src_pkg or src_pkg is None:
+                # intra-package and root imports are not layering edges,
+                # but module-scope ones still feed the cycle graph below
+                pass
+            else:
+                tgt_layer = LAYER_OF.get(tgt_pkg)
+                if tgt_layer is None:
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=edge.line,
+                            message=(
+                                f"import target subpackage '{tgt_pkg}' is not "
+                                "in the layer map — add it to LAYERS"
+                            ),
+                        )
+                    )
+                elif tgt_layer > src_layer and not (edge.deferred and edge.guarded):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=edge.line,
+                            message=(
+                                f"upward import: {src_pkg} (layer {src_layer}) "
+                                f"imports {tgt_pkg} (layer {tgt_layer}) via "
+                                f"'{edge.target}' — only same-layer or lower "
+                                "imports are allowed (guarded function-scope "
+                                "imports of optional accelerators are exempt)"
+                            ),
+                        )
+                    )
+            if not edge.deferred:
+                # cycle graph on module-scope imports, resolved to real
+                # modules: `from kwok_tpu.x import name` targets the
+                # submodule x.name when that exists — importing a
+                # SUBMODULE through a partially-initialized package is
+                # legal (the sys.modules fallback, bpo-17636), so it is
+                # not an edge onto x/__init__; importing an ATTRIBUTE of
+                # x/__init__ is (that's the case that raises
+                # "partially initialized module" on a cold import), so
+                # those keep the edge onto the package module x
+                targets: List[str] = []
+                sub_hits = [
+                    f"{edge.target}.{n}"
+                    for n in edge.names
+                    if f"{edge.target}.{n}" in modules
+                ]
+                if edge.names and sub_hits and len(sub_hits) == len(edge.names):
+                    targets = sub_hits
+                elif edge.target in modules:
+                    targets = [edge.target] + sub_hits
+                elif sub_hits:
+                    targets = sub_hits
+                else:
+                    parent = ".".join(edge.target.split(".")[:-1])
+                    if parent in modules:
+                        targets = [parent]
+                for tgt_mod in targets:
+                    if tgt_mod != mod:
+                        graph.setdefault(mod, set()).add(tgt_mod)
+
+    findings.extend(_find_cycles(graph, file_of))
+    return findings
+
+
+def _find_cycles(
+    graph: Dict[str, Set[str]], file_of: Dict[str, SourceFile]
+) -> List[Finding]:
+    """Tarjan SCC over the module-scope import graph; every SCC with
+    more than one node (or a self-loop) is a cycle finding."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the repo graph is shallow, but recursion
+        # limits are not a failure mode a linter should have)
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    nodes = set(graph)
+    for tgts in graph.values():
+        nodes.update(tgts)
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for scc in sccs:
+        if len(scc) < 2 and not (
+            len(scc) == 1 and scc[0] in graph.get(scc[0], ())
+        ):
+            continue
+        members = sorted(scc)
+        anchor = members[0]
+        sf = file_of.get(anchor)
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=sf.path if sf else anchor.replace(".", "/") + ".py",
+                line=1,
+                message="import cycle: " + " <-> ".join(members),
+            )
+        )
+    return findings

@@ -1,0 +1,260 @@
+"""Parity-citations analyzer: module docstrings must cite something
+that still exists.
+
+The repo convention (CLAUDE.md:47-48) is that every module docstring
+cites the reference files (``file:line``) it mirrors, so parity can be
+audited mechanically.  Citations rot: ``cluster/store.py`` shipped for
+two PRs citing a ``cluster.httpapi`` module that never existed (the
+facade is really ``kwok_tpu.cluster.apiserver`` +
+``kwok_tpu.cluster.k8s_api``).  This analyzer makes the convention a
+gate:
+
+- **presence**: every non-``__init__`` kwok_tpu module must have a
+  module docstring containing at least one ``path.ext:line[-line]``
+  citation token.  Modules with no reference analog cite the repo's
+  own design docs (``SURVEY.md:NN``, ``PARITY.md:NN`` ...) — those
+  resolve against the repo root.
+- **resolution**: each token's path must resolve — against the repo
+  root first, then the reference checkout (``--reference``, default
+  ``/root/reference``): exact relative path, else unique-basename
+  lookup.  Where it resolves, the cited line must be within the file.
+  When the reference checkout is absent (this container does not ship
+  it), reference-shaped tokens are skipped as unverifiable rather than
+  failed — the gate stays deterministic everywhere, and runs next to a
+  checkout get the full check.
+- **self-references**: dotted kwok-tpu tokens in docstrings must name
+  a real module, or a real top-level attribute of one
+  (``kwok_tpu.cluster.store.ResourceStore``) — the check that catches
+  the ``httpapi`` class of drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kwok_tpu.analysis import Finding, SourceFile
+
+RULE = "parity-citations"
+
+#: ``pkg/utils/lifecycle/lifecycle.go:125-191``, ``SURVEY.md:30``,
+#: ``controller.go:559`` ...
+CITE_RE = re.compile(
+    r"(?P<path>[\w\-./]*[\w\-]+\.(?:go|py|c|cc|cpp|h|hpp|sh|yaml|yml|tpl|md))"
+    r":(?P<start>\d+)(?:-(?P<end>\d+))?"
+)
+
+SELF_RE = re.compile(r"\bkwok_tpu(?:\.\w+)+")
+
+
+def _line_count(path: str, cache: Dict[str, Optional[int]]) -> Optional[int]:
+    if path not in cache:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            # a trailing newline ends the last line, it does not open a
+            # new one — "a\nb\n" is 2 lines, so line N+1 must NOT
+            # resolve (the classic rot after a tail section is deleted)
+            n = data.count(b"\n")
+            if data and not data.endswith(b"\n"):
+                n += 1
+            cache[path] = n
+        except OSError:
+            cache[path] = None
+    return cache[path]
+
+
+class _Resolver:
+    def __init__(self, repo_root: str, reference_root: str):
+        self.repo_root = repo_root
+        self.reference_root = reference_root
+        self.have_reference = os.path.isdir(reference_root)
+        self._basenames: Optional[Dict[str, List[str]]] = None
+        self._lines: Dict[str, Optional[int]] = {}
+
+    def _basename_index(self) -> Dict[str, List[str]]:
+        if self._basenames is None:
+            idx: Dict[str, List[str]] = {}
+            for dirpath, dirnames, filenames in os.walk(self.reference_root):
+                if ".git" in dirnames:
+                    dirnames.remove(".git")
+                for name in filenames:
+                    idx.setdefault(name, []).append(os.path.join(dirpath, name))
+            self._basenames = idx
+        return self._basenames
+
+    def resolve(self, path: str, start: int, end: Optional[int]) -> Optional[str]:
+        """None when the citation is good or unverifiable; otherwise a
+        human-readable problem."""
+        last = end if end is not None else start
+        if end is not None and end < start:
+            return f"inverted line range {start}-{end}"
+        # 1) repo-relative (kwok_tpu/..., SURVEY.md, native/...)
+        cand = os.path.join(self.repo_root, path)
+        if os.path.isfile(cand):
+            n = _line_count(cand, self._lines)
+            if n is not None and last > n:
+                return f"cites line {last} but {path} has {n} lines"
+            return None
+        # 2) reference-relative
+        if self.have_reference:
+            cand = os.path.join(self.reference_root, path)
+            if os.path.isfile(cand):
+                n = _line_count(cand, self._lines)
+                if n is not None and last > n:
+                    return (
+                        f"cites line {last} but reference {path} has {n} lines"
+                    )
+                return None
+            if "/" not in path:
+                hits = self._basename_index().get(path, [])
+                if hits:
+                    for h in hits:
+                        n = _line_count(h, self._lines)
+                        if n is not None and last <= n:
+                            return None
+                    return (
+                        f"no file named {path} in the reference has "
+                        f"{last} lines"
+                    )
+            return f"{path} not found in repo or reference checkout"
+        # reference absent: repo-unknown tokens are unverifiable, skip
+        return None
+
+
+def _top_level_names(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.asname or a.name)
+        elif isinstance(node, ast.If):
+            # names bound under `if _HAVE_X:` / try-like guards
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+    return names
+
+
+def _check_self_ref(
+    token: str,
+    repo_root: str,
+    tree_cache: Optional[Dict[str, Optional[ast.Module]]] = None,
+) -> Optional[str]:
+    """Validate a dotted kwok_tpu token against the live tree."""
+    parts = token.split(".")
+    # longest prefix that is a module or package
+    mod_end = 0
+    for i in range(len(parts), 0, -1):
+        rel = os.path.join(*parts[:i])
+        if os.path.isfile(os.path.join(repo_root, rel + ".py")) or os.path.isfile(
+            os.path.join(repo_root, rel, "__init__.py")
+        ):
+            mod_end = i
+            break
+    if mod_end == 0:
+        return f"{token}: no such module"
+    tail = parts[mod_end:]
+    if not tail:
+        return None
+    rel = os.path.join(*parts[:mod_end])
+    mod_file = (
+        os.path.join(repo_root, rel + ".py")
+        if os.path.isfile(os.path.join(repo_root, rel + ".py"))
+        else os.path.join(repo_root, rel, "__init__.py")
+    )
+    if tree_cache is None:
+        tree_cache = {}
+    if mod_file not in tree_cache:
+        # many docstrings cite the same big modules (store.py etc.) —
+        # parse each cited file once per run, like _line_count above
+        try:
+            with open(mod_file, "r", encoding="utf-8") as f:
+                tree_cache[mod_file] = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            tree_cache[mod_file] = None
+    tree = tree_cache[mod_file]
+    if tree is None:
+        return None
+    if tail[0] in _top_level_names(tree):
+        # deeper tails (Class.method) are beyond static reach — accept
+        return None
+    mod = ".".join(parts[:mod_end])
+    return f"{token}: module {mod} has no attribute or submodule '{tail[0]}'"
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    resolver = _Resolver(config.root, config.reference_root)
+    tree_cache: Dict[str, Optional[ast.Module]] = {}
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith("kwok_tpu/"):
+            continue
+        doc = ast.get_docstring(sf.tree, clean=False) or ""
+        doc_node = (
+            sf.tree.body[0]
+            if sf.tree.body
+            and isinstance(sf.tree.body[0], ast.Expr)
+            and isinstance(sf.tree.body[0].value, ast.Constant)
+            else None
+        )
+        doc_line = doc_node.lineno if doc_node is not None else 1
+        is_init = sf.path.endswith("__init__.py")
+
+        cites = list(CITE_RE.finditer(doc))
+        if not cites and not is_init:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=doc_line,
+                    message=(
+                        "module docstring has no file:line citation — every "
+                        "module cites the reference file(s) it mirrors, or "
+                        "the repo doc (SURVEY.md:NN / PARITY.md:NN) that "
+                        "specifies it (CLAUDE.md convention)"
+                    ),
+                )
+            )
+        for m in cites:
+            problem = resolver.resolve(
+                m.group("path"),
+                int(m.group("start")),
+                int(m.group("end")) if m.group("end") else None,
+            )
+            if problem is not None:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=doc_line + doc[: m.start()].count("\n"),
+                        message=f"stale citation {m.group(0)}: {problem}",
+                    )
+                )
+        for m in SELF_RE.finditer(doc):
+            problem = _check_self_ref(m.group(0), config.root, tree_cache)
+            if problem is not None:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=doc_line + doc[: m.start()].count("\n"),
+                        message=f"stale self-reference {problem}",
+                    )
+                )
+    return findings

@@ -1,0 +1,226 @@
+"""Tracer-safety analyzer: keep the device kernels pure and traceable.
+
+The tick kernels (``ops/tick.py``; sharded variants in
+``parallel/mesh.py``; drained by ``engine/simulator.py``) implement
+the north star's device-resident state-transition kernel
+(SURVEY.md:22-26) and are jitted —
+everything inside them runs under a JAX tracer, where host syncs and
+Python-side nondeterminism are bugs that typecheck:
+
+- ``.item()`` / ``.tolist()`` / ``np.asarray(...)`` / ``jax.device_get``
+  on a traced value forces a device->host transfer per call — the exact
+  per-tick blocking read the macro-tick redesign removed
+  (``ops/tick.py`` ``_run_ticks_collect_impl`` docstring);
+- ``time.time()`` / ``datetime.now()`` / stdlib ``random.*`` burn host
+  state into the trace: the value at *trace* time is baked into the
+  compiled program, silently wrong on every later call (virtual time
+  lives in ``SoA.now``; randomness must ride the threaded PRNG
+  ``key``);
+- a Python ``if``/``while`` on a traced argument raises
+  ``TracerBoolConversionError`` at runtime — but only on the first call
+  with a novel shape, so it hides until retrace.
+
+Kernel discovery: a function is a kernel when (a) it is decorated with
+``jax.jit``/``jit``, (b) its name appears as an argument to a call
+whose text mentions ``jit`` (covers ``functools.partial(jax.jit,
+...)(_tick_impl)`` and ``jax.jit(run, ...)``), or (c) it is called by
+another kernel in the same module (transitive, per module).  Parameters
+named in a ``static_argnames`` literal at the jit site are static and
+exempt from the traced-``if`` check.  The check only runs over the
+files named in ``KERNEL_FILES`` — host-side numpy in the rest of the
+repo is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from kwok_tpu.analysis import Finding, SourceFile, dotted_name
+
+RULE = "tracer-safety"
+
+#: the modules that define/jit device kernels
+KERNEL_FILES = (
+    "kwok_tpu/ops/tick.py",
+    "kwok_tpu/engine/simulator.py",
+    "kwok_tpu/parallel/mesh.py",
+)
+
+#: attribute-call names that force a host sync on a traced value
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+
+#: dotted-call patterns that are host-state / host-sync inside a trace
+_HOST_DOTTED = re.compile(
+    r"^(np\.|numpy\.|jax\.device_get$|time\.(time|monotonic|monotonic_ns|sleep)$"
+    r"|datetime\.|random\.)"
+)
+
+
+def _jit_static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                    names.add(node.value)
+    return names
+
+
+def _find_kernels(tree: ast.Module) -> Dict[str, Set[str]]:
+    """function name -> static param names, for every kernel function
+    in the module (transitively closed over same-module calls)."""
+    funcs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+
+    kernels: Dict[str, Set[str]] = {}
+
+    def mark(name: str, static: Set[str]) -> None:
+        if name in funcs:
+            kernels.setdefault(name, set()).update(static)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                text = dotted_name(dec if not isinstance(dec, ast.Call) else dec.func)
+                if "jit" in text.split("."):
+                    static = (
+                        _jit_static_argnames(dec) if isinstance(dec, ast.Call) else set()
+                    )
+                    mark(node.name, static)
+        if not isinstance(node, ast.Call):
+            continue
+        try:
+            func_text = ast.unparse(node.func)
+        except Exception:  # pragma: no cover
+            func_text = ""
+        if "jit" not in func_text:
+            continue
+        static = _jit_static_argnames(node)
+        if isinstance(node.func, ast.Call):
+            # functools.partial(jax.jit, static_argnames=...) carries
+            # the statics on the inner partial call
+            static |= _jit_static_argnames(node.func)
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in funcs:
+                    mark(sub.id, static)
+
+    # transitive closure: a function called from a kernel body (by bare
+    # name) is traced too, as is any def nested inside a kernel (scan
+    # bodies handed to lax.scan/fori_loop)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(kernels):
+            for fn in funcs[name]:
+                for node in ast.walk(fn):
+                    target: Optional[str] = None
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)
+                        and node.func.id in funcs
+                    ):
+                        target = node.func.id
+                    elif (
+                        isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name in funcs
+                        and node is not fn
+                    ):
+                        target = node.name
+                    if target is not None and target not in kernels:
+                        kernels[target] = set()
+                        changed = True
+    return kernels
+
+
+def _check_kernel(sf: SourceFile, fn: ast.FunctionDef, static: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    params = {
+        a.arg
+        for a in [*fn.args.posonlyargs, *fn.args.args, *fn.args.kwonlyargs]
+        if a.arg not in ("self", "cls")
+    }
+    traced = params - static
+
+    def walk_own(node: ast.AST):
+        """Descend without entering nested defs — those are kernels in
+        their own right and get checked against their own params."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from walk_own(child)
+
+    for node in walk_own(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"host sync '.{func.attr}()' inside kernel "
+                            f"'{fn.name}' — forces a device->host transfer "
+                            "per trace"
+                        ),
+                    )
+                )
+                continue
+            dotted = dotted_name(func)
+            if dotted and not dotted.startswith("jax.") and _HOST_DOTTED.match(dotted):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"host-side call '{dotted}' inside kernel "
+                            f"'{fn.name}' — host state/sync is baked in at "
+                            "trace time (use SoA.now / the threaded PRNG "
+                            "key / jnp)"
+                        ),
+                    )
+                )
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Name) and sub.id in traced:
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.path,
+                            line=node.lineno,
+                            message=(
+                                f"Python branch on traced argument "
+                                f"'{sub.id}' inside kernel '{fn.name}' — "
+                                "use jnp.where/lax.cond, or declare it in "
+                                "static_argnames"
+                            ),
+                        )
+                    )
+                    break
+    return findings
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.path not in KERNEL_FILES:
+            continue
+        kernels = _find_kernels(sf.tree)
+        if not kernels:
+            continue
+        by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+        for name, static in sorted(kernels.items()):
+            for fn in by_name.get(name, []):
+                findings.extend(_check_kernel(sf, fn, static))
+    return findings

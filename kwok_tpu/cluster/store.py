@@ -19,8 +19,9 @@ the standalone equivalent — the bus every other component rides:
   ``spec.nodeName`` pod re-list rides this — reference
   controller.go:559-573).
 
-An HTTP facade with kube-API routes sits on top in
-``kwok_tpu.cluster.httpapi`` for out-of-process clients; in-process
+An HTTP facade with kube-API routes sits on top for out-of-process
+clients — ``kwok_tpu.cluster.apiserver`` owns the listener and
+``kwok_tpu.cluster.k8s_api`` the route handlers; in-process
 controllers use this object directly (the Go↔device bridge boundary).
 """
 
@@ -410,7 +411,11 @@ class _LaneGrant:
 
     def __enter__(self) -> Optional[StatusLane]:
         store = self.store
-        store._mut.acquire()
+        # deliberately manual: on a successful grant the mutex stays
+        # held across the with-body until __exit__ releases it (that IS
+        # the lane — the grantee splices store state under the lock);
+        # the except below covers the only path that must release here
+        store._mut.acquire()  # kwoklint: disable=lock-discipline
         try:
             try:
                 st = store._state(self.kind)

@@ -1,7 +1,7 @@
 """meta.k8s.io Table responses for kubectl ``get``.
 
 The real kube-apiserver (the facade's behavioral reference —
-runtime/binary/cluster.go composes one) answers
+runtime/binary/cluster.go:316-728 composes one) answers
 ``Accept: application/json;as=Table;v=v1;g=meta.k8s.io`` with a
 ``Table`` whose columns mirror kubectl's printed output
 (NAME/READY/STATUS/... for pods, NAME/STATUS/ROLES/... for nodes).

@@ -1,9 +1,9 @@
 """Device lease lanes: lease renewals ride the node player's tick.
 
 The reference renews each node's Lease from N host workers popping a
-delay queue (reference pkg/kwok/controllers/node_lease_controller.go:
-108-143, renew = duration/4 + 4% one-sided jitter, controller.go:
-245-249).  At 10k nodes that is a steady stream of single-object
+delay queue (reference node_lease_controller.go:108-143 under
+pkg/kwok/controllers/, renew = duration/4 + 4% one-sided jitter,
+controller.go:245-249).  At 10k nodes that is a steady stream of single-object
 round-trips.  Here the cadence lives ON DEVICE as a fire-time column
 (`ops/tick.py::LeaseLane`) ticked in the node player's step: every
 lease due in a tick drains as one batch through

@@ -4,7 +4,8 @@ seam.
 Where ``StagePlayer`` (host backend) runs the reference's per-object
 loop, this player keeps every object as a row of the device-resident
 SoA and replaces informer-dedup + Lifecycle.Match + WeightDelayingQueue
-+ N play workers with ONE batched tick kernel (SURVEY.md §2.9, §7.3):
++ N play workers with ONE batched tick kernel (SURVEY.md:202-218
+§2.9, §7.3):
 
     watch deltas -> admit/refresh rows (host, batched between ticks)
     -> tick() on device (match + weighted choice + timers + effects)

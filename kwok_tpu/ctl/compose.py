@@ -1,8 +1,8 @@
 """Compose runtime: the cluster as a docker-compose project.
 
 Mirrors the reference's compose runtime (reference
-pkg/kwokctl/runtime/compose/: per-component containers generated from
-the same Component specs the binary runtime forks).  Component argv
+pkg/kwokctl/runtime/compose/, SURVEY.md:153: per-component containers
+generated from the same Component specs the binary runtime forks).  Component argv
 lists translate into services on a python base image with the
 framework bind-mounted; ``up``/``down`` shell out to ``docker compose``
 (podman/nerdctl work identically via ``engine=``), and dry-run prints

@@ -2,7 +2,8 @@
 
 The reference composes a kubectl-proxy component so tooling without
 cluster credentials can reach the apiserver on a local port (reference
-pkg/kwokctl/components/kubectl_proxy.go).  This is the same relay for
+pkg/kwokctl/components/kubectl_proxy.go; the component-builder
+inventory is SURVEY.md:155).  This is the same relay for
 kwok-tpu clusters: it owns the TLS client identity (admin cert from the
 cluster's pki) and forwards any HTTP request — including watch
 streams — to the apiserver, so ``kwokctl proxy`` + plain ``curl

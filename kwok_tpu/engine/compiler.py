@@ -1,8 +1,9 @@
 """Stage-set compiler: Stage CRDs -> dense tensors for the device kernel.
 
 This is the ahead-of-time counterpart of the reference's per-object
-interpretation (reference: pkg/utils/lifecycle/lifecycle.go NewStage +
-Match + Delay, next.go Patches). Three artifacts are produced:
+interpretation (reference: pkg/utils/lifecycle/lifecycle.go:194-267
+NewStage, Match at lifecycle.go:125-191, Delay at lifecycle.go:313-341,
+plus next.go:43-96 Patches). Three artifacts are produced:
 
 1. **Predicates** — every selector becomes rows of (column, mask,
    negate) tests over the bitmask feature columns (features.py).

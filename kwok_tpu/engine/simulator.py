@@ -2,7 +2,7 @@
 
 Owns the device-resident SoA and the host-side object mirror. The
 division of labor mirrors the Go<->device bridge mandated by the north
-star (SURVEY.md §2.9, §7): objects are admitted/updated/deleted on the
+star (SURVEY.md:202-218 §2.9, §7): objects are admitted/updated/deleted on the
 host (feature extraction + signature/override classing), the tick
 kernel advances the FSM on device, and only *dirty rows* come back —
 the host then materializes their full JSON status with the same

@@ -1,7 +1,7 @@
 """ResourceUsage evaluation: instantaneous values, cumulative integration,
 and a vectorized bulk path for whole-cluster scrapes.
 
-Reference behavior (pkg/kwok/server/metrics_resource_usage.go):
+Reference behavior (pkg/kwok/server/metrics_resource_usage.go:36-264):
 - per-container usage resolves the pod's ``ResourceUsage`` CR first, else the
   first matching ``ClusterResourceUsage`` (selector on namespace/name), then
   the first usages entry matching the container name (``:226-264``);

@@ -52,7 +52,10 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         here = os.path.dirname(os.path.abspath(__file__))
         cached = os.path.join(here, _LIB_NAME)
-        if not os.path.exists(cached) and not _build(cached):
+        # the compile runs under _lock on purpose: build-once semantics —
+        # concurrent first callers must block until the library exists
+        # rather than race duplicate compiler invocations
+        if not os.path.exists(cached) and not _build(cached):  # kwoklint: disable=lock-discipline
             return None
         try:
             lib = ctypes.CDLL(cached)

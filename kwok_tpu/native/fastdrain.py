@@ -1,6 +1,10 @@
 """Loader for the kwok_fastdrain CPython extension.
 
-Unlike the ctypes-based delay heap (kwok_tpu/native/__init__.py), the
+The accelerator exists because "only dirty rows cross the boundary"
+(SURVEY.md:373) leaves the drain's dict-building as the host
+bottleneck; the reference has no native analog (CGO is disabled,
+hack/releases.sh:186).  Unlike the ctypes-based delay heap
+(kwok_tpu/native/__init__.py), the
 drain accelerator manipulates Python dicts directly, so it is a real
 extension module compiled against Python.h and imported from its build
 path.  ``KWOK_TPU_NATIVE=0`` or a missing toolchain falls back to the
@@ -80,7 +84,10 @@ def load():
                 and os.path.getmtime(src) > os.path.getmtime(cached)
             )
         )
-        if stale and not _build(cached):
+        # the compile runs under the lock on purpose: build-once
+        # semantics — concurrent first callers must block until the
+        # extension exists rather than race duplicate compiles
+        if stale and not _build(cached):  # kwoklint: disable=lock-discipline
             return None
         try:
             loader = importlib.machinery.ExtensionFileLoader(

@@ -1,424 +1,55 @@
-"""SPDY/3.1 upgrade + framing for the kubelet streaming endpoints.
+"""SPDY/3.1 upgrade handling for the kubelet streaming endpoints —
+the server-side half.
 
 The reference serves exec/attach/port-forward over BOTH SPDY/3.1 and
 WebSocket (reference pkg/kwok/server/debugging_exec.go:148-165 wires
 k8s.io/apiserver's remotecommand.ServeExec, whose upgrade path is
 moby/spdystream behind client-go's spdy.RoundTripper; kubectl ≤1.28
-and most client-go consumers default to SPDY).  This module implements
-the server side of that wire protocol on the raw socket:
+and most client-go consumers default to SPDY).  The symmetric framing
+protocol (frames, header compression, flow control, streams) lives in
+``kwok_tpu.utils.spdyproto`` so the client (``kwok_tpu.utils
+.spdyclient``) sits below the server in the layer map; this module
+adds what only the server needs:
 
 - the ``Connection: Upgrade`` / ``Upgrade: SPDY/3.1`` handshake with
-  ``X-Stream-Protocol-Version`` negotiation,
-- SPDY/3.1 control and data frames: SYN_STREAM / SYN_REPLY /
-  RST_STREAM / SETTINGS / PING / GOAWAY / HEADERS / WINDOW_UPDATE,
-- the SPDY/3 zlib header compression (per-direction persistent
-  compressors with the draft-3 dictionary; each block ends with a
-  SYNC flush), and
-- stream plumbing: the client opens one stream per channel with a
-  ``streamtype`` header (error/stdin/stdout/stderr/resize — the
-  kubelet remote-command convention) or data/error pairs keyed by
-  ``port``/``requestid`` (port forward).
-
-``SpdyChannelAdapter`` then presents the SAME duck-type as the
-WebSocket channel object (``send_channel``/``recv``/``close``), so the
-server's exec/attach handlers drive either transport unchanged.
-
-The header dictionary below is the SPDY draft-3 constant
-(reconstructed from the spec, §2.6.10.1).  Both directions of this
-implementation use it symmetrically; byte-exactness only governs
-interop with foreign implementations (client-go), which cannot be
-exercised in this environment (no kubectl binary, no egress).
+  ``X-Stream-Protocol-Version`` negotiation on a
+  BaseHTTPRequestHandler, and
+- ``SpdyChannelAdapter``: presents the SAME duck-type as the
+  WebSocket channel object (``send_channel``/``recv``/``close``), so
+  the server's exec/attach handlers drive either transport unchanged.
 """
 
 from __future__ import annotations
 
-import struct
 import threading
-import zlib
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-# --------------------------------------------------------------- dictionary
-
-_WORDS = [
-    "options", "head", "post", "put", "delete", "trace", "accept",
-    "accept-charset", "accept-encoding", "accept-language",
-    "accept-ranges", "age", "allow", "authorization", "cache-control",
-    "connection", "content-base", "content-encoding",
-    "content-language", "content-length", "content-location",
-    "content-md5", "content-range", "content-type", "date", "etag",
-    "expect", "expires", "from", "host", "if-match",
-    "if-modified-since", "if-none-match", "if-range",
-    "if-unmodified-since", "last-modified", "location", "max-forwards",
-    "pragma", "proxy-authenticate", "proxy-authorization", "range",
-    "referer", "retry-after", "server", "te", "trailer",
-    "transfer-encoding", "upgrade", "user-agent", "vary", "via",
-    "warning", "www-authenticate", "method", "get", "status", "200 OK",
-    "version", "HTTP/1.1", "url", "public", "set-cookie", "keep-alive",
-    "origin",
-]
-_TAIL = (
-    "100101201202205206300302303304305306307402405406407408409410411412"
-    "413414415416417502504505"
-    "203 Non-Authoritative Information204 No Content301 Moved Permanently"
-    "400 Bad Request401 Unauthorized403 Forbidden404 Not Found"
-    "500 Internal Server Error501 Not Implemented503 Service Unavailable"
-    "Jan Feb Mar Apr May Jun Jul Aug Sept Oct Nov Dec"
-    " 00:00:00"
-    " Mon, Tue, Wed, Thu, Fri, Sat, Sun, GMT"
-    "chunked,text/html,image/png,image/jpg,image/gif,"
-    "application/xml,application/xhtml+xml,text/plain,text/javascript,"
-    "publicprivatemax-age=gzip,deflate,sdchcharset=utf-8charset=iso-8859-1"
-    ",utf-,*,enq=0.7,q=0.8,q=0.9,q=1.0,q=0.1,q=0.001,q=0.002,q=0.5,en-gb"
-    "chunkedtext/htmlimage/pngimage/jpgimage/gifapplication/xml"
-    "application/xhtml+xmltext/plaintext/javascriptpublicprivate"
-    "max-age=gzip,deflate,sdchcharset=utf-8charset=iso-8859-1,utf-,*,en"
+# protocol re-exports: this module remains the server-facing import
+# surface for the SPDY vocabulary
+from kwok_tpu.utils.spdyproto import (  # noqa: F401
+    FLAG_FIN,
+    GOAWAY,
+    HEADERS,
+    INITIAL_WINDOW,
+    PING,
+    PORT_FORWARD_PROTOCOLS,
+    REMOTE_COMMAND_PROTOCOLS,
+    RST_STREAM,
+    SETTINGS,
+    SPDY_DICT,
+    SPDY_VERSION,
+    SYN_REPLY,
+    SYN_STREAM,
+    WINDOW_UPDATE,
+    SpdySession,
+    SpdyStream,
 )
-SPDY_DICT = (
-    b"".join(struct.pack(">I", len(w)) + w.encode() for w in _WORDS)
-    + _TAIL.encode()
-    + b"\x00"
-)
-
-SPDY_VERSION = 3
-
-# control frame types
-SYN_STREAM = 1
-SYN_REPLY = 2
-RST_STREAM = 3
-SETTINGS = 4
-PING = 6
-GOAWAY = 7
-HEADERS = 8
-WINDOW_UPDATE = 9
-
-FLAG_FIN = 0x01
-
-#: per-stream / per-session initial flow-control window (SPDY/3.1)
-INITIAL_WINDOW = 64 * 1024
-
-#: the remote-command sub-protocols we answer for SPDY clients
-#: (reference remotecommand supports v1-v4 over SPDY; v4 carries the
-#: JSON Status error channel this server emits)
-REMOTE_COMMAND_PROTOCOLS = ("v4.channel.k8s.io",)
-PORT_FORWARD_PROTOCOLS = ("portforward.k8s.io",)
 
 
 def is_spdy_upgrade(headers) -> bool:
     up = (headers.get("Upgrade") or "").lower()
     conn = (headers.get("Connection") or "").lower()
     return "spdy/3.1" in up and "upgrade" in conn
-
-
-def _encode_headers(pairs: Dict[str, str], deflater) -> bytes:
-    out = [struct.pack(">I", len(pairs))]
-    for k, v in pairs.items():
-        kb = k.lower().encode()
-        vb = v.encode()
-        out.append(struct.pack(">I", len(kb)) + kb)
-        out.append(struct.pack(">I", len(vb)) + vb)
-    raw = b"".join(out)
-    return deflater.compress(raw) + deflater.flush(zlib.Z_SYNC_FLUSH)
-
-
-def _decode_headers(block: bytes, inflater) -> Dict[str, str]:
-    raw = inflater.decompress(block)
-    n = struct.unpack_from(">I", raw, 0)[0]
-    i = 4
-    out: Dict[str, str] = {}
-    for _ in range(n):
-        klen = struct.unpack_from(">I", raw, i)[0]
-        i += 4
-        k = raw[i : i + klen].decode("latin-1")
-        i += klen
-        vlen = struct.unpack_from(">I", raw, i)[0]
-        i += 4
-        v = raw[i : i + vlen].decode("latin-1")
-        i += vlen
-        out[k.lower()] = v
-    return out
-
-
-class SpdyStream:
-    """One SPDY stream: an inbound byte queue plus framed writes."""
-
-    def __init__(self, session: "SpdySession", stream_id: int, headers: Dict[str, str]):
-        self.session = session
-        self.stream_id = stream_id
-        self.headers = headers
-        self._chunks: List[Optional[bytes]] = []
-        self._cv = threading.Condition()
-        self._closed_remote = False
-        self._closed_local = False
-        self._send_window = INITIAL_WINDOW
-
-    @property
-    def stream_type(self) -> str:
-        return self.headers.get("streamtype", "")
-
-    # called by the session reader
-    def _feed(self, data: bytes, fin: bool) -> None:
-        with self._cv:
-            if data:
-                self._chunks.append(data)
-            if fin:
-                self._closed_remote = True
-            self._cv.notify_all()
-
-    def _credit(self, delta: int) -> None:
-        with self._cv:
-            self._send_window += delta
-            self._cv.notify_all()
-
-    def read(self, timeout: Optional[float] = None) -> Optional[bytes]:
-        """Next inbound chunk; None at remote FIN / session end."""
-        with self._cv:
-            while not self._chunks:
-                if self._closed_remote or self.session.closed:
-                    return None
-                if not self._cv.wait(timeout):
-                    raise TimeoutError("spdy stream read timeout")
-            return self._chunks.pop(0)
-
-    def write(self, data: bytes) -> bool:
-        # respect the peer's flow-control window (64 KiB initial; the
-        # peer credits us back with WINDOW_UPDATE as it consumes)
-        view = memoryview(data)
-        while view:
-            with self._cv:
-                while self._send_window <= 0:
-                    if self._closed_local or self.session.closed:
-                        return False
-                    self._cv.wait(1.0)
-                n = min(len(view), self._send_window, 1 << 20)
-                self._send_window -= n
-            if not self.session._send_data(self.stream_id, bytes(view[:n]), 0):
-                return False
-            view = view[n:]
-        return True
-
-    def close(self) -> None:
-        """Half-close our side (FIN)."""
-        if not self._closed_local:
-            self._closed_local = True
-            self.session._send_data(self.stream_id, b"", FLAG_FIN)
-        self.session._maybe_reap(self)
-
-
-class SpdySession:
-    """One side of an SPDY/3.1 connection (server by default; pass
-    ``client=True`` for odd client stream ids + open_stream)."""
-
-    def __init__(self, sock, client: bool = False):
-        self.sock = sock
-        self.closed = False
-        self._next_id = 1 if client else 2
-        self._wlock = threading.Lock()
-        self._deflate = zlib.compressobj(6, zlib.DEFLATED, 15, 8,
-                                         zlib.Z_DEFAULT_STRATEGY, SPDY_DICT)
-        self._inflate = zlib.decompressobj(zdict=SPDY_DICT)
-        self.streams: Dict[int, SpdyStream] = {}
-        self._accept_q: List[SpdyStream] = []
-        self._cv = threading.Condition()
-        self._recv_window = INITIAL_WINDOW
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
-
-    # ------------------------------------------------------------- send side
-
-    def _send(self, frame: bytes) -> bool:
-        with self._wlock:
-            return self._send_locked(frame)
-
-    def _send_locked(self, frame: bytes) -> bool:
-        """Write with ``_wlock`` already held.  Header-bearing frames
-        MUST compress and send under one continuous hold: the deflate
-        stream is stateful, so the order blocks pass through
-        ``self._deflate`` must equal the order they hit the wire, or a
-        concurrent ``open_stream``/``syn_reply`` desyncs the peer's
-        shared inflater (ADVICE r5 #2)."""
-        try:
-            self.sock.sendall(frame)
-            return True
-        except OSError:
-            self._mark_closed()
-            return False
-
-    def _control(self, ftype: int, flags: int, payload: bytes) -> bytes:
-        head = struct.pack(
-            ">HHBBH",
-            0x8000 | SPDY_VERSION,
-            ftype,
-            flags,
-            (len(payload) >> 16) & 0xFF,
-            len(payload) & 0xFFFF,
-        )
-        return head + payload
-
-    def _send_data(self, stream_id: int, data: bytes, flags: int) -> bool:
-        head = struct.pack(
-            ">IBBH",
-            stream_id & 0x7FFFFFFF,
-            flags,
-            (len(data) >> 16) & 0xFF,
-            len(data) & 0xFFFF,
-        )
-        return self._send(head + data)
-
-    def syn_reply(self, stream_id: int, headers: Dict[str, str]) -> bool:
-        with self._wlock:
-            block = _encode_headers(headers, self._deflate)
-            payload = struct.pack(">I", stream_id & 0x7FFFFFFF) + block
-            return self._send_locked(self._control(SYN_REPLY, 0, payload))
-
-    def rst_stream(self, stream_id: int, status: int = 1) -> bool:
-        payload = struct.pack(">II", stream_id & 0x7FFFFFFF, status)
-        return self._send(self._control(RST_STREAM, 0, payload))
-
-    def _window_update(self, stream_id: int, delta: int) -> None:
-        payload = struct.pack(">II", stream_id & 0x7FFFFFFF, delta)
-        self._send(self._control(WINDOW_UPDATE, 0, payload))
-
-    def goaway(self) -> None:
-        self._send(self._control(GOAWAY, 0, struct.pack(">II", 0, 0)))
-
-    def open_stream(
-        self, headers: Dict[str, str], fin: bool = False
-    ) -> SpdyStream:
-        """Initiate a stream (SYN_STREAM) — the client side of the
-        kubelet streaming protocols (one stream per channel)."""
-        with self._cv:
-            sid = self._next_id
-            self._next_id += 2
-        stream = SpdyStream(self, sid, {k.lower(): v for k, v in headers.items()})
-        self.streams[sid] = stream
-        with self._wlock:
-            block = _encode_headers(headers, self._deflate)
-            payload = (
-                struct.pack(">II", sid & 0x7FFFFFFF, 0) + b"\x00\x00" + block
-            )
-            self._send_locked(
-                self._control(SYN_STREAM, FLAG_FIN if fin else 0, payload)
-            )
-        return stream
-
-    # ------------------------------------------------------------- recv side
-
-    def _read_exact(self, n: int) -> Optional[bytes]:
-        buf = b""
-        while len(buf) < n:
-            try:
-                chunk = self.sock.recv(n - len(buf))
-            except OSError:
-                return None
-            if not chunk:
-                return None
-            buf += chunk
-        return buf
-
-    def _read_loop(self) -> None:
-        while not self.closed:
-            head = self._read_exact(8)
-            if head is None:
-                break
-            first, = struct.unpack_from(">I", head, 0)
-            flags = head[4]
-            length = (head[5] << 16) | (head[6] << 8) | head[7]
-            payload = self._read_exact(length) if length else b""
-            if payload is None:
-                break
-            if first & 0x80000000:  # control frame
-                ftype = first & 0xFFFF
-                self._on_control(ftype, flags, payload)
-            else:
-                self._on_data(first & 0x7FFFFFFF, flags, payload)
-        self._mark_closed()
-
-    def _on_control(self, ftype: int, flags: int, payload: bytes) -> None:
-        if ftype == SYN_STREAM:
-            stream_id = struct.unpack_from(">I", payload, 0)[0] & 0x7FFFFFFF
-            headers = _decode_headers(payload[10:], self._inflate)
-            stream = SpdyStream(self, stream_id, headers)
-            with self._cv:
-                self.streams[stream_id] = stream
-                self._accept_q.append(stream)
-                self._cv.notify_all()
-            self.syn_reply(stream_id, {})
-            if flags & FLAG_FIN:
-                stream._feed(b"", fin=True)
-        elif ftype == PING:
-            # echo every ping (the spec echoes only peer-initiated ids;
-            # a server never pings here, so everything is peer-initiated)
-            self._send(self._control(PING, 0, payload))
-        elif ftype == WINDOW_UPDATE:
-            stream_id, delta = struct.unpack_from(">II", payload, 0)
-            stream_id &= 0x7FFFFFFF
-            delta &= 0x7FFFFFFF
-            if stream_id:
-                st = self.streams.get(stream_id)
-                if st is not None:
-                    st._credit(delta)
-        elif ftype == SYN_REPLY:
-            pass  # our SYN_STREAM acknowledged; headers carry nothing we use
-        elif ftype == RST_STREAM:
-            stream_id = struct.unpack_from(">I", payload, 0)[0] & 0x7FFFFFFF
-            st = self.streams.pop(stream_id, None)
-            if st is not None:
-                st._feed(b"", fin=True)
-        elif ftype == GOAWAY:
-            self._mark_closed()
-        # SETTINGS / HEADERS: accepted and ignored (no server behavior
-        # depends on them for the kubelet streaming protocols)
-
-    def _maybe_reap(self, st: SpdyStream) -> None:
-        """Forget a stream once both sides closed — a port-forward
-        session held open for hours must not accumulate per-connection
-        stream objects."""
-        if st._closed_local and st._closed_remote:
-            self.streams.pop(st.stream_id, None)
-
-    def _on_data(self, stream_id: int, flags: int, data: bytes) -> None:
-        st = self.streams.get(stream_id)
-        if st is None:
-            self.rst_stream(stream_id, 2)  # INVALID_STREAM
-            return
-        st._feed(data, fin=bool(flags & FLAG_FIN))
-        if flags & FLAG_FIN:
-            self._maybe_reap(st)
-        if data:
-            # credit the peer back immediately: stream + session windows
-            # (SPDY/3.1 session-level flow control rides stream id 0)
-            self._window_update(stream_id, len(data))
-            self._window_update(0, len(data))
-
-    # -------------------------------------------------------------- accept
-
-    def accept_stream(self, timeout: Optional[float] = None) -> Optional[SpdyStream]:
-        """Next client-opened stream (None on session close/timeout)."""
-        with self._cv:
-            while not self._accept_q:
-                if self.closed:
-                    return None
-                if not self._cv.wait(timeout):
-                    return None
-            return self._accept_q.pop(0)
-
-    def _mark_closed(self) -> None:
-        if self.closed:
-            return
-        self.closed = True
-        with self._cv:
-            self._cv.notify_all()
-        for st in list(self.streams.values()):
-            st._feed(b"", fin=True)
-
-    def close(self) -> None:
-        if not self.closed:
-            self.goaway()
-        self._mark_closed()
-        try:
-            self.sock.close()
-        except OSError:
-            pass
 
 
 def accept_upgrade(handler, protocols) -> Optional[Tuple[SpdySession, str]]:

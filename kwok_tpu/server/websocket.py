@@ -1,8 +1,9 @@
 """Minimal RFC 6455 WebSocket server + the Kubernetes channel protocols.
 
 The reference kubelet surface streams exec/attach/port-forward over
-SPDY or WebSocket upgrades (reference pkg/kwok/server/debugging.go:
-36-102 wires k8s.io/apiserver's upgrade-aware handlers); kubectl ≥1.29
+SPDY or WebSocket upgrades (reference debugging.go:36-102 under
+pkg/kwok/server/ wires k8s.io/apiserver's upgrade-aware handlers);
+kubectl ≥1.29
 defaults to WebSocket.  This module implements the wire format those
 clients speak, on top of the stdlib HTTP handler's raw socket:
 
@@ -23,12 +24,31 @@ clients speak, on top of the stdlib HTTP handler's raw socket:
 
 from __future__ import annotations
 
-import base64
-import hashlib
 import json
 import struct
 import threading
 from typing import List, Optional, Tuple
+
+# protocol vocabulary is shared with the client half
+# (kwok_tpu/utils/wsclient.py) via utils.wsproto — one source of
+# truth, and the client stays below the server in the layer map
+from kwok_tpu.utils.wsproto import (  # noqa: F401
+    _GUID,
+    _accept_key,
+    CHAN_ERROR,
+    CHAN_RESIZE,
+    CHAN_STDERR,
+    CHAN_STDIN,
+    CHAN_STDOUT,
+    OP_BINARY,
+    OP_CLOSE,
+    OP_CONT,
+    OP_PING,
+    OP_PONG,
+    OP_TEXT,
+    PORT_FORWARD_PROTOCOLS,
+    REMOTE_COMMAND_PROTOCOLS,
+)
 
 __all__ = [
     "REMOTE_COMMAND_PROTOCOLS",
@@ -45,35 +65,10 @@ __all__ = [
     "status_failure",
 ]
 
-_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
-
-#: newest first — the server picks the first supported protocol the
-#: client offered, like k8s.io/apiserver's negotiation
-REMOTE_COMMAND_PROTOCOLS = ["v5.channel.k8s.io", "v4.channel.k8s.io"]
-PORT_FORWARD_PROTOCOLS = ["v2.portforward.k8s.io", "portforward.k8s.io"]
-
-CHAN_STDIN = 0
-CHAN_STDOUT = 1
-CHAN_STDERR = 2
-CHAN_ERROR = 3
-CHAN_RESIZE = 4
-
-OP_CONT = 0x0
-OP_TEXT = 0x1
-OP_BINARY = 0x2
-OP_CLOSE = 0x8
-OP_PING = 0x9
-OP_PONG = 0xA
-
 
 def is_upgrade(headers) -> bool:
     conn = (headers.get("Connection") or "").lower()
     return "upgrade" in conn and (headers.get("Upgrade") or "").lower() == "websocket"
-
-
-def _accept_key(key: str) -> str:
-    digest = hashlib.sha1((key + _GUID).encode()).digest()
-    return base64.b64encode(digest).decode()
 
 
 def negotiate_protocol(headers, supported: List[str]) -> Optional[str]:
@@ -144,8 +139,13 @@ class WebSocket:
             if self.closed:
                 return False
             try:
-                self.wfile.write(head + payload)
-                self.wfile.flush()
+                # sanctioned blocking-under-lock: _send_mut IS the wire
+                # serializer — stdout/stderr pumps and the recv thread's
+                # PONGs write concurrently, and a frame interleaved with
+                # another frame's bytes desyncs the peer (same contract
+                # as spdyproto's _wlock around compress+send)
+                self.wfile.write(head + payload)  # kwoklint: disable=lock-discipline
+                self.wfile.flush()  # kwoklint: disable=lock-discipline
                 return True
             except (BrokenPipeError, ConnectionError, OSError):
                 self.closed = True

@@ -129,6 +129,13 @@ def parse_rfc3339(s: str) -> Optional[datetime.datetime]:
     try:
         if s.endswith("Z"):
             s = s[:-1] + "+00:00"
+        # RFC 3339 allows any number of fractional digits, but
+        # fromisoformat before Python 3.11 accepts exactly 3 or 6 —
+        # normalize ("00:00:00.5" -> "00:00:00.500000")
+        m = re.match(r"^(.*T\d{2}:\d{2}:\d{2})\.(\d+)(.*)$", s)
+        if m:
+            frac = (m.group(2) + "000000")[:6]
+            s = f"{m.group(1)}.{frac}{m.group(3)}"
         t = datetime.datetime.fromisoformat(s)
         if t.tzinfo is None:
             t = t.replace(tzinfo=datetime.timezone.utc)

@@ -1,7 +1,8 @@
 """Structured logging (the pkg/log equivalent).
 
 The reference wraps slog with levels, key=value attributes, context
-carrying, and ``KObj`` object references (reference pkg/log/logger.go).
+carrying, and ``KObj`` object references (reference pkg/log/logger.go;
+SURVEY.md:356 records the role).
 This is the same shape on stdlib logging: one process-wide root with
 ``key=value`` formatting, ``with_values`` child loggers, a ``kobj``
 helper rendering ``ns/name`` refs, and a ``-v`` flag mapping

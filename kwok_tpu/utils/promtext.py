@@ -1,7 +1,8 @@
 """Prometheus text-format parsing, shared by every scrape consumer
 (`kwokctl kubectl top` and the metrics.k8s.io facade both read the
-kubelet's resource-metrics endpoint; one parser keeps them from
-drifting).  Handles quoted label values containing commas and escaped
+kubelet's resource-metrics endpoint, whose values the reference
+computes in pkg/kwok/server/metrics_resource_usage.go:36-264; one
+parser keeps them from drifting).  Handles quoted label values containing commas and escaped
 quotes, which naive ``split(",")`` parsers mis-split."""
 
 from __future__ import annotations

@@ -16,7 +16,7 @@ import socket
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlsplit
 
-from kwok_tpu.server.spdy import SpdySession
+from kwok_tpu.utils.spdyproto import SpdySession
 
 
 class SpdyUpgradeError(ConnectionError):

@@ -6,7 +6,7 @@ frames plus the k8s conventions — remote-command channels
 trailer) and per-port port-forward channels
 (``portforward.k8s.io``).  Used by ``kwokctl kubectl
 exec/attach/port-forward`` (the kubectl seat; reference e2e exercises
-the same flows, test/e2e/cases.go) and by the protocol tests.
+the same flows, test/e2e/cases.go:7-50) and by the protocol tests.
 """
 
 from __future__ import annotations
@@ -19,10 +19,10 @@ import socket
 import struct
 from typing import List, Optional, Tuple
 
-# one source of truth for the protocol vocabulary — the server half
-# (kwok_tpu/server/websocket.py) defines it; drifting copies would
-# break negotiation silently
-from kwok_tpu.server.websocket import (
+# one source of truth for the protocol vocabulary — utils.wsproto
+# defines it for both halves (the server imports the same module);
+# drifting copies would break negotiation silently
+from kwok_tpu.utils.wsproto import (
     CHAN_ERROR,
     CHAN_STDERR,
     CHAN_STDIN,

@@ -1,0 +1,50 @@
+"""Kubernetes WebSocket streaming vocabulary, shared by both halves.
+
+One source of truth for the channel bytes, sub-protocol names and
+handshake key derivation of the kubelet streaming protocols — the
+server (``kwok_tpu.server.websocket``) and the client
+(``kwok_tpu.utils.wsclient``) both import from here, so the
+vocabulary cannot drift between them and the client stays below the
+server in the layer map.  The conventions mirror what
+k8s.io/apiserver's upgrade-aware handlers negotiate (reference
+pkg/kwok/server/debugging.go:36-102):
+
+- remote command (``v4.channel.k8s.io``/``v5.channel.k8s.io``):
+  binary frames whose first byte selects the stream — 0 stdin,
+  1 stdout, 2 stderr, 3 an error/status JSON trailer, 4 terminal
+  resize;
+- port forward (``portforward.k8s.io``/``v2.portforward.k8s.io``):
+  two channels per requested port (2i data, 2i+1 error), each
+  opening with a little-endian uint16 port frame.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+
+#: RFC 6455 §1.3 magic GUID for Sec-WebSocket-Accept
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+#: newest first — the server picks the first supported protocol the
+#: client offered, like k8s.io/apiserver's negotiation
+REMOTE_COMMAND_PROTOCOLS = ["v5.channel.k8s.io", "v4.channel.k8s.io"]
+PORT_FORWARD_PROTOCOLS = ["v2.portforward.k8s.io", "portforward.k8s.io"]
+
+CHAN_STDIN = 0
+CHAN_STDOUT = 1
+CHAN_STDERR = 2
+CHAN_ERROR = 3
+CHAN_RESIZE = 4
+
+OP_CONT = 0x0
+OP_TEXT = 0x1
+OP_BINARY = 0x2
+OP_CLOSE = 0x8
+OP_PING = 0x9
+OP_PONG = 0xA
+
+
+def _accept_key(key: str) -> str:
+    digest = hashlib.sha1((key + _GUID).encode()).digest()
+    return base64.b64encode(digest).decode()

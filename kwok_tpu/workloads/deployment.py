@@ -1,6 +1,7 @@
 """Deployment reconciliation: ReplicaSet revisions + rolling updates
 (the kube-controller-manager deployment loop; upstream
-pkg/controller/deployment — behavioral reference only).
+pkg/controller/deployment — behavioral reference only; the parity row
+is PARITY.md:122).
 
 Revision model: each distinct ``spec.template`` hashes to a
 ``pod-template-hash`` (common.pod_template_hash); the Deployment owns

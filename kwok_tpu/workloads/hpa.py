@@ -1,10 +1,13 @@
 """HorizontalPodAutoscaler (autoscaling/v2) reconciliation, driven by
 the simulated-usage engine.
 
-The real HPA loop asks the metrics API, which the metrics-server fills
-from kubelet scrapes; in this simulator the source of truth behind all
-of that is the ResourceUsage/ClusterResourceUsage CRs evaluated by
-``metrics/usage.py``.  This controller cuts the middleman and reads
+The real HPA loop (upstream pkg/controller/podautoscaler — behavioral
+reference only; parity row PARITY.md:122) asks the metrics API, which
+the metrics-server fills from kubelet scrapes; in this simulator the
+source of truth behind all of that is the
+ResourceUsage/ClusterResourceUsage CRs evaluated by
+``metrics/usage.py`` (reference computation:
+metrics_resource_usage.go:36-264).  This controller cuts the middleman and reads
 the same engine directly: per reconcile it loads the usage CRs from
 the store, builds a :class:`UsageEvaluator` over store getters, and
 vector-evaluates the target's pods (``bulk_pod_usage`` — the lowered
@@ -81,10 +84,11 @@ class HPAController:
         self._now = now or time.time
         #: (ns, name) -> [(t, recommendation)] inside the window
         self._recommendations: Dict[Tuple[str, str], List[Tuple[float, int]]] = {}
-        #: (usage rv, cluster-usage rv) -> evaluator; HPAs re-reconcile
-        #: every resync tick, so without this each tick re-lists and
-        #: re-compiles every usage CR (2 round-trips per HPA over the
-        #: REST client even when nothing changed)
+        #: usage-CR identity+version -> evaluator.  The two list calls
+        #: still happen every reconcile (they feed the cache key); what
+        #: this skips is re-parsing the CRs and re-lowering their CEL
+        #: column programs when nothing changed — the expensive half of
+        #: each resync tick
         self._ev_cache: Optional[Tuple[Tuple[Any, Any], Any]] = None
 
     # ------------------------------------------------------------- usage
@@ -195,6 +199,10 @@ class HPAController:
         desired = self._stabilize(
             (namespace, name), spec, current, desired
         )
+        # re-clamp after stabilization: the window can resurrect a
+        # recommendation recorded before min/maxReplicas changed, and
+        # upstream normalizes to the live bounds last
+        desired = max(min_r, min(max_r, desired))
 
         if desired != current:
             try:

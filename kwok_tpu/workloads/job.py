@@ -1,6 +1,6 @@
 """Job reconciliation: completions / parallelism / backoffLimit (the
 kube-controller-manager job loop; upstream pkg/controller/job —
-behavioral reference only).
+behavioral reference only; the parity row is PARITY.md:122).
 
 The pod-state model is the same one the stage FSM drives: a job pod
 that reaches ``status.phase: Succeeded`` counts toward completions, a

@@ -1,5 +1,7 @@
 """WorkloadManager: the controller-manager loop hosting the workload
-controllers (ReplicaSet / Deployment / Job / HorizontalPodAutoscaler).
+controllers (ReplicaSet / Deployment / Job / HorizontalPodAutoscaler;
+the parity row is PARITY.md:122 — the reference runs the real kcm
+binary instead, SURVEY.md:152).
 
 Shape mirrors the other controller seats in this tree (gc_controller,
 scheduler): informers feed one event queue; a mapper turns events into

@@ -1,5 +1,6 @@
 """ReplicaSet reconciliation (the kube-controller-manager replicaset
-loop; upstream pkg/controller/replicaset — behavioral reference only).
+loop; upstream pkg/controller/replicaset — behavioral reference only;
+the parity row is PARITY.md:122).
 
 One reconcile pass:
 

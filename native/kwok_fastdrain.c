@@ -26,6 +26,18 @@
 #include <stddef.h>
 #include <stdlib.h>
 
+/* Py_T_* member-def names are 3.12+; map to the structmember.h
+ * spellings on older CPythons so the extension builds on 3.10/3.11. */
+#if PY_VERSION_HEX < 0x030c0000
+#include <structmember.h>
+#ifndef Py_T_OBJECT_EX
+#define Py_T_OBJECT_EX T_OBJECT_EX
+#endif
+#ifndef Py_T_LONGLONG
+#define Py_T_LONGLONG T_LONGLONG
+#endif
+#endif
+
 static PyObject *s_metadata, *s_namespace, *s_name, *s_resourceVersion,
     *s_status, *s_MODIFIED, *s_DELETED, *s_default, *s_empty, *s_type,
     *s_object, *s_spec, *s_labels, *s_annotations, *s_ownerReferences,

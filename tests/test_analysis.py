@@ -1,0 +1,707 @@
+"""kwoklint: the repo gate plus per-analyzer unit tests.
+
+``test_repo_is_clean`` is the tier-1 wiring: the whole suite runs over
+the real tree and must report zero unsuppressed findings — the same
+contract ``python -m kwok_tpu.analysis`` enforces at the CLI.  The
+rest unit-tests each rule against synthetic positive/negative snippets
+in a throwaway repo layout, plus the framework pieces (suppression,
+baseline, cache, CLI).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from kwok_tpu.analysis import Finding
+from kwok_tpu.analysis.driver import (
+    Config,
+    load_baseline,
+    repo_root,
+    run,
+    save_baseline,
+    subtract_baseline,
+)
+
+REPO = repo_root()
+
+
+def write_repo(tmp_path, files):
+    """Materialize {relpath: source} under tmp_path; returns root str.
+
+    Every intermediate kwok_tpu package directory gets an __init__.py
+    so module/package resolution behaves like the real tree.
+    """
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        d = p.parent
+        while d != tmp_path:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+            d = d.parent
+    return str(tmp_path)
+
+
+def run_rules(root, rules, reference_root="/nonexistent-reference"):
+    return run(Config(root=root, reference_root=reference_root, rules=rules))
+
+
+# ------------------------------------------------------------------ the gate
+
+
+def test_repo_is_clean():
+    """Tier-1 gate: the full suite over the real repo is finding-free."""
+    findings = run(Config(root=REPO))
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+# ----------------------------------------------------------------- layering
+
+
+def test_layering_flags_upward_import(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/low.py": "import kwok_tpu.server.high\n",
+            "kwok_tpu/server/high.py": "X = 1\n",
+        },
+    )
+    fs = run_rules(root, ["layering"])
+    assert len(fs) == 1 and "upward import" in fs[0].message
+    assert fs[0].path == "kwok_tpu/utils/low.py"
+
+
+def test_layering_allows_downward_and_same_layer(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/server/high.py": "from kwok_tpu.utils import low\n",
+            "kwok_tpu/utils/low.py": "from kwok_tpu.utils import other\n",
+            "kwok_tpu/utils/other.py": "X = 1\n",
+        },
+    )
+    assert run_rules(root, ["layering"]) == []
+
+
+def test_layering_exempts_guarded_function_scope_import(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/low.py": """
+            def accel():
+                try:
+                    from kwok_tpu.native.fast import thing
+                except Exception:
+                    return None
+                return thing
+            """,
+            "kwok_tpu/native/fast.py": "thing = 1\n",
+        },
+    )
+    assert run_rules(root, ["layering"]) == []
+
+
+def test_layering_wrong_guard_is_not_an_exemption(tmp_path):
+    """An upward import in an except-handler body, or guarded only by a
+    non-ImportError handler, still propagates when the target is absent
+    — no exemption."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/handler_body.py": """
+            def f():
+                try:
+                    work()
+                except ValueError:
+                    from kwok_tpu.server.high import X
+                    return X
+            """,
+            "kwok_tpu/utils/wrong_type.py": """
+            def f():
+                try:
+                    from kwok_tpu.server.high import X
+                except ValueError:
+                    return None
+                return X
+            """,
+            "kwok_tpu/server/high.py": "X = 1\n",
+        },
+    )
+    fs = run_rules(root, ["layering"])
+    assert sorted(f.path for f in fs) == [
+        "kwok_tpu/utils/handler_body.py",
+        "kwok_tpu/utils/wrong_type.py",
+    ]
+
+
+def test_layering_unguarded_function_scope_upward_still_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/low.py": """
+            def f():
+                from kwok_tpu.server.high import X
+                return X
+            """,
+            "kwok_tpu/server/high.py": "X = 1\n",
+        },
+    )
+    fs = run_rules(root, ["layering"])
+    assert len(fs) == 1 and "upward import" in fs[0].message
+
+
+def test_layering_detects_cycle(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": "from kwok_tpu.utils import b\n",
+            "kwok_tpu/utils/b.py": "from kwok_tpu.utils import a\n",
+        },
+    )
+    fs = run_rules(root, ["layering"])
+    assert len(fs) == 1 and "import cycle" in fs[0].message
+
+
+def test_layering_submodule_import_is_not_a_package_cycle(tmp_path):
+    # `from kwok_tpu.pkgx import sub` in a sibling + pkgx/__init__
+    # re-exporting from sub is normal Python, not a cycle
+    tmp = tmp_path
+    (tmp / "kwok_tpu" / "utils").mkdir(parents=True)
+    (tmp / "kwok_tpu" / "__init__.py").write_text("")
+    (tmp / "kwok_tpu" / "utils" / "__init__.py").write_text(
+        "from kwok_tpu.utils.sub import X\n"
+    )
+    (tmp / "kwok_tpu" / "utils" / "sub.py").write_text("X = 1\n")
+    (tmp / "kwok_tpu" / "utils" / "other.py").write_text(
+        "from kwok_tpu.utils import sub\n"
+    )
+    assert run_rules(str(tmp), ["layering"]) == []
+
+
+def test_layering_unknown_subpackage_flagged(tmp_path):
+    root = write_repo(tmp_path, {"kwok_tpu/mystery/x.py": "X = 1\n"})
+    fs = run_rules(root, ["layering"])
+    assert any("not in the layer map" in f.message for f in fs)
+
+
+# ----------------------------------------------------------- store-boundary
+
+
+def test_store_boundary_flags_private_access(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def f(store):
+                return store._types
+            """,
+        },
+    )
+    fs = run_rules(root, ["store-boundary"])
+    assert len(fs) == 1 and "store._types" in fs[0].message
+
+
+def test_store_boundary_allows_cluster_and_public_surface(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            # inside cluster/: owns the internals
+            "kwok_tpu/cluster/s.py": "def f(store):\n    return store._mut\n",
+            # outside: public surface + hasattr probe + own private attr
+            "kwok_tpu/controllers/c.py": """
+            class C:
+                def __init__(self, store):
+                    self._store = store
+                def f(self):
+                    if hasattr(self._store, "status_lane"):
+                        return self._store.list("Pod")
+                    return self._store.bulk([])
+            """,
+        },
+    )
+    assert run_rules(root, ["store-boundary"]) == []
+
+
+def test_store_boundary_client_receiver_also_guarded(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/workloads/w.py": "def f(client):\n    return client._conn()\n",
+        },
+    )
+    fs = run_rules(root, ["store-boundary"])
+    assert len(fs) == 1 and "client._conn" in fs[0].message
+
+
+# ---------------------------------------------------------- lock-discipline
+
+
+def test_lock_raw_acquire_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            def f(lock):
+                lock.acquire()
+                do_work()
+                lock.release()
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1 and "raw lock.acquire()" in fs[0].message
+
+
+def test_lock_acquire_with_try_finally_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            def f(lock):
+                lock.acquire()
+                try:
+                    do_work()
+                finally:
+                    lock.release()
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-discipline"]) == []
+
+
+def test_lock_blocking_sleep_under_lock_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            import time
+            def f(self):
+                with self._lock:
+                    time.sleep(1)
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+
+
+def test_lock_transitive_helper_under_lock_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            class S:
+                def _send_raw(self, frame):
+                    self.sock.sendall(frame)
+                def send(self, frame):
+                    with self._wlock:
+                        return self._send_raw(frame)
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1 and "_send_raw" in fs[0].message
+
+
+def test_lock_socket_file_write_under_lock_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            def send(self, frame):
+                with self._send_mut:
+                    self.wfile.write(frame)
+            def log(self, line):
+                with self._mut:
+                    self.buffer.write(line)  # not a socket: clean
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1 and "wfile.write" in fs[0].message
+
+
+def test_lock_cv_wait_and_plain_calls_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            def f(self):
+                with self._cv:
+                    while not self._q:
+                        self._cv.wait(0.5)
+                    return self._q.pop(0)
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-discipline"]) == []
+
+
+def test_lock_subprocess_under_lock_fires_and_suppression_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": """
+            import subprocess
+            def f(self):
+                with self._mut:
+                    subprocess.run(["true"])
+            """,
+            "kwok_tpu/utils/b.py": """
+            import subprocess
+            def f(self):
+                with self._mut:
+                    subprocess.run(["true"])  # kwoklint: disable=lock-discipline
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert [f.path for f in fs] == ["kwok_tpu/utils/a.py"]
+
+
+# ------------------------------------------------------------ tracer-safety
+
+
+def _kernel_file(body):
+    return (
+        "import functools\nimport time\nimport numpy as np\n"
+        "import jax\nimport jax.numpy as jnp\n\n" + textwrap.dedent(body)
+    )
+
+
+def test_tracer_host_sync_and_time_fire(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ops/tick.py": _kernel_file(
+                """
+                def _tick_impl(params, soa):
+                    n = soa.now.item()
+                    t = time.time()
+                    arr = np.asarray(soa.features)
+                    return n, t, arr
+
+                tick = jax.jit(_tick_impl)
+                """
+            ),
+        },
+    )
+    fs = run_rules(root, ["tracer-safety"])
+    msgs = "\n".join(f.message for f in fs)
+    assert ".item()" in msgs and "time.time" in msgs and "np.asarray" in msgs
+    assert len(fs) == 3
+
+
+def test_tracer_python_branch_on_traced_arg_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ops/tick.py": _kernel_file(
+                """
+                def _tick_impl(params, soa):
+                    if soa:
+                        return params
+                    return params
+
+                tick = jax.jit(_tick_impl)
+                """
+            ),
+        },
+    )
+    fs = run_rules(root, ["tracer-safety"])
+    assert len(fs) == 1 and "traced argument 'soa'" in fs[0].message
+
+
+def test_tracer_static_argnames_branch_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ops/tick.py": _kernel_file(
+                """
+                def _tick_impl(params, soa, dt_ms):
+                    if dt_ms:
+                        return soa
+                    return soa
+
+                tick = functools.partial(
+                    jax.jit, static_argnames=("dt_ms",)
+                )(_tick_impl)
+                """
+            ),
+        },
+    )
+    assert run_rules(root, ["tracer-safety"]) == []
+
+
+def test_tracer_host_code_outside_kernels_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ops/tick.py": _kernel_file(
+                """
+                def _tick_impl(params, soa):
+                    return jnp.where(soa.active, 1, 0)
+
+                tick = jax.jit(_tick_impl)
+
+                def host_drain(soa):
+                    # host side: np + time are fine here
+                    return np.asarray(soa), time.time()
+                """
+            ),
+        },
+    )
+    assert run_rules(root, ["tracer-safety"]) == []
+
+
+def test_tracer_jax_random_is_not_stdlib_random(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ops/tick.py": _kernel_file(
+                """
+                import random
+
+                def _tick_impl(params, soa):
+                    k1, k2 = jax.random.split(soa.key)
+                    bad = random.random()
+                    return k1, k2, bad
+
+                tick = jax.jit(_tick_impl)
+                """
+            ),
+        },
+    )
+    fs = run_rules(root, ["tracer-safety"])
+    assert len(fs) == 1 and "random.random" in fs[0].message
+
+
+# --------------------------------------------------------- parity-citations
+
+
+def _cited_module(cite):
+    return f'"""Module mirroring the reference ({cite})."""\nX = 1\n'
+
+
+def test_citation_missing_fires_and_init_exempt(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/nocite.py": '"""No citation here."""\nX = 1\n',
+        },
+    )
+    fs = run_rules(root, ["parity-citations"])
+    # only the module fires; the generated __init__.py files do not
+    assert [f.path for f in fs] == ["kwok_tpu/utils/nocite.py"]
+    assert "no file:line citation" in fs[0].message
+
+
+def test_citation_repo_local_resolves_and_line_range_checked(tmp_path):
+    files = {
+        "kwok_tpu/utils/good.py": _cited_module("DESIGN.md:2"),
+        "kwok_tpu/utils/bad.py": _cited_module("DESIGN.md:999"),
+    }
+    root = write_repo(tmp_path, files)
+    (tmp_path / "DESIGN.md").write_text("line1\nline2\nline3\n")
+    fs = run_rules(root, ["parity-citations"])
+    assert [f.path for f in fs] == ["kwok_tpu/utils/bad.py"]
+    assert "has 4 lines" in fs[0].message or "has 3 lines" in fs[0].message
+
+
+def test_citation_reference_tree_resolution(tmp_path):
+    ref = tmp_path / "ref"
+    (ref / "pkg" / "kwok").mkdir(parents=True)
+    (ref / "pkg" / "kwok" / "main.go").write_text("package main\n" * 50)
+    root = write_repo(
+        tmp_path / "repo",
+        {
+            "kwok_tpu/utils/a.py": _cited_module("pkg/kwok/main.go:10"),
+            "kwok_tpu/utils/b.py": _cited_module("main.go:49"),
+            "kwok_tpu/utils/c.py": _cited_module("pkg/kwok/main.go:400"),
+            "kwok_tpu/utils/d.py": _cited_module("pkg/kwok/gone.go:10"),
+        },
+    )
+    fs = run_rules(root, ["parity-citations"], reference_root=str(ref))
+    assert sorted(f.path for f in fs) == [
+        "kwok_tpu/utils/c.py",
+        "kwok_tpu/utils/d.py",
+    ]
+
+
+def test_citation_reference_absent_is_unverifiable_not_stale(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {"kwok_tpu/utils/a.py": _cited_module("pkg/kwok/main.go:10")},
+    )
+    assert run_rules(root, ["parity-citations"]) == []
+
+
+def test_citation_stale_self_reference_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                '"""See kwok_tpu.utils.ghost for the facade (DESIGN.md:1)."""\nX = 1\n'
+            ),
+        },
+    )
+    (tmp_path / "DESIGN.md").write_text("doc\n")
+    fs = run_rules(root, ["parity-citations"])
+    assert len(fs) == 1 and "kwok_tpu.utils.ghost" in fs[0].message
+
+
+def test_citation_self_reference_to_module_and_attribute_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                '"""Uses kwok_tpu.utils.b and kwok_tpu.utils.b.Thing '
+                '(DESIGN.md:1)."""\nX = 1\n'
+            ),
+            "kwok_tpu/utils/b.py": (
+                '"""Thing lives here (DESIGN.md:1)."""\nclass Thing:\n    pass\n'
+            ),
+        },
+    )
+    (tmp_path / "DESIGN.md").write_text("doc\n")
+    assert run_rules(root, ["parity-citations"]) == []
+
+
+# ------------------------------------------------- suppression and baseline
+
+
+def test_file_wide_suppression(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                "# kwoklint: disable-file=store-boundary\n"
+                "def f(store):\n    return store._types\n"
+            ),
+        },
+    )
+    assert run_rules(root, ["store-boundary"]) == []
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                "def f(store):\n"
+                "    # kwoklint: disable=store-boundary\n"
+                "    return store._types\n"
+            ),
+        },
+    )
+    assert run_rules(root, ["store-boundary"]) == []
+
+
+def test_suppression_text_inside_string_is_inert(tmp_path):
+    """Documentation quoting the suppression syntax (in a docstring or
+    string literal) must not disable anything — only COMMENT tokens do."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                "def f(store):\n"
+                '    x = "# kwoklint: disable=store-boundary"\n'
+                "    return store._types, x\n"
+            ),
+            "kwok_tpu/utils/b.py": (
+                '"""Docs quote the syntax:\n'
+                "# kwoklint: disable-file=store-boundary\n"
+                '"""\n'
+                "def f(store):\n"
+                "    return store._types\n"
+            ),
+        },
+    )
+    fs = run_rules(root, ["store-boundary"])
+    assert sorted(f.path for f in fs) == [
+        "kwok_tpu/utils/a.py",
+        "kwok_tpu/utils/b.py",
+    ]
+
+
+def test_baseline_multiset_semantics(tmp_path):
+    f1 = Finding("r", "p.py", 3, "msg")
+    f2 = Finding("r", "p.py", 9, "msg")  # same identity, new instance
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, [f1])
+    baseline = load_baseline(path)
+    assert subtract_baseline([f1], baseline) == []
+    # two live findings, one baselined slot: the second still surfaces
+    left = subtract_baseline([f1, f2], baseline)
+    assert left == [f2]
+
+
+def test_cache_roundtrip_stable(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {"kwok_tpu/utils/a.py": "def f(store):\n    return store._x\n"},
+    )
+    cache = str(tmp_path / "cache.json")
+    cfg = Config(root=root, rules=["store-boundary"])
+    first = run(cfg, cache_path=cache)
+    assert os.path.exists(cache)
+    second = run(cfg, cache_path=cache)  # served from cache
+    assert first == second and len(first) == 1
+
+
+# ------------------------------------------------------------------ the CLI
+
+
+def test_cli_json_clean_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--format", "json"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["count"] == 0
+
+
+def test_cli_unknown_rule_exits_2():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--rules", "nonsense"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_cli_findings_exit_1_and_baseline_flow(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {"kwok_tpu/workloads/w.py": "def f(store):\n    return store._types\n"},
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+    args = [sys.executable, "-m", "kwok_tpu.analysis", "--root", root,
+            "--rules", "store-boundary"]
+    proc = subprocess.run(args, capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 1
+    assert "store._types" in proc.stdout
+
+    # write the baseline, then the same findings are absorbed
+    proc = subprocess.run(
+        args + ["--update-baseline"], capture_output=True, text=True, env=env,
+        timeout=120,
+    )
+    assert proc.returncode == 0
+    proc = subprocess.run(
+        args + ["--baseline"], capture_output=True, text=True, env=env, timeout=120
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,172 @@
+"""ctl/pki.py openssl-CLI fallback coverage.
+
+The fallback was added for environments without ``cryptography``
+(CHANGES.md:5) but until now only ran where the import failed — an
+environment WITH the package never exercised it.  These tests force
+the fallback (monkeypatching the module flag the import guard sets),
+assert the generated PKI actually works (chain verification, SANs,
+EKUs, key permissions, a real TLS handshake), and — where
+``cryptography`` is installed — assert cert/SAN parity between the two
+generation paths (reference behavior: pkg/kwokctl/pki/pki.go:49-91
+GeneratePki, CA + certs with localhost SANs).
+"""
+
+import os
+import re
+import socket
+import ssl
+import stat
+import subprocess
+import threading
+
+import pytest
+
+import kwok_tpu.ctl.pki as pki_mod
+
+EXTRA_SANS = ["10.9.8.7", "kwok.example.test"]
+DEFAULT_SANS = {"localhost", "127.0.0.1", "::1"}
+
+
+def _openssl_text(path):
+    return subprocess.run(
+        ["openssl", "x509", "-in", path, "-noout", "-text"],
+        check=True,
+        capture_output=True,
+        text=True,
+    ).stdout
+
+
+def _sans(cert_text):
+    """Parse the SAN extension into {'DNS:foo', 'IP:1.2.3.4', ...}."""
+    m = re.search(
+        r"X509v3 Subject Alternative Name:\s*\n\s*(.+)", cert_text
+    )
+    if not m:
+        return set()
+    out = set()
+    for part in m.group(1).split(","):
+        part = part.strip().replace("IP Address:", "IP:")
+        if part:
+            out.add(part)
+    return out
+
+
+def _subject_cn(cert_text):
+    m = re.search(r"Subject:.*?CN\s*=\s*([\w.\-]+)", cert_text)
+    return m.group(1) if m else None
+
+
+def _ekus(cert_text):
+    m = re.search(
+        r"X509v3 Extended Key Usage:\s*\n\s*(.+)", cert_text
+    )
+    return {p.strip() for p in m.group(1).split(",")} if m else set()
+
+
+@pytest.fixture()
+def openssl_pki(tmp_path, monkeypatch):
+    """PKI generated through the CLI fallback, cryptography or not."""
+    monkeypatch.setattr(pki_mod, "_HAVE_CRYPTOGRAPHY", False)
+    return pki_mod.generate_pki(str(tmp_path / "pki"), extra_sans=EXTRA_SANS)
+
+
+def test_openssl_fallback_layout_and_chain(openssl_pki):
+    paths = openssl_pki
+    for p in (
+        paths.ca_crt,
+        paths.ca_key,
+        paths.server_crt,
+        paths.server_key,
+        paths.admin_crt,
+        paths.admin_key,
+    ):
+        assert os.path.exists(p), p
+    # private keys are 0600
+    for p in (paths.ca_key, paths.server_key, paths.admin_key):
+        assert stat.S_IMODE(os.stat(p).st_mode) == 0o600
+    # both leaf certs chain to the CA
+    for crt in (paths.server_crt, paths.admin_crt):
+        subprocess.run(
+            ["openssl", "verify", "-CAfile", paths.ca_crt, crt],
+            check=True,
+            capture_output=True,
+        )
+
+
+def test_openssl_fallback_identities_and_sans(openssl_pki):
+    paths = openssl_pki
+    server = _openssl_text(paths.server_crt)
+    admin = _openssl_text(paths.admin_crt)
+    assert _subject_cn(server) == "kwok-tpu-apiserver"
+    # the admin identity matches the reference's kubernetes-admin cert
+    assert _subject_cn(admin) == "kubernetes-admin"
+    assert "TLS Web Server Authentication" in _ekus(server)
+    assert "TLS Web Client Authentication" in _ekus(admin)
+    sans = _sans(server)
+    assert {"DNS:localhost", "IP:127.0.0.1"} <= sans
+    assert "IP:10.9.8.7" in sans and "DNS:kwok.example.test" in sans
+
+
+def test_openssl_fallback_idempotent(openssl_pki, tmp_path):
+    before = open(openssl_pki.server_crt, "rb").read()
+    again = pki_mod.generate_pki(openssl_pki.base, extra_sans=EXTRA_SANS)
+    assert open(again.server_crt, "rb").read() == before
+
+
+def test_openssl_fallback_handshake(openssl_pki):
+    """The fallback certs drive a real TLS handshake: a client
+    verifying against the CA connects to a server presenting the
+    serving cert, hostname-checked as localhost."""
+    paths = openssl_pki
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(paths.server_crt, paths.server_key)
+    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client_ctx.load_verify_locations(paths.ca_crt)
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    server_err = []
+
+    def serve():
+        try:
+            conn, _ = lsock.accept()
+            with server_ctx.wrap_socket(conn, server_side=True) as tls:
+                tls.sendall(b"ok")
+        except Exception as exc:  # noqa: BLE001 — surfaced in the assert
+            server_err.append(exc)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as raw:
+        with client_ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+            assert tls.recv(2) == b"ok"
+            cert = tls.getpeercert()
+    t.join(timeout=5)
+    assert not server_err, server_err
+    assert ("DNS", "localhost") in cert.get("subjectAltName", ())
+
+
+def test_openssl_matches_cryptography_path(tmp_path, monkeypatch):
+    """Cert/SAN parity between the two generation paths (runs where
+    ``cryptography`` is installed; the fallback-only environment skips
+    — it has nothing to compare against)."""
+    pytest.importorskip("cryptography")
+    assert pki_mod._HAVE_CRYPTOGRAPHY
+
+    crypto = pki_mod.generate_pki(str(tmp_path / "crypto"), extra_sans=EXTRA_SANS)
+    monkeypatch.setattr(pki_mod, "_HAVE_CRYPTOGRAPHY", False)
+    cli = pki_mod.generate_pki(str(tmp_path / "cli"), extra_sans=EXTRA_SANS)
+
+    for attr in ("server_crt", "admin_crt"):
+        a = _openssl_text(getattr(crypto, attr))
+        b = _openssl_text(getattr(cli, attr))
+        assert _subject_cn(a) == _subject_cn(b)
+        assert _ekus(a) == _ekus(b)
+    assert _sans(_openssl_text(crypto.server_crt)) == _sans(
+        _openssl_text(cli.server_crt)
+    )
+    # admin (client) certs carry no SANs on either path
+    assert _sans(_openssl_text(crypto.admin_crt)) == set()
+    assert _sans(_openssl_text(cli.admin_crt)) == set()

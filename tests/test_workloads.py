@@ -628,6 +628,28 @@ def test_hpa_respects_max_replicas():
     assert d["spec"]["replicas"] == 10  # clamped to maxReplicas
 
 
+def test_hpa_stabilization_cannot_exceed_lowered_max_replicas():
+    """A window recommendation recorded before maxReplicas was lowered
+    must not push the target above the NEW maximum — the live bounds
+    clamp last, like upstream's normalization."""
+    store, dc, rsc = hpa_fixture(replicas=8, usage="100m", request="1")
+    clock = {"t": 1000.0}
+    hc = HPAController(store, now=lambda: clock["t"])
+    # a recommendation of 10 sits in the stabilization window, then the
+    # user lowers maxReplicas to 5
+    hc._recommendations[("default", "hpa")] = [(1000.0, 10)]
+    store.patch(
+        "HorizontalPodAutoscaler",
+        "hpa",
+        {"spec": {"maxReplicas": 5}},
+        patch_type="merge",
+        namespace="default",
+    )
+    hc.reconcile("default", "hpa")
+    d = store.get("Deployment", "web", namespace="default")
+    assert d["spec"]["replicas"] == 5  # new max wins over the window
+
+
 # --------------------------------------------------- scale subresource
 
 

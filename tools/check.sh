@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# One-command repo gate: kwoklint + tier-1 tests + a scaled bench smoke.
+# This is the CI entrypoint shape — each stage fails fast and loudly.
+#
+#   tools/check.sh            # full tier-1 (sequential, ~15 min)
+#   FAST=1 tools/check.sh     # -n 4 --dist loadfile (~8 min, may flake timing gates)
+#   SKIP_BENCH=1 tools/check.sh
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "== kwoklint (python -m kwok_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m kwok_tpu.analysis
+
+echo "== tier-1 tests (pytest -m 'not slow') =="
+PYTEST_ARGS=(-q -m 'not slow' -p no:cacheprovider)
+if [[ "${FAST:-0}" == "1" ]]; then
+    PYTEST_ARGS+=(-n 4 --dist loadfile)
+fi
+JAX_PLATFORMS=cpu python -m pytest tests/ "${PYTEST_ARGS[@]}"
+
+if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
+    echo "== bench smoke (BENCH_PODS-scaled) =="
+    JAX_PLATFORMS=cpu \
+        BENCH_PODS="${BENCH_PODS:-200}" BENCH_NODES="${BENCH_NODES:-20}" \
+        BENCH_TICKS="${BENCH_TICKS:-50}" \
+        BENCH_E2E_PODS="${BENCH_E2E_PODS:-200}" \
+        BENCH_E2E_WINDOWS="${BENCH_E2E_WINDOWS:-1}" \
+        BENCH_E2E_WINDOW_S="${BENCH_E2E_WINDOW_S:-5}" \
+        BENCH_E2E_BUDGET_S="${BENCH_E2E_BUDGET_S:-60}" \
+        python bench.py
+fi
+
+echo "== all checks passed =="
