@@ -109,6 +109,7 @@ def all_rules() -> "dict[str, object]":
         lock_discipline,
         parity_citations,
         store_boundary,
+        swallowed_errors,
         tracer_safety,
     )
 
@@ -118,6 +119,7 @@ def all_rules() -> "dict[str, object]":
         "lock-discipline": lock_discipline.analyze,
         "tracer-safety": tracer_safety.analyze,
         "parity-citations": parity_citations.analyze,
+        "swallowed-errors": swallowed_errors.analyze,
     }
 
 

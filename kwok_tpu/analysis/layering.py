@@ -11,7 +11,8 @@ and the package layout carry the kwok_tpu mapping) is, bottom to top::
     controllers, workloads,
     metrics, snapshot, cni (5)  reconcilers over the cluster bus
     server, tools          (6)  kubelet-surface HTTP + dev tooling
-    ctl, cmd               (7)  cluster lifecycle CLI + entrypoints
+    ctl, cmd, chaos        (7)  cluster lifecycle CLI + entrypoints +
+                                fault injection (drives ctl components)
 
 Two rules:
 
@@ -47,7 +48,7 @@ LAYERS: List[Tuple[str, ...]] = [
     ("cluster",),
     ("controllers", "workloads", "metrics", "snapshot", "cni"),
     ("server", "tools"),
-    ("ctl", "cmd"),
+    ("ctl", "cmd", "chaos"),
 ]
 
 LAYER_OF: Dict[str, int] = {

@@ -1,0 +1,111 @@
+"""Swallowed-errors analyzer: no silent ``except: pass`` in daemon
+loops.
+
+The robustness twin of lock-discipline: a daemon or controller loop
+that catches an exception and drops it on the floor turns every
+transient fault into an invisible one — the chaos subsystem
+(PARITY.md:174 §4/§5 strategy) injects failures precisely so their
+handling can be observed, and an ``except ...: pass`` inside the loop
+body is the one shape that guarantees it cannot be.  The reference
+gates the same class of bug with golangci-lint's errcheck over its
+controller loops (SURVEY.md §2.9 names the loops).
+
+Two triggers, both scoped to statements lexically inside a ``while``
+loop body (the daemon-loop idiom; code in nested function defs is
+excluded — it runs on some other stack):
+
+- **except-and-pass**: any handler whose entire body is ``pass``.
+  Catch narrowly and log at debug level instead
+  (``kwok_tpu.utils.log``), or suppress with the reason the drop is
+  correct (e.g. a best-effort teardown).
+- **bare-except**: ``except:`` with no exception type — it eats
+  ``KeyboardInterrupt``/``SystemExit`` too, which is how a daemon
+  becomes unkillable; flagged regardless of what the body does.
+
+``# kwoklint: disable=swallowed-errors`` plus a reason comment is the
+escape hatch, same as every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from kwok_tpu.analysis import Finding, SourceFile
+
+RULE = "swallowed-errors"
+
+
+def _iter_loop_statements(loop: ast.While):
+    """Every statement lexically inside the loop body, not descending
+    into nested function/class definitions (their bodies execute on a
+    different stack, not in this loop)."""
+
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            yield stmt
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(stmt, field, None)
+                if isinstance(block, list):
+                    yield from walk(block)
+            for handler in getattr(stmt, "handlers", []) or []:
+                yield from walk(handler.body)
+
+    yield from walk(loop.body)
+
+
+def _check_try(sf: SourceFile, node: ast.Try) -> List[Finding]:
+    findings: List[Finding] = []
+    for handler in node.handlers:
+        bare = handler.type is None
+        only_pass = len(handler.body) == 1 and isinstance(
+            handler.body[0], ast.Pass
+        )
+        if bare:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=handler.lineno,
+                    message=(
+                        "bare 'except:' in a daemon loop body — it eats "
+                        "KeyboardInterrupt/SystemExit too; name the "
+                        "exception types (and log what you catch)"
+                    ),
+                )
+            )
+        elif only_pass:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=handler.lineno,
+                    message=(
+                        "exception swallowed by 'pass' in a daemon loop "
+                        "body — log it at debug level "
+                        "(kwok_tpu.utils.log) or suppress with the "
+                        "reason the drop is correct"
+                    ),
+                )
+            )
+    return findings
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith("kwok_tpu/"):
+            continue
+        seen = set()  # nested whiles visit inner statements twice
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.While):
+                continue
+            for stmt in _iter_loop_statements(node):
+                if isinstance(stmt, ast.Try) and id(stmt) not in seen:
+                    seen.add(id(stmt))
+                    findings.extend(_check_try(sf, stmt))
+    return findings

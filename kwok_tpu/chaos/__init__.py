@@ -1,0 +1,46 @@
+"""Fault injection as a first-class subsystem.
+
+The reference's robustness story is chaos-in-data: the ``pod-chaos`` /
+``node-chaos`` stage sets flip *object state* adversarially
+(``kwok_tpu/stages/pod-chaos.yaml:1``, reference
+kustomize/stage/pod/chaos) while the infrastructure underneath is
+assumed healthy.  This rebuild runs a real multi-process control plane,
+so the infrastructure itself must be breakable on demand — the
+Jepsen-style stance that failure paths stay correct only if they are
+exercised continuously (PAPERS.md).  Three injection layers, all driven
+by one deterministic seeded :class:`~kwok_tpu.chaos.plan.FaultPlan`:
+
+- **HTTP boundary** (:mod:`kwok_tpu.chaos.http_faults`): added latency,
+  429/503 rejections with Retry-After, connection resets, watch-stream
+  drops, and per-client partitions, hooked into the apiserver facade
+  via its ``fault_injector`` seam.
+- **process layer** (:mod:`kwok_tpu.chaos.process_faults`): SIGKILL /
+  SIGSTOP+SIGCONT / restart of control-plane components through
+  ``kwok_tpu.ctl.runtime``; recovery is the supervisor's job.
+- **store commit path**: ``ResourceStore.set_crash_hook`` fires at the
+  before-/after-commit boundaries so WAL recovery is testable at the
+  exact instants a crash hurts.
+
+Profiles are YAML (``kwokctl create cluster --chaos-profile`` wires
+them into the apiserver daemon); ``python -m kwok_tpu.chaos`` is the
+offline driver (schedule printing, process-fault driving, and the
+self-contained durability smoke used by tools/check.sh).
+"""
+
+from kwok_tpu.chaos.plan import (  # noqa: F401
+    FaultPlan,
+    HttpFaultSpec,
+    PartitionWindow,
+    ProcessFaultSpec,
+    load_profile,
+)
+from kwok_tpu.chaos.http_faults import HttpFaultInjector  # noqa: F401
+
+__all__ = [
+    "FaultPlan",
+    "HttpFaultSpec",
+    "PartitionWindow",
+    "ProcessFaultSpec",
+    "load_profile",
+    "HttpFaultInjector",
+]

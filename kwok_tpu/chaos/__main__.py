@@ -1,0 +1,227 @@
+"""Offline chaos driver: ``python -m kwok_tpu.chaos``.
+
+Three modes over one seeded profile
+(:mod:`kwok_tpu.chaos.plan`; reference chaos-as-data precedent
+``kwok_tpu/stages/pod-chaos.yaml:1``):
+
+- ``--print-schedule``  render the deterministic fault schedule as
+  JSON (what WILL happen for this seed) without touching anything.
+- ``--cluster NAME``    drive the profile's process faults against a
+  live kwokctl cluster; ``--supervise`` also runs the component
+  supervisor so kills recover.  HTTP faults live inside the apiserver
+  daemon — create the cluster with ``--chaos-profile`` to enable them.
+- ``--smoke``           self-contained durability check (seconds, no
+  subprocesses): drive writes through an apiserver facade under
+  injected 503s/resets/latency with the retrying client, then replay
+  snapshot+WAL into a fresh store and assert byte-identical state —
+  zero lost acknowledged writes.  tools/check.sh runs this on every
+  check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+from kwok_tpu.chaos.http_faults import HttpFaultInjector
+from kwok_tpu.chaos.plan import FaultPlan, HttpFaultSpec, load_profile
+
+
+def run_smoke(seed: int = 42, pods: int = 40, duration: float = 30.0) -> dict:
+    """In-process chaos smoke; returns the report dict (raises on any
+    lost write or non-convergence)."""
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import ApiUnavailable, ClusterClient, RetryPolicy
+    from kwok_tpu.cluster.store import Conflict, NotFound, ResourceStore
+    from kwok_tpu.cluster.wal import WriteAheadLog
+    from kwok_tpu.utils.backoff import Backoff
+
+    def must(fn, *a, **kw):
+        """Drive a mutation to an acknowledged outcome, the way the
+        controllers do: ApiUnavailable means the op MAY have applied
+        (e.g. a chaos reset ate the response) — replay it, treating
+        already-applied answers as success.  Conflict, not
+        AlreadyExists: the REST client maps every 409 to the base
+        Conflict, and nothing here carries rv preconditions, so a 409
+        on replay can only mean the first attempt landed."""
+        for _ in range(50):
+            try:
+                return fn(*a, **kw)
+            except ApiUnavailable:
+                continue
+            except Conflict:
+                return None  # first attempt applied; the ack was eaten
+            except NotFound:
+                return None  # delete applied; the ack was eaten
+        raise SystemExit("chaos smoke FAILED: mutation never converged")
+
+    plan = FaultPlan(
+        seed=seed,
+        duration=duration,
+        http=HttpFaultSpec(
+            latency_p=0.10,
+            latency_s=0.01,
+            reject_p=0.15,
+            reject_status=503,
+            retry_after=0.05,
+            reset_p=0.08,
+        ),
+    )
+    inj = HttpFaultInjector(plan)
+    t_start = time.monotonic()
+    with tempfile.TemporaryDirectory() as tmp:
+        wal_path = os.path.join(tmp, "wal.jsonl")
+        state_path = os.path.join(tmp, "state.json")
+        store = ResourceStore()
+        store.attach_wal(WriteAheadLog(wal_path, fsync="off"))
+        with APIServer(store, fault_injector=inj) as srv:
+            client = ClusterClient(
+                srv.url,
+                retry=RetryPolicy(
+                    seed=seed,
+                    max_attempts=10,
+                    budget_s=30.0,
+                    backoff=Backoff(duration=0.02, cap=0.5),
+                ),
+                client_id="chaos-smoke",
+            )
+            # every acked write below crossed the faulty boundary
+            for i in range(pods):
+                must(
+                    client.create,
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {"name": f"smoke-{i}", "namespace": "default"},
+                        "spec": {"nodeName": f"node-{i % 4}"},
+                        "status": {},
+                    },
+                )
+            for i in range(pods):
+                must(
+                    client.patch,
+                    "Pod",
+                    f"smoke-{i}",
+                    {"status": {"phase": "Running"}},
+                    "merge",
+                    subresource="status",
+                )
+            for i in range(0, pods, 5):
+                must(client.delete, "Pod", f"smoke-{i}")
+            live = store.dump_state()
+        # crash: throw the store away, recover snapshot-less from WAL
+        recovered = ResourceStore()
+        replayed = recovered.replay_wal(wal_path)
+        t_recovered = time.monotonic()
+        if recovered.dump_state() != live:
+            raise SystemExit("chaos smoke FAILED: WAL replay diverged from live state")
+        # and the snapshot+compact path: save, recover from both halves
+        store.save_file(state_path)
+        recovered2 = ResourceStore()
+        recovered2.load_file(state_path)
+        recovered2.replay_wal(wal_path)
+        if recovered2.dump_state() != live:
+            raise SystemExit(
+                "chaos smoke FAILED: snapshot+WAL recovery diverged from live state"
+            )
+    expect_pods = pods - len(range(0, pods, 5))
+    if recovered.count("Pod") != expect_pods:
+        raise SystemExit(
+            f"chaos smoke FAILED: {recovered.count('Pod')} pods after recovery, "
+            f"want {expect_pods}"
+        )
+    return {
+        "seed": seed,
+        "acked_writes": pods * 2 + len(range(0, pods, 5)),
+        "replayed_records": replayed,
+        "faults": inj.snapshot(),
+        "recovery_s": round(t_recovered - t_start, 3),
+        "lost_writes": 0,
+    }
+
+
+def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
+    from kwok_tpu.chaos.process_faults import ProcessFaultDriver
+    from kwok_tpu.ctl.runtime import BinaryRuntime, ComponentSupervisor
+
+    rt = BinaryRuntime(cluster)
+    if not rt.exists():
+        raise SystemExit(f"cluster {cluster!r} does not exist (kwokctl create cluster)")
+    sup = None
+    if supervise:
+        import random
+
+        sup = ComponentSupervisor(rt, rng=random.Random(plan.seed)).start()
+    driver = ProcessFaultDriver(rt, plan)
+    try:
+        driver.run()
+        if supervise:
+            # let the supervisor finish recovering what the last fault
+            # broke before reporting
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if all(rt.running_components().values()):
+                    break
+                time.sleep(0.25)
+    finally:
+        if sup is not None:
+            sup.stop()
+    return {
+        "process_events": driver.events,
+        "supervisor_events": sup.events if sup is not None else [],
+        "recovery_times_s": (
+            [round(r, 3) for r in sup.recovery_times] if sup is not None else []
+        ),
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="kwok-tpu-chaos", description=__doc__)
+    p.add_argument("--profile", default="", help="chaos profile YAML")
+    p.add_argument("--seed", type=int, default=None, help="override the profile seed")
+    p.add_argument(
+        "--print-schedule",
+        action="store_true",
+        help="print the deterministic fault schedule and exit",
+    )
+    p.add_argument("--cluster", default="", help="drive process faults against this cluster")
+    p.add_argument(
+        "--supervise",
+        action="store_true",
+        help="run the component supervisor while driving faults",
+    )
+    p.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run the in-process durability smoke (used by tools/check.sh)",
+    )
+    p.add_argument("--pods", type=int, default=40, help="smoke population")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.smoke:
+        report = run_smoke(seed=args.seed if args.seed is not None else 42, pods=args.pods)
+        print(json.dumps(report))
+        return 0
+    plan = load_profile(args.profile) if args.profile else FaultPlan()
+    if args.seed is not None:
+        plan.seed = args.seed
+    if args.print_schedule:
+        print(json.dumps(plan.to_dict(), indent=2))
+        return 0
+    if args.cluster:
+        report = drive_cluster(plan, args.cluster, args.supervise)
+        print(json.dumps(report, indent=2))
+        return 0
+    build_parser().print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

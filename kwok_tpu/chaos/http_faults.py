@@ -1,0 +1,126 @@
+"""HTTP-boundary fault injector — the apiserver's ``fault_injector``
+duck type.
+
+Sits behind the seam ``kwok_tpu.cluster.apiserver`` exposes (the
+handler asks ``on_request``/``on_watch_tick`` before dispatching; this
+module never imports the server, keeping chaos above cluster in the
+layer map).  Decisions come from one seeded ``random.Random`` under a
+lock, so a run's decision *sequence* is deterministic for a given
+seed; health endpoints are never faulted (liveness must stay truthful
+or recovery itself flaps — the same reason the reference's chaos
+stages leave the kubelet's own heartbeat machinery alone,
+``kwok_tpu/stages/node-chaos.yaml:1``).
+
+Actions returned to the handler::
+
+    {"action": "latency", "seconds": s}            sleep then serve
+    {"action": "reject", "status": 429|503,
+     "retry_after": s|None}                        typed rejection
+    {"action": "reset"}                            close with no reply
+    None                                           serve normally
+
+``on_watch_tick`` returning True drops the watch stream mid-flight.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+from kwok_tpu.chaos.plan import FaultPlan
+
+__all__ = ["HttpFaultInjector"]
+
+#: paths that must stay truthful — see module docstring
+_EXEMPT = ("/healthz", "/readyz", "/livez")
+
+
+class HttpFaultInjector:
+    """Seeded per-request fault decisions over a :class:`FaultPlan`."""
+
+    def __init__(self, plan: FaultPlan, clock=None):
+        self.plan = plan
+        self._clock = clock or time.monotonic
+        self._rng = random.Random(plan.seed)
+        self._mut = threading.Lock()
+        self._t0 = self._clock()
+        #: injected-fault counters by kind, for smoke asserts and the
+        #: daemon's shutdown report
+        self.counters: Dict[str, int] = {
+            "latency": 0,
+            "reject": 0,
+            "reset": 0,
+            "watch_drop": 0,
+            "partition": 0,
+        }
+
+    def start(self) -> None:
+        """(Re)open the active-fault window from now."""
+        with self._mut:
+            self._t0 = self._clock()
+
+    @property
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def active(self) -> bool:
+        return self.elapsed < self.plan.duration
+
+    # ------------------------------------------------------------- handler API
+
+    def on_request(
+        self, method: str, path: str, client_id: str
+    ) -> Optional[dict]:
+        if path.split("?", 1)[0] in _EXEMPT:
+            return None
+        spec = self.plan.http
+        with self._mut:
+            elapsed = self._clock() - self._t0
+            if elapsed >= self.plan.duration:
+                return None
+            for part in spec.partitions:
+                if part.client and part.client == client_id and part.active(elapsed):
+                    self.counters["partition"] += 1
+                    return {"action": "reset"}
+            draw = self._rng.random()
+            # one draw, stacked thresholds: keeps the decision sequence
+            # a pure function of (seed, request ordinal)
+            if draw < spec.reset_p:
+                self.counters["reset"] += 1
+                return {"action": "reset"}
+            draw -= spec.reset_p
+            if draw < spec.reject_p:
+                self.counters["reject"] += 1
+                return {
+                    "action": "reject",
+                    "status": spec.reject_status,
+                    "retry_after": spec.retry_after,
+                }
+            draw -= spec.reject_p
+            if draw < spec.latency_p:
+                self.counters["latency"] += 1
+                return {"action": "latency", "seconds": spec.latency_s}
+        return None
+
+    def on_watch_tick(self, client_id: str) -> bool:
+        spec = self.plan.http
+        if spec.watch_drop_p <= 0.0:
+            return False
+        with self._mut:
+            elapsed = self._clock() - self._t0
+            if elapsed >= self.plan.duration:
+                return False
+            for part in spec.partitions:
+                if part.client and part.client == client_id and part.active(elapsed):
+                    self.counters["watch_drop"] += 1
+                    return True
+            if self._rng.random() < spec.watch_drop_p:
+                self.counters["watch_drop"] += 1
+                return True
+        return False
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._mut:
+            return dict(self.counters)

@@ -1,0 +1,190 @@
+"""Fault plans: the deterministic, seeded schedule every injector obeys.
+
+A plan is data, not behavior — the YAML profile shape mirrors how the
+reference declares chaos as data in its stage sets
+(``kwok_tpu/stages/node-chaos.yaml:1``), extended from object state to
+infrastructure.  One ``seed`` drives every random decision (HTTP fault
+draws, retry jitter via the client's seeded RetryPolicy, process fault
+ordering), so a chaos run is reproducible: same seed + same workload →
+the same decision sequence.
+
+Profile YAML::
+
+    kind: ChaosProfile
+    seed: 42
+    duration: 30            # seconds of active fault injection
+    http:
+      latency:   {p: 0.10, seconds: 0.05}
+      reject:    {p: 0.05, status: 503, retryAfter: 0.2}
+      reset:     {p: 0.02}
+      watchDrop: {p: 0.01}  # per 0.25s watch-loop tick
+      partitions:
+        - {client: kwok-controller, at: 5, duration: 3}
+    process:
+      - {component: apiserver, at: 8, action: kill}
+      - {component: kube-controller-manager, at: 12, action: stop, resumeAfter: 2}
+
+``action`` is ``kill`` (SIGKILL; the supervisor restarts), ``stop``
+(SIGSTOP, SIGCONT after ``resumeAfter``), or ``restart`` (graceful
+stop + start).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import yaml
+
+__all__ = [
+    "HttpFaultSpec",
+    "PartitionWindow",
+    "ProcessFaultSpec",
+    "FaultPlan",
+    "load_profile",
+]
+
+PROCESS_ACTIONS = ("kill", "stop", "restart")
+
+
+@dataclass(frozen=True)
+class PartitionWindow:
+    """One client's view of the apiserver goes dark for a window:
+    requests carrying a matching ``X-Kwok-Client`` are reset without a
+    response while ``at <= t-t0 < at + duration``."""
+
+    client: str
+    at: float
+    duration: float
+
+    def active(self, elapsed: float) -> bool:
+        return self.at <= elapsed < self.at + self.duration
+
+
+@dataclass
+class HttpFaultSpec:
+    """Per-request fault probabilities at the apiserver HTTP boundary."""
+
+    latency_p: float = 0.0
+    latency_s: float = 0.05
+    reject_p: float = 0.0
+    reject_status: int = 503
+    retry_after: Optional[float] = 0.2
+    reset_p: float = 0.0
+    watch_drop_p: float = 0.0
+    partitions: List[PartitionWindow] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HttpFaultSpec":
+        lat = d.get("latency") or {}
+        rej = d.get("reject") or {}
+        res = d.get("reset") or {}
+        drop = d.get("watchDrop") or {}
+        ra = rej.get("retryAfter", 0.2)
+        return cls(
+            latency_p=float(lat.get("p", 0.0)),
+            latency_s=float(lat.get("seconds", 0.05)),
+            reject_p=float(rej.get("p", 0.0)),
+            reject_status=int(rej.get("status", 503)),
+            retry_after=None if ra is None else float(ra),
+            reset_p=float(res.get("p", 0.0)),
+            watch_drop_p=float(drop.get("p", 0.0)),
+            partitions=[
+                PartitionWindow(
+                    client=str(p.get("client") or ""),
+                    at=float(p.get("at", 0.0)),
+                    duration=float(p.get("duration", 0.0)),
+                )
+                for p in d.get("partitions") or []
+            ],
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "latency": {"p": self.latency_p, "seconds": self.latency_s},
+            "reject": {
+                "p": self.reject_p,
+                "status": self.reject_status,
+                "retryAfter": self.retry_after,
+            },
+            "reset": {"p": self.reset_p},
+            "watchDrop": {"p": self.watch_drop_p},
+            "partitions": [
+                {"client": p.client, "at": p.at, "duration": p.duration}
+                for p in self.partitions
+            ],
+        }
+
+
+@dataclass(frozen=True)
+class ProcessFaultSpec:
+    """One scheduled process-layer fault."""
+
+    component: str
+    at: float
+    action: str  # kill | stop | restart
+    resume_after: float = 0.0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProcessFaultSpec":
+        action = str(d.get("action") or "kill")
+        if action not in PROCESS_ACTIONS:
+            raise ValueError(
+                f"process fault action {action!r} not in {PROCESS_ACTIONS}"
+            )
+        return cls(
+            component=str(d.get("component") or ""),
+            at=float(d.get("at", 0.0)),
+            action=action,
+            resume_after=float(d.get("resumeAfter", 0.0)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "component": self.component,
+            "at": self.at,
+            "action": self.action,
+            "resumeAfter": self.resume_after,
+        }
+
+
+@dataclass
+class FaultPlan:
+    """Everything a chaos run needs, reproducible from ``seed``."""
+
+    seed: int = 0
+    duration: float = 30.0
+    http: HttpFaultSpec = field(default_factory=HttpFaultSpec)
+    process: List[ProcessFaultSpec] = field(default_factory=list)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        kind = d.get("kind")
+        if kind not in (None, "ChaosProfile"):
+            raise ValueError(f"not a ChaosProfile document: kind={kind!r}")
+        return cls(
+            seed=int(d.get("seed", 0)),
+            duration=float(d.get("duration", 30.0)),
+            http=HttpFaultSpec.from_dict(d.get("http") or {}),
+            process=sorted(
+                (ProcessFaultSpec.from_dict(p) for p in d.get("process") or []),
+                key=lambda p: (p.at, p.component),
+            ),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": "ChaosProfile",
+            "seed": self.seed,
+            "duration": self.duration,
+            "http": self.http.to_dict(),
+            "process": [p.to_dict() for p in self.process],
+        }
+
+
+def load_profile(path: str) -> FaultPlan:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: chaos profile must be a mapping")
+    return FaultPlan.from_dict(doc)

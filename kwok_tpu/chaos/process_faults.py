@@ -1,0 +1,106 @@
+"""Process-layer fault driver: kill / pause / restart real components.
+
+Walks a :class:`~kwok_tpu.chaos.plan.FaultPlan`'s process schedule
+against a live cluster through the runtime's component ops
+(``kwok_tpu.ctl.runtime.BinaryRuntime``), the same layer the reference
+runtime exposes Start/Stop per component on
+(reference runtime/config.go:30-147):
+
+- ``kill``     SIGKILL — no graceful shutdown, no final state save;
+               recovery is the supervisor's problem (and the WAL's).
+- ``stop``     SIGSTOP, then SIGCONT after ``resumeAfter`` seconds — a
+               livelocked-but-alive component (liveness probes pass,
+               work stalls).
+- ``restart``  graceful stop + start through the runtime, the rolling-
+               restart case.
+
+The driver is wall-clock scheduled from plan ``at`` offsets and
+records every action with timestamps, so tests can correlate injected
+faults with observed recovery.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import List, Optional
+
+from kwok_tpu.chaos.plan import FaultPlan, ProcessFaultSpec
+
+__all__ = ["ProcessFaultDriver"]
+
+
+class ProcessFaultDriver:
+    """Execute a plan's process faults against a runtime."""
+
+    def __init__(self, runtime, plan: FaultPlan):
+        self.runtime = runtime
+        self.plan = plan
+        #: [{"t": wall-offset, "component", "action"}] in execution order
+        self.events: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resumes: List[tuple] = []  # (due_offset, component)
+
+    def start(self) -> "ProcessFaultDriver":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # never leave a component SIGSTOPped behind a cancelled run
+        for _, comp in self._resumes:
+            self.runtime.signal_component(comp, signal.SIGCONT)
+        self._resumes = []
+
+    def run(self) -> None:
+        """Blocking: replay the schedule, then resume any still-paused
+        components, then return."""
+        t0 = time.monotonic()
+        pending = list(self.plan.process)
+        while (pending or self._resumes) and not self._stop.is_set():
+            now = time.monotonic() - t0
+            # SIGCONT resumes that came due
+            for due, comp in list(self._resumes):
+                if now >= due:
+                    self.runtime.signal_component(comp, signal.SIGCONT)
+                    self._record(now, comp, "resume")
+                    self._resumes.remove((due, comp))
+            if pending and now >= pending[0].at:
+                spec = pending.pop(0)
+                self._apply(spec, now)
+                continue
+            next_due = min(
+                [p.at for p in pending[:1]] + [d for d, _ in self._resumes],
+                default=None,
+            )
+            if next_due is None:
+                break
+            self._stop.wait(min(max(next_due - now, 0.0), 0.25))
+        for _, comp in self._resumes:
+            self.runtime.signal_component(comp, signal.SIGCONT)
+            self._record(time.monotonic() - t0, comp, "resume")
+        self._resumes = []
+
+    def _apply(self, spec: ProcessFaultSpec, now: float) -> None:
+        if spec.action == "kill":
+            self.runtime.signal_component(spec.component, signal.SIGKILL)
+        elif spec.action == "stop":
+            self.runtime.signal_component(spec.component, signal.SIGSTOP)
+            self._resumes.append((now + max(spec.resume_after, 0.0), spec.component))
+        elif spec.action == "restart":
+            self.runtime.stop_component(spec.component)
+            for comp in self.runtime.load_components():
+                if comp.name == spec.component:
+                    self.runtime.start_component(comp)
+                    break
+        self._record(now, spec.component, spec.action)
+
+    def _record(self, now: float, component: str, action: str) -> None:
+        self.events.append(
+            {"t": round(now, 3), "component": component, "action": action}
+        )

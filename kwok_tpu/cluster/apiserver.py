@@ -164,6 +164,62 @@ class _Handler(BaseHTTPRequestHandler):
     def _user(self) -> Optional[str]:
         return self.headers.get("Impersonate-User") or None
 
+    # --------------------------------------------------------------- chaos
+
+    def _inject_fault(self) -> bool:
+        """Consult the attached fault injector (kwok_tpu.chaos duck
+        type: ``on_request(method, path, client_id) -> action|None``)
+        before dispatching.  Returns True when the request was consumed
+        by the fault (rejected or reset); latency faults sleep and fall
+        through to normal handling."""
+        inj = getattr(self.server, "fault_injector", None)
+        if inj is None:
+            return False
+        act = inj.on_request(
+            self.command, self.path, self.headers.get("X-Kwok-Client") or ""
+        )
+        if act is None:
+            return False
+        kind = act.get("action")
+        if kind == "latency":
+            time.sleep(float(act.get("seconds", 0.0)))
+            return False
+        if kind == "reject":
+            code = int(act.get("status", 503))
+            reason = (
+                "TooManyRequests" if code == 429 else "ServiceUnavailable"
+            )
+            body = json.dumps(
+                {"error": "chaos: injected fault", "reason": reason}
+            ).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            ra = act.get("retry_after")
+            if ra is not None:
+                self.send_header("Retry-After", str(ra))
+            self.send_header("Content-Length", str(len(body)))
+            # the request body was never read — the keep-alive framing
+            # is gone, so the connection must die with the rejection
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionError, OSError):
+                pass
+            return True
+        if kind == "reset":
+            # abrupt close without a status line: the client observes a
+            # connection reset / empty reply, exactly like a crashed or
+            # partitioned server
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return True
+        return False
+
     @staticmethod
     def _ns(q: dict) -> Optional[str]:
         return q.get("namespace") or None
@@ -171,6 +227,8 @@ class _Handler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- verbs
 
     def do_GET(self):
+        if self._inject_fault():
+            return
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "GET", head, rest, q):
             return
@@ -237,6 +295,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_POST(self):
+        if self._inject_fault():
+            return
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "POST", head, rest, q):
             return
@@ -269,6 +329,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_PUT(self):
+        if self._inject_fault():
+            return
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PUT", head, rest, q):
             return
@@ -289,6 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_PATCH(self):
+        if self._inject_fault():
+            return
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PATCH", head, rest, q):
             return
@@ -314,6 +378,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_DELETE(self):
+        if self._inject_fault():
+            return
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "DELETE", head, rest, q):
             return
@@ -355,9 +421,25 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.close_connection = True
         shutdown = getattr(self.server, "shutting_down", None)
+        inj = getattr(self.server, "fault_injector", None)
+        cid = self.headers.get("X-Kwok-Client") or ""
         try:
             idle = 0.0
+            last_chaos = time.monotonic()
             while shutdown is None or not shutdown.is_set():
+                if inj is not None:
+                    # at most one drop draw per 0.25s: under event load
+                    # the loop spins per burst, and a per-iteration draw
+                    # would scale the drop rate with traffic instead of
+                    # the per-tick probability the profile documents
+                    now = time.monotonic()
+                    if now - last_chaos >= 0.25:
+                        last_chaos = now
+                        if inj.on_watch_tick(cid):
+                            # chaos watch-stream drop: hang up
+                            # mid-stream; the client reflector resumes
+                            # from its last rv
+                            break
                 ev = w.next(timeout=0.25)
                 if ev is None:
                     idle += 0.25
@@ -455,6 +537,7 @@ class APIServer:
         client_ca: Optional[str] = None,
         audit_path: Optional[str] = None,
         kubelet_url: Optional[str] = None,
+        fault_injector=None,
     ):
         # acquire the audit file before binding the port so a bad path
         # fails without leaking a listening socket; unbuffered O_APPEND
@@ -469,6 +552,11 @@ class APIServer:
             # watch handler loops poll this so stop() actually ends them
             self._httpd.shutting_down = threading.Event()
             self._httpd.audit_sink = self._audit_file
+            # chaos seam (kwok_tpu.chaos duck type); None = no faults.
+            # cmd/apiserver wires it from --chaos-profile — this module
+            # only carries the hook, keeping cluster below chaos in the
+            # layer map.
+            self._httpd.fault_injector = fault_injector
             # Kubernetes wire-protocol facade (k8s_api.py): /api, /apis,
             # /version, /openapi — what stock kubectl/client-go speak
             self._httpd.k8s = K8sFacade(store, kubelet_url=kubelet_url)
@@ -499,6 +587,12 @@ class APIServer:
         host, port = self.address
         scheme = "https" if self._tls else "http"
         return f"{scheme}://{host}:{port}"
+
+    def set_fault_injector(self, injector) -> None:
+        """Attach/detach (None) the chaos fault injector on a live
+        server; in-flight requests keep the injector they started
+        with."""
+        self._httpd.fault_injector = injector
 
     def start(self) -> "APIServer":
         self._thread = threading.Thread(

@@ -22,8 +22,11 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
+import random
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from kwok_tpu.cluster.store import (
@@ -33,9 +36,16 @@ from kwok_tpu.cluster.store import (
     ResourceType,
     Selector,
 )
+from kwok_tpu.utils.backoff import Backoff
 from kwok_tpu.utils.queue import Queue
 
-__all__ = ["ClusterClient", "RemoteWatcher", "APIError"]
+__all__ = [
+    "ClusterClient",
+    "RemoteWatcher",
+    "APIError",
+    "ApiUnavailable",
+    "RetryPolicy",
+]
 
 _PATCH_CT = {
     "merge": "application/merge-patch+json",
@@ -49,6 +59,73 @@ class APIError(RuntimeError):
         super().__init__(f"{reason} ({code}): {message}")
         self.code = code
         self.reason = reason
+
+
+class ApiUnavailable(RuntimeError):
+    """Terminal transport error: the apiserver stayed unreachable or
+    overloaded past the retry budget.  Replaces the raw ``OSError`` /
+    ``HTTPException`` leak callers used to see — carries how hard the
+    client tried (``attempts``) and the last HTTP status observed
+    (``last_status``; None when the failure was at the socket layer),
+    so daemon loops can log one structured line and back off."""
+
+    def __init__(
+        self,
+        message: str,
+        attempts: int = 1,
+        last_status: Optional[int] = None,
+    ):
+        detail = f"{message} (attempts={attempts}"
+        if last_status is not None:
+            detail += f", last_status={last_status}"
+        super().__init__(detail + ")")
+        self.attempts = attempts
+        self.last_status = last_status
+
+
+@dataclass
+class RetryPolicy:
+    """Unified transport retry schedule (client-go's rest.Request
+    backoff seat, reference pkg/utils/client/clientset.go:1): jittered
+    exponential backoff between attempts, a wall-clock retry budget,
+    and Retry-After honoring on 429/503.
+
+    429/503 are pre-processing rejections in kube-apiserver semantics,
+    so they are safe to retry for every verb; socket-level send
+    failures never reached the server and retry too.  A response lost
+    *after* a mutating request went out is terminal (the server may
+    have applied it) — that stays the caller's problem, surfaced as
+    :class:`ApiUnavailable`.
+
+    ``seed`` makes the jitter schedule reproducible under a chaos seed
+    (the rng is instance-local; there is no global-random fallback).
+    """
+
+    max_attempts: int = 5
+    budget_s: float = 10.0
+    backoff: Backoff = field(
+        default_factory=lambda: Backoff(duration=0.1, cap=2.0)
+    )
+    retry_statuses: Tuple[int, ...] = (429, 503)
+    honor_retry_after: bool = True
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int, retry_after: Optional[float]) -> float:
+        """Seconds to sleep before attempt ``attempt + 1``."""
+        d = self.backoff.delay(attempt, self._rng)
+        if retry_after is not None and self.honor_retry_after:
+            d = max(d, retry_after)
+        return d
+
+
+#: health probes and other latency-sensitive callers: one fresh-socket
+#: retry (the legacy behavior), no sleeping
+NO_RETRY = RetryPolicy(
+    max_attempts=2, budget_s=1.0, backoff=Backoff(duration=0.0, cap=0.0)
+)
 
 
 def _raise_for(code: int, payload: Any) -> None:
@@ -153,12 +230,19 @@ class ClusterClient:
         ca_cert: Optional[str] = None,
         client_cert: Optional[str] = None,
         client_key: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        client_id: Optional[str] = None,
     ):
         self._https = url.startswith("https://")
         if "://" in url:
             url = url.split("://", 1)[1]
         self._hostport = url.rstrip("/")
         self._timeout = timeout
+        self._retry = retry or RetryPolicy()
+        #: identifies this client to the apiserver (X-Kwok-Client) so
+        #: chaos partitions can target one component; defaults to the
+        #: component name the runtime exports
+        self.client_id = client_id or os.environ.get("KWOK_COMPONENT_NAME") or ""
         self._local = threading.local()
         self._types: Dict[str, ResourceType] = {}
         self._types_mut = threading.Lock()
@@ -205,10 +289,21 @@ class ClusterClient:
         path: str,
         body: Any = None,
         headers: Optional[Dict[str, str]] = None,
-        _retried: bool = False,
+        retry: Optional[RetryPolicy] = None,
     ) -> Any:
-        conn = self._conn()
+        """One API call under the client's :class:`RetryPolicy`.
+
+        Retries (with jittered backoff, honoring Retry-After) on:
+        socket-level send failures for any verb (the request never
+        reached the server), lost responses for idempotent reads, and
+        429/503 statuses for any verb (pre-processing rejections).
+        Terminal failures surface as :class:`ApiUnavailable`; a lost
+        response after a mutating request went out is terminal
+        immediately (the server may have applied it)."""
+        policy = retry if retry is not None else self._retry
         hdrs = {"Content-Type": "application/json"}
+        if self.client_id:
+            hdrs["X-Kwok-Client"] = self.client_id
         if headers:
             hdrs.update(headers)
         if method != "GET":
@@ -220,30 +315,67 @@ class ClusterClient:
             if tp:
                 hdrs.setdefault("traceparent", tp)
         payload = json.dumps(body) if body is not None else None
-        try:
-            conn.request(method, path, body=payload, headers=hdrs)
-        except (OSError, http.client.HTTPException):
-            # send failed → the request never reached the server, so a
-            # retry on a fresh socket is safe for any verb (typical cause:
-            # the server closed an idle keep-alive connection)
-            self._drop_conn(conn)
-            if _retried:
-                raise
-            return self._request(method, path, body, headers, _retried=True)
-        try:
-            resp = conn.getresponse()
-            raw = resp.read()
-        except (OSError, http.client.HTTPException):
-            # response lost after the request went out: the server may
-            # have applied the mutation, so only idempotent reads retry
-            self._drop_conn(conn)
-            if _retried or method not in ("GET", "HEAD"):
-                raise
-            return self._request(method, path, body, headers, _retried=True)
-        data = json.loads(raw) if raw else None
-        if resp.status >= 400:
-            _raise_for(resp.status, data)
-        return data
+        start = time.monotonic()
+        attempts = 0
+        last_status: Optional[int] = None
+
+        def _wait_or_raise(message: str, retry_after=None, cause=None):
+            # decide between sleeping into the next attempt and raising
+            # the typed terminal error
+            if attempts >= policy.max_attempts:
+                raise ApiUnavailable(message, attempts, last_status) from cause
+            delay = policy.delay(attempts - 1, retry_after)
+            if time.monotonic() + delay > start + policy.budget_s:
+                raise ApiUnavailable(
+                    f"{message} (retry budget exhausted)", attempts, last_status
+                ) from cause
+            if delay > 0:
+                time.sleep(delay)
+
+        while True:
+            attempts += 1
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=payload, headers=hdrs)
+            except (OSError, http.client.HTTPException) as exc:
+                # send failed → the request never reached the server, so
+                # a retry on a fresh socket is safe for any verb (typical
+                # cause: the server closed an idle keep-alive connection,
+                # or a chaos reset/partition)
+                self._drop_conn(conn)
+                _wait_or_raise(f"{method} {path}: {exc}", cause=exc)
+                continue
+            try:
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (OSError, http.client.HTTPException) as exc:
+                # response lost after the request went out: the server
+                # may have applied the mutation, so only idempotent
+                # reads retry
+                self._drop_conn(conn)
+                if method not in ("GET", "HEAD"):
+                    raise ApiUnavailable(
+                        f"{method} {path}: response lost after send: {exc}",
+                        attempts,
+                        last_status,
+                    ) from exc
+                _wait_or_raise(f"{method} {path}: {exc}", cause=exc)
+                continue
+            if resp.status in policy.retry_statuses:
+                last_status = resp.status
+                ra = resp.getheader("Retry-After")
+                try:
+                    retry_after = float(ra) if ra else None
+                except ValueError:
+                    retry_after = None
+                _wait_or_raise(
+                    f"{method} {path}: HTTP {resp.status}", retry_after
+                )
+                continue
+            data = json.loads(raw) if raw else None
+            if resp.status >= 400:
+                _raise_for(resp.status, data)
+            return data
 
     @staticmethod
     def _q(**params) -> str:
@@ -545,8 +677,20 @@ class ClusterClient:
         )
         # watch connections idle between events; no read timeout
         conn = self._fresh_conn(timeout=None)
-        conn.request("GET", path, headers={"Accept": "application/json"})
-        resp = conn.getresponse()
+        hdrs = {"Accept": "application/json"}
+        if self.client_id:
+            hdrs["X-Kwok-Client"] = self.client_id
+        try:
+            conn.request("GET", path, headers=hdrs)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as exc:
+            # same typed terminal error as _request — watch setup has
+            # no retry loop of its own (the informer reflector owns it)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise ApiUnavailable(f"watch {plural}: {exc}", 1) from exc
         if resp.status >= 400:
             raw = resp.read()
             conn.close()
@@ -565,7 +709,12 @@ class ClusterClient:
 
     def healthy(self) -> bool:
         try:
-            return self._request("GET", "/healthz").get("status") == "ok"
+            # NO_RETRY: a health probe must answer fast; its caller owns
+            # the poll loop (wait_ready, the component supervisor)
+            return (
+                self._request("GET", "/healthz", retry=NO_RETRY).get("status")
+                == "ok"
+            )
         except Exception:  # noqa: BLE001 — health probe
             return False
 

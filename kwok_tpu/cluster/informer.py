@@ -143,6 +143,11 @@ class Informer:
         #: May lag a re-list briefly; excluding a stale (stopped)
         #: watcher is harmless and the echoes then flow normally.
         self.active_watcher = None
+        #: reflector self-metrics: full list+replace cycles vs. watch
+        #: streams resumed at the last delivered resourceVersion with
+        #: no re-list (the chaos e2e asserts recovery rides resumes)
+        self.relists = 0
+        self.resumes = 0
         # duck-typed remote stores (ClusterClient) have no copy kwarg
         import inspect
 
@@ -204,142 +209,64 @@ class Informer:
 
         def loop():
             backoff = 0.1
+            #: highest resourceVersion delivered to the consumer; a
+            #: dead stream reconnects from here (reflector resume)
+            #: instead of paying a full re-list — the re-list only
+            #: happens when the store answers Expired (history gap)
+            last_rv: Optional[int] = None
+            wkw = {}
+            if not opt.status_interest and self._watch_has_interest:
+                wkw["status_interest"] = False
             while not done.is_set():
-                try:
-                    items, rv = self._list(opt)
-                except Expired:
-                    continue
-                except Exception:  # noqa: BLE001 — transient apiserver outage
-                    # reflector retry-with-backoff: a dead apiserver must
-                    # not kill the watch thread (client-go reflectors
-                    # behave the same way)
-                    done.wait(backoff)
-                    backoff = min(backoff * 2, 5.0)
-                    continue
+                w = None
+                if last_rv is not None:
+                    try:
+                        w = self._store.watch(
+                            self._kind,
+                            namespace=opt.namespace,
+                            since_rv=last_rv,
+                            label_selector=opt.label_selector,
+                            field_selector=opt.field_selector,
+                            **wkw,
+                        )
+                        self.resumes += 1
+                    except Expired:
+                        # the gap outgrew the history ring (or the
+                        # store restarted past us): fall back to the
+                        # list+replace path below
+                        last_rv = None
+                    except Exception:  # noqa: BLE001 — apiserver outage
+                        done.wait(backoff)
+                        backoff = min(backoff * 2, 5.0)
+                        continue
+                if w is None:
+                    rv = self._relist_once(opt, events, getter, use_cache, seen)
+                    if rv is None:
+                        backoff = min(backoff * 2, 5.0)
+                        done.wait(backoff)
+                        continue
+                    try:
+                        w = self._store.watch(
+                            self._kind,
+                            namespace=opt.namespace,
+                            since_rv=rv,
+                            label_selector=opt.label_selector,
+                            field_selector=opt.field_selector,
+                            **wkw,
+                        )
+                    except Expired:
+                        continue
+                    except Exception:  # noqa: BLE001 — apiserver outage
+                        done.wait(backoff)
+                        backoff = min(backoff * 2, 5.0)
+                        continue
+                    last_rv = rv
                 backoff = 0.1
-                if not use_cache and opt.predicate is not None:
-                    fresh_keys = set()
-                    for obj in items:
-                        meta = obj.get("metadata") or {}
-                        fresh_keys.add(
-                            (meta.get("namespace") or "", meta.get("name") or "")
-                        )
-                    # objects that vanished (or left the predicate set)
-                    # during a watch gap must release their rows
-                    for key in seen - fresh_keys:
-                        events.add(
-                            InformerEvent(
-                                DELETED,
-                                {"metadata": {"namespace": key[0], "name": key[1]}},
-                            )
-                        )
-                    seen.clear()
-                    seen.update(fresh_keys)
-                if use_cache:
-                    # reconcile: reflector "replace" semantics. Objects
-                    # that vanished during a watch gap surface as DELETED;
-                    # unchanged objects are not re-emitted.
-                    fresh = {}
-                    for obj in items:
-                        meta = obj.get("metadata") or {}
-                        fresh[(meta.get("namespace") or "", meta.get("name") or "")] = obj
-                    for stale in getter.list():
-                        meta = stale.get("metadata") or {}
-                        key = (meta.get("namespace") or "", meta.get("name") or "")
-                        if key not in fresh:
-                            getter._apply(DELETED, stale)
-                            events.add(InformerEvent(DELETED, stale))
-                    for obj in items:
-                        meta = obj.get("metadata") or {}
-                        prev = getter.get(meta.get("name") or "", meta.get("namespace") or "")
-                        if prev is not None and prev.get("metadata", {}).get(
-                            "resourceVersion"
-                        ) == meta.get("resourceVersion"):
-                            continue
-                        getter._apply(ADDED, obj)
-                        events.add(
-                            InformerEvent(ADDED if prev is None else MODIFIED, obj)
-                        )
-                else:
-                    for obj in items:
-                        events.add(InformerEvent(ADDED, obj))
-                wkw = {}
-                if not opt.status_interest and self._watch_has_interest:
-                    wkw["status_interest"] = False
-                try:
-                    w = self._store.watch(
-                        self._kind,
-                        namespace=opt.namespace,
-                        since_rv=rv,
-                        label_selector=opt.label_selector,
-                        field_selector=opt.field_selector,
-                        **wkw,
-                    )
-                except Expired:
-                    continue
-                except Exception:  # noqa: BLE001 — transient apiserver outage
-                    done.wait(backoff)
-                    backoff = min(backoff * 2, 5.0)
-                    continue
                 self.active_watcher = w
                 try:
-                    while not done.is_set():
-                        ev = w.next(timeout=0.2)
-                        if ev is None:
-                            if w.stopped:
-                                # stream died underneath us (remote watch
-                                # connection lost): re-list and re-watch,
-                                # the reflector resume path
-                                break
-                            continue
-                        # drain everything already queued and forward it
-                        # as ONE batch: at device-drain rates the
-                        # per-event queue wakeups dominate this thread
-                        batch = [ev]
-                        batch.extend(w.drain())
-                        if opt.predicate is None and _FAST is not None:
-                            # native fast path: update the cache mirror
-                            # in one pass and forward the store events
-                            # as-is (WatchEvent and InformerEvent are
-                            # duck-compatible: .type/.object)
-                            if use_cache:
-                                with getter._mut:
-                                    _FAST.cache_apply(getter._items, batch)
-                            events.extend(batch)
-                            continue
-                        out = []
-                        cache_ops = []
-                        for ev in batch:
-                            obj = ev.object
-                            meta = obj.get("metadata") or {}
-                            key = (
-                                meta.get("namespace") or "",
-                                meta.get("name") or "",
-                            )
-                            if opt.predicate is not None and not opt.predicate(obj):
-                                # object left the predicate set: surface as
-                                # a delete so controllers stop managing it
-                                if use_cache:
-                                    if getter.get(key[1], key[0]):
-                                        cache_ops.append((DELETED, obj))
-                                        out.append(InformerEvent(DELETED, obj))
-                                elif key in seen:
-                                    seen.discard(key)
-                                    out.append(InformerEvent(DELETED, obj))
-                                continue
-                            if use_cache:
-                                cache_ops.append((ev.type, obj))
-                            elif opt.predicate is not None:
-                                if ev.type == DELETED:
-                                    seen.discard(key)
-                                else:
-                                    seen.add(key)
-                            out.append(InformerEvent(ev.type, obj))
-                        if cache_ops:
-                            getter._apply_batch(cache_ops)
-                        events.extend(out)
-                    # fall through: either done was set (outer loop exits)
-                    # or the stream died (outer loop re-lists + re-watches)
+                    last_rv = self._pump_stream(
+                        w, opt, events, done, getter, use_cache, seen, last_rv
+                    )
                 finally:
                     w.stop()
 
@@ -347,6 +274,136 @@ class Informer:
         t.start()
         self._threads.append(t)
         return getter
+
+    def _relist_once(self, opt, events, getter, use_cache, seen):
+        """One list+replace cycle (reflector "replace" semantics).
+        Returns the list's resourceVersion, or None on a transient
+        failure (caller backs off).  The rv travels by return value,
+        not instance state — one Informer may run several watch loops
+        (self._threads), and a shared attribute would let loop A
+        resume from loop B's newer rv, silently skipping events."""
+        try:
+            items, rv = self._list(opt)
+        except Exception:  # noqa: BLE001 — transient apiserver outage
+            # reflector retry-with-backoff: a dead apiserver must
+            # not kill the watch thread (client-go reflectors
+            # behave the same way)
+            return None
+        self.relists += 1
+        if not use_cache and opt.predicate is not None:
+            fresh_keys = set()
+            for obj in items:
+                meta = obj.get("metadata") or {}
+                fresh_keys.add(
+                    (meta.get("namespace") or "", meta.get("name") or "")
+                )
+            # objects that vanished (or left the predicate set)
+            # during a watch gap must release their rows
+            for key in seen - fresh_keys:
+                events.add(
+                    InformerEvent(
+                        DELETED,
+                        {"metadata": {"namespace": key[0], "name": key[1]}},
+                    )
+                )
+            seen.clear()
+            seen.update(fresh_keys)
+        if use_cache:
+            # reconcile: reflector "replace" semantics. Objects
+            # that vanished during a watch gap surface as DELETED;
+            # unchanged objects are not re-emitted.
+            fresh = {}
+            for obj in items:
+                meta = obj.get("metadata") or {}
+                fresh[(meta.get("namespace") or "", meta.get("name") or "")] = obj
+            for stale in getter.list():
+                meta = stale.get("metadata") or {}
+                key = (meta.get("namespace") or "", meta.get("name") or "")
+                if key not in fresh:
+                    getter._apply(DELETED, stale)
+                    events.add(InformerEvent(DELETED, stale))
+            for obj in items:
+                meta = obj.get("metadata") or {}
+                prev = getter.get(meta.get("name") or "", meta.get("namespace") or "")
+                if prev is not None and prev.get("metadata", {}).get(
+                    "resourceVersion"
+                ) == meta.get("resourceVersion"):
+                    continue
+                getter._apply(ADDED, obj)
+                events.add(
+                    InformerEvent(ADDED if prev is None else MODIFIED, obj)
+                )
+        else:
+            for obj in items:
+                events.add(InformerEvent(ADDED, obj))
+        return rv
+
+    def _pump_stream(
+        self, w, opt, events, done, getter, use_cache, seen, last_rv
+    ):
+        """Forward one live watch stream until it dies or ``done`` is
+        set; returns the highest delivered resourceVersion so the outer
+        loop can resume there."""
+        while not done.is_set():
+            ev = w.next(timeout=0.2)
+            if ev is None:
+                if w.stopped:
+                    # stream died underneath us (remote watch
+                    # connection lost, chaos drop): the outer loop
+                    # resumes at last_rv, re-listing only on Expired
+                    break
+                continue
+            # drain everything already queued and forward it
+            # as ONE batch: at device-drain rates the
+            # per-event queue wakeups dominate this thread
+            batch = [ev]
+            batch.extend(w.drain())
+            for bev in batch:
+                brv = getattr(bev, "rv", 0) or 0
+                if last_rv is None or brv > last_rv:
+                    last_rv = brv
+            if opt.predicate is None and _FAST is not None:
+                # native fast path: update the cache mirror
+                # in one pass and forward the store events
+                # as-is (WatchEvent and InformerEvent are
+                # duck-compatible: .type/.object)
+                if use_cache:
+                    with getter._mut:
+                        _FAST.cache_apply(getter._items, batch)
+                events.extend(batch)
+                continue
+            out = []
+            cache_ops = []
+            for ev in batch:
+                obj = ev.object
+                meta = obj.get("metadata") or {}
+                key = (
+                    meta.get("namespace") or "",
+                    meta.get("name") or "",
+                )
+                if opt.predicate is not None and not opt.predicate(obj):
+                    # object left the predicate set: surface as
+                    # a delete so controllers stop managing it
+                    if use_cache:
+                        if getter.get(key[1], key[0]):
+                            cache_ops.append((DELETED, obj))
+                            out.append(InformerEvent(DELETED, obj))
+                    elif key in seen:
+                        seen.discard(key)
+                        out.append(InformerEvent(DELETED, obj))
+                    continue
+                if use_cache:
+                    cache_ops.append((ev.type, obj))
+                elif opt.predicate is not None:
+                    if ev.type == DELETED:
+                        seen.discard(key)
+                    else:
+                        seen.add(key)
+                out.append(InformerEvent(ev.type, obj))
+            if cache_ops:
+                getter._apply_batch(cache_ops)
+            events.extend(out)
+        return last_rv
 
     def watch_with_cache(
         self, opt: WatchOptions, events: Queue, done: Optional[threading.Event] = None

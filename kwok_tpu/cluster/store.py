@@ -339,14 +339,17 @@ class Watcher:
         while True:
             try:
                 return self._events.popleft()
-            except IndexError:
+            # IndexError IS the empty-queue signal on a lock-free deque
+            # pop — nothing was dropped, the wait below handles it
+            except IndexError:  # kwoklint: disable=swallowed-errors
                 pass
             if self._stopped.is_set():
                 return None
             self._signal.clear()
             try:
                 return self._events.popleft()
-            except IndexError:
+            # same empty-probe idiom as above
+            except IndexError:  # kwoklint: disable=swallowed-errors
                 pass
             if not self._signal.wait(timeout):
                 return None
@@ -423,6 +426,9 @@ class _LaneGrant:
                 return None
             if (
                 self.exclude is None
+                # a WAL cannot observe statuses spliced in place — with
+                # durability on, status batches take the logging lanes
+                or store._wal is not None
                 or any(p.startswith("status.") for p in st.indexes)
                 or any(
                     w is not self.exclude
@@ -506,6 +512,20 @@ class ResourceStore:
         self._mut = threading.RLock()
         self._rv = 0
         self._uid = 0
+        #: durability hooks (kwok_tpu.cluster.wal): None keeps every
+        #: mutation path WAL-free (the in-process/bench posture); the
+        #: apiserver daemon attaches a log via attach_wal
+        self._wal = None
+        #: per-thread WAL deferral buffer for the bulk lane (_wal_put)
+        self._wal_local = threading.local()
+        #: chaos crash point (kwok_tpu.chaos): called with a phase name
+        #: at commit boundaries; a hook that raises simulates a process
+        #: dying before/after the commit became durable
+        self._crash_hook: Optional[Callable[[str], None]] = None
+        #: resourceVersions at/below this predate the history ring
+        #: (snapshot boot or state restore): a watch resume from below
+        #: gets Expired and re-lists instead of silently missing events
+        self._history_floor = 0
         self._types: Dict[str, _TypeState] = {}
         #: (verb, key, as_user); bounded — at device-drain rates an
         #: unbounded list is a slow memory leak
@@ -516,6 +536,55 @@ class ResourceStore:
         # and pod controller list pods by node on every scrape/sync
         self.register_index("Pod", "spec.nodeName")
 
+    # -------------------------------------------------------------- durability
+
+    def attach_wal(self, wal) -> None:
+        """Attach a :class:`kwok_tpu.cluster.wal.WriteAheadLog`: every
+        subsequent committed mutation is appended (under the store
+        mutex, so records land in commit order) before watchers see its
+        event — except inside :meth:`bulk`, which defers its records
+        into one batched write landed before the *ack* but after the
+        per-op events; a watcher that got ahead of a crash in that
+        window is healed by the future-rv Expired in :meth:`watch`.
+        ``save_file`` compacts the log behind each snapshot.  Attaching
+        disables the zero-copy status lane — spliced-in-place statuses
+        would bypass the log."""
+        with self._mut:
+            self._wal = wal
+
+    def set_crash_hook(self, hook: Optional[Callable[[str], None]]) -> None:
+        """Install a chaos crash point: ``hook(phase)`` runs at
+        ``before-commit`` (nothing mutated yet) and ``after-commit``
+        (object + WAL record committed, ack not yet sent) on the
+        single-object mutation paths.  A hook that raises leaves the
+        store exactly as a crash at that boundary would."""
+        with self._mut:
+            self._crash_hook = hook
+
+    def _commit_point(self, phase: str) -> None:
+        hook = self._crash_hook
+        if hook is not None:
+            hook(phase)
+
+    def _wal_put(self, rec: dict) -> None:
+        """Write one WAL record — or buffer it when this thread is
+        inside a deferring batch (``bulk``), which flushes the whole
+        run with one ``append_many``.  Deferral can interleave this
+        thread's records after another thread's direct ones in the
+        file, so replay orders by rv, not file position."""
+        buf = getattr(self._wal_local, "buf", None)
+        if buf is not None:
+            buf.append(rec)
+        else:
+            self._wal.append(rec)
+
+    def _wal_event(self, etype: str, obj: dict, rv: int) -> None:
+        """Append one committed mutation; caller holds the mutex and
+        has already checked ``self._wal is not None``."""
+        self._wal_put(
+            {"t": "ev", "rv": rv, "u": self._uid, "e": etype, "o": obj}
+        )
+
     # ------------------------------------------------------------------ registry
 
     def register_type(self, rtype: ResourceType) -> None:
@@ -525,6 +594,17 @@ class ResourceStore:
                 self._types[key] = _TypeState(
                     rtype=rtype, history=deque(maxlen=self.HISTORY)
                 )
+                if self._wal is not None:
+                    self._wal_put(
+                        {
+                            "t": "type",
+                            "rv": self._rv,
+                            "api_version": rtype.api_version,
+                            "kind": rtype.kind,
+                            "plural": rtype.plural,
+                            "namespaced": rtype.namespaced,
+                        }
+                    )
             self._types[rtype.plural.lower()] = self._types[key]
 
     def register_index(self, kind: str, path: str) -> None:
@@ -648,9 +728,13 @@ class ResourceStore:
                 # controllers echo it back as status.observedGeneration
                 meta.setdefault("generation", 1)
             self._audit.append(("create", f"{kind}:{key}", as_user))
+            self._commit_point("before-commit")
             rv = self._bump(obj)
             st.objects[key] = obj
             self._index_update(st, key, None, obj)
+            if self._wal is not None:
+                self._wal_event(ADDED, obj, rv)
+            self._commit_point("after-commit")
             self._emit(st, ADDED, obj, rv)
             return obj if not copy_result else copy_json(obj)
 
@@ -1073,15 +1157,22 @@ class ResourceStore:
                 meta["generation"] = int(old_gen or 0) + 1
             elif old_gen is not None:
                 meta["generation"] = old_gen
+        self._commit_point("before-commit")
         if meta.get("deletionTimestamp") is not None and not meta.get("finalizers"):
             rv = self._bump(new)
             del st.objects[key]
             self._index_update(st, key, old, None)
+            if self._wal is not None:
+                self._wal_event(DELETED, new, rv)
+            self._commit_point("after-commit")
             self._emit(st, DELETED, new, rv)
             return new if not copy_result else copy_json(new)
         rv = self._bump(new)
         st.objects[key] = new
         self._index_update(st, key, old, new)
+        if self._wal is not None:
+            self._wal_event(MODIFIED, new, rv)
+        self._commit_point("after-commit")
         self._emit(st, MODIFIED, new, rv)
         return new if not copy_result else copy_json(new)
 
@@ -1108,16 +1199,23 @@ class ResourceStore:
             # them out by reference) — never mutate one in place
             cur = dict(cur)
             meta = cur["metadata"] = dict(cur.get("metadata") or {})
+            self._commit_point("before-commit")
             if meta.get("finalizers"):
                 if meta.get("deletionTimestamp") is None:
                     meta["deletionTimestamp"] = self._now_string()
                     rv = self._bump(cur)
                     st.objects[key] = cur
+                    if self._wal is not None:
+                        self._wal_event(MODIFIED, cur, rv)
+                    self._commit_point("after-commit")
                     self._emit(st, MODIFIED, cur, rv)
                 return cur if not copy_result else copy_json(cur)
             rv = self._bump(cur)
             del st.objects[key]
             self._index_update(st, key, cur, None)
+            if self._wal is not None:
+                self._wal_event(DELETED, cur, rv)
+            self._commit_point("after-commit")
             self._emit(st, DELETED, cur, rv)
             return None
 
@@ -1153,7 +1251,25 @@ class ResourceStore:
                 ),
                 status_interest=status_interest,
             )
+            if since_rv is not None and since_rv > self._rv:
+                # a resume from the future means the store lost state
+                # this consumer already observed (crash between a bulk
+                # batch's event emission and its WAL append is the one
+                # such window) — Expired forces the re-list that heals
+                # the divergence instead of silently diverging forever
+                raise Expired(
+                    f"resourceVersion {since_rv} is ahead of the store "
+                    f"({self._rv}); state rolled back across a restart"
+                )
             if since_rv is not None and since_rv < self._rv:
+                if since_rv < self._history_floor:
+                    # the ring predates this version entirely (snapshot
+                    # boot / state restore): same answer as a too-small
+                    # watch cache — Expired, consumer re-lists
+                    raise Expired(
+                        f"resourceVersion {since_rv} predates the store's "
+                        f"history floor {self._history_floor}"
+                    )
                 if since_rv < st.inplace_rv and status_interest:
                     # the zero-copy lane left a gap below this version.
                     # Yield the lane for a while so this consumer's
@@ -1212,6 +1328,7 @@ class ResourceStore:
             if (
                 _FAST is not None
                 and not status_indexed
+                and self._wal is None  # in-place splices bypass the log
                 and exclude is not None
                 and all(
                     w is exclude or w.stopped or not w.status_interest
@@ -1245,6 +1362,8 @@ class ResourceStore:
                     self._audit.append(
                         ("patch-status-batch", f"{kind}:{len(evs)}", None)
                     )
+                    if self._wal is not None:
+                        self._wal_status_batch(kind, items, out)
                     for w in list(st.watchers):
                         if w is not exclude and w.status_interest:
                             w._push_batch(evs)
@@ -1277,10 +1396,25 @@ class ResourceStore:
                 self._audit.append(
                     ("patch-status-batch", f"{kind}:{len(evs)}", None)
                 )
+                if self._wal is not None:
+                    self._wal_status_batch(kind, items, out)
                 for w in list(st.watchers):
                     if w is not exclude and w.status_interest:
                         w._push_batch(evs)
             return out
+
+    def _wal_status_batch(self, kind: str, items, out) -> None:
+        """One WAL record for a whole status batch; caller holds the
+        mutex.  ``items``/``out`` align per apply_status_batch."""
+        pairs = [
+            [ns, name, status, res[0]]
+            for (ns, name, status), res in zip(items, out)
+            if res is not None
+        ]
+        if pairs:
+            self._wal_put(
+                {"t": "status", "rv": pairs[-1][3], "k": kind, "i": pairs}
+            )
 
     def status_lane(self, kind: str, exclude: Optional[Watcher]):
         """Grant the caller the zero-copy status-commit lane for one
@@ -1357,6 +1491,27 @@ class ResourceStore:
                 )
             )
         results: List[dict] = []
+        # defer this thread's WAL records and land the whole batch with
+        # one write+flush — per-op flushes were the WAL's only
+        # measurable cost at device-drain rates
+        defer_wal = self._wal is not None
+        if defer_wal:
+            self._wal_local.buf = []
+        try:
+            self._bulk_ops(ops, results, copy_results)
+        finally:
+            if defer_wal:
+                buf = self._wal_local.buf
+                self._wal_local.buf = None
+                # every WAL file op happens under the store mutex —
+                # append_many must not race save_file's compact (which
+                # closes and reopens the log file)
+                with self._mut:
+                    if self._wal is not None:
+                        self._wal.append_many(buf)
+        return results
+
+    def _bulk_ops(self, ops, results, copy_results) -> None:
         for op in ops:
             try:
                 verb = op.get("verb")
@@ -1402,7 +1557,6 @@ class ResourceStore:
                 results.append(
                     {"status": "error", "reason": "Invalid", "error": str(exc)}
                 )
-        return results
 
     # -------------------------------------------------------------- persistence
 
@@ -1466,16 +1620,159 @@ class ResourceStore:
                 self._index_update(st, key, old, obj)
                 self._emit(st, ADDED, obj, self._rv)
                 n += 1
+            # a restore behaves like a fresh re-list: resumes from
+            # before it are answered with Expired, not a partial replay
+            self._history_floor = self._rv
+            if self._wal is not None:
+                # the log's old coverage is superseded wholesale; make
+                # the restored keyspace itself durable so a crash before
+                # the next snapshot cannot roll it back
+                self._wal.reset()
+                self._wal.append({"t": "reset", "rv": self._rv})
+                for rt in self.kinds():
+                    self._wal.append(
+                        {
+                            "t": "type",
+                            "rv": self._rv,
+                            "api_version": rt.api_version,
+                            "kind": rt.kind,
+                            "plural": rt.plural,
+                            "namespaced": rt.namespaced,
+                        }
+                    )
+                for rt in self.kinds():
+                    st = self._state(rt.kind)
+                    for obj in st.objects.values():
+                        self._wal_event(ADDED, obj, self._rv)
+                self._wal.sync()
             return n
 
     def save_file(self, path: str) -> None:
-        atomic_write_json(path, self.dump_state())
+        state = self.dump_state()
+        atomic_write_json(path, state)
+        # records at/below the snapshot's rv are now covered twice;
+        # drop them (crash mid-compact keeps the old complete log).
+        # Under the store mutex: compact closes and reopens the log
+        # file, and appends (which all hold the mutex) must never hit
+        # the closed handle.  Mutations that landed between dump_state
+        # and here have rv above the snapshot and are kept.
+        with self._mut:
+            if self._wal is not None:
+                self._wal.compact(int(state["resourceVersion"]))
 
     def load_file(self, path: str) -> int:
         import json as _json
 
         with open(path, "r", encoding="utf-8") as f:
-            return self.restore_state(_json.load(f))
+            n = self.restore_state(_json.load(f))
+        return n
+
+    def replay_wal(self, path: str) -> int:
+        """Boot-time crash recovery: apply WAL records beyond the
+        already-loaded snapshot (call after :meth:`load_file`, before
+        :meth:`attach_wal` and before serving).  Replayed events also
+        repopulate the watch-history ring, so informers that were
+        mid-watch when the process died resume at their last
+        resourceVersion through the ordinary reflector path instead of
+        re-listing; resumes from below the replay window still get
+        Expired via the history floor.  Returns the number of applied
+        records."""
+        from kwok_tpu.cluster.wal import read_records
+
+        n = 0
+        with self._mut:
+            floor = self._rv
+            # rv order, not file order: the bulk lane's deferred batch
+            # write can interleave after another thread's direct
+            # records in the file (stable sort keeps same-rv runs —
+            # e.g. a restore dump — in their written order)
+            records = sorted(
+                read_records(path), key=lambda r: int(r.get("rv", 0))
+            )
+            for rec in records:
+                t = rec.get("t")
+                if t == "type":
+                    self.register_type(
+                        ResourceType(
+                            api_version=rec["api_version"],
+                            kind=rec["kind"],
+                            plural=rec["plural"],
+                            namespaced=bool(rec.get("namespaced", True)),
+                        )
+                    )
+                    continue
+                if t == "reset":
+                    # a state restore wiped the keyspace after the
+                    # snapshot this boot loaded — start from empty and
+                    # apply everything that follows
+                    for rt in self.kinds():
+                        st = self._state(rt.kind)
+                        for key, old in list(st.objects.items()):
+                            del st.objects[key]
+                            self._index_update(st, key, old, None)
+                    floor = -1
+                    self._rv = max(self._rv, int(rec.get("rv", 0)))
+                    # resumes from before the restore point are stale
+                    self._history_floor = max(
+                        self._history_floor, int(rec.get("rv", 0))
+                    )
+                    n += 1
+                    continue
+                rv = int(rec.get("rv", 0))
+                if rv <= floor:
+                    continue  # the snapshot already covers this record
+                if t == "ev":
+                    self._replay_event(rec)
+                    n += 1
+                elif t == "status":
+                    self._replay_status(rec)
+                    n += 1
+            self._history_floor = max(self._history_floor, max(floor, 0))
+        return n
+
+    def _replay_event(self, rec: dict) -> None:
+        obj = rec["o"]
+        etype = rec["e"]
+        rv = int(rec["rv"])
+        try:
+            st = self._state(obj.get("kind") or "")
+        except NotFound:
+            return  # type record lost to a torn tail; object is too
+        key = self._key(st, obj)
+        old = st.objects.get(key)
+        if etype == DELETED:
+            if old is not None:
+                del st.objects[key]
+                self._index_update(st, key, old, None)
+        else:
+            st.objects[key] = obj
+            self._index_update(st, key, old, obj)
+        self._rv = max(self._rv, rv)
+        self._uid = max(self._uid, int(rec.get("u", 0)))
+        # no watchers exist at boot: append to history only, so later
+        # watch(since_rv=...) resumes replay it
+        st.history.append(WatchEvent(type=etype, object=obj, rv=rv))
+
+    def _replay_status(self, rec: dict) -> None:
+        try:
+            st = self._state(rec["k"])
+        except NotFound:
+            return
+        namespaced = st.rtype.namespaced
+        for ns, name, status, rv in rec["i"]:
+            key = ((ns or "default") if namespaced else "", name)
+            cur = st.objects.get(key)
+            if cur is None:
+                continue
+            new = dict(cur)
+            new["status"] = status
+            nm = dict(cur["metadata"])
+            nm["resourceVersion"] = str(rv)
+            new["metadata"] = nm
+            st.objects[key] = new
+            self._index_update(st, key, cur, new)
+            st.history.append(WatchEvent(type=MODIFIED, object=new, rv=int(rv)))
+            self._rv = max(self._rv, int(rv))
 
     # -------------------------------------------------------------------- stats
 

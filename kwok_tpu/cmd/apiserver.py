@@ -4,7 +4,12 @@ The binary runtime's stand-in for etcd + kube-apiserver (reference
 runtime/binary/cluster.go:316-420 starts both; our store folds the
 pair into one process).  State persists to ``--state-file`` as the
 etcd-snapshot analog: loaded on boot, written on SIGTERM and every
-``--save-interval`` seconds.
+``--save-interval`` seconds.  ``--wal-file`` adds the etcd-WAL seat
+(``kwok_tpu.cluster.wal``): every acked mutation is logged between
+snapshots and replayed on boot, so a crashed daemon loses nothing and
+restarted watch streams resume without re-lists.  ``--chaos-profile``
+arms the HTTP fault injector (``kwok_tpu.chaos``) from a seeded
+profile — latency/429/503/resets/watch-drops at this boundary.
 """
 
 from __future__ import annotations
@@ -25,6 +30,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=2718)
     p.add_argument("--state-file", default="", help="persist store state here")
     p.add_argument("--save-interval", type=float, default=10.0)
+    p.add_argument(
+        "--wal-file",
+        default="",
+        help="write-ahead log for crash durability between snapshots",
+    )
+    p.add_argument(
+        "--wal-fsync",
+        choices=["always", "interval", "off"],
+        default="interval",
+        help="WAL fsync policy (process-crash safety needs none of "
+        "them; machine-crash safety wants 'always')",
+    )
+    p.add_argument(
+        "--chaos-profile",
+        default="",
+        help="arm the HTTP fault injector from this seeded profile YAML",
+    )
     p.add_argument("--tls-cert", default="")
     p.add_argument("--tls-key", default="")
     p.add_argument("--client-ca", default="")
@@ -49,6 +71,33 @@ def main(argv=None) -> int:
     if args.state_file and os.path.exists(args.state_file):
         n = store.load_file(args.state_file)
         print(f"restored {n} objects from {args.state_file}", flush=True)
+    if args.wal_file:
+        # order matters: replay what the last process crashed on, THEN
+        # attach for appending — the log keeps covering its records
+        # until a snapshot compacts them
+        from kwok_tpu.cluster.wal import WriteAheadLog
+
+        if os.path.exists(args.wal_file):
+            n = store.replay_wal(args.wal_file)
+            if n:
+                print(
+                    f"replayed {n} WAL records from {args.wal_file} "
+                    f"(rv {store.resource_version})",
+                    flush=True,
+                )
+        store.attach_wal(WriteAheadLog(args.wal_file, fsync=args.wal_fsync))
+
+    injector = None
+    if args.chaos_profile:
+        from kwok_tpu.chaos import HttpFaultInjector, load_profile
+
+        plan = load_profile(args.chaos_profile)
+        injector = HttpFaultInjector(plan)
+        print(
+            f"chaos: HTTP fault injection armed (seed={plan.seed}, "
+            f"duration={plan.duration}s)",
+            flush=True,
+        )
 
     srv = APIServer(
         store,
@@ -59,6 +108,7 @@ def main(argv=None) -> int:
         client_ca=args.client_ca or None,
         audit_path=args.audit_file or None,
         kubelet_url=args.kubelet_url or None,
+        fault_injector=injector,
     )
     srv.start()
     print(f"apiserver listening on {srv.url}", flush=True)
@@ -79,6 +129,8 @@ def main(argv=None) -> int:
     if args.state_file and store.resource_version != saved_rv:
         store.save_file(args.state_file)
     srv.stop()
+    if injector is not None:
+        print(f"chaos: injected faults {injector.snapshot()}", flush=True)
     return 0
 
 

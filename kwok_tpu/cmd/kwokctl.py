@@ -76,6 +76,7 @@ def cmd_create_cluster(args) -> int:
         config_paths=args.config,
         controller_args=args.controller_arg,
         enable_tracing=args.enable_tracing,
+        chaos_profile=args.chaos_profile or None,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -1285,6 +1286,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="run the trace collector component and point every "
         "component's tracer at it (the jaeger seat)",
+    )
+    c.add_argument(
+        "--chaos-profile",
+        default="",
+        help="arm apiserver HTTP fault injection from this seeded "
+        "profile YAML (see kwok_tpu.chaos; python -m kwok_tpu.chaos "
+        "drives the process-fault layer)",
     )
     c.add_argument("--wait", type=float, default=60.0)
     c.set_defaults(fn=cmd_create_cluster)

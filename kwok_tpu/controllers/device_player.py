@@ -34,12 +34,15 @@ from kwok_tpu.engine.render_plan import build as _plan_build
 from kwok_tpu.engine.simulator import DEFAULT_EPOCH, DeviceSimulator, Transition
 from kwok_tpu.native.fastdrain import load as _load_fastdrain
 from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.log import get_logger
 from kwok_tpu.utils.patch import apply_merge_patch as _merge_patch
 from kwok_tpu.utils.patch import is_noop_patch
 from kwok_tpu.utils.queue import Queue
 
 # drain accelerator (native/kwok_fastdrain.c); None -> pure Python
 _FAST = _load_fastdrain()
+
+_LOG = get_logger("device-player")
 
 #: live players for the interpreter-exit safety net: a daemon tick
 #: thread killed mid-XLA-dispatch at teardown aborts the whole process
@@ -277,8 +280,8 @@ class DeviceStagePlayer:
         # covers callers driving step_pipelined by hand around a stop
         try:
             self.flush_pipeline()
-        except Exception:  # noqa: BLE001 — best effort at shutdown
-            pass
+        except Exception as exc:  # noqa: BLE001 — best effort at shutdown
+            _LOG.debug("final pipeline flush failed at shutdown", error=exc)
 
     def _grow_row_arrays(self) -> None:
         """Keep the row-indexed caches sized to the SoA capacity (the

@@ -6,12 +6,16 @@
 from __future__ import annotations
 
 import ipaddress
-import random
 import threading
 from dataclasses import dataclass, field
 from typing import Optional, Set
 
 from kwok_tpu.engine.lifecycle import CompiledStage
+
+# canonical implementation moved to utils (layer 0) so cluster/client's
+# RetryPolicy shares the same schedule; re-exported here because
+# controller code historically imports it from this module
+from kwok_tpu.utils.backoff import Backoff  # noqa: F401
 
 
 class IPPool:
@@ -69,23 +73,6 @@ class IPPool:
 
 
 @dataclass
-class Backoff:
-    """Capped exponential backoff with jitter
-    (reference utils.go:133-143 defaultBackoff/backoffDelayByStep:
-    1s × 2ⁿ, jitter 0.2, cap 32 min)."""
-
-    duration: float = 1.0
-    factor: float = 2.0
-    jitter: float = 0.2
-    cap: float = 32 * 60.0
-
-    def delay(self, steps: int, rng: Optional[random.Random] = None) -> float:
-        d = min(self.duration * (self.factor**steps), self.cap)
-        r = (rng or random).random()
-        return d * (1.0 + self.jitter * r)
-
-
-@dataclass
 class StageJob:
     """One queued transition (reference utils.go:123-130
     resourceStageJob[T])."""
@@ -106,7 +93,11 @@ class StageJob:
 
 def should_retry(err: Exception) -> bool:
     """Retry only connection/timeout-ish failures (utils.go:146-160).
-    The in-process store can only fail transiently on Conflict."""
+    The in-process store can only fail transiently on Conflict; the
+    REST client surfaces exhausted transport retries as the typed
+    ApiUnavailable, which is transient by definition (the stage retry
+    backoff then spaces out the next attempt)."""
+    from kwok_tpu.cluster.client import ApiUnavailable
     from kwok_tpu.cluster.store import Conflict
 
-    return isinstance(err, (ConnectionError, TimeoutError, Conflict))
+    return isinstance(err, (ConnectionError, TimeoutError, Conflict, ApiUnavailable))

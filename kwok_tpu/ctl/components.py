@@ -58,6 +58,7 @@ def build_apiserver_component(
     secure: bool = False,
     pki_dir: Optional[str] = None,
     kubelet_port: Optional[int] = None,
+    chaos_profile: Optional[str] = None,
 ) -> Component:
     """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
     args = [
@@ -70,9 +71,15 @@ def build_apiserver_component(
         str(port),
         "--state-file",
         os.path.join(workdir, "state.json"),
+        # etcd-WAL seat: snapshot + log together make every acked write
+        # survive a crash (and the supervisor's restart resume watches)
+        "--wal-file",
+        os.path.join(workdir, "wal.jsonl"),
         "--audit-file",
         os.path.join(workdir, "logs", "audit.log"),
     ]
+    if chaos_profile:
+        args += ["--chaos-profile", chaos_profile]
     if kubelet_port:
         # pod log/exec subresources proxy to the fake kubelet, like a
         # real apiserver proxies to the node (server debugging.go:36-102)
@@ -227,6 +234,7 @@ def build_core_components(
     config_paths: Optional[List[str]] = None,
     backend: str = "host",
     extra_args: Optional[List[str]] = None,
+    chaos_profile: Optional[str] = None,
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
@@ -240,6 +248,7 @@ def build_core_components(
             secure=secure,
             pki_dir=pki_dir,
             kubelet_port=kubelet_port,
+            chaos_profile=chaos_profile,
         ),
         build_scheduler_component(server_url, secure=secure, pki_dir=pki_dir),
         build_kcm_component(server_url, secure=secure, pki_dir=pki_dir),

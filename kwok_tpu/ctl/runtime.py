@@ -23,16 +23,19 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import shutil
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional
 
 import yaml
 
 from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.utils.backoff import Backoff
 from kwok_tpu.ctl.components import (
     Component,
     build_core_components,
@@ -106,6 +109,7 @@ class BinaryRuntime:
         config_paths: Optional[List[str]] = None,
         controller_args: Optional[List[str]] = None,
         enable_tracing: bool = False,
+        chaos_profile: Optional[str] = None,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -138,6 +142,16 @@ class BinaryRuntime:
                 shutil.copyfile(src, dst)
             stored_paths.append(dst)
 
+        stored_chaos: Optional[str] = None
+        if chaos_profile:
+            # copied like user configs, so the cluster dir stays
+            # self-contained and restarts re-arm the same seeded plan
+            stored_chaos = self._path("chaos-profile.yaml")
+            if dry_run.enabled:
+                dry_run.emit(f"cp {chaos_profile} {stored_chaos}")
+            else:
+                shutil.copyfile(chaos_profile, stored_chaos)
+
         components = build_core_components(
             self.workdir,
             server_url,
@@ -148,6 +162,7 @@ class BinaryRuntime:
             config_paths=stored_paths,
             backend=backend,
             extra_args=controller_args,
+            chaos_profile=stored_chaos,
         )
         tracing_port = 0
         if enable_tracing:
@@ -173,6 +188,8 @@ class BinaryRuntime:
         }
         if tracing_port:
             conf["ports"]["tracing"] = tracing_port
+        if stored_chaos:
+            conf["chaosProfile"] = stored_chaos
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:
@@ -209,9 +226,25 @@ class BinaryRuntime:
             return False
         try:
             os.kill(pid, 0)
-            return True
         except OSError:
             return False
+        # signal 0 also succeeds on zombies: a SIGKILLed component whose
+        # parent (an in-process runtime embedder, e.g. the test suite)
+        # has not reaped it yet would read as alive forever — and the
+        # supervisor would never restart it.  /proc state Z is dead for
+        # every practical purpose; reap it here when it is our child.
+        try:
+            with open(f"/proc/{pid}/stat", "r", encoding="ascii") as f:
+                state = f.read().rsplit(")", 1)[-1].split()
+            if state and state[0] == "Z":
+                try:
+                    os.waitpid(pid, os.WNOHANG)
+                except (ChildProcessError, OSError):
+                    pass
+                return False
+        except (OSError, IndexError, ValueError):
+            pass  # no /proc (non-Linux): keep the signal-0 answer
+        return True
 
     def start_component(self, comp: Component) -> None:
         """(reference binary runtime forks via os/exec, logging to files)"""
@@ -223,6 +256,9 @@ class BinaryRuntime:
         log = open(self._path("logs", f"{comp.name}.log"), "ab")
         env = dict(os.environ)
         env.update(comp.env)
+        # the daemon's ClusterClient stamps this as X-Kwok-Client, so
+        # chaos partitions (and debug tooling) can target one component
+        env.setdefault("KWOK_COMPONENT_NAME", comp.name)
         # daemons import kwok_tpu regardless of the caller's cwd
         pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         pkg_root = os.path.dirname(pkg_parent)
@@ -246,6 +282,29 @@ class BinaryRuntime:
     def stop_component(self, name: str, timeout: float = 10.0) -> None:
         self._signal_component(name)
         self._await_component_exit(name, timeout)
+
+    def component_alive(self, name: str) -> bool:
+        """True when the component's recorded pid answers signal 0
+        (includes SIGSTOPped processes — paused is not dead)."""
+        return self._alive(self._pid(name))
+
+    def signal_component(self, name: str, sig: int) -> bool:
+        """Deliver a raw signal to a component (the chaos process-fault
+        lane: SIGKILL / SIGSTOP / SIGCONT).  Unlike stop_component this
+        neither waits nor removes the pidfile — a SIGKILLed component
+        stays visible as dead, which is exactly what the supervisor
+        keys on.  Returns False when no live pid was found."""
+        if dry_run.enabled:
+            dry_run.emit(f"kill -{sig} {name}")
+            return True
+        pid = self._pid(name)
+        if not self._alive(pid):
+            return False
+        try:
+            os.kill(pid, sig)
+            return True
+        except OSError:
+            return False
 
     def _signal_component(self, name: str) -> None:
         if dry_run.enabled:
@@ -420,3 +479,139 @@ class BinaryRuntime:
             return ""
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             return f.read()
+
+
+class ComponentSupervisor:
+    """Probe components and restart crashed ones — the seat a real
+    deployment fills with systemd/kubelet restart policy (reference
+    runtime/config.go:30-147 exposes per-component Start/Stop but
+    nothing watches them; a dead component simply stayed dead here
+    too, until this loop).
+
+    - **probe**: pid liveness each ``poll_interval``; the apiserver
+      additionally must answer /healthz after a restart before it
+      counts as recovered (a bound process that cannot serve is still
+      down).  SIGSTOPped components look alive — pausing is the chaos
+      plan's business, not ours to "fix".
+    - **restart with backoff**: per-component jittered exponential
+      backoff (shared :class:`kwok_tpu.utils.backoff.Backoff`; the rng
+      is explicit so a seeded chaos run replays the same schedule).
+    - **crash-loop detection**: more than ``crash_loop_threshold``
+      restarts inside ``crash_loop_window`` seconds parks the
+      component (no further restarts) and records a ``crash-loop``
+      event — flapping forever is worse than staying down loudly.
+    - **self-metrics**: ``events`` (timestamped action log),
+      ``recovery_times`` (death-detected → serving again, seconds) —
+      the chaos e2e asserts recovery time is bounded from these.
+    """
+
+    def __init__(
+        self,
+        runtime: "BinaryRuntime",
+        poll_interval: float = 0.25,
+        backoff: Optional[Backoff] = None,
+        crash_loop_threshold: int = 5,
+        crash_loop_window: float = 30.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.runtime = runtime
+        self.poll_interval = poll_interval
+        self.backoff = backoff or Backoff(duration=0.25, cap=5.0)
+        self.crash_loop_threshold = crash_loop_threshold
+        self.crash_loop_window = crash_loop_window
+        self.rng = rng or random.Random()
+        self.events: List[dict] = []
+        self.recovery_times: List[float] = []
+        self.crash_looped: set = set()
+        self._restart_times: Dict[str, List[float]] = {}
+        self._death_time: Dict[str, float] = {}
+        self._restart_due: Dict[str, float] = {}
+        self._client: Optional[ClusterClient] = None
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ComponentSupervisor":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop supervising (call BEFORE runtime.down(), or the
+        supervisor resurrects what down() is killing)."""
+        self._done.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._done.wait(self.poll_interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — a probe hiccup (e.g. the
+                # cluster dir vanishing mid-read during delete) must not
+                # kill the supervision loop; next tick re-reads
+                continue
+
+    # ----------------------------------------------------------------- probe
+
+    def _serving(self, name: str) -> bool:
+        """Process-alive, plus /healthz for the apiserver (serving is
+        the bar for 'recovered', not just forked)."""
+        if not self.runtime.component_alive(name):
+            return False
+        if name != "apiserver":
+            return True
+        if self._client is None:
+            try:
+                self._client = self.runtime.client(timeout=2.0)
+            except (OSError, KeyError, ValueError):
+                return False
+        return self._client.healthy()
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One probe+restart pass (public so tests can drive it without
+        the thread)."""
+        now = time.monotonic() if now is None else now
+        for comp in self.runtime.load_components():
+            name = comp.name
+            if name in self.crash_looped:
+                continue
+            if self._serving(name):
+                death = self._death_time.pop(name, None)
+                if death is not None:
+                    self.recovery_times.append(now - death)
+                    self._record(now, name, "recovered")
+                self._restart_due.pop(name, None)
+                continue
+            if self.runtime.component_alive(name):
+                # alive-but-not-serving (apiserver mid-boot): keep the
+                # death clock running, nothing to restart
+                continue
+            if name not in self._death_time:
+                self._death_time[name] = now
+                self._record(now, name, "died")
+            due = self._restart_due.get(name)
+            if due is None:
+                recent = [
+                    t
+                    for t in self._restart_times.get(name, [])
+                    if now - t < self.crash_loop_window
+                ]
+                if len(recent) >= self.crash_loop_threshold:
+                    self.crash_looped.add(name)
+                    self._record(now, name, "crash-loop")
+                    continue
+                delay = self.backoff.delay(len(recent), self.rng)
+                self._restart_due[name] = now + delay
+                continue
+            if now >= due:
+                self.runtime.start_component(comp)
+                self._restart_times.setdefault(name, []).append(now)
+                self._restart_due.pop(name, None)
+                self._record(now, name, "restarted")
+
+    def _record(self, now: float, component: str, action: str) -> None:
+        self.events.append(
+            {"t": round(now, 3), "component": component, "action": action}
+        )

@@ -729,7 +729,10 @@ class Server:
                     try:
                         proc.stdin.write(data)
                         proc.stdin.flush()
-                    except (BrokenPipeError, OSError):
+                    # the exec'd process exited with stdin pending: the
+                    # wait loop below reports the exit status — nothing
+                    # to log per dropped frame
+                    except (BrokenPipeError, OSError):  # kwoklint: disable=swallowed-errors
                         pass
                 elif (
                     channel == 255
@@ -741,7 +744,8 @@ class Server:
                     # v5 close-channel frame: stdin EOF without detach
                     try:
                         proc.stdin.close()
-                    except OSError:
+                    # already closed by process exit — EOF either way
+                    except OSError:  # kwoklint: disable=swallowed-errors
                         pass
                 # CHAN_RESIZE frames are accepted and ignored — there is
                 # no real TTY behind a fake pod
@@ -1001,7 +1005,10 @@ class Server:
                 if channel % 2 == 0 and idx < len(socks) and socks[idx] is not None:
                     try:
                         socks[idx].sendall(data)
-                    except OSError:
+                    # target hung up mid-forward: the per-stream reader
+                    # notices and closes the channel; frames in flight
+                    # are legitimately discarded
+                    except OSError:  # kwoklint: disable=swallowed-errors
                         pass
         finally:
             for sock in socks:

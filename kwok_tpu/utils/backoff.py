@@ -1,0 +1,38 @@
+"""Capped exponential backoff with jitter — the one retry schedule
+shared by stage retries, the REST client's RetryPolicy, and the
+component supervisor (reference pkg/kwok/controllers/utils.go:133-143
+defaultBackoff/backoffDelayByStep: 1s × 2ⁿ, jitter 0.2, cap 32 min).
+
+Lives in ``utils`` (layer 0) so both ``cluster`` and ``controllers``
+can share it without a layering edge; ``controllers.utils`` re-exports
+for its historical importers.
+
+The jitter source is an *explicit* ``random.Random``: there is
+deliberately no fallback to the global ``random`` module, so retry
+schedules are reproducible under a chaos seed and tracer-safe by
+construction (kwoklint's tracer-safety rule bans stdlib randomness in
+jitted code; an explicit instance can never leak in ambiently).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class Backoff:
+    """``delay(steps, rng)`` = ``min(duration·factorˢᵗᵉᵖˢ, cap)``
+    stretched by up to ``jitter`` of itself."""
+
+    duration: float = 1.0
+    factor: float = 2.0
+    jitter: float = 0.2
+    cap: float = 32 * 60.0
+
+    def delay(self, steps: int, rng: random.Random) -> float:
+        d = min(self.duration * (self.factor**steps), self.cap)
+        return d * (1.0 + self.jitter * rng.random())
+
+
+__all__ = ["Backoff"]

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from kwok_tpu.api.extra_types import ClusterResourceUsage, ResourceUsage
 from kwok_tpu.cluster.k8s_api import SCALABLE_KINDS
 from kwok_tpu.cluster.store import NotFound
+from kwok_tpu.utils.log import get_logger
 from kwok_tpu.utils.cel import parse_quantity
 from kwok_tpu.workloads.common import (
     CONTROLLER_USER,
@@ -49,6 +50,8 @@ from kwok_tpu.workloads.common import (
 )
 
 __all__ = ["HPAController"]
+
+_LOG = get_logger("hpa")
 
 #: upstream horizontal-pod-autoscaler tolerance: no scale when the
 #: usage ratio is within 10% of 1.0
@@ -138,14 +141,14 @@ class HPAController:
         ev = UsageEvaluator(pod_getter, node_getter, list_pods, now=self._now)
         try:
             ev.set_usages([ResourceUsage.from_dict(u) for u in usages])
-        except Exception:  # noqa: BLE001 — malformed CR: evaluate without
-            pass
+        except Exception as exc:  # noqa: BLE001 — malformed CR: evaluate without
+            _LOG.debug("ignoring malformed ResourceUsage CRs", error=exc)
         try:
             ev.set_cluster_usages(
                 [ClusterResourceUsage.from_dict(u) for u in cluster_usages]
             )
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001 — malformed CR: evaluate without
+            _LOG.debug("ignoring malformed ClusterResourceUsage CRs", error=exc)
         self._ev_cache = (key, ev)
         return ev
 

@@ -705,3 +705,116 @@ def test_cli_findings_exit_1_and_baseline_flow(tmp_path):
         args + ["--baseline"], capture_output=True, text=True, env=env, timeout=120
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------- swallowed-errors
+
+
+def test_swallowed_except_pass_in_loop_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(q):
+                while True:
+                    try:
+                        q.work()
+                    except Exception:
+                        pass
+            """,
+        },
+    )
+    fs = run_rules(root, ["swallowed-errors"])
+    assert len(fs) == 1 and "swallowed by 'pass'" in fs[0].message
+
+
+def test_swallowed_bare_except_in_loop_fires_even_with_body(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(q):
+                while True:
+                    try:
+                        q.work()
+                    except:
+                        q.note()
+            """,
+        },
+    )
+    fs = run_rules(root, ["swallowed-errors"])
+    assert len(fs) == 1 and "bare 'except:'" in fs[0].message
+
+
+def test_swallowed_handler_that_logs_is_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(q, log):
+                while True:
+                    try:
+                        q.work()
+                    except ValueError as exc:
+                        log.debug("work failed", error=exc)
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []
+
+
+def test_swallowed_outside_loop_is_clean(tmp_path):
+    """The rule scopes to daemon loop bodies: a best-effort teardown
+    outside any while loop is not its business."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def teardown(conn):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []
+
+
+def test_swallowed_nested_def_in_loop_is_clean(tmp_path):
+    """Code inside a function defined in the loop runs on another
+    stack; only the loop's own statements count."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(q):
+                while True:
+                    def cb():
+                        try:
+                            q.work()
+                        except OSError:
+                            pass
+                    q.schedule(cb)
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []
+
+
+def test_swallowed_suppression_comment_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(q):
+                while True:
+                    try:
+                        q.pop()
+                    # IndexError is the empty signal, nothing dropped
+                    except IndexError:  # kwoklint: disable=swallowed-errors
+                        pass
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []

@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# One-command repo gate: kwoklint + tier-1 tests + a scaled bench smoke.
-# This is the CI entrypoint shape — each stage fails fast and loudly.
+# One-command repo gate: kwoklint + tier-1 tests + a chaos smoke + a
+# scaled bench smoke.  This is the CI entrypoint shape — each stage
+# fails fast and loudly.
 #
 #   tools/check.sh            # full tier-1 (sequential, ~15 min)
 #   FAST=1 tools/check.sh     # -n 4 --dist loadfile (~8 min, may flake timing gates)
-#   SKIP_BENCH=1 tools/check.sh
+#   SKIP_BENCH=1 SKIP_CHAOS=1 tools/check.sh
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
@@ -18,6 +19,11 @@ if [[ "${FAST:-0}" == "1" ]]; then
     PYTEST_ARGS+=(-n 4 --dist loadfile)
 fi
 JAX_PLATFORMS=cpu python -m pytest tests/ "${PYTEST_ARGS[@]}"
+
+if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
+    echo "== chaos smoke (seeded faults -> WAL recovery, zero lost writes) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --smoke --pods "${CHAOS_PODS:-40}"
+fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
     echo "== bench smoke (BENCH_PODS-scaled) =="
