@@ -59,6 +59,28 @@ E2E_GC = os.environ.get("BENCH_E2E_GC", "1") not in ("0", "false")
 INIT_RETRIES = int(os.environ.get("BENCH_INIT_RETRIES", 5))
 INIT_RETRY_DELAY = float(os.environ.get("BENCH_INIT_RETRY_DELAY", 60))
 TARGET_TPS = 100_000.0
+#: seconds of seeded best-effort flood for the overload/shedding
+#: measurement (0 disables)
+OVERLOAD_S = float(os.environ.get("BENCH_OVERLOAD_S", 1.5))
+
+
+def run_overload_bench() -> dict:
+    """Graceful-degradation counters for the perf trajectory: run the
+    in-process overload smoke and distill its shed/queued/latency
+    numbers into one compact dict."""
+    from kwok_tpu.chaos.__main__ import run_overload_smoke
+
+    rep = run_overload_smoke(seed=42, duration=OVERLOAD_S)
+    flood = rep["flood"]
+    be = rep["levels"]["best-effort"]
+    return {
+        "flood_sent": flood["sent"],
+        "shed": flood["shed"],
+        "served": flood["ok"],
+        "queued_peak": be["queued_peak"],
+        "canary_writes": rep["canary_writes"],
+        "canary_worst_latency_s": rep["canary_worst_latency_s"],
+    }
 
 
 def _clear_backends() -> None:
@@ -342,6 +364,21 @@ def main() -> int:
 
                 traceback.print_exc()
                 out["e2e"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if OVERLOAD_S > 0:
+            # degradation trajectory: a short seeded best-effort flood
+            # against a flow-controlled apiserver; records how much was
+            # shed vs queued and what the system-priority canary paid
+            # (kwok_tpu.chaos overload smoke, scaled down)
+            try:
+                out["overload"] = run_overload_bench()
+            # SystemExit too: the smoke raises it on a failed assert,
+            # and the bench must still emit its one JSON line
+            except (Exception, SystemExit) as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                out["overload"] = {"error": f"{type(e).__name__}: {e}"}
     except Exception as e:  # noqa: BLE001 — always emit the one JSON line
         import traceback
 

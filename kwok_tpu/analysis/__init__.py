@@ -111,6 +111,7 @@ def all_rules() -> "dict[str, object]":
         store_boundary,
         swallowed_errors,
         tracer_safety,
+        unbounded_buffer,
     )
 
     return {
@@ -120,6 +121,7 @@ def all_rules() -> "dict[str, object]":
         "tracer-safety": tracer_safety.analyze,
         "parity-citations": parity_citations.analyze,
         "swallowed-errors": swallowed_errors.analyze,
+        "unbounded-buffer": unbounded_buffer.analyze,
     }
 
 

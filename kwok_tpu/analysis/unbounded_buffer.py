@@ -1,0 +1,205 @@
+"""Unbounded-buffer analyzer: fan-out buffers in the watch path must
+have a bound.
+
+The overload-protection work (PARITY.md:174 §4 strategy) established
+the invariant this rule mechanizes: any buffer an event/stream fan-out
+appends to in the serving layers must be bounded — by a
+``maxlen=``/``maxsize=`` constructor argument or by an explicit
+``len()`` high-water check — because a slow consumer otherwise turns
+the buffer into an unbounded server-side memory leak (the exact
+failure the watcher high-water eviction in
+``kwok_tpu.cluster.store`` closes; the reference leans on client-go's
+bounded watch caches for the same property, SURVEY.md:30 names the
+watch topology).
+
+Scope: classes in ``kwok_tpu/cluster/`` and ``kwok_tpu/server/`` (the
+request/watch serving layers).  A finding fires when a class
+
+1. assigns an instance attribute to an **unbounded buffer
+   constructor** — ``deque()`` with no ``maxlen``, ``Queue()`` with no
+   ``maxsize``, or a bare list literal — and
+2. **appends** to that attribute (``.append`` / ``.extend`` /
+   ``.appendleft`` / ``.add`` / ``.put``) from an *event-flow
+   context*: lexically inside a ``while`` loop, or anywhere in a
+   method named like a per-event delivery hook (``_push``, ``_pump``,
+   ``add``, ``put``, ``feed``, ``emit``, ...) — one append per
+   subscription or per config document is growth bounded by the
+   caller, not by event rate, and stays exempt — and
+3. the class nowhere **bounds** it: no ``len(self.<attr>)``
+   comparison with the attribute.
+
+Fix by bounding the buffer, adding a high-water eviction (see
+``store.Watcher``), or blocking the producer (socket-level
+backpressure); a deliberately unbounded buffer carries ``# kwoklint:
+disable=unbounded-buffer`` plus the reason, like every other rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from kwok_tpu.analysis import Finding, SourceFile, terminal_name
+
+RULE = "unbounded-buffer"
+
+#: serving-layer path prefixes this rule patrols
+SCOPE = ("kwok_tpu/cluster/", "kwok_tpu/server/")
+
+#: constructor names that build an unbounded FIFO when called without
+#: their bounding kwarg
+_BOUND_KWARG = {"deque": "maxlen", "Queue": "maxsize"}
+
+_APPEND_METHODS = {"append", "extend", "appendleft", "add", "put"}
+
+#: a method with one of these exact names is a per-event delivery hook:
+#: its appends count as event-flow even outside a lexical while loop
+#: (the store pushes per mutation, not in a loop)
+_EVENT_METHODS = {
+    "_push",
+    "_push_batch",
+    "push",
+    "add",
+    "put",
+    "_pump",
+    "pump",
+    "feed",
+    "emit",
+    "_emit",
+    "on_event",
+}
+
+
+def _unbounded_ctor(value: ast.AST) -> bool:
+    """True for ``deque()`` / ``Queue()`` without their bound kwarg,
+    and for a bare list literal."""
+    if isinstance(value, ast.List):
+        return True
+    if not isinstance(value, ast.Call):
+        return False
+    name = terminal_name(value.func)
+    bound_kwarg = _BOUND_KWARG.get(name)
+    if bound_kwarg is None:
+        return False
+    for kw in value.keywords:
+        if kw.arg == bound_kwarg and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value in (None, 0)
+        ):
+            return False
+    # positional bounds count too: deque(iterable, maxlen) and the
+    # stdlib-style Queue(maxsize) — unless the value is literally 0 or
+    # None (the documented "unbounded" spellings)
+    if name == "deque" and len(value.args) >= 2:
+        return False
+    if name == "Queue" and value.args:
+        a0 = value.args[0]
+        if not (isinstance(a0, ast.Constant) and a0.value in (None, 0)):
+            return False
+    return True
+
+
+def _self_attr(node: ast.AST) -> str:
+    """``self.<attr>`` -> attr name, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _walk_appends(
+    node: ast.AST, in_while: bool, event_method: bool, appends: Dict[str, int]
+) -> None:
+    """Record event-flow appends to self attributes under ``node``.
+
+    ``while`` (the daemon/pump idiom — same scoping as the
+    swallowed-errors rule) marks everything beneath it as event flow;
+    ``for`` does not, because iterating a config document list is
+    growth bounded by the input, not by event rate."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return  # nested defs run on another stack; visited separately
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in _APPEND_METHODS
+    ):
+        attr = _self_attr(node.func.value)
+        if attr and (in_while or event_method):
+            appends.setdefault(attr, node.lineno)
+    inside = in_while or isinstance(node, ast.While)
+    for child in ast.iter_child_nodes(node):
+        _walk_appends(child, inside, event_method, appends)
+
+
+def _check_class(sf: SourceFile, cls: ast.ClassDef) -> List[Finding]:
+    buffers: Dict[str, Tuple[int, str]] = {}  # attr -> (line, ctor repr)
+    appends: Dict[str, int] = {}  # attr -> event-flow append line
+    bounded: set = set()
+    for node in ast.walk(cls):
+        # 1) unbounded-buffer assignments to self attributes
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            value = node.value
+            if value is not None and _unbounded_ctor(value):
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        kind = (
+                            "[]"
+                            if isinstance(value, ast.List)
+                            else f"{terminal_name(value.func)}()"
+                        )
+                        buffers.setdefault(attr, (node.lineno, kind))
+        # 3) bound evidence: len(self.<attr>) used in a comparison
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Name)
+                    and sub.func.id == "len"
+                    and sub.args
+                ):
+                    attr = _self_attr(sub.args[0])
+                    if attr:
+                        bounded.add(attr)
+    # 2) event-flow appends, per method
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            event = node.name in _EVENT_METHODS
+            for child in node.body:
+                _walk_appends(child, False, event, appends)
+    findings: List[Finding] = []
+    for attr, (line, kind) in sorted(buffers.items()):
+        if attr not in appends or attr in bounded:
+            continue
+        findings.append(
+            Finding(
+                rule=RULE,
+                path=sf.path,
+                line=line,
+                message=(
+                    f"{cls.name}.{attr} is an unbounded {kind} buffer "
+                    f"fed from an event-flow path (line {appends[attr]}) "
+                    "with no maxsize/maxlen or len() high-water check — "
+                    "a slow consumer grows it without bound; bound it, "
+                    "evict (see store.Watcher), or suppress with the "
+                    "reason growth is bounded elsewhere"
+                ),
+            )
+        )
+    return findings
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith(SCOPE):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(sf, node))
+    return findings

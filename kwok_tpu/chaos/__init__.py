@@ -30,17 +30,23 @@ self-contained durability smoke used by tools/check.sh).
 from kwok_tpu.chaos.plan import (  # noqa: F401
     FaultPlan,
     HttpFaultSpec,
+    OverloadWindow,
     PartitionWindow,
     ProcessFaultSpec,
     load_profile,
 )
-from kwok_tpu.chaos.http_faults import HttpFaultInjector  # noqa: F401
+from kwok_tpu.chaos.http_faults import (  # noqa: F401
+    HttpFaultInjector,
+    OverloadDriver,
+)
 
 __all__ = [
     "FaultPlan",
     "HttpFaultSpec",
+    "OverloadWindow",
     "PartitionWindow",
     "ProcessFaultSpec",
     "load_profile",
     "HttpFaultInjector",
+    "OverloadDriver",
 ]

@@ -16,6 +16,14 @@ Three modes over one seeded profile
   snapshot+WAL into a fresh store and assert byte-identical state —
   zero lost acknowledged writes.  tools/check.sh runs this on every
   check.
+- ``--overload-smoke``  self-contained graceful-degradation check: a
+  seeded best-effort flood (the plan's ``overload`` fault kind) against
+  an apiserver running APF flow control
+  (``kwok_tpu.cluster.flowcontrol``) while a system-priority canary
+  keeps writing.  Asserts every canary write acks with bounded
+  latency, the flood is shed with well-formed 429+Retry-After (zero
+  connection errors), and no system-level request was rejected.
+  tools/check.sh runs this on every check too.
 """
 
 from __future__ import annotations
@@ -144,6 +152,146 @@ def run_smoke(seed: int = 42, pods: int = 40, duration: float = 30.0) -> dict:
     }
 
 
+def run_overload_smoke(
+    seed: int = 42, duration: float = 2.0
+) -> dict:
+    """In-process overload smoke; returns the report dict (raises on
+    any lost canary write, hung/reset shed connection, or system-level
+    rejection)."""
+    from kwok_tpu.chaos.http_faults import OverloadDriver
+    from kwok_tpu.chaos.plan import OverloadWindow
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import ClusterClient, RetryPolicy
+    from kwok_tpu.cluster.flowcontrol import (
+        DEFAULT_LEVELS,
+        FlowConfig,
+        FlowController,
+        PriorityLevel,
+    )
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.utils.backoff import Backoff
+
+    plan = FaultPlan(
+        seed=seed,
+        duration=duration + 30,
+        http=HttpFaultSpec(
+            overloads=[
+                OverloadWindow(
+                    at=0.0, duration=duration, rps=2000, clients=8
+                )
+            ]
+        ),
+    )
+    # a deliberately tiny budget: best-effort gets one seat and almost
+    # no queue, so the flood saturates it instantly while system keeps
+    # its own seats
+    levels = tuple(
+        lv
+        if lv.name != "best-effort"
+        else PriorityLevel(
+            "best-effort", shares=lv.shares, queues=2,
+            queue_wait_s=0.1, queue_limit=2,
+        )
+        for lv in DEFAULT_LEVELS
+    )
+    flow = FlowController(
+        FlowConfig(max_inflight=8, levels=levels), seed=seed
+    )
+    store = ResourceStore()
+    # a populated cluster: the flood lists pods, and the point of the
+    # smoke is a flood whose per-request cost outruns one best-effort
+    # seat — an empty list would be served faster than it arrives
+    store.bulk(
+        [
+            {
+                "verb": "create",
+                "data": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"ballast-{i}",
+                        "namespace": "default",
+                    },
+                    "spec": {"nodeName": f"node-{i % 8}"},
+                    "status": {"phase": "Running"},
+                },
+            }
+            for i in range(2000)
+        ]
+    )
+    t_start = time.monotonic()
+    with APIServer(store, flow=flow) as srv:
+        driver = OverloadDriver(plan, srv.url).start()
+        client = ClusterClient(
+            srv.url,
+            retry=RetryPolicy(
+                seed=seed,
+                max_attempts=10,
+                budget_s=30.0,
+                backoff=Backoff(duration=0.02, cap=0.5),
+            ),
+            client_id="kwokctl",  # system priority by default schema
+        )
+        canaries = 0
+        worst_latency = 0.0
+        while time.monotonic() - t_start < duration:
+            t0 = time.monotonic()
+            client.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"canary-{canaries}",
+                        "namespace": "default",
+                    },
+                    "data": {"i": str(canaries)},
+                }
+            )
+            worst_latency = max(worst_latency, time.monotonic() - t0)
+            canaries += 1
+            time.sleep(0.01)
+        if not driver.wait(timeout=30):
+            driver.stop()
+            raise SystemExit("overload smoke FAILED: flood never finished")
+        counters = driver.snapshot()
+        levels_snap = flow.snapshot()
+        if store.count("ConfigMap") != canaries:
+            raise SystemExit(
+                f"overload smoke FAILED: {store.count('ConfigMap')}/"
+                f"{canaries} canary writes survived the flood"
+            )
+        if counters["shed"] == 0:
+            raise SystemExit(
+                "overload smoke FAILED: the flood was never shed "
+                f"(flow control inactive? {counters})"
+            )
+        if counters["shed_without_retry_after"]:
+            raise SystemExit(
+                "overload smoke FAILED: "
+                f"{counters['shed_without_retry_after']} 429s lacked "
+                "Retry-After"
+            )
+        if counters["conn_errors"]:
+            raise SystemExit(
+                "overload smoke FAILED: "
+                f"{counters['conn_errors']} flood connections hung/reset "
+                "instead of a typed rejection"
+            )
+        if levels_snap["system"]["rejected"]:
+            raise SystemExit(
+                "overload smoke FAILED: system-priority traffic was shed "
+                f"({levels_snap['system']})"
+            )
+    return {
+        "seed": seed,
+        "canary_writes": canaries,
+        "canary_worst_latency_s": round(worst_latency, 3),
+        "flood": counters,
+        "levels": levels_snap,
+        "lost_writes": 0,
+    }
+
+
 def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
     from kwok_tpu.chaos.process_faults import ProcessFaultDriver
     from kwok_tpu.ctl.runtime import BinaryRuntime, ComponentSupervisor
@@ -199,7 +347,19 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="run the in-process durability smoke (used by tools/check.sh)",
     )
+    p.add_argument(
+        "--overload-smoke",
+        action="store_true",
+        help="run the in-process overload/graceful-shedding smoke "
+        "(used by tools/check.sh)",
+    )
     p.add_argument("--pods", type=int, default=40, help="smoke population")
+    p.add_argument(
+        "--flood-seconds",
+        type=float,
+        default=2.0,
+        help="overload smoke flood duration",
+    )
     return p
 
 
@@ -207,6 +367,13 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.smoke:
         report = run_smoke(seed=args.seed if args.seed is not None else 42, pods=args.pods)
+        print(json.dumps(report))
+        return 0
+    if args.overload_smoke:
+        report = run_overload_smoke(
+            seed=args.seed if args.seed is not None else 42,
+            duration=args.flood_seconds,
+        )
         print(json.dumps(report))
         return 0
     plan = load_profile(args.profile) if args.profile else FaultPlan()

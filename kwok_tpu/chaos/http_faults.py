@@ -20,6 +20,13 @@ Actions returned to the handler::
     None                                           serve normally
 
 ``on_watch_tick`` returning True drops the watch stream mid-flight.
+
+:class:`OverloadDriver` is the injector's flood arm: it executes the
+plan's ``overload`` windows (seeded best-effort request floods) against
+a server URL, recording per-response outcomes so a chaos run can assert
+the APF layer shed the flood with well-formed 429s
+(``kwok_tpu.cluster.flowcontrol``) rather than hung or reset
+connections.
 """
 
 from __future__ import annotations
@@ -31,7 +38,7 @@ from typing import Dict, Optional
 
 from kwok_tpu.chaos.plan import FaultPlan
 
-__all__ = ["HttpFaultInjector"]
+__all__ = ["HttpFaultInjector", "OverloadDriver"]
 
 #: paths that must stay truthful — see module docstring
 _EXEMPT = ("/healthz", "/readyz", "/livez")
@@ -120,6 +127,144 @@ class HttpFaultInjector:
                 self.counters["watch_drop"] += 1
                 return True
         return False
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._mut:
+            return dict(self.counters)
+
+
+class OverloadDriver:
+    """Execute a plan's ``overload`` windows: seeded best-effort
+    request floods against ``url``.
+
+    Each window runs ``clients`` worker threads pacing toward the
+    window's total rps with seeded jitter.  Workers use raw
+    ``http.client`` — no retries, one fresh connection per request — so
+    every response (or connection failure) is observed exactly once::
+
+        sent                 requests issued
+        ok                   2xx answers
+        shed                 429 answers
+        shed_without_retry_after   429s missing the Retry-After header
+        other_status         any other HTTP status (injected 503s etc.)
+        conn_errors          socket-level failures (no parseable reply)
+
+    The graceful-degradation contract under a pure overload plan is
+    ``shed > 0`` with ``shed_without_retry_after == 0`` and
+    ``conn_errors == 0`` — load is refused loudly, never dropped on the
+    floor."""
+
+    def __init__(self, plan: FaultPlan, url: str, clock=None):
+        self.plan = plan
+        self.url = url
+        self._clock = clock or time.monotonic
+        self._seed = plan.seed
+        self._mut = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list = []
+        self.counters: Dict[str, int] = {
+            "sent": 0,
+            "ok": 0,
+            "shed": 0,
+            "shed_without_retry_after": 0,
+            "other_status": 0,
+            "conn_errors": 0,
+        }
+
+    def start(self) -> "OverloadDriver":
+        """Schedule every overload window from now; returns self."""
+        t0 = self._clock()
+        for wi, win in enumerate(self.plan.http.overloads):
+            for ci in range(max(1, win.clients)):
+                t = threading.Thread(
+                    target=self._worker,
+                    args=(t0, wi, win, ci),
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        return self
+
+    def _worker(self, t0: float, wi: int, win, ci: int) -> None:
+        from urllib.parse import urlsplit
+
+        u = urlsplit(self.url)
+        hostport = u.netloc
+        rng = random.Random(f"{self._seed}/{wi}/{ci}")
+        period = max(1, win.clients) / max(win.rps, 0.1)
+        client_id = f"{win.client_prefix}-{ci}"
+        # wait for the window to open
+        while not self._stop.is_set():
+            delta = (t0 + win.at) - self._clock()
+            if delta <= 0:
+                break
+            if self._stop.wait(min(delta, 0.1)):
+                return
+        while not self._stop.is_set():
+            if self._clock() - t0 >= win.at + win.duration:
+                return
+            self._one_request(hostport, win.path, client_id)
+            # seeded jitter keeps workers from phase-locking while the
+            # mean pacing stays at the window's rps
+            self._stop.wait(period * (0.5 + rng.random()))
+
+    def _one_request(self, hostport: str, path: str, client_id: str) -> None:
+        import http.client
+
+        if self.url.startswith("https://"):
+            import ssl
+
+            # the flood is hostile-by-design traffic; it does not get
+            # the cluster CA, so it skips verification like any
+            # anonymous internet client would fail to do properly
+            conn = http.client.HTTPSConnection(
+                hostport, timeout=10, context=ssl._create_unverified_context()
+            )
+        else:
+            conn = http.client.HTTPConnection(hostport, timeout=10)
+        outcome = "conn_errors"
+        retry_after_missing = False
+        try:
+            conn.request(
+                "GET", path, headers={"X-Kwok-Client": client_id}
+            )
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status == 429:
+                outcome = "shed"
+                retry_after_missing = resp.getheader("Retry-After") is None
+            elif 200 <= resp.status < 300:
+                outcome = "ok"
+            else:
+                outcome = "other_status"
+        except (OSError, http.client.HTTPException):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        with self._mut:
+            self.counters["sent"] += 1
+            self.counters[outcome] += 1
+            if retry_after_missing:
+                self.counters["shed_without_retry_after"] += 1
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every window's workers finished; False on
+        timeout."""
+        deadline = None if timeout is None else self._clock() + timeout
+        for t in self._threads:
+            left = None if deadline is None else max(0.0, deadline - self._clock())
+            t.join(left)
+            if t.is_alive():
+                return False
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
 
     def snapshot(self) -> Dict[str, int]:
         with self._mut:

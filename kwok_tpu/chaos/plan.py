@@ -20,6 +20,8 @@ Profile YAML::
       watchDrop: {p: 0.01}  # per 0.25s watch-loop tick
       partitions:
         - {client: kwok-controller, at: 5, duration: 3}
+      overload:             # best-effort request floods (APF exercise)
+        - {at: 2, duration: 5, rps: 200, clients: 4}
     process:
       - {component: apiserver, at: 8, action: kill}
       - {component: kube-controller-manager, at: 12, action: stop, resumeAfter: 2}
@@ -38,6 +40,7 @@ import yaml
 
 __all__ = [
     "HttpFaultSpec",
+    "OverloadWindow",
     "PartitionWindow",
     "ProcessFaultSpec",
     "FaultPlan",
@@ -61,6 +64,47 @@ class PartitionWindow:
         return self.at <= elapsed < self.at + self.duration
 
 
+@dataclass(frozen=True)
+class OverloadWindow:
+    """One scheduled best-effort request flood: ``clients`` worker
+    threads issuing ~``rps`` total requests/second against ``path``
+    while ``at <= t-t0 < at + duration``.  Each worker identifies as
+    ``{clientPrefix}-{i}`` — unknown to the default flow schema, so the
+    flood classifies as best-effort and exercises the APF shedding
+    path without touching higher priority levels."""
+
+    at: float
+    duration: float
+    rps: float = 100.0
+    clients: int = 4
+    path: str = "/r/pods"
+    client_prefix: str = "chaos-flood"
+
+    def active(self, elapsed: float) -> bool:
+        return self.at <= elapsed < self.at + self.duration
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OverloadWindow":
+        return cls(
+            at=float(d.get("at", 0.0)),
+            duration=float(d.get("duration", 0.0)),
+            rps=float(d.get("rps", 100.0)),
+            clients=int(d.get("clients", 4)),
+            path=str(d.get("path") or "/r/pods"),
+            client_prefix=str(d.get("clientPrefix") or "chaos-flood"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "at": self.at,
+            "duration": self.duration,
+            "rps": self.rps,
+            "clients": self.clients,
+            "path": self.path,
+            "clientPrefix": self.client_prefix,
+        }
+
+
 @dataclass
 class HttpFaultSpec:
     """Per-request fault probabilities at the apiserver HTTP boundary."""
@@ -73,6 +117,7 @@ class HttpFaultSpec:
     reset_p: float = 0.0
     watch_drop_p: float = 0.0
     partitions: List[PartitionWindow] = field(default_factory=list)
+    overloads: List[OverloadWindow] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "HttpFaultSpec":
@@ -97,6 +142,9 @@ class HttpFaultSpec:
                 )
                 for p in d.get("partitions") or []
             ],
+            overloads=[
+                OverloadWindow.from_dict(o) for o in d.get("overload") or []
+            ],
         )
 
     def to_dict(self) -> dict:
@@ -113,6 +161,7 @@ class HttpFaultSpec:
                 {"client": p.client, "at": p.at, "duration": p.duration}
                 for p in self.partitions
             ],
+            "overload": [o.to_dict() for o in self.overloads],
         }
 
 

@@ -51,6 +51,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
+from kwok_tpu.cluster.flowcontrol import FlowRejected, expose_metrics
 from kwok_tpu.cluster.k8s_api import (
     PATCH_CONTENT_TYPES,
     K8sFacade,
@@ -71,6 +72,16 @@ _K8S_HEADS = {"api", "apis", "version", "openapi"}
 
 #: watch heartbeat cadence; lets both ends detect dead peers
 _BOOKMARK_EVERY = 15.0
+
+#: route heads that bypass flow control: liveness and the metrics
+#: scrape must stay truthful under overload, or shedding hides itself
+#: (same reason the chaos injector exempts them)
+_FLOW_EXEMPT = {"healthz", "readyz", "livez", "metrics"}
+
+#: default server-side watch deadline (seconds): a real apiserver caps
+#: every watch at --min-request-timeout-ish horizons and clients resume
+#: transparently; this bounds how long a dead peer can pin a thread
+DEFAULT_WATCH_TIMEOUT = 3600.0
 
 
 def _traced(fn):
@@ -156,10 +167,18 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(raw) if raw else None
 
     def _route(self) -> Tuple[str, list, dict]:
+        # memoized per path: the flow gate (_dispatch) and the verb
+        # handler both parse the same request, and this sits on the
+        # hot path the whole overload layer exists to protect
+        cached = getattr(self, "_route_cache", None)
+        if cached is not None and cached[0] == self.path:
+            return cached[1]
         u = urlsplit(self.path)
         parts = [unquote(p) for p in u.path.split("/") if p]
         q = {k: v[-1] for k, v in parse_qs(u.query).items()}
-        return (parts[0] if parts else ""), parts[1:], q
+        parsed = ((parts[0] if parts else ""), parts[1:], q)
+        self._route_cache = (self.path, parsed)
+        return parsed
 
     def _user(self) -> Optional[str]:
         return self.headers.get("Impersonate-User") or None
@@ -224,17 +243,91 @@ class _Handler(BaseHTTPRequestHandler):
     def _ns(q: dict) -> Optional[str]:
         return q.get("namespace") or None
 
+    # --------------------------------------------------------- flow control
+
+    def _dispatch(self, inner) -> None:
+        """Chaos seam first, then APF admission: classify the caller's
+        X-Kwok-Client into a priority level, take (or queue for) an
+        inflight seat, shed with a well-formed 429 + Retry-After when
+        the level's queue wait runs out.  Watches are long-running:
+        admitted through the same gate but holding no seat."""
+        if self._inject_fault():
+            return
+        flow = getattr(self.server, "flow", None)
+        self._flow_level = None
+        if flow is None:
+            inner()
+            return
+        head, _rest, q = self._route()
+        if head in _FLOW_EXEMPT:
+            inner()
+            return
+        cid = self.headers.get("X-Kwok-Client") or ""
+        self._flow_level = flow.classify(cid)
+        try:
+            ticket = flow.admit(
+                cid,
+                self.command,
+                self.path,
+                # same truthiness as both dialects' watch routing —
+                # "watch=false" is an ordinary (seat-holding) list
+                long_running=q.get("watch") in ("1", "true"),
+                level=self._flow_level,
+            )
+        except FlowRejected as rej:
+            self._send_shed(rej)
+            return
+        try:
+            inner()
+        finally:
+            flow.release(ticket)
+
+    def _send_shed(self, rej: FlowRejected) -> None:
+        """429 with Retry-After — the graceful-shedding contract: the
+        client always gets a parseable rejection, never a hung socket
+        or an unexplained reset."""
+        body = json.dumps(
+            {"error": f"overloaded: {rej}", "reason": "TooManyRequests"}
+        ).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", str(rej.retry_after))
+        self.send_header("Content-Length", str(len(body)))
+        # the request body was never read — the keep-alive framing is
+        # gone, so the connection must die with the rejection
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass
+
     # --------------------------------------------------------------- verbs
 
     def do_GET(self):
-        if self._inject_fault():
-            return
+        self._dispatch(self._handle_get)
+
+    def _handle_get(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "GET", head, rest, q):
             return
         try:
             if head == "healthz" or head == "readyz" or head == "livez":
                 self._send_json(200, {"status": "ok"})
+            elif head == "metrics":
+                # per-priority-level flow-control gauges + watch
+                # eviction counters, Prometheus text format
+                body = expose_metrics(
+                    getattr(self.server, "flow", None), self.store
+                ).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif head == "dashboard":
                 # built-in live dashboard — the kubernetes-dashboard
                 # component seat (reference components/dashboard.go runs
@@ -259,7 +352,11 @@ class _Handler(BaseHTTPRequestHandler):
                     {"resourceVersion": self.store.resource_version, "counts": counts},
                 )
             elif head == "r" and len(rest) == 1:
-                if q.get("watch"):
+                # canonical watch values only — must stay in lockstep
+                # with _dispatch's long-running classification, or a
+                # seat-holding request could be served as an
+                # indefinite stream
+                if q.get("watch") in ("1", "true"):
                     self._serve_watch(rest[0], q)
                 elif q.get("limit") or q.get("continue"):
                     items, rv, nxt = self.store.list_page(
@@ -295,8 +392,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_POST(self):
-        if self._inject_fault():
-            return
+        self._dispatch(self._handle_post)
+
+    def _handle_post(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "POST", head, rest, q):
             return
@@ -329,8 +427,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_PUT(self):
-        if self._inject_fault():
-            return
+        self._dispatch(self._handle_put)
+
+    def _handle_put(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PUT", head, rest, q):
             return
@@ -351,8 +450,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_PATCH(self):
-        if self._inject_fault():
-            return
+        self._dispatch(self._handle_patch)
+
+    def _handle_patch(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "PATCH", head, rest, q):
             return
@@ -378,8 +478,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     @_traced
     def do_DELETE(self):
-        if self._inject_fault():
-            return
+        self._dispatch(self._handle_delete)
+
+    def _handle_delete(self):
         head, rest, q = self._route()
         if head in _K8S_HEADS and self.server.k8s.handle(self, "DELETE", head, rest, q):
             return
@@ -423,10 +524,19 @@ class _Handler(BaseHTTPRequestHandler):
         shutdown = getattr(self.server, "shutting_down", None)
         inj = getattr(self.server, "fault_injector", None)
         cid = self.headers.get("X-Kwok-Client") or ""
+        # server-side deadline: ?timeoutSeconds=N, else the server
+        # default — a clean EOF the reflector resumes from, so no dead
+        # peer can pin this handler thread forever
+        timeout_s = float(q.get("timeoutSeconds") or 0) or getattr(
+            self.server, "watch_timeout", 0
+        )
+        deadline = time.monotonic() + timeout_s if timeout_s else None
         try:
             idle = 0.0
             last_chaos = time.monotonic()
             while shutdown is None or not shutdown.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
                 if inj is not None:
                     # at most one drop draw per 0.25s: under event load
                     # the loop spins per burst, and a per-iteration draw
@@ -442,6 +552,17 @@ class _Handler(BaseHTTPRequestHandler):
                             break
                 ev = w.next(timeout=0.25)
                 if ev is None:
+                    if w.stopped:
+                        # evicted by backpressure (slow consumer): hang
+                        # up so the client resumes at its last rv — the
+                        # watch-cache-gone answer, not unbounded buffering
+                        if getattr(w, "evicted", False):
+                            flow = getattr(self.server, "flow", None)
+                            if flow is not None:
+                                flow.note_evicted(
+                                    getattr(self, "_flow_level", None)
+                                )
+                        break
                     idle += 0.25
                     if idle >= _BOOKMARK_EVERY:
                         idle = 0.0
@@ -538,6 +659,8 @@ class APIServer:
         audit_path: Optional[str] = None,
         kubelet_url: Optional[str] = None,
         fault_injector=None,
+        flow=None,
+        watch_timeout: float = DEFAULT_WATCH_TIMEOUT,
     ):
         # acquire the audit file before binding the port so a bad path
         # fails without leaking a listening socket; unbuffered O_APPEND
@@ -557,6 +680,11 @@ class APIServer:
             # only carries the hook, keeping cluster below chaos in the
             # layer map.
             self._httpd.fault_injector = fault_injector
+            # APF seam (cluster.flowcontrol.FlowController); None = no
+            # admission control (bare in-process test servers)
+            self._httpd.flow = flow
+            # default server-side watch deadline; 0 disables
+            self._httpd.watch_timeout = float(watch_timeout or 0)
             # Kubernetes wire-protocol facade (k8s_api.py): /api, /apis,
             # /version, /openapi — what stock kubectl/client-go speak
             self._httpd.k8s = K8sFacade(store, kubelet_url=kubelet_url)
@@ -587,6 +715,11 @@ class APIServer:
         host, port = self.address
         scheme = "https" if self._tls else "http"
         return f"{scheme}://{host}:{port}"
+
+    @property
+    def flow(self):
+        """The attached FlowController (None when admission is off)."""
+        return self._httpd.flow
 
     def set_fault_injector(self, injector) -> None:
         """Attach/detach (None) the chaos fault injector on a live

@@ -45,6 +45,7 @@ __all__ = [
     "APIError",
     "ApiUnavailable",
     "RetryPolicy",
+    "parse_retry_after",
 ]
 
 _PATCH_CT = {
@@ -128,6 +129,34 @@ NO_RETRY = RetryPolicy(
 )
 
 
+def parse_retry_after(raw: Optional[str]) -> Optional[float]:
+    """Seconds to wait from a ``Retry-After`` header value.
+
+    Accepts both RFC 7231 forms: delay-seconds (including the
+    fractional values this framework's servers emit) and an absolute
+    HTTP-date, converted to a non-negative delta from now.  Returns
+    None for absent or unparseable values."""
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        pass
+    from email.utils import parsedate_to_datetime
+
+    try:
+        dt = parsedate_to_datetime(raw)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    if dt.tzinfo is None:
+        import datetime as _dt
+
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return max(0.0, dt.timestamp() - time.time())
+
+
 def _raise_for(code: int, payload: Any) -> None:
     reason = (payload or {}).get("reason", "Unknown")
     msg = (payload or {}).get("error", "")
@@ -142,13 +171,25 @@ def _raise_for(code: int, payload: Any) -> None:
 
 class RemoteWatcher:
     """Client end of a watch stream; same surface as store.Watcher
-    (next/stop/stopped/iteration)."""
+    (next/stop/stopped/iteration).
+
+    Backpressure twin of the server's watcher high-water: a consumer
+    that stops draining ``next()`` would otherwise grow ``_queue``
+    without bound while the pump keeps reading the socket.  Past
+    ``HIGH_WATER`` undelivered events the stream self-evicts (pump
+    stops, connection closes); the informer reflector then resumes at
+    its last delivered resourceVersion."""
+
+    #: undelivered-event bound before the stream self-evicts
+    HIGH_WATER = 100_000
 
     def __init__(self, conn: http.client.HTTPConnection, resp: http.client.HTTPResponse):
         self._conn = conn
         self._resp = resp
         self._queue: Queue = Queue()
         self._stopped = threading.Event()
+        #: True when the high-water cutoff ended the stream
+        self.evicted = False
         self._thread = threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
@@ -165,6 +206,11 @@ class RemoteWatcher:
                 if ev.get("type") == "BOOKMARK":
                     continue
                 self._queue.add(ev)
+                if len(self._queue) > self.HIGH_WATER:
+                    # slow consumer: stop buffering history; the owner
+                    # reconnects from its last rv instead
+                    self.evicted = True
+                    break
         except (OSError, http.client.HTTPException):
             pass
         finally:
@@ -239,10 +285,17 @@ class ClusterClient:
         self._hostport = url.rstrip("/")
         self._timeout = timeout
         self._retry = retry or RetryPolicy()
-        #: identifies this client to the apiserver (X-Kwok-Client) so
-        #: chaos partitions can target one component; defaults to the
-        #: component name the runtime exports
-        self.client_id = client_id or os.environ.get("KWOK_COMPONENT_NAME") or ""
+        #: identifies this client to the apiserver (X-Kwok-Client) on
+        #: EVERY verb — flow control classifies on it and chaos
+        #: partitions target it.  Defaults to the component name the
+        #: runtime exports; standalone callers (kwokctl, tests, REPLs)
+        #: fall back to "kwok-client", which the default flow schema
+        #: ranks as operator traffic rather than anonymous best-effort.
+        self.client_id = (
+            client_id
+            or os.environ.get("KWOK_COMPONENT_NAME")
+            or "kwok-client"
+        )
         self._local = threading.local()
         self._types: Dict[str, ResourceType] = {}
         self._types_mut = threading.Lock()
@@ -363,11 +416,11 @@ class ClusterClient:
                 continue
             if resp.status in policy.retry_statuses:
                 last_status = resp.status
-                ra = resp.getheader("Retry-After")
-                try:
-                    retry_after = float(ra) if ra else None
-                except ValueError:
-                    retry_after = None
+                retry_after = parse_retry_after(resp.getheader("Retry-After"))
+                # a shed/reject response closes the connection (the
+                # server broke keep-alive framing on purpose); start
+                # the retry on a fresh socket
+                self._drop_conn(conn)
                 _wait_or_raise(
                     f"{method} {path}: HTTP {resp.status}", retry_after
                 )

@@ -1,0 +1,490 @@
+"""API-Priority-and-Fairness for the apiserver — overload protection.
+
+A real kube-apiserver bounds concurrent work with the APF machinery
+(``--max-requests-inflight`` partitioned into priority levels, each
+with shuffle-sharded fair queues and a bounded queue wait; reference
+runtime/binary/cluster.go:316-728 launches the apiserver that carries
+those flags).  This module is the standalone equivalent for the two
+HTTP frontends (:mod:`kwok_tpu.cluster.apiserver` routes both its
+legacy dialect and the :mod:`kwok_tpu.cluster.k8s_api` facade through
+one :class:`FlowController`):
+
+- requests are **classified** into priority levels from the caller's
+  ``X-Kwok-Client`` identity (system > controllers > workloads >
+  best-effort; YAML-overridable via ``kwokctl create cluster
+  --flow-config``),
+- each level owns a **concurrency share** of the global inflight
+  budget (``--max-inflight``), with **shuffle-sharded fair queues** so
+  one noisy flow cannot occupy a level's whole queue capacity,
+- a queued request waits at most ``queueWaitSeconds`` for a seat, then
+  is **rejected with 429** and a ``Retry-After`` derived from the
+  level's queue depth — graceful shedding, never a hung socket,
+- **long-running requests** (watches) pass admission but release their
+  seat immediately, like APF's exemption for WATCH (a watch holds a
+  connection for minutes; counting it against inflight seats would
+  starve the level).
+
+Metrics: per-level ``inflight`` / ``queued`` gauges plus
+``rejected`` / ``dispatched`` / ``evicted-watchers`` counters, rendered
+in Prometheus text form by :func:`expose_metrics` (served at the
+apiserver's ``/metrics``; scraped with
+``kwok_tpu.utils.promtext.iter_samples``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "PriorityLevel",
+    "FlowRule",
+    "FlowConfig",
+    "FlowController",
+    "FlowRejected",
+    "load_flow_config",
+    "expose_metrics",
+]
+
+#: canonical level names, highest priority first (priority here only
+#: orders documentation/reporting; isolation comes from each level's
+#: private seats + queues, so a best-effort flood cannot consume a
+#: system seat)
+SYSTEM = "system"
+CONTROLLERS = "controllers"
+WORKLOADS = "workloads"
+BEST_EFFORT = "best-effort"
+
+#: ceiling on a derived Retry-After — a shed client should back off,
+#: not give up for minutes
+RETRY_AFTER_CAP_S = 30.0
+
+
+@dataclass(frozen=True)
+class PriorityLevel:
+    """One priority level's concurrency/queueing configuration."""
+
+    name: str
+    #: proportional slice of the global inflight budget
+    shares: int
+    #: fair queues in this level (shuffle-sharding domain)
+    queues: int = 8
+    #: max seconds a request may wait queued before the 429
+    queue_wait_s: float = 1.0
+    #: per-queue backlog bound; a full queue rejects immediately
+    queue_limit: int = 128
+
+
+@dataclass(frozen=True)
+class FlowRule:
+    """Maps client identities to a level.  Exact names beat prefixes;
+    among rules of the same match kind, list order wins."""
+
+    level: str
+    clients: Tuple[str, ...] = ()
+    prefixes: Tuple[str, ...] = ()
+
+
+DEFAULT_LEVELS: Tuple[PriorityLevel, ...] = (
+    PriorityLevel(SYSTEM, shares=40, queues=2, queue_wait_s=2.0),
+    PriorityLevel(CONTROLLERS, shares=30, queues=4, queue_wait_s=1.5),
+    PriorityLevel(WORKLOADS, shares=20, queues=8, queue_wait_s=1.0),
+    PriorityLevel(BEST_EFFORT, shares=10, queues=8, queue_wait_s=0.5),
+)
+
+#: default classification: the cluster's own control plane and the
+#: operator CLI rank above workload traffic; unknown/anonymous clients
+#: are best-effort (matching kube-apiserver's catch-all flow schema)
+DEFAULT_FLOWS: Tuple[FlowRule, ...] = (
+    FlowRule(SYSTEM, clients=("kwokctl", "kwok-client", "supervisor"),
+             prefixes=("system:",)),
+    FlowRule(
+        CONTROLLERS,
+        clients=(
+            "kwok-controller",
+            "kube-controller-manager",
+            "scheduler",
+            "tracing",
+        ),
+        prefixes=("controller:",),
+    ),
+    FlowRule(WORKLOADS, clients=("device-player",), prefixes=("workload:",)),
+)
+
+
+class FlowRejected(Exception):
+    """Request shed by flow control — render as 429 + Retry-After."""
+
+    def __init__(self, level: str, retry_after: float, message: str):
+        super().__init__(message)
+        self.level = level
+        self.retry_after = retry_after
+
+
+@dataclass
+class FlowConfig:
+    """Parsed flow configuration (defaults + YAML overrides)."""
+
+    max_inflight: int = 64
+    levels: Tuple[PriorityLevel, ...] = DEFAULT_LEVELS
+    flows: Tuple[FlowRule, ...] = DEFAULT_FLOWS
+    default_level: str = BEST_EFFORT
+
+    def __post_init__(self):
+        names = {lv.name for lv in self.levels}
+        if self.default_level not in names:
+            raise ValueError(
+                f"default level {self.default_level!r} is not defined"
+            )
+        for rule in self.flows:
+            if rule.level not in names:
+                raise ValueError(
+                    f"flow rule maps to unknown level {rule.level!r}"
+                )
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FlowConfig":
+        kind = d.get("kind")
+        if kind not in (None, "FlowConfiguration"):
+            raise ValueError(f"not a FlowConfiguration document: kind={kind!r}")
+        by_name = {lv.name: lv for lv in DEFAULT_LEVELS}
+        for raw in d.get("levels") or []:
+            name = str(raw.get("name") or "")
+            if not name:
+                raise ValueError("flow level needs a name")
+            base = by_name.get(name)
+            by_name[name] = PriorityLevel(
+                name=name,
+                shares=int(raw.get("shares", base.shares if base else 10)),
+                queues=int(raw.get("queues", base.queues if base else 8)),
+                queue_wait_s=float(
+                    raw.get(
+                        "queueWaitSeconds",
+                        base.queue_wait_s if base else 1.0,
+                    )
+                ),
+                queue_limit=int(
+                    raw.get("queueLimit", base.queue_limit if base else 128)
+                ),
+            )
+        # user flows are consulted before the defaults, so a profile can
+        # re-route a default-classified client without restating the map
+        flows = tuple(
+            FlowRule(
+                level=str(raw.get("level") or ""),
+                clients=tuple(str(c) for c in raw.get("clients") or []),
+                prefixes=tuple(str(p) for p in raw.get("prefixes") or []),
+            )
+            for raw in d.get("flows") or []
+        ) + DEFAULT_FLOWS
+        return cls(
+            max_inflight=int(d.get("maxInflight", 64)),
+            levels=tuple(by_name.values()),
+            flows=flows,
+            default_level=str(d.get("defaultLevel", BEST_EFFORT)),
+        )
+
+
+def load_flow_config(path: str) -> FlowConfig:
+    import yaml
+
+    with open(path, "r", encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: flow config must be a mapping")
+    return FlowConfig.from_dict(doc)
+
+
+class _Waiter:
+    """One queued request: a private wakeup plus the granted flag the
+    dispatcher sets under the controller lock (seat handoff)."""
+
+    __slots__ = ("event", "granted", "client_id")
+
+    def __init__(self, client_id: str):
+        self.event = threading.Event()
+        self.granted = False
+        self.client_id = client_id
+
+
+class _Level:
+    """Runtime state of one priority level."""
+
+    __slots__ = (
+        "spec",
+        "seats",
+        "inflight",
+        "queues",
+        "queued",
+        "rr",
+        "dispatched",
+        "rejected",
+        "queued_peak",
+        "evicted_watchers",
+    )
+
+    def __init__(self, spec: PriorityLevel, seats: int):
+        self.spec = spec
+        self.seats = seats
+        self.inflight = 0
+        self.queues: List[deque] = [deque() for _ in range(max(1, spec.queues))]
+        self.queued = 0
+        self.rr = 0
+        self.dispatched = 0
+        self.rejected = 0
+        self.queued_peak = 0
+        self.evicted_watchers = 0
+
+
+class _Ticket:
+    """Inflight-seat handle returned by acquire; release() is
+    idempotent so long-running requests can pre-release."""
+
+    __slots__ = ("level", "released")
+
+    def __init__(self, level: str):
+        self.level = level
+        self.released = False
+
+
+class FlowController:
+    """Admission control over one apiserver's request stream.
+
+    Thread-safe; one instance per server.  ``seed`` makes the shuffle
+    shard assignment deterministic (the chaos e2e pins it so a flood's
+    queue collisions replay)."""
+
+    #: shuffle shard size: each flow hashes to this many candidate
+    #: queues and enqueues on the shortest (APF's d=2 power of two
+    #: choices at small queue counts)
+    SHARD = 2
+
+    def __init__(self, config: Optional[FlowConfig] = None, seed: int = 0):
+        self.config = config or FlowConfig()
+        self.seed = seed
+        self._mut = threading.Lock()
+        total_shares = sum(lv.shares for lv in self.config.levels) or 1
+        self._levels: Dict[str, _Level] = {}
+        for spec in self.config.levels:
+            # every level keeps at least one seat: a starved system
+            # level under a tiny --max-inflight would invert the whole
+            # point of priority isolation
+            seats = max(
+                1, round(self.config.max_inflight * spec.shares / total_shares)
+            )
+            self._levels[spec.name] = _Level(spec, seats)
+        # exact-match index over the rules, first writer wins (rule
+        # order IS the precedence order within a match kind)
+        self._exact: Dict[str, str] = {}
+        self._prefixes: List[Tuple[str, str]] = []
+        for rule in self.config.flows:
+            for c in rule.clients:
+                self._exact.setdefault(c, rule.level)
+            for p in rule.prefixes:
+                self._prefixes.append((p, rule.level))
+
+    # ------------------------------------------------------------ classify
+
+    def classify(self, client_id: str) -> str:
+        """Client identity -> level name.  Precedence: exact client
+        match first (rule order), then prefix match (rule order), then
+        the default level."""
+        cid = client_id or ""
+        level = self._exact.get(cid)
+        if level is not None:
+            return level
+        for prefix, level in self._prefixes:
+            if cid.startswith(prefix):
+                return level
+        return self.config.default_level
+
+    def seats(self, level: str) -> int:
+        return self._levels[level].seats
+
+    # ------------------------------------------------------------- admission
+
+    def _shard_queues(self, lvl: _Level, client_id: str) -> List[int]:
+        """The flow's candidate queue indices (shuffle shard): stable
+        for (seed, level, client), so one flow always lands on the same
+        small queue subset and cannot roam the whole level."""
+        n = len(lvl.queues)
+        if n == 1:
+            return [0]
+        out: List[int] = []
+        for k in range(min(self.SHARD, n)):
+            h = hashlib.blake2b(
+                f"{self.seed}/{lvl.spec.name}/{client_id}/{k}".encode(),
+                digest_size=4,
+            ).digest()
+            idx = int.from_bytes(h, "big") % n
+            if idx not in out:
+                out.append(idx)
+        return out
+
+    def _retry_after(self, lvl: _Level) -> float:
+        """Backoff hint derived from queue depth: roughly how long the
+        current backlog needs to drain through the level's seats, never
+        below one queue-wait and capped at :data:`RETRY_AFTER_CAP_S`."""
+        depth = lvl.queued
+        est = lvl.spec.queue_wait_s * (1.0 + depth / max(1, lvl.seats))
+        return round(min(RETRY_AFTER_CAP_S, max(0.1, est)), 2)
+
+    def admit(
+        self,
+        client_id: str,
+        method: str = "GET",
+        path: str = "",
+        long_running: bool = False,
+        level: Optional[str] = None,
+    ) -> _Ticket:
+        """Admit one request, blocking in its level's fair queue for at
+        most the level's queue-wait.  Raises :class:`FlowRejected`
+        (429) when the queue is full or the wait deadline passes.
+        ``long_running`` requests (watches) are admitted the same way
+        but hold no seat afterwards.  ``level`` skips re-classifying a
+        caller the HTTP gate already classified."""
+        if level is None or level not in self._levels:
+            level = self.classify(client_id)
+        lvl = self._levels[level]
+        ticket = _Ticket(level)
+        waiter: Optional[_Waiter] = None
+        with self._mut:
+            if lvl.inflight < lvl.seats:
+                # queues non-empty implies inflight == seats (release
+                # hands seats to waiters before decrementing), so this
+                # grant never jumps an earlier queued request
+                lvl.inflight += 1
+                lvl.dispatched += 1
+            else:
+                cand = self._shard_queues(lvl, client_id)
+                qi = min(cand, key=lambda i: len(lvl.queues[i]))
+                if len(lvl.queues[qi]) >= lvl.spec.queue_limit:
+                    lvl.rejected += 1
+                    raise FlowRejected(
+                        level,
+                        self._retry_after(lvl),
+                        f"{level} queue full ({lvl.spec.queue_limit})",
+                    )
+                waiter = _Waiter(client_id)
+                lvl.queues[qi].append(waiter)
+                lvl.queued += 1
+                lvl.queued_peak = max(lvl.queued_peak, lvl.queued)
+        if waiter is not None:
+            # outside the lock: the bounded queue wait IS the deadline
+            waiter.event.wait(lvl.spec.queue_wait_s)
+            with self._mut:
+                if not waiter.granted:
+                    # timed out (or spurious wake without a grant):
+                    # withdraw from whichever queue still holds us
+                    for q in lvl.queues:
+                        try:
+                            q.remove(waiter)
+                            break
+                        except ValueError:
+                            continue
+                    lvl.queued -= 1
+                    lvl.rejected += 1
+                    ra = self._retry_after(lvl)
+                    raise FlowRejected(
+                        level,
+                        ra,
+                        f"{level} queue wait exceeded "
+                        f"{lvl.spec.queue_wait_s}s",
+                    )
+                lvl.dispatched += 1
+        if long_running:
+            self.release(ticket)
+        return ticket
+
+    def release(self, ticket: _Ticket) -> None:
+        """Free the ticket's seat, handing it to the level's next
+        queued request (round-robin across the fair queues)."""
+        with self._mut:
+            if ticket.released:
+                return
+            ticket.released = True
+            lvl = self._levels[ticket.level]
+            n = len(lvl.queues)
+            for step in range(n):
+                qi = (lvl.rr + 1 + step) % n
+                if lvl.queues[qi]:
+                    w = lvl.queues[qi].popleft()
+                    lvl.rr = qi
+                    lvl.queued -= 1
+                    # seat transfers: inflight stays, the waiter wakes
+                    # already holding it
+                    w.granted = True
+                    w.event.set()
+                    return
+            lvl.inflight -= 1
+
+    # --------------------------------------------------------------- metrics
+
+    def note_evicted(self, level: Optional[str]) -> None:
+        """Record a watch stream dropped by backpressure, attributed to
+        the consumer's priority level (None → default level)."""
+        name = level if level in self._levels else self.config.default_level
+        with self._mut:
+            self._levels[name].evicted_watchers += 1
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._mut:
+            return {
+                name: {
+                    "seats": lvl.seats,
+                    "inflight": lvl.inflight,
+                    "queued": lvl.queued,
+                    "queued_peak": lvl.queued_peak,
+                    "dispatched": lvl.dispatched,
+                    "rejected": lvl.rejected,
+                    "evicted_watchers": lvl.evicted_watchers,
+                }
+                for name, lvl in self._levels.items()
+            }
+
+
+def expose_metrics(flow: Optional[FlowController], store=None) -> str:
+    """Prometheus text exposition of the flow-control state (plus the
+    store's watch-eviction total when a store is passed), built on the
+    settable collectors the Metric CR pipeline already uses."""
+    try:
+        # deferred + guarded: metrics sits above cluster in the layer
+        # map; the import is an optional-dependency probe by design so
+        # the store/server layer never hard-requires it
+        from kwok_tpu.metrics.collectors import Counter, Gauge, Registry
+    except ImportError:
+        return ""
+    reg = Registry()
+    if flow is not None:
+        for name, row in sorted(flow.snapshot().items()):
+            labels = {"level": name}
+            spec = [
+                ("kwok_apiserver_flow_seats", "gauge", "seats", "concurrency seats"),
+                ("kwok_apiserver_flow_inflight", "gauge", "inflight", "requests being served"),
+                ("kwok_apiserver_flow_queued", "gauge", "queued", "requests waiting for a seat"),
+                ("kwok_apiserver_flow_dispatched_total", "counter", "dispatched", "requests admitted"),
+                ("kwok_apiserver_flow_rejected_total", "counter", "rejected", "requests shed with 429"),
+                ("kwok_apiserver_flow_evicted_watchers_total", "counter", "evicted_watchers", "watch streams dropped by backpressure"),
+            ]
+            for mname, mtype, key, help_ in spec:
+                ctor = Gauge if mtype == "gauge" else Counter
+                c = ctor(mname, help=help_, const_labels=labels)
+                c.set(row[key])
+                reg.register(f"{mname}{name}", c)
+    if store is not None:
+        g = Gauge(
+            "kwok_apiserver_watch_evictions_total",
+            help="store-level slow-watcher evictions (all consumers)",
+        )
+        g.set(getattr(store, "watch_evictions", 0))
+        reg.register("kwok_apiserver_watch_evictions_total", g)
+        rv = Gauge(
+            "kwok_apiserver_resource_version",
+            help="store resourceVersion",
+        )
+        rv.set(store.resource_version)
+        reg.register("kwok_apiserver_resource_version", rv)
+    return reg.expose()

@@ -815,7 +815,14 @@ class K8sFacade:
         ns = None if r.all_namespaces else r.namespace
         since = q.get("resourceVersion")
         bookmarks = q.get("allowWatchBookmarks") in ("true", "1")
-        timeout_s = float(q.get("timeoutSeconds") or 0) or None
+        # server-side deadline: explicit ?timeoutSeconds, else the
+        # server default (cluster.apiserver wires it) — watches end
+        # with a clean EOF the reflector resumes from
+        timeout_s = (
+            float(q.get("timeoutSeconds") or 0)
+            or float(getattr(handler.server, "watch_timeout", 0) or 0)
+            or None
+        )
         # k8s "Get State and Start at Most Recent" semantics: a watch
         # without a resourceVersion (or rv=0) first streams synthetic
         # ADDED events for all existing objects, then goes live — plain
@@ -894,6 +901,44 @@ class K8sFacade:
                     break
                 ev = w.next(timeout=0.25)
                 if ev is None:
+                    if w.stopped:
+                        if getattr(w, "evicted", False):
+                            # slow consumer dropped by backpressure:
+                            # k8s watch-cache-gone shape — one ERROR
+                            # frame carrying a 410 Status, then EOF;
+                            # informed clients resume at their last rv
+                            flow = getattr(handler.server, "flow", None)
+                            if flow is not None:
+                                flow.note_evicted(
+                                    getattr(handler, "_flow_level", None)
+                                )
+                            # the peer was evicted for being slow, so
+                            # its receive buffer may be full: bound the
+                            # farewell write or this thread re-creates
+                            # the pinned-handler problem eviction
+                            # exists to solve (timeout lands in the
+                            # outer except and we just hang up)
+                            try:
+                                handler.connection.settimeout(5.0)
+                            # best-effort: a socket already torn down
+                            # cannot take a timeout, and the write
+                            # below will fail fast on it anyway
+                            except OSError:  # kwoklint: disable=swallowed-errors
+                                pass
+                            self._write_frame(
+                                handler,
+                                {
+                                    "type": "ERROR",
+                                    "object": status_body(
+                                        410,
+                                        "Expired",
+                                        "watch backlog exceeded the "
+                                        "high-water mark; resume from "
+                                        "your last resourceVersion",
+                                    ),
+                                },
+                            )
+                        break
                     idle += 0.25
                     if bookmarks and idle >= _BOOKMARK_EVERY:
                         idle = 0.0

@@ -280,7 +280,15 @@ def match_field_selector(obj: dict, sel: Selector) -> bool:
 
 
 class Watcher:
-    """One watch subscription; iterate or poll its events."""
+    """One watch subscription; iterate or poll its events.
+
+    Backpressure: the event buffer has a high-water mark.  A consumer
+    that falls more than ``high_water`` events behind is **evicted** —
+    the buffer is dropped and the watcher stops, the watch-cache-gone
+    answer a real apiserver gives a too-slow watcher.  The consumer
+    resumes at its last delivered resourceVersion (the reflector path;
+    the history ring still covers those events), instead of this buffer
+    holding unbounded history in memory."""
 
     def __init__(
         self,
@@ -288,6 +296,7 @@ class Watcher:
         filt: Callable[[dict], bool],
         trivial: bool = False,
         status_interest: bool = True,
+        high_water: int = 0,
     ):
         self._store = store
         self._filter = filt
@@ -300,9 +309,22 @@ class Watcher:
         #: touch).  Status batches skip it, and it keeps the zero-copy
         #: commit lane eligible; all other events flow normally.
         self.status_interest = status_interest
+        #: undelivered-event bound; 0 disables eviction (bare Watcher
+        #: construction in tests and tooling stays unbounded)
+        self.high_water = high_water
+        #: True once backpressure dropped this subscription; consumers
+        #: distinguish "stream ended" (resume) from "stopped by me"
+        self.evicted = False
         self._events: deque = deque()
         self._signal = threading.Event()
         self._stopped = threading.Event()
+
+    def _evict(self) -> None:
+        """Slow-consumer cutoff: drop the backlog, mark gone, stop."""
+        self.evicted = True
+        self._events.clear()
+        self._store._note_eviction(self)
+        self.stop()
 
     def _push(self, ev: "WatchEvent") -> None:
         if self._stopped.is_set():
@@ -310,6 +332,9 @@ class Watcher:
         if not self._filter(ev.object):
             return
         self._events.append(ev)
+        if self.high_water and len(self._events) > self.high_water:
+            self._evict()
+            return
         self._signal.set()
 
     def _push_batch(self, evs: List["WatchEvent"]) -> None:
@@ -323,7 +348,18 @@ class Watcher:
         else:
             f = self._filter
             self._events.extend(ev for ev in evs if f(ev.object))
+        if self.high_water and len(self._events) > self.high_water:
+            self._evict()
+            return
         self._signal.set()
+
+    def _seed(self, evs: List["WatchEvent"]) -> None:
+        """Preload resume-replay events with no high-water check: the
+        backlog is bounded by the history ring and predates the
+        consumer's first read, so it is not slow-consumer evidence."""
+        self._events.extend(evs)
+        if evs:
+            self._signal.set()
 
     def drain(self) -> List["WatchEvent"]:
         """Pop every currently-queued event without blocking."""
@@ -495,10 +531,17 @@ class ResourceStore:
 
     HISTORY = 16384
 
+    #: default undelivered-event bound per watcher (half the history
+    #: ring: an evicted consumer's resume-at-rv replay is then always
+    #: still covered by the ring, so eviction never forces a re-list
+    #: by itself)
+    WATCH_HIGH_WATER = 8192
+
     def __init__(
         self,
         clock: Optional[Clock] = None,
         namespace_finalizers: bool = False,
+        watch_high_water: Optional[int] = None,
     ):
         #: inject NS_FINALIZER on Namespace create (the real apiserver
         #: injects spec.finalizers the same way) — opt-in by cluster
@@ -530,6 +573,14 @@ class ResourceStore:
         #: (verb, key, as_user); bounded — at device-drain rates an
         #: unbounded list is a slow memory leak
         self._audit: deque = deque(maxlen=1_000_000)
+        #: per-watcher undelivered-event bound (0 disables eviction)
+        self.watch_high_water = (
+            self.WATCH_HIGH_WATER
+            if watch_high_water is None
+            else int(watch_high_water)
+        )
+        #: slow watchers evicted by backpressure (scraped via /metrics)
+        self.watch_evictions = 0
         for t in BUILTIN_TYPES:
             self.register_type(t)
         # the hottest field-selector in the system: the kubelet server
@@ -688,6 +739,11 @@ class ResourceStore:
             for st in self._types.values():
                 if watcher in st.watchers:
                     st.watchers.remove(watcher)
+
+    def _note_eviction(self, watcher: Watcher) -> None:
+        # always called with the mutex held (pushes happen under it)
+        self.watch_evictions += 1
+        self._audit.append(("watch-evicted", "", None))
 
     def _bump(self, obj: dict) -> int:
         self._rv += 1
@@ -1250,6 +1306,7 @@ class ResourceStore:
                     and field_selector is None
                 ),
                 status_interest=status_interest,
+                high_water=self.watch_high_water,
             )
             if since_rv is not None and since_rv > self._rv:
                 # a resume from the future means the store lost state
@@ -1283,9 +1340,12 @@ class ResourceStore:
                 hist = list(st.history)
                 if hist and hist[0].rv > since_rv + 1 and len(hist) == st.history.maxlen:
                     raise Expired(f"resourceVersion {since_rv} is too old")
-                for ev in hist:
-                    if ev.rv > since_rv:
-                        w._push(ev)
+                # resume replay bypasses the high-water check (_seed):
+                # the backlog is ring-bounded and predates the
+                # consumer's first read — only LIVE lag evicts
+                w._seed(
+                    [ev for ev in hist if ev.rv > since_rv and filt(ev.object)]
+                )
             st.watchers.append(w)
             return w
 

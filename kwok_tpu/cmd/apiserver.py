@@ -9,7 +9,12 @@ etcd-snapshot analog: loaded on boot, written on SIGTERM and every
 snapshots and replayed on boot, so a crashed daemon loses nothing and
 restarted watch streams resume without re-lists.  ``--chaos-profile``
 arms the HTTP fault injector (``kwok_tpu.chaos``) from a seeded
-profile — latency/429/503/resets/watch-drops at this boundary.
+profile — latency/429/503/resets/watch-drops at this boundary, plus
+best-effort request floods when the profile carries ``overload``
+windows.  ``--max-inflight`` / ``--flow-config`` arm APF-style flow
+control (``kwok_tpu.cluster.flowcontrol``): per-priority-level
+concurrency shares with fair queues, 429+Retry-After shedding, and
+per-level metrics at ``/metrics``.
 """
 
 from __future__ import annotations
@@ -46,6 +51,26 @@ def build_parser() -> argparse.ArgumentParser:
         "--chaos-profile",
         default="",
         help="arm the HTTP fault injector from this seeded profile YAML",
+    )
+    p.add_argument(
+        "--max-inflight",
+        type=int,
+        default=64,
+        help="global concurrent-request budget, split across priority "
+        "levels (0 disables flow control, like a pre-APF apiserver)",
+    )
+    p.add_argument(
+        "--flow-config",
+        default="",
+        help="YAML flow schema overriding the default priority levels "
+        "and client classification",
+    )
+    p.add_argument(
+        "--watch-timeout",
+        type=float,
+        default=3600.0,
+        help="default server-side watch deadline in seconds "
+        "(?timeoutSeconds= overrides per request; 0 disables)",
     )
     p.add_argument("--tls-cert", default="")
     p.add_argument("--tls-key", default="")
@@ -88,6 +113,7 @@ def main(argv=None) -> int:
         store.attach_wal(WriteAheadLog(args.wal_file, fsync=args.wal_fsync))
 
     injector = None
+    plan = None
     if args.chaos_profile:
         from kwok_tpu.chaos import HttpFaultInjector, load_profile
 
@@ -96,6 +122,28 @@ def main(argv=None) -> int:
         print(
             f"chaos: HTTP fault injection armed (seed={plan.seed}, "
             f"duration={plan.duration}s)",
+            flush=True,
+        )
+
+    flow = None
+    if args.max_inflight > 0 or args.flow_config:
+        from kwok_tpu.cluster.flowcontrol import (
+            FlowConfig,
+            FlowController,
+            load_flow_config,
+        )
+
+        if args.flow_config:
+            config = load_flow_config(args.flow_config)
+        else:
+            config = FlowConfig(max_inflight=args.max_inflight)
+        flow = FlowController(
+            config, seed=plan.seed if plan is not None else 0
+        )
+        print(
+            "flowcontrol: APF armed "
+            f"(max_inflight={config.max_inflight}, levels="
+            f"{[lv.name for lv in config.levels]})",
             flush=True,
         )
 
@@ -109,9 +157,22 @@ def main(argv=None) -> int:
         audit_path=args.audit_file or None,
         kubelet_url=args.kubelet_url or None,
         fault_injector=injector,
+        flow=flow,
+        watch_timeout=args.watch_timeout,
     )
     srv.start()
     print(f"apiserver listening on {srv.url}", flush=True)
+
+    overload = None
+    if plan is not None and plan.http.overloads:
+        from kwok_tpu.chaos import OverloadDriver
+
+        overload = OverloadDriver(plan, srv.url).start()
+        print(
+            f"chaos: overload flood armed "
+            f"({len(plan.http.overloads)} windows)",
+            flush=True,
+        )
 
     done = threading.Event()
 
@@ -128,9 +189,14 @@ def main(argv=None) -> int:
             store.save_file(args.state_file)
     if args.state_file and store.resource_version != saved_rv:
         store.save_file(args.state_file)
+    if overload is not None:
+        overload.stop()
+        print(f"chaos: overload flood {overload.snapshot()}", flush=True)
     srv.stop()
     if injector is not None:
         print(f"chaos: injected faults {injector.snapshot()}", flush=True)
+    if flow is not None:
+        print(f"flowcontrol: levels {flow.snapshot()}", flush=True)
     return 0
 
 

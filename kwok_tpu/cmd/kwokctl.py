@@ -77,6 +77,8 @@ def cmd_create_cluster(args) -> int:
         controller_args=args.controller_arg,
         enable_tracing=args.enable_tracing,
         chaos_profile=args.chaos_profile or None,
+        flow_config=args.flow_config or None,
+        max_inflight=args.max_inflight,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -1293,6 +1295,20 @@ def build_parser() -> argparse.ArgumentParser:
         help="arm apiserver HTTP fault injection from this seeded "
         "profile YAML (see kwok_tpu.chaos; python -m kwok_tpu.chaos "
         "drives the process-fault layer)",
+    )
+    c.add_argument(
+        "--flow-config",
+        default="",
+        help="apiserver APF flow schema YAML: priority levels, "
+        "concurrency shares, and client classification "
+        "(see kwok_tpu.cluster.flowcontrol)",
+    )
+    c.add_argument(
+        "--max-inflight",
+        type=int,
+        default=None,
+        help="apiserver global inflight budget split across priority "
+        "levels (default 64; 0 disables flow control)",
     )
     c.add_argument("--wait", type=float, default=60.0)
     c.set_defaults(fn=cmd_create_cluster)

@@ -52,6 +52,12 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+#: default global inflight budget for cluster apiservers — the
+#: reference's --max-requests-inflight seat, split across the APF
+#: priority levels (cluster.flowcontrol)
+DEFAULT_MAX_INFLIGHT = 64
+
+
 def build_apiserver_component(
     workdir: str,
     port: int,
@@ -59,6 +65,8 @@ def build_apiserver_component(
     pki_dir: Optional[str] = None,
     kubelet_port: Optional[int] = None,
     chaos_profile: Optional[str] = None,
+    flow_config: Optional[str] = None,
+    max_inflight: Optional[int] = None,
 ) -> Component:
     """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
     args = [
@@ -77,7 +85,14 @@ def build_apiserver_component(
         os.path.join(workdir, "wal.jsonl"),
         "--audit-file",
         os.path.join(workdir, "logs", "audit.log"),
+        # overload protection on by default (the reference apiserver's
+        # --max-requests-inflight posture); explicit in the component
+        # spec so the cluster's protection level is auditable
+        "--max-inflight",
+        str(DEFAULT_MAX_INFLIGHT if max_inflight is None else max_inflight),
     ]
+    if flow_config:
+        args += ["--flow-config", flow_config]
     if chaos_profile:
         args += ["--chaos-profile", chaos_profile]
     if kubelet_port:
@@ -235,6 +250,8 @@ def build_core_components(
     backend: str = "host",
     extra_args: Optional[List[str]] = None,
     chaos_profile: Optional[str] = None,
+    flow_config: Optional[str] = None,
+    max_inflight: Optional[int] = None,
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
@@ -249,6 +266,8 @@ def build_core_components(
             pki_dir=pki_dir,
             kubelet_port=kubelet_port,
             chaos_profile=chaos_profile,
+            flow_config=flow_config,
+            max_inflight=max_inflight,
         ),
         build_scheduler_component(server_url, secure=secure, pki_dir=pki_dir),
         build_kcm_component(server_url, secure=secure, pki_dir=pki_dir),

@@ -110,6 +110,8 @@ class BinaryRuntime:
         controller_args: Optional[List[str]] = None,
         enable_tracing: bool = False,
         chaos_profile: Optional[str] = None,
+        flow_config: Optional[str] = None,
+        max_inflight: Optional[int] = None,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -152,6 +154,16 @@ class BinaryRuntime:
             else:
                 shutil.copyfile(chaos_profile, stored_chaos)
 
+        stored_flow: Optional[str] = None
+        if flow_config:
+            # same self-containment as the chaos profile: restarts
+            # re-arm the same priority levels and flow schema
+            stored_flow = self._path("flow-config.yaml")
+            if dry_run.enabled:
+                dry_run.emit(f"cp {flow_config} {stored_flow}")
+            else:
+                shutil.copyfile(flow_config, stored_flow)
+
         components = build_core_components(
             self.workdir,
             server_url,
@@ -163,6 +175,8 @@ class BinaryRuntime:
             backend=backend,
             extra_args=controller_args,
             chaos_profile=stored_chaos,
+            flow_config=stored_flow,
+            max_inflight=max_inflight,
         )
         tracing_port = 0
         if enable_tracing:
@@ -190,6 +204,10 @@ class BinaryRuntime:
             conf["ports"]["tracing"] = tracing_port
         if stored_chaos:
             conf["chaosProfile"] = stored_chaos
+        if stored_flow:
+            conf["flowConfig"] = stored_flow
+        if max_inflight is not None:
+            conf["maxInflight"] = int(max_inflight)
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:

@@ -151,8 +151,20 @@ class SpdyChannelAdapter:
         if self.session.closed:
             self._push(None)
 
+    #: inbound frame backlog bound: past this the pump blocks instead
+    #: of buffering, pushing backpressure down to the SPDY stream (and
+    #: ultimately the peer's socket) rather than growing server memory
+    MAX_IN_Q = 1024
+
     def _push(self, item) -> None:
         with self._cv:
+            while (
+                item is not None
+                and len(self._in_q) >= self.MAX_IN_Q
+                and not self.session.closed
+            ):
+                # wait() drops the lock; the consumer's recv() drains
+                self._cv.wait(0.1)
             self._in_q.append(item)
             self._cv.notify_all()
 
@@ -162,7 +174,10 @@ class SpdyChannelAdapter:
                 if self.session.closed:
                     return None
                 self._cv.wait(0.5)
-            return self._in_q.pop(0)
+            item = self._in_q.pop(0)
+            # wake a pump blocked on the MAX_IN_Q backpressure bound
+            self._cv.notify_all()
+            return item
 
     def send_channel(self, channel: int, data: bytes) -> bool:
         for t, ch in _TYPE_TO_CHANNEL.items():

@@ -818,3 +818,179 @@ def test_swallowed_suppression_comment_works(tmp_path):
         },
     )
     assert run_rules(root, ["swallowed-errors"]) == []
+
+
+# ---------------------------------------------------------- unbounded-buffer
+
+
+def test_unbounded_deque_pushed_in_while_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/w.py": """
+            from collections import deque
+
+            class Pump:
+                def __init__(self):
+                    self._events = deque()
+
+                def run(self, src):
+                    while True:
+                        self._events.append(src.read())
+            """,
+        },
+    )
+    fs = run_rules(root, ["unbounded-buffer"])
+    assert len(fs) == 1 and "Pump._events" in fs[0].message
+    assert fs[0].path == "kwok_tpu/cluster/w.py"
+
+
+def test_unbounded_queue_in_event_method_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/server/q.py": """
+            from kwok_tpu.utils.queue import Queue
+
+            class Fanout:
+                def __init__(self):
+                    self._queue = Queue()
+
+                def _push(self, ev):
+                    self._queue.add(ev)
+            """,
+            "kwok_tpu/utils/queue.py": "class Queue:\n    pass\n",
+        },
+    )
+    fs = run_rules(root, ["unbounded-buffer"])
+    assert len(fs) == 1 and "Fanout._queue" in fs[0].message
+
+
+def test_high_water_check_is_a_bound(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/w.py": """
+            from collections import deque
+
+            class Pump:
+                HIGH_WATER = 100
+
+                def __init__(self):
+                    self._events = deque()
+
+                def _push(self, ev):
+                    self._events.append(ev)
+                    if len(self._events) > self.HIGH_WATER:
+                        self._events.clear()
+            """,
+        },
+    )
+    assert run_rules(root, ["unbounded-buffer"]) == []
+
+
+def test_maxlen_ctor_is_a_bound(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/w.py": """
+            from collections import deque
+
+            class Pump:
+                def __init__(self):
+                    self._events = deque(maxlen=4096)
+
+                def _push(self, ev):
+                    self._events.append(ev)
+            """,
+        },
+    )
+    assert run_rules(root, ["unbounded-buffer"]) == []
+
+
+def test_config_list_append_outside_event_flow_clean(tmp_path):
+    """One append per config doc / subscription — growth bounded by the
+    caller, not by event rate — stays exempt."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/server/s.py": """
+            class Server:
+                def __init__(self):
+                    self.logs = []
+                    self._threads = []
+
+                def set_configs(self, docs):
+                    for d in docs:
+                        self.logs.append(d)
+
+                def watch(self, t):
+                    self._threads.append(t)
+            """,
+        },
+    )
+    assert run_rules(root, ["unbounded-buffer"]) == []
+
+
+def test_outside_serving_scope_clean(tmp_path):
+    """The rule patrols cluster/ and server/ only."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            from collections import deque
+
+            class Loop:
+                def __init__(self):
+                    self._q = deque()
+
+                def run(self):
+                    while True:
+                        self._q.append(1)
+            """,
+        },
+    )
+    assert run_rules(root, ["unbounded-buffer"]) == []
+
+
+def test_unbounded_suppression_comment_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/w.py": """
+            from collections import deque
+
+            class Pump:
+                def __init__(self):
+                    # growth bounded by the session's frame budget
+                    self._events = deque()  # kwoklint: disable=unbounded-buffer
+
+                def run(self, src):
+                    while True:
+                        self._events.append(src.read())
+            """,
+        },
+    )
+    assert run_rules(root, ["unbounded-buffer"]) == []
+
+
+def test_positional_queue_maxsize_is_a_bound(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/server/q.py": """
+            from queue import Queue
+
+            class Fanout:
+                def __init__(self):
+                    self._queue = Queue(512)
+                    self._unbounded = Queue(0)
+
+                def _push(self, ev):
+                    self._queue.put(ev)
+                    self._unbounded.put(ev)
+            """,
+        },
+    )
+    fs = run_rules(root, ["unbounded-buffer"])
+    assert len(fs) == 1 and "Fanout._unbounded" in fs[0].message

@@ -1,0 +1,399 @@
+"""Flowcontrol internals: classifier precedence, share math, queue-wait
+deadline, Retry-After derivation, and watcher high-water eviction with
+informer resume — all in-process, no daemons."""
+
+import threading
+import time
+
+import pytest
+
+from kwok_tpu.cluster.flowcontrol import (
+    BEST_EFFORT,
+    DEFAULT_LEVELS,
+    RETRY_AFTER_CAP_S,
+    FlowConfig,
+    FlowController,
+    FlowRejected,
+    FlowRule,
+    PriorityLevel,
+    expose_metrics,
+    load_flow_config,
+)
+
+# ---------------------------------------------------------------- classifier
+
+
+def test_classifier_default_schema():
+    c = FlowController()
+    assert c.classify("kwokctl") == "system"
+    assert c.classify("kwok-client") == "system"
+    assert c.classify("kube-controller-manager") == "controllers"
+    assert c.classify("scheduler") == "controllers"
+    assert c.classify("device-player") == "workloads"
+    assert c.classify("someone-else") == "best-effort"
+    assert c.classify("") == "best-effort"
+
+
+def test_classifier_exact_beats_prefix():
+    cfg = FlowConfig(
+        flows=(
+            FlowRule("workloads", prefixes=("canary",)),
+            FlowRule("system", clients=("canary-1",)),
+        )
+        + tuple(),
+    )
+    c = FlowController(cfg)
+    # canary-1 matches both the workloads prefix and the system exact
+    # name: exact wins even though the prefix rule is listed first
+    assert c.classify("canary-1") == "system"
+    assert c.classify("canary-2") == "workloads"
+
+
+def test_classifier_rule_order_within_match_kind():
+    cfg = FlowConfig(
+        flows=(
+            FlowRule("controllers", prefixes=("a",)),
+            FlowRule("workloads", prefixes=("ab",)),
+        ),
+    )
+    c = FlowController(cfg)
+    # both prefixes match "abc"; the first-listed rule wins
+    assert c.classify("abc") == "controllers"
+
+
+def test_user_flows_precede_defaults_in_yaml(tmp_path):
+    p = tmp_path / "flow.yaml"
+    p.write_text(
+        """
+kind: FlowConfiguration
+maxInflight: 16
+flows:
+  - {level: system, clients: [canary]}
+levels:
+  - {name: best-effort, queueWaitSeconds: 0.05, queueLimit: 2}
+"""
+    )
+    cfg = load_flow_config(str(p))
+    assert cfg.max_inflight == 16
+    c = FlowController(cfg)
+    assert c.classify("canary") == "system"
+    # defaults still apply to unmapped clients
+    assert c.classify("kwok-controller") == "controllers"
+    be = next(lv for lv in cfg.levels if lv.name == "best-effort")
+    assert be.queue_wait_s == 0.05 and be.queue_limit == 2
+    # untouched fields inherit the default level's values
+    assert be.shares == 10
+
+
+def test_flow_config_rejects_unknown_level():
+    with pytest.raises(ValueError):
+        FlowConfig(flows=(FlowRule("no-such-level", clients=("x",)),))
+
+
+# ---------------------------------------------------------------- share math
+
+
+def test_share_math_partitions_max_inflight():
+    c = FlowController(FlowConfig(max_inflight=100))
+    # DEFAULT_LEVELS shares: 40/30/20/10 of 100
+    assert c.seats("system") == 40
+    assert c.seats("controllers") == 30
+    assert c.seats("workloads") == 20
+    assert c.seats("best-effort") == 10
+
+
+def test_share_math_minimum_one_seat():
+    c = FlowController(FlowConfig(max_inflight=2))
+    for lv in DEFAULT_LEVELS:
+        assert c.seats(lv.name) >= 1
+
+
+# ----------------------------------------------------------------- admission
+
+
+def _tiny_controller(queue_wait=0.1, queue_limit=8, queues=1):
+    levels = tuple(
+        lv
+        if lv.name != BEST_EFFORT
+        else PriorityLevel(
+            BEST_EFFORT,
+            shares=lv.shares,
+            queues=queues,
+            queue_wait_s=queue_wait,
+            queue_limit=queue_limit,
+        )
+        for lv in DEFAULT_LEVELS
+    )
+    return FlowController(FlowConfig(max_inflight=2, levels=levels))
+
+
+def test_queue_wait_deadline_rejects_with_retry_after():
+    c = _tiny_controller(queue_wait=0.1)
+    held = c.admit("flood")  # takes best-effort's only seat
+    t0 = time.monotonic()
+    with pytest.raises(FlowRejected) as ei:
+        c.admit("flood")
+    waited = time.monotonic() - t0
+    assert 0.05 <= waited < 2.0  # waited the deadline, then shed
+    assert ei.value.level == "best-effort"
+    assert ei.value.retry_after > 0
+    c.release(held)
+    snap = c.snapshot()["best-effort"]
+    assert snap["rejected"] == 1 and snap["queued"] == 0
+
+
+def test_queue_full_rejects_immediately():
+    c = _tiny_controller(queue_wait=5.0, queue_limit=1, queues=1)
+    held = c.admit("a")
+    granted = []
+
+    def waiter():  # fills the single queue slot, granted on release
+        t = c.admit("b")
+        granted.append(t)
+        c.release(t)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    t0 = time.monotonic()
+    with pytest.raises(FlowRejected):
+        c.admit("c")
+    assert time.monotonic() - t0 < 1.0  # no queue-wait sleep: instant
+    c.release(held)
+    th.join(timeout=10)
+    assert granted
+
+
+def test_seat_hands_off_to_queued_waiter():
+    c = _tiny_controller(queue_wait=5.0)
+    held = c.admit("a")
+    got = []
+
+    def waiter():
+        t = c.admit("b")
+        got.append(t)
+        c.release(t)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    assert c.snapshot()["best-effort"]["queued"] == 1
+    c.release(held)
+    th.join(timeout=10)
+    assert got and got[0].released
+    snap = c.snapshot()["best-effort"]
+    assert snap["inflight"] == 0 and snap["queued"] == 0
+    assert snap["dispatched"] == 2 and snap["rejected"] == 0
+
+
+def test_levels_are_isolated():
+    """Saturating best-effort must not delay or shed system traffic."""
+    c = _tiny_controller(queue_wait=0.1)
+    held = c.admit("flood")
+    t0 = time.monotonic()
+    t = c.admit("kwokctl")  # system level: own seats
+    assert time.monotonic() - t0 < 0.05
+    c.release(t)
+    c.release(held)
+    assert c.snapshot()["system"]["rejected"] == 0
+
+
+def test_long_running_admission_holds_no_seat():
+    c = _tiny_controller()
+    t = c.admit("flood", long_running=True)
+    assert t.released
+    assert c.snapshot()["best-effort"]["inflight"] == 0
+    # a second long-running request admits fine too
+    c.admit("flood", long_running=True)
+
+
+def test_release_is_idempotent():
+    c = _tiny_controller()
+    t = c.admit("x")
+    c.release(t)
+    c.release(t)
+    assert c.snapshot()["best-effort"]["inflight"] == 0
+
+
+# ------------------------------------------------------------- retry-after
+
+
+def test_retry_after_grows_with_queue_depth_and_caps():
+    c = FlowController(FlowConfig(max_inflight=4))
+    lvl = c._levels["best-effort"]
+    lvl.queued = 0
+    shallow = c._retry_after(lvl)
+    lvl.queued = 10
+    deep = c._retry_after(lvl)
+    lvl.queued = 100000
+    capped = c._retry_after(lvl)
+    lvl.queued = 0
+    assert shallow < deep <= capped == RETRY_AFTER_CAP_S
+
+
+# ------------------------------------------------------- Retry-After parsing
+
+
+def test_parse_retry_after_fractional_and_int():
+    from kwok_tpu.cluster.client import parse_retry_after
+
+    assert parse_retry_after("1.5") == 1.5
+    assert parse_retry_after("3") == 3.0
+    assert parse_retry_after("-2") == 0.0  # never negative
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("") is None
+    assert parse_retry_after("soon") is None
+
+
+def test_parse_retry_after_http_date():
+    from email.utils import formatdate
+
+    from kwok_tpu.cluster.client import parse_retry_after
+
+    future = formatdate(time.time() + 30, usegmt=True)
+    got = parse_retry_after(future)
+    assert got is not None and 25.0 < got <= 31.0
+    past = formatdate(time.time() - 30, usegmt=True)
+    assert parse_retry_after(past) == 0.0
+
+
+# ------------------------------------------------- watcher high-water/evict
+
+
+def _make_store(high_water):
+    from kwok_tpu.cluster.store import ResourceStore
+
+    return ResourceStore(watch_high_water=high_water)
+
+
+def _mk_cm(store, i):
+    return store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": f"cm-{i}", "namespace": "default"},
+            "data": {"i": str(i)},
+        }
+    )
+
+
+def test_slow_watcher_evicted_at_high_water():
+    store = _make_store(high_water=10)
+    w = store.watch("ConfigMap")
+    for i in range(11):
+        _mk_cm(store, i)
+    assert w.evicted and w.stopped
+    assert w.next(timeout=0) is None  # backlog dropped, not delivered
+    assert store.watch_evictions == 1
+    # fast consumers are unaffected: a fresh watcher seeing few events
+    w2 = store.watch("ConfigMap")
+    _mk_cm(store, 100)
+    assert w2.next(timeout=1).object["metadata"]["name"] == "cm-100"
+    assert not w2.evicted
+
+
+def test_eviction_then_resume_at_rv_replays_without_relist():
+    """The PR 3 informer path: after eviction the consumer resumes at
+    its last delivered rv and the history ring replays the gap — no
+    re-list, no lost events."""
+    store = _make_store(high_water=10)
+    w = store.watch("ConfigMap")
+    _mk_cm(store, 0)
+    first = w.next(timeout=1)
+    last_rv = first.rv
+    for i in range(1, 30):
+        _mk_cm(store, i)
+    assert w.evicted
+    # resume exactly where the evicted consumer left off
+    w2 = store.watch("ConfigMap", since_rv=last_rv)
+    names = set()
+    while True:
+        ev = w2.next(timeout=0.2)
+        if ev is None:
+            break
+        names.add(ev.object["metadata"]["name"])
+    assert names == {f"cm-{i}" for i in range(1, 30)}
+    assert not w2.evicted  # replay backlog is exempt from high-water
+
+
+def test_batch_push_eviction():
+    """apply_status_batch delivers a whole batch atomically; a batch
+    beyond high_water evicts rather than buffering it."""
+    store = _make_store(high_water=10)
+    for i in range(30):
+        _mk_cm(store, i)
+    w = store.watch("ConfigMap")
+    store.apply_status_batch(
+        "ConfigMap",
+        [("default", f"cm-{i}", {"phase": "x"}) for i in range(30)],
+    )
+    assert w.evicted
+    assert store.watch_evictions == 1
+
+
+def test_informer_recovers_from_server_side_eviction():
+    """End of the loop: the informer's own watcher is evicted by a
+    burst; the reflector resumes (resume counter) without a second
+    re-list and the cache converges."""
+    from kwok_tpu.cluster.informer import Informer, WatchOptions
+    from kwok_tpu.utils.queue import Queue
+
+    store = _make_store(high_water=10)
+    events: Queue = Queue()
+    done = threading.Event()
+    inf = Informer(store, "ConfigMap")
+    cache = inf.watch_with_cache(WatchOptions(), events, done=done)
+    try:
+        for i in range(31):
+            _mk_cm(store, i)
+        deadline = time.monotonic() + 10
+        while len(cache) < 31 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(cache) == 31
+        assert inf.relists == 1
+        # burst in one atomic batch: the store delivers all 31 events
+        # in one _push_batch, far past high_water — guaranteed eviction
+        # of the informer's live watcher
+        store.apply_status_batch(
+            "ConfigMap",
+            [("default", f"cm-{i}", {"phase": "x"}) for i in range(31)],
+        )
+        assert store.watch_evictions >= 1
+        # the reflector resumes at its last rv and replays the batch
+        # from the history ring — the cache converges to the new
+        # statuses with NO second re-list
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            obj = cache.get("cm-30", "default")
+            if obj is not None and (obj.get("status") or {}).get("phase") == "x":
+                break
+            time.sleep(0.02)
+        obj = cache.get("cm-30", "default")
+        assert obj is not None and obj["status"]["phase"] == "x", (
+            f"relists={inf.relists} resumes={inf.resumes}"
+        )
+        assert inf.relists == 1, "eviction forced a re-list"
+        assert inf.resumes >= 1
+    finally:
+        done.set()
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_expose_metrics_renders_per_level_samples():
+    from kwok_tpu.utils.promtext import iter_samples
+
+    c = FlowController(FlowConfig(max_inflight=8))
+    t = c.admit("flood")
+    store = _make_store(high_water=10)
+    text = expose_metrics(c, store)
+    c.release(t)
+    samples = {
+        (name, labels.get("level")): val
+        for name, labels, val in iter_samples(text)
+    }
+    assert samples[("kwok_apiserver_flow_inflight", "best-effort")] == 1
+    assert samples[("kwok_apiserver_flow_inflight", "system")] == 0
+    assert ("kwok_apiserver_flow_rejected_total", "controllers") in samples
+    assert ("kwok_apiserver_watch_evictions_total", None) in samples

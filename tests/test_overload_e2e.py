@@ -1,0 +1,264 @@
+"""Chaos overload e2e: the ISSUE 4 acceptance scenario.
+
+A seeded best-effort flood (the chaos plan's ``overload`` fault kind)
+hits an apiserver running APF flow control while a system-priority
+canary keeps writing and a controllers-priority informer keeps
+watching.  Graceful degradation, end to end:
+
+- every canary write acks (zero lost acked writes) with bounded
+  latency,
+- every shed flood request is a well-formed 429 carrying Retry-After —
+  zero hung or reset connections attributable to shedding,
+- a slow watcher is evicted at the high-water mark and the informer
+  resumes at its last resourceVersion without a forced re-list,
+- per-level inflight/queued/rejected metrics are scraped over HTTP and
+  land on the expected levels (best-effort shed, system untouched).
+
+All in-process (one APIServer thread, no daemons), seeded, seconds.
+"""
+
+import threading
+import time
+import urllib.request
+
+from kwok_tpu.chaos.http_faults import OverloadDriver
+from kwok_tpu.chaos.plan import FaultPlan, HttpFaultSpec, OverloadWindow
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient, RetryPolicy
+from kwok_tpu.cluster.flowcontrol import (
+    DEFAULT_FLOWS,
+    DEFAULT_LEVELS,
+    FlowConfig,
+    FlowController,
+    FlowRule,
+    PriorityLevel,
+)
+from kwok_tpu.cluster.informer import Informer, WatchOptions
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.utils.backoff import Backoff
+from kwok_tpu.utils.promtext import iter_samples
+from kwok_tpu.utils.queue import Queue
+
+SEED = 42
+FLOOD_S = 2.5
+HIGH_WATER = 25
+CANARY_LATENCY_BOUND_S = 10.0
+
+
+def _flow() -> FlowController:
+    # a tiny budget so the flood saturates best-effort instantly, while
+    # the canary rides a custom flow rule onto the system level
+    levels = tuple(
+        lv
+        if lv.name != "best-effort"
+        else PriorityLevel(
+            "best-effort", shares=lv.shares, queues=2,
+            queue_wait_s=0.1, queue_limit=2,
+        )
+        for lv in DEFAULT_LEVELS
+    )
+    return FlowController(
+        FlowConfig(
+            max_inflight=8,
+            levels=levels,
+            # custom rule first, defaults behind it (the same merge
+            # FlowConfig.from_dict performs for YAML profiles)
+            flows=(FlowRule("system", clients=("canary",)),) + DEFAULT_FLOWS,
+        ),
+        seed=SEED,
+    )
+
+
+def _retry(seed=7):
+    return RetryPolicy(
+        seed=seed,
+        max_attempts=10,
+        budget_s=30.0,
+        backoff=Backoff(duration=0.02, cap=0.5),
+    )
+
+
+def _ballast(store, n=1500):
+    """Populate pods so the flooded list endpoint has realistic cost
+    (an empty list is served faster than the flood arrives)."""
+    store.bulk(
+        [
+            {
+                "verb": "create",
+                "data": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"ballast-{i}",
+                        "namespace": "default",
+                    },
+                    "spec": {"nodeName": f"node-{i % 8}"},
+                    "status": {"phase": "Running"},
+                },
+            }
+            for i in range(n)
+        ]
+    )
+
+
+def test_overload_graceful_degradation_e2e():
+    flow = _flow()
+    store = ResourceStore(watch_high_water=HIGH_WATER)
+    _ballast(store)
+    with APIServer(store, flow=flow) as srv:
+        # controllers-priority informer established before the flood
+        inf_client = ClusterClient(
+            srv.url, retry=_retry(1), client_id="kube-controller-manager"
+        )
+        events: Queue = Queue()
+        done = threading.Event()
+        inf = Informer(inf_client, "ConfigMap")
+        cache = inf.watch_with_cache(WatchOptions(), events, done=done)
+        deadline = time.monotonic() + 15
+        while inf.relists < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert inf.relists == 1
+
+        # seeded flood: the chaos plan's overload fault kind
+        plan = FaultPlan(
+            seed=SEED,
+            duration=FLOOD_S + 60,
+            http=HttpFaultSpec(
+                overloads=[
+                    OverloadWindow(
+                        at=0.0, duration=FLOOD_S, rps=2000, clients=8
+                    )
+                ]
+            ),
+        )
+        driver = OverloadDriver(plan, srv.url).start()
+        canary = ClusterClient(srv.url, retry=_retry(), client_id="canary")
+
+        t0 = time.monotonic()
+        canaries = 0
+        worst = 0.0
+        while time.monotonic() - t0 < FLOOD_S:
+            s = time.monotonic()
+            canary.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"canary-{canaries}",
+                        "namespace": "default",
+                    },
+                    "data": {"i": str(canaries)},
+                }
+            )
+            worst = max(worst, time.monotonic() - s)
+            canaries += 1
+            time.sleep(0.01)
+        assert driver.wait(timeout=60), "flood workers never finished"
+        counters = driver.snapshot()
+
+        # 1) zero lost acked writes, bounded canary latency
+        assert canaries > 0
+        assert store.count("ConfigMap") == canaries
+        assert worst < CANARY_LATENCY_BOUND_S, (
+            f"canary latency {worst:.2f}s under flood"
+        )
+
+        # 2) graceful shedding: 429+Retry-After, never a hung socket
+        assert counters["shed"] > 0, f"flood was never shed: {counters}"
+        assert counters["shed_without_retry_after"] == 0, counters
+        assert counters["conn_errors"] == 0, (
+            f"hung/reset connections under shedding: {counters}"
+        )
+
+        # 3) slow-watcher eviction -> informer resume, no forced re-list
+        #    (top up the set so one atomic status batch tops high_water)
+        total = max(canaries, HIGH_WATER + 5)
+        for i in range(canaries, total):
+            canary.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"canary-{i}",
+                        "namespace": "default",
+                    },
+                    "data": {"i": str(i)},
+                }
+            )
+        deadline = time.monotonic() + 15
+        while len(cache) < total and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(cache) == total
+        store.apply_status_batch(
+            "ConfigMap",
+            [("default", f"canary-{i}", {"phase": "x"}) for i in range(total)],
+        )
+        assert store.watch_evictions >= 1
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            obj = cache.get(f"canary-{total - 1}", "default")
+            if obj is not None and (obj.get("status") or {}).get("phase") == "x":
+                break
+            time.sleep(0.02)
+        obj = cache.get(f"canary-{total - 1}", "default")
+        assert obj is not None and obj["status"]["phase"] == "x", (
+            f"relists={inf.relists} resumes={inf.resumes}"
+        )
+        assert inf.relists == 1, (
+            f"eviction forced a re-list (resumes={inf.resumes})"
+        )
+        assert inf.resumes >= 1
+        done.set()
+
+        # 4) per-level metrics over the wire
+        body = (
+            urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+            .read()
+            .decode()
+        )
+        samples = {
+            (name, labels.get("level")): val
+            for name, labels, val in iter_samples(body)
+        }
+        assert samples[("kwok_apiserver_flow_rejected_total", "best-effort")] > 0
+        assert samples[("kwok_apiserver_flow_rejected_total", "system")] == 0
+        assert samples[("kwok_apiserver_flow_rejected_total", "controllers")] == 0
+        assert (
+            samples[
+                ("kwok_apiserver_flow_evicted_watchers_total", "controllers")
+            ]
+            >= 1
+        )
+        assert samples[("kwok_apiserver_flow_dispatched_total", "system")] > 0
+        assert samples[("kwok_apiserver_watch_evictions_total", None)] >= 1
+        # gauges exist and have settled back to idle
+        assert samples[("kwok_apiserver_flow_inflight", "best-effort")] == 0
+        assert samples[("kwok_apiserver_flow_queued", "best-effort")] == 0
+
+
+def test_watch_timeout_closes_stream_cleanly():
+    """Server-side deadline: a watch with ?timeoutSeconds ends with a
+    clean EOF the client observes as a stopped stream (no error), and
+    the connection does not outlive the deadline."""
+    store = ResourceStore()
+    with APIServer(store, watch_timeout=3600.0) as srv:
+        client = ClusterClient(srv.url, retry=_retry())
+        w = client.watch("ConfigMap")
+        try:
+            assert not w.stopped
+        finally:
+            w.stop()
+        # explicit short deadline via the query param
+        import http.client
+
+        host, port = srv.address
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        t0 = time.monotonic()
+        conn.request("GET", "/r/configmaps?watch=1&timeoutSeconds=1")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        data = resp.read()  # EOF at the deadline
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, f"watch outlived its 1s deadline: {elapsed:.1f}s"
+        conn.close()
+        del data

@@ -23,6 +23,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/ "${PYTEST_ARGS[@]}"
 if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== chaos smoke (seeded faults -> WAL recovery, zero lost writes) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --smoke --pods "${CHAOS_PODS:-40}"
+    echo "== overload smoke (best-effort flood -> 429s, canary unharmed) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --overload-smoke \
+        --flood-seconds "${OVERLOAD_SECONDS:-2}"
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
