@@ -112,6 +112,7 @@ def all_rules() -> "dict[str, object]":
         swallowed_errors,
         tracer_safety,
         unbounded_buffer,
+        wallclock_deadline,
     )
 
     return {
@@ -122,6 +123,7 @@ def all_rules() -> "dict[str, object]":
         "parity-citations": parity_citations.analyze,
         "swallowed-errors": swallowed_errors.analyze,
         "unbounded-buffer": unbounded_buffer.analyze,
+        "wallclock-deadline": wallclock_deadline.analyze,
     }
 
 

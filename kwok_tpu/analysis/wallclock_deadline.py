@@ -1,0 +1,131 @@
+"""Wallclock-deadline analyzer: lease/deadline math must be monotonic.
+
+The HA control plane measures lease expiry, renew deadlines, and
+takeover bounds on ``kwok_tpu.utils.clock`` monotonic time
+(``MonotonicClock``; client-go's leaderelection.go:61-73 documents why
+— wall clocks step under NTP/suspend, and a backwards step turns an
+expired lease live again, which is exactly the split-brain the
+election exists to prevent).  This rule mechanizes that invariant for
+the layers that carry lease/deadline arithmetic:
+
+Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/controllers/``,
+``kwok_tpu/ctl/``.
+
+A finding fires when a ``time.time()`` call participates in *deadline
+or expiry arithmetic*:
+
+1. inside a comparison (``time.time() < deadline``), or
+2. inside arithmetic (``expiry - time.time()``,
+   ``time.time() + timeout``), or
+3. assigned (possibly via arithmetic) to a deadline-ish name
+   (``deadline``, ``expiry``, ``due``, ``renew...``, ``until`` ...).
+
+Plain timestamping (``{"ts": time.time()}`` audit lines, metric
+labels) is wall-clock by nature and stays exempt.  Fix by injecting a
+``utils.clock.Clock`` (``MonotonicClock`` for real deadlines,
+``FakeClock`` in tests) or using ``time.monotonic()`` directly; a
+deliberate wall-clock computation (e.g. HTTP-date parsing) carries
+``# kwoklint: disable=wallclock-deadline`` plus the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from kwok_tpu.analysis import Finding, SourceFile, dotted_name
+
+RULE = "wallclock-deadline"
+
+#: layers whose deadline math must be monotonic
+SCOPE = ("kwok_tpu/cluster/", "kwok_tpu/controllers/", "kwok_tpu/ctl/")
+
+#: assignment targets that make a bare ``time.time()`` a deadline
+_DEADLINE_NAME = re.compile(
+    r"(deadline|expir|expiry|due|renew|lease|timeout_at|until)", re.IGNORECASE
+)
+
+_MSG = (
+    "time.time() used in deadline/expiry arithmetic; wall clocks step "
+    "(NTP/suspend) — use kwok_tpu.utils.clock (MonotonicClock) or "
+    "time.monotonic() for lease/deadline math"
+)
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) == "time.time"
+
+
+def _arith_operands(node: ast.AST) -> Iterable[ast.AST]:
+    """time.time() calls reachable through *arithmetic* structure only
+    (BinOp/UnaryOp/Compare/IfExp operands).  Descending through other
+    nodes (calls, dict literals) would flag plain timestamping like
+    ``json.dumps({"ts": time.time()}) + "\\n"``, which is wall-clock by
+    nature."""
+    if _is_wallclock_call(node):
+        yield node
+        return
+    children: List[ast.AST] = []
+    if isinstance(node, ast.BinOp):
+        children = [node.left, node.right]
+    elif isinstance(node, ast.UnaryOp):
+        children = [node.operand]
+    elif isinstance(node, ast.Compare):
+        children = [node.left, *node.comparators]
+    elif isinstance(node, ast.IfExp):
+        children = [node.body, node.orelse]
+    for child in children:
+        yield from _arith_operands(child)
+
+
+def _contains_wallclock(node: ast.AST) -> bool:
+    return any(True for _ in _arith_operands(node)) or _is_wallclock_call(node)
+
+
+def _target_names(node: ast.AST) -> Iterable[str]:
+    for t in ast.walk(node):
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, ast.Attribute):
+            yield t.attr
+
+
+def analyze(files: List[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith(SCOPE):
+            continue
+        flagged = set()  # line numbers already reported
+
+        def flag(call: ast.AST) -> None:
+            if call.lineno in flagged:
+                return
+            flagged.add(call.lineno)
+            findings.append(
+                Finding(rule=RULE, path=sf.path, line=call.lineno, message=_MSG)
+            )
+
+        for node in ast.walk(sf.tree):
+            # arithmetic / comparison with time.time() as an operand
+            if isinstance(node, (ast.BinOp, ast.Compare)):
+                for sub in _arith_operands(node):
+                    flag(sub)
+            elif isinstance(node, ast.AugAssign):
+                for sub in _arith_operands(node.value):
+                    flag(sub)
+            # deadline-ish assignment targets
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                if value is None or not _contains_wallclock(value):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                names = [n for t in targets for n in _target_names(t)]
+                if any(_DEADLINE_NAME.search(n) for n in names):
+                    for sub in _arith_operands(value):
+                        flag(sub)
+    return findings

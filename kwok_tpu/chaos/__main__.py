@@ -24,6 +24,12 @@ Three modes over one seeded profile
   latency, the flood is shed with well-formed 429+Retry-After (zero
   connection errors), and no system-level request was rejected.
   tools/check.sh runs this on every check too.
+- ``--failover-smoke``  self-contained HA check: three leader electors
+  (cluster/election.py) on one APF-armed apiserver.  Asserts a single
+  leader at a time, bounded takeover (2x leaseDuration after a silent
+  kill, ~one renew interval after a graceful release), and that a
+  stale leadership generation's writes are fenced with 409 while the
+  live leader's pass.  tools/check.sh runs this on every check too.
 """
 
 from __future__ import annotations
@@ -292,6 +298,128 @@ def run_overload_smoke(
     }
 
 
+def run_failover_smoke(seed: int = 42, lease_duration: float = 2.5) -> dict:
+    """In-process HA smoke: three electors on one apiserver (APF on).
+
+    Asserts the acceptance bounds of the leader-election subsystem
+    (cluster/election.py) with real wall-clock timing:
+
+    - exactly one leader at a time (the standby never self-promotes
+      while the leader renews),
+    - after the leader goes silent (SIGKILL analog: stop WITHOUT
+      releasing), a standby holds the lease within 2x leaseDuration,
+    - after a graceful step-down (release, the SIGTERM path), a
+      standby holds it within ~one renew interval (asserted at
+      <= leaseDuration, reported exactly),
+    - the dead ex-leader's fence token is rejected with 409 while the
+      live leader's token passes (split-brain write fencing).
+    """
+    import random
+
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import ClusterClient
+    from kwok_tpu.cluster.election import LeaderElector
+    from kwok_tpu.cluster.flowcontrol import FlowConfig, FlowController
+    from kwok_tpu.cluster.store import Conflict, ResourceStore
+
+    lease_name = "kwok-controller"
+    store = ResourceStore()
+    flow = FlowController(FlowConfig(max_inflight=16), seed=seed)
+
+    def wait_until(cond, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(0.02)
+        return cond()
+
+    with APIServer(store, flow=flow) as srv:
+
+        def mk(identity: str, bump: int) -> LeaderElector:
+            return LeaderElector(
+                # lease traffic rides the system priority level, like
+                # the daemons' electors (X-Kwok-Client "system:...")
+                ClusterClient(srv.url, client_id=f"system:{identity}"),
+                lease_name,
+                identity,
+                lease_duration=lease_duration,
+                rng=random.Random(seed + bump),
+            )
+
+        a = mk("replica-a", 1).start()
+        if not wait_until(a.is_leader, 2 * lease_duration):
+            raise SystemExit("failover smoke FAILED: first elector never led")
+        b = mk("replica-b", 2).start()
+        time.sleep(0.3)
+        if b.is_leader():
+            raise SystemExit("failover smoke FAILED: two concurrent leaders")
+        stale_fence = a.fence()
+
+        # --- hard failure: the leader falls silent (SIGKILL analog) ---
+        t0 = time.monotonic()
+        a.stop(release=False)
+        if not wait_until(b.is_leader, 2 * lease_duration + 2.0):
+            raise SystemExit(
+                "failover smoke FAILED: standby never took over after kill"
+            )
+        takeover_kill_s = time.monotonic() - t0
+        if takeover_kill_s > 2 * lease_duration:
+            raise SystemExit(
+                "failover smoke FAILED: takeover after kill took "
+                f"{takeover_kill_s:.2f}s > 2x leaseDuration "
+                f"({2 * lease_duration:.2f}s)"
+            )
+
+        # --- fencing: the dead generation cannot write, the live can ---
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "fence-probe", "namespace": "default"},
+            "data": {},
+        }
+        stale_client = ClusterClient(
+            srv.url, fence_provider=lambda: stale_fence
+        )
+        try:
+            stale_client.create(dict(cm))
+        except Conflict:
+            pass
+        else:
+            raise SystemExit(
+                "failover smoke FAILED: stale-leader write was NOT fenced"
+            )
+        ClusterClient(srv.url, fence_provider=b.fence).create(dict(cm))
+
+        # --- graceful step-down: release -> immediate handover ---
+        c = mk("replica-c", 3).start()
+        time.sleep(0.3)  # let c start polling (and observe b's lease)
+        t1 = time.monotonic()
+        b.stop(release=True)
+        if not wait_until(c.is_leader, 2 * lease_duration + 2.0):
+            raise SystemExit(
+                "failover smoke FAILED: standby never took over after release"
+            )
+        takeover_release_s = time.monotonic() - t1
+        if takeover_release_s > lease_duration:
+            raise SystemExit(
+                "failover smoke FAILED: graceful takeover took "
+                f"{takeover_release_s:.2f}s > leaseDuration "
+                f"({lease_duration:.2f}s; expected ~one renew interval)"
+            )
+        transitions = c.transitions
+        c.stop(release=True)
+    return {
+        "seed": seed,
+        "lease_duration_s": lease_duration,
+        "takeover_after_kill_s": round(takeover_kill_s, 3),
+        "takeover_after_release_s": round(takeover_release_s, 3),
+        "lease_transitions": transitions,
+        "stale_writes_fenced": 1,
+        "split_brain_writes": 0,
+    }
+
+
 def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
     from kwok_tpu.chaos.process_faults import ProcessFaultDriver
     from kwok_tpu.ctl.runtime import BinaryRuntime, ComponentSupervisor
@@ -304,7 +432,7 @@ def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
         import random
 
         sup = ComponentSupervisor(rt, rng=random.Random(plan.seed)).start()
-    driver = ProcessFaultDriver(rt, plan)
+    driver = ProcessFaultDriver(rt, plan, client=rt.client(timeout=5.0))
     try:
         driver.run()
         if supervise:
@@ -353,6 +481,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the in-process overload/graceful-shedding smoke "
         "(used by tools/check.sh)",
     )
+    p.add_argument(
+        "--failover-smoke",
+        action="store_true",
+        help="run the in-process leader-election failover smoke: "
+        "bounded takeover after kill/release + stale-leader write "
+        "fencing (used by tools/check.sh)",
+    )
+    p.add_argument(
+        "--lease-seconds",
+        type=float,
+        default=2.5,
+        help="failover smoke election lease duration",
+    )
     p.add_argument("--pods", type=int, default=40, help="smoke population")
     p.add_argument(
         "--flood-seconds",
@@ -373,6 +514,13 @@ def main(argv=None) -> int:
         report = run_overload_smoke(
             seed=args.seed if args.seed is not None else 42,
             duration=args.flood_seconds,
+        )
+        print(json.dumps(report))
+        return 0
+    if args.failover_smoke:
+        report = run_failover_smoke(
+            seed=args.seed if args.seed is not None else 42,
+            lease_duration=args.lease_seconds,
         )
         print(json.dumps(report))
         return 0

@@ -25,10 +25,14 @@ Profile YAML::
     process:
       - {component: apiserver, at: 8, action: kill}
       - {component: kube-controller-manager, at: 12, action: stop, resumeAfter: 2}
+      - {component: kwok-controller, at: 20, action: leader-kill}
 
 ``action`` is ``kill`` (SIGKILL; the supervisor restarts), ``stop``
-(SIGSTOP, SIGCONT after ``resumeAfter``), or ``restart`` (graceful
-stop + start).
+(SIGSTOP, SIGCONT after ``resumeAfter``), ``restart`` (graceful
+stop + start), or ``leader-kill`` (resolve which replica of
+``component`` currently holds its election Lease — cluster/election.py
+— and SIGKILL that one; the targeted fault the failover bound is
+asserted under).
 """
 
 from __future__ import annotations
@@ -47,7 +51,7 @@ __all__ = [
     "load_profile",
 ]
 
-PROCESS_ACTIONS = ("kill", "stop", "restart")
+PROCESS_ACTIONS = ("kill", "stop", "restart", "leader-kill")
 
 
 @dataclass(frozen=True)

@@ -13,6 +13,11 @@ runtime exposes Start/Stop per component on
                work stalls).
 - ``restart``  graceful stop + start through the runtime, the rolling-
                restart case.
+- ``leader-kill``  resolve the replica of ``component`` currently
+               holding its election Lease (cluster/election.py; lease
+               name == component base name in kube-system) and SIGKILL
+               that instance — the targeted fault behind the bounded-
+               failover assertion.
 
 The driver is wall-clock scheduled from plan ``at`` offsets and
 records every action with timestamps, so tests can correlate injected
@@ -34,9 +39,12 @@ __all__ = ["ProcessFaultDriver"]
 class ProcessFaultDriver:
     """Execute a plan's process faults against a runtime."""
 
-    def __init__(self, runtime, plan: FaultPlan):
+    def __init__(self, runtime, plan: FaultPlan, client=None):
         self.runtime = runtime
         self.plan = plan
+        #: cluster client for leader-kill holder resolution; lazily
+        #: built from the runtime when not supplied
+        self._client = client
         #: [{"t": wall-offset, "component", "action"}] in execution order
         self.events: List[dict] = []
         self._stop = threading.Event()
@@ -86,7 +94,44 @@ class ProcessFaultDriver:
             self._record(time.monotonic() - t0, comp, "resume")
         self._resumes = []
 
+    def _resolve_leader(self, component: str) -> str:
+        """Holder of ``component``'s election Lease (instance names
+        double as holder identities, ctl/components.py replica_name).
+
+        Tries the Lease named exactly like the component first, then
+        scans kube-system for a lease whose holder IS one of the
+        component's instances (``component`` or ``component-N``) — the
+        scheduler seat needs this, its components are ``scheduler[-N]``
+        but its election lease is ``kwok-scheduler``.  Falls back to
+        the base name when unresolvable so the fault still fires at
+        *something*."""
+        try:
+            if self._client is None:
+                self._client = self.runtime.client(timeout=5.0)
+            try:
+                lease = self._client.get(
+                    "Lease", component, namespace="kube-system"
+                )
+                holder = (lease.get("spec") or {}).get("holderIdentity")
+                if holder:
+                    return holder
+            except Exception:  # noqa: BLE001 — no lease by that name;
+                # match by holder instance name below
+                pass
+            for lease in self._client.list("Lease", namespace="kube-system")[0]:
+                holder = (lease.get("spec") or {}).get("holderIdentity") or ""
+                if holder == component or holder.startswith(component + "-"):
+                    return holder
+        except Exception:  # noqa: BLE001 — apiserver down: base name
+            pass
+        return component
+
     def _apply(self, spec: ProcessFaultSpec, now: float) -> None:
+        if spec.action == "leader-kill":
+            target = self._resolve_leader(spec.component)
+            self.runtime.signal_component(target, signal.SIGKILL)
+            self._record(now, target, "leader-kill")
+            return
         if spec.action == "kill":
             self.runtime.signal_component(spec.component, signal.SIGKILL)
         elif spec.action == "stop":

@@ -243,15 +243,81 @@ class _Handler(BaseHTTPRequestHandler):
     def _ns(q: dict) -> Optional[str]:
         return q.get("namespace") or None
 
+    # ------------------------------------------------------- leader fencing
+
+    def _fenced_out(self) -> bool:
+        """Validate a mutating request's ``X-Kwok-Leader-Fence`` header
+        against the live election Lease (cluster/election.py fence
+        tokens).  A mismatched holder or lease-transition count means
+        the writer's leadership generation is stale — a paused-then-
+        resumed (SIGSTOP/SIGCONT) ex-leader, or one deposed mid-flight
+        — and its write is rejected with 409 before it can split-brain
+        the store.  Reads never carry the header."""
+        if self.command in ("GET", "HEAD"):
+            return False
+        from kwok_tpu.cluster.election import FENCE_HEADER, parse_fence
+
+        raw = self.headers.get(FENCE_HEADER)
+        if not raw:
+            return False
+
+        parsed = parse_fence(raw)
+        stale = "malformed fence token"
+        if parsed is not None:
+            ns, name, holder, transitions = parsed
+            try:
+                spec = (
+                    self.store.get("Lease", name, namespace=ns) or {}
+                ).get("spec") or {}
+            except Exception:  # noqa: BLE001 — a vanished lease is a
+                # revoked generation, same verdict as a mismatch
+                spec = None
+            if spec is None:
+                stale = f"election lease {ns}/{name} is gone"
+            else:
+                live_holder = spec.get("holderIdentity") or ""
+                try:
+                    live_tr = int(spec.get("leaseTransitions") or 0)
+                except (TypeError, ValueError):
+                    live_tr = 0
+                if live_holder == holder and live_tr == transitions:
+                    return False
+                stale = (
+                    f"lease {ns}/{name} is held by "
+                    f"{live_holder or '<nobody>'} at transition {live_tr}"
+                )
+        body = json.dumps(
+            {
+                "error": f"stale leader fence ({stale}): write rejected",
+                "reason": "Conflict",
+            }
+        ).encode()
+        self.send_response(409)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        # the request body was never read — the keep-alive framing is
+        # gone, so the connection must die with the rejection
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionError, OSError):
+            pass
+        return True
+
     # --------------------------------------------------------- flow control
 
     def _dispatch(self, inner) -> None:
-        """Chaos seam first, then APF admission: classify the caller's
-        X-Kwok-Client into a priority level, take (or queue for) an
-        inflight seat, shed with a well-formed 429 + Retry-After when
-        the level's queue wait runs out.  Watches are long-running:
-        admitted through the same gate but holding no seat."""
+        """Chaos seam first, then the leader fence, then APF admission:
+        classify the caller's X-Kwok-Client into a priority level, take
+        (or queue for) an inflight seat, shed with a well-formed 429 +
+        Retry-After when the level's queue wait runs out.  Watches are
+        long-running: admitted through the same gate but holding no
+        seat."""
         if self._inject_fault():
+            return
+        if self._fenced_out():
             return
         flow = getattr(self.server, "flow", None)
         self._flow_level = None

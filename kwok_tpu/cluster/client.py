@@ -27,7 +27,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kwok_tpu.cluster.store import (
     Conflict,
@@ -154,7 +154,10 @@ def parse_retry_after(raw: Optional[str]) -> Optional[float]:
         import datetime as _dt
 
         dt = dt.replace(tzinfo=_dt.timezone.utc)
-    return max(0.0, dt.timestamp() - time.time())
+    # an HTTP-date Retry-After is wall-clock BY DEFINITION (RFC 7231
+    # delta against the server's notion of now); monotonic time has no
+    # epoch to compare it to
+    return max(0.0, dt.timestamp() - time.time())  # kwoklint: disable=wallclock-deadline
 
 
 def _raise_for(code: int, payload: Any) -> None:
@@ -278,6 +281,7 @@ class ClusterClient:
         client_key: Optional[str] = None,
         retry: Optional[RetryPolicy] = None,
         client_id: Optional[str] = None,
+        fence_provider: Optional[Callable[[], Optional[str]]] = None,
     ):
         self._https = url.startswith("https://")
         if "://" in url:
@@ -296,6 +300,13 @@ class ClusterClient:
             or os.environ.get("KWOK_COMPONENT_NAME")
             or "kwok-client"
         )
+        #: leader-fence seam (cluster/election.py): a callable returning
+        #: the current X-Kwok-Leader-Fence token, or None when the
+        #: owning component is not leading.  Stamped on every mutating
+        #: verb so the apiserver can reject stale-generation writes
+        #: with 409 (split-brain guard).  Elector clients leave this
+        #: unset — lease CAS is their own fence.
+        self.fence_provider = fence_provider
         self._local = threading.local()
         self._types: Dict[str, ResourceType] = {}
         self._types_mut = threading.Lock()
@@ -367,6 +378,12 @@ class ClusterClient:
             tp = traceparent(get_tracer().current())
             if tp:
                 hdrs.setdefault("traceparent", tp)
+            if self.fence_provider is not None:
+                fence = self.fence_provider()
+                if fence:
+                    from kwok_tpu.cluster.election import FENCE_HEADER
+
+                    hdrs.setdefault(FENCE_HEADER, fence)
         payload = json.dumps(body) if body is not None else None
         start = time.monotonic()
         attempts = 0

@@ -487,4 +487,42 @@ def expose_metrics(flow: Optional[FlowController], store=None) -> str:
         )
         rv.set(store.resource_version)
         reg.register("kwok_apiserver_resource_version", rv)
+        _expose_election(reg, store, Gauge)
     return reg.expose()
+
+
+def _expose_election(reg, store, Gauge) -> None:
+    """Per-election-lease leadership gauges from the kube-system
+    Leases (cluster/election.py writes them): holder, transition
+    count, and renew age — the cluster-wide view of who leads each
+    control-plane seat, scraped without touching any component."""
+    from kwok_tpu.utils.clock import wall_age
+
+    try:
+        leases, _rv = store.list("Lease", namespace="kube-system")
+    except Exception:  # noqa: BLE001 — Lease kind may be unregistered
+        return
+    for lease in leases:
+        name = (lease.get("metadata") or {}).get("name") or ""
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        labels = {"lease": name, "holder": holder}
+        g = Gauge(
+            "kwok_leader_election_transitions",
+            help="lease transitions (leadership takeovers)",
+            const_labels=labels,
+        )
+        try:
+            g.set(int(spec.get("leaseTransitions") or 0))
+        except (TypeError, ValueError):
+            g.set(0)
+        reg.register(f"kwok_leader_election_transitions{name}", g)
+        age = wall_age(spec.get("renewTime"))
+        if age is not None:
+            a = Gauge(
+                "kwok_leader_election_renew_age_seconds",
+                help="seconds since the holder last renewed",
+                const_labels=labels,
+            )
+            a.set(round(age, 3))
+            reg.register(f"kwok_leader_election_renew_age_seconds{name}", a)

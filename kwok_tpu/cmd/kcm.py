@@ -12,16 +12,27 @@ groups (``--controllers``):
   Deployment / Job / HorizontalPodAutoscaler (kwok_tpu.workloads),
   reconciling over the REST client exactly as they do over an
   in-process store.
+
+``--leader-elect`` (default on, like the real kcm's
+``--leader-elect``; vendor/k8s.io/client-go/tools/leaderelection/
+leaderelection.go semantics via cluster/election.py): replicas
+campaign on one coordination.k8s.io Lease; only the holder runs the
+controller groups, every reconcile round re-checks
+``elector.is_leader()``, mutations carry the leader-fence header, and
+SIGTERM releases the lease so a standby takes over in ~one retry
+interval.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
 
 from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.election import LeaderElector
 from kwok_tpu.controllers.gc_controller import GCController
 
 
@@ -36,8 +47,84 @@ def build_parser() -> argparse.ArgumentParser:
         default="gc,workloads",
         help="comma list of controller groups to run (gc, workloads)",
     )
+    add_leader_elect_flags(p, lease_name="kube-controller-manager")
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
+
+
+def add_leader_elect_flags(
+    p: argparse.ArgumentParser, lease_name: str
+) -> None:
+    """The shared --leader-elect flag family (kwok/kcm/scheduler all
+    carry the same set, like the real components' LeaderElection
+    config block)."""
+    p.add_argument(
+        "--leader-elect",
+        dest="leader_elect",
+        action="store_true",
+        default=True,
+        help="campaign on a coordination.k8s.io Lease; only the "
+        "holder reconciles (default: on)",
+    )
+    p.add_argument(
+        "--no-leader-elect",
+        dest="leader_elect",
+        action="store_false",
+        help="run unconditionally (single-instance compositions, "
+        "node-lease sharding setups)",
+    )
+    p.add_argument(
+        "--leader-elect-lease-name",
+        default=lease_name,
+        help="election Lease name in kube-system; replicas of one "
+        "component share it",
+    )
+    p.add_argument(
+        "--leader-elect-lease-duration",
+        type=float,
+        default=15.0,
+        help="seconds a non-renewed lease stays valid (renew cadence "
+        "and acquire retries run at a jittered 1/3 of this)",
+    )
+
+
+def run_elected(
+    args,
+    identity: str,
+    client: ClusterClient,
+    start_controllers,
+    stop_controllers,
+    elect_client: ClusterClient,
+):
+    """Host a daemon's controller set behind a LeaderElector; returns
+    the elector (or None with controllers started directly when
+    election is off).  ``client`` gets the leader-fence provider so
+    every mutation is generation-checked server-side."""
+    if not args.leader_elect:
+        start_controllers(None)
+        return None
+    holder = {}
+
+    def on_started():
+        start_controllers(holder["elector"].is_leader)
+
+    elector = LeaderElector(
+        elect_client,
+        args.leader_elect_lease_name,
+        identity,
+        lease_duration=args.leader_elect_lease_duration,
+        on_started_leading=on_started,
+        on_stopped_leading=stop_controllers,
+    )
+    holder["elector"] = elector
+    client.fence_provider = elector.fence
+    elector.start()
+    print(
+        f"leader election: campaigning on "
+        f"kube-system/{args.leader_elect_lease_name} as {identity}",
+        flush=True,
+    )
+    return elector
 
 
 def main(argv=None) -> int:
@@ -45,12 +132,12 @@ def main(argv=None) -> int:
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
-    client = ClusterClient(
-        args.server,
-        ca_cert=args.ca_cert or None,
-        client_cert=args.client_cert or None,
-        client_key=args.client_key or None,
-    )
+    certs = {
+        "ca_cert": args.ca_cert or None,
+        "client_cert": args.client_cert or None,
+        "client_key": args.client_key or None,
+    }
+    client = ClusterClient(args.server, **certs)
     if not client.wait_ready(timeout=60):
         print("apiserver not ready", file=sys.stderr)
         return 1
@@ -59,13 +146,45 @@ def main(argv=None) -> int:
     if unknown:
         print(f"unknown controller groups: {sorted(unknown)}", file=sys.stderr)
         return 2
-    running = []
-    if "gc" in groups:
-        running.append(GCController(client).start())
-    if "workloads" in groups:
-        from kwok_tpu.workloads import WorkloadManager
 
-        running.append(WorkloadManager(client).start())
+    identity = os.environ.get("KWOK_COMPONENT_NAME") or (
+        f"kube-controller-manager-{os.getpid()}"
+    )
+    running = []
+    run_mut = threading.Lock()
+
+    def start_controllers(active) -> None:
+        with run_mut:
+            if running:
+                return
+            if "gc" in groups:
+                running.append(GCController(client, active=active).start())
+            if "workloads" in groups:
+                from kwok_tpu.workloads import WorkloadManager
+
+                running.append(
+                    WorkloadManager(client, active=active).start()
+                )
+        print("controller-manager reconciling", flush=True)
+
+    def stop_controllers() -> None:
+        with run_mut:
+            ctrls, running[:] = list(running), []
+        for ctrl in ctrls:
+            ctrl.stop()
+        print("controller-manager standing by (lost lease)", flush=True)
+
+    # lease traffic rides the system priority level (X-Kwok-Client
+    # "system:<identity>"), so a best-effort flood cannot flap
+    # leadership (cluster/flowcontrol.py DEFAULT_FLOWS)
+    elector = run_elected(
+        args,
+        identity,
+        client,
+        start_controllers,
+        stop_controllers,
+        ClusterClient(args.server, client_id=f"system:{identity}", **certs),
+    )
     print("controller-manager running", flush=True)
 
     done = threading.Event()
@@ -76,8 +195,12 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     done.wait()
-    for ctrl in running:
-        ctrl.stop()
+    # controllers first (their teardown writes still carry a VALID
+    # fence), then release the lease — the standby takes over in ~one
+    # retry interval instead of waiting out the full lease duration
+    stop_controllers()
+    if elector is not None:
+        elector.stop(release=True)
     return 0
 
 

@@ -70,6 +70,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="CA for (optional) client-cert auth on the kubelet port")
     p.add_argument("--wait-timeout", type=float, default=60.0)
     p.add_argument("--seed", type=int, default=None)
+    from kwok_tpu.cmd.kcm import add_leader_elect_flags
+
+    add_leader_elect_flags(p, lease_name="kwok-controller")
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
@@ -187,10 +190,13 @@ def start_config_watcher(client, srv, done: threading.Event, base_configs=None) 
     threading.Thread(target=loop, daemon=True).start()
 
 
-def _controller_self_metrics(ctr):
+def _controller_self_metrics(get_ctr, elector=None):
     """Self-metrics updater: stage transitions/patches per kind (host
-    and device paths) and device tick-lag quantiles (the p99
-    heartbeat-lag signal, SURVEY §7 step 5)."""
+    and device paths), device tick-lag quantiles (the p99
+    heartbeat-lag signal, SURVEY §7 step 5), and this replica's
+    leader-election state.  ``get_ctr`` indirects through the election
+    holder — a standby replica has no Controller yet (None), but its
+    election gauges still publish."""
 
     def update(registry) -> None:
         from kwok_tpu.metrics.collectors import Counter, Gauge
@@ -209,6 +215,38 @@ def _controller_self_metrics(ctr):
             # _total series must expose TYPE counter so rate()/increase()
             # treat restarts (player rebuilds) as counter resets
             _set(Counter, name, help_, value, **labels)
+
+        if elector is not None:
+            gauge(
+                "kwok_leader_election_is_leader",
+                "1 while this replica holds the election lease.",
+                1 if elector.is_leader() else 0,
+                lease=elector.lease_name,
+            )
+            gauge(
+                "kwok_leader_election_transitions",
+                "Lease transition count of this replica's generation.",
+                elector.transitions,
+                lease=elector.lease_name,
+            )
+            counter(
+                "kwok_leader_election_stepdowns_total",
+                "Voluntary renew-deadline step-downs.",
+                elector.stepdowns,
+                lease=elector.lease_name,
+            )
+            age = elector.last_renew_age()
+            if age is not None:
+                gauge(
+                    "kwok_leader_election_last_renew_age_seconds",
+                    "Seconds since the last successful lease renew.",
+                    round(age, 3),
+                    lease=elector.lease_name,
+                )
+
+        ctr = get_ctr()
+        if ctr is None:
+            return  # standby: no players running
 
         players = []
         for kind, host in (("Node", ctr.nodes), ("Pod", ctr.pods)):
@@ -362,8 +400,51 @@ def main(argv=None) -> int:
         print(f"apiserver {args.server} not ready", file=sys.stderr)
         return 1
 
-    ctr = Controller(client, conf, local_stages=stages, seed=args.seed)
-    ctr.start()
+    # the Controller lives behind the leader election: built and
+    # started on acquisition, stopped (node leases released) on
+    # deposition — a standby replica keeps informer-free and write-free
+    holder = {"ctr": None}
+    ctr_mut = threading.Lock()
+
+    def start_controllers(active=None) -> None:
+        with ctr_mut:
+            if holder["ctr"] is not None:
+                return
+            c = Controller(client, conf, local_stages=stages, seed=args.seed)
+            c.start()
+            holder["ctr"] = c
+        print("kwok controller reconciling", flush=True)
+
+    def stop_controllers() -> None:
+        with ctr_mut:
+            c, holder["ctr"] = holder["ctr"], None
+        if c is None:
+            return
+        leases = c.node_leases
+        c.stop()
+        if leases is not None:
+            # proactive handoff: null our node-lease holds so the next
+            # leader (or a sharding peer) takes the nodes immediately
+            # instead of waiting out each lease's expiry
+            leases.release_all()
+        print("kwok controller standing by", flush=True)
+
+    from kwok_tpu.cmd.kcm import run_elected
+
+    elector = run_elected(
+        args,
+        conf.id,
+        client,
+        start_controllers,
+        stop_controllers,
+        ClusterClient(
+            args.server,
+            ca_cert=args.ca_cert or None,
+            client_cert=args.client_cert or None,
+            client_key=args.client_key or None,
+            client_id=f"system:{conf.id}",
+        ),
+    )
     print(f"kwok controller started (backend={conf.backend})", flush=True)
 
     # long-lived setup objects out of the GC's sight: the drain hot path
@@ -399,7 +480,9 @@ def main(argv=None) -> int:
             from_document(d) for d in docs if d.get("kind") in server_kinds
         ]
         srv.set_configs(local_configs)
-        srv.add_self_updater(_controller_self_metrics(ctr))
+        srv.add_self_updater(
+            _controller_self_metrics(lambda: holder["ctr"], elector)
+        )
         bound = srv.serve(
             port=int(port or 10247),
             host=host or "127.0.0.1",
@@ -424,7 +507,12 @@ def main(argv=None) -> int:
 
     if srv is not None:
         srv.close()
-    ctr.stop()
+    # teardown writes (node-lease releases) happen while the election
+    # fence is still valid; only then release the election lease so
+    # the standby takes over in ~one retry interval
+    stop_controllers()
+    if elector is not None:
+        elector.stop(release=True)
     return 0
 
 

@@ -24,6 +24,7 @@ from kwok_tpu.cluster.k8s_api import SCALABLE_KINDS
 from kwok_tpu.cluster.store import Conflict, NotFound
 from kwok_tpu.ctl.dryrun import dry_run
 from kwok_tpu.ctl.runtime import BinaryRuntime, cluster_dir, list_clusters
+from kwok_tpu.utils.clock import wall_age
 
 DEFAULT_CLUSTER = "kwok-tpu"
 
@@ -79,6 +80,8 @@ def cmd_create_cluster(args) -> int:
         chaos_profile=args.chaos_profile or None,
         flow_config=args.flow_config or None,
         max_inflight=args.max_inflight,
+        controller_replicas=args.controller_replicas,
+        leader_elect=args.leader_elect,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -117,9 +120,41 @@ def cmd_get_clusters(args) -> int:
 
 
 def cmd_get_components(args) -> int:
+    """Component liveness plus per-component election state: which
+    instance holds each election Lease, its transition count, and the
+    renew age (cluster/election.py publishes these as the Lease spec;
+    the kube-scheduler/kcm expose the same through their leases)."""
     rt = _require_cluster(args)
+    election = {}  # holder instance -> (lease, transitions, renew age)
+    try:
+        client = rt.client(timeout=2.0)
+        leases, _rv = client.list("Lease", namespace="kube-system")
+        for lease in leases:
+            spec = lease.get("spec") or {}
+            holder = spec.get("holderIdentity") or ""
+            if not holder:
+                continue
+            try:
+                transitions = int(spec.get("leaseTransitions") or 0)
+            except (TypeError, ValueError):
+                transitions = 0
+            age = wall_age(spec.get("renewTime"))
+            election[holder] = (
+                (lease.get("metadata") or {}).get("name") or "",
+                transitions,
+                age,
+            )
+    except Exception:  # noqa: BLE001 — a down apiserver degrades to
+        # the plain liveness listing rather than failing the command
+        pass
     for name, alive in rt.running_components().items():
-        print(f"{name}\t{'Running' if alive else 'Stopped'}")
+        line = f"{name}\t{'Running' if alive else 'Stopped'}"
+        if name in election:
+            lease, transitions, age = election[name]
+            line += f"\tleader({lease})\ttransitions={transitions}"
+            if age is not None:
+                line += f"\trenewed={age:.1f}s ago"
+        print(line)
     return 0
 
 
@@ -1309,6 +1344,29 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="apiserver global inflight budget split across priority "
         "levels (default 64; 0 disables flow control)",
+    )
+    c.add_argument(
+        "--controller-replicas",
+        type=int,
+        default=1,
+        help="replicas per controller-tier component (scheduler, kcm, "
+        "kwok-controller); replicas campaign on one Lease per "
+        "component and only the holder reconciles",
+    )
+    c.add_argument(
+        "--leader-elect",
+        dest="leader_elect",
+        action="store_true",
+        default=True,
+        help="lease-based leader election for controller components "
+        "(default: on)",
+    )
+    c.add_argument(
+        "--no-leader-elect",
+        dest="leader_elect",
+        action="store_false",
+        help="disable leader election (every replica reconciles; only "
+        "sane with --controller-replicas 1 or node-lease sharding)",
     )
     c.add_argument("--wait", type=float, default=60.0)
     c.set_defaults(fn=cmd_create_cluster)

@@ -5,16 +5,24 @@ pkg/kwokctl/components/kube_scheduler.go:51 builds it;
 runtime/binary/cluster.go:316-728 starts it after the apiserver).
 Connects to the cluster apiserver and binds unbound pods
 (controllers/scheduler.py).
+
+``--leader-elect`` (default on, the real kube-scheduler's flag;
+cluster/election.py): replicas campaign on one Lease, only the holder
+binds, every bind round re-checks ``elector.is_leader()``, binds carry
+the leader-fence header, and SIGTERM releases the lease for a ~one-
+retry-interval handover.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
 
 from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cmd.kcm import add_leader_elect_flags, run_elected
 from kwok_tpu.controllers.scheduler import Scheduler
 
 
@@ -24,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ca-cert", default="")
     p.add_argument("--client-cert", default="")
     p.add_argument("--client-key", default="")
+    add_leader_elect_flags(p, lease_name="kwok-scheduler")
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
@@ -33,16 +42,43 @@ def main(argv=None) -> int:
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
-    client = ClusterClient(
-        args.server,
-        ca_cert=args.ca_cert or None,
-        client_cert=args.client_cert or None,
-        client_key=args.client_key or None,
-    )
+    certs = {
+        "ca_cert": args.ca_cert or None,
+        "client_cert": args.client_cert or None,
+        "client_key": args.client_key or None,
+    }
+    client = ClusterClient(args.server, **certs)
     if not client.wait_ready(timeout=60):
         print("apiserver not ready", file=sys.stderr)
         return 1
-    sched = Scheduler(client).start()
+
+    identity = os.environ.get("KWOK_COMPONENT_NAME") or (
+        f"kwok-scheduler-{os.getpid()}"
+    )
+    running = []
+    run_mut = threading.Lock()
+
+    def start_controllers(active) -> None:
+        with run_mut:
+            if running:
+                return
+            running.append(Scheduler(client, active=active).start())
+        print("scheduler binding", flush=True)
+
+    def stop_controllers() -> None:
+        with run_mut:
+            ctrls, running[:] = list(running), []
+        for ctrl in ctrls:
+            ctrl.stop()
+
+    elector = run_elected(
+        args,
+        identity,
+        client,
+        start_controllers,
+        stop_controllers,
+        ClusterClient(args.server, client_id=f"system:{identity}", **certs),
+    )
     print("scheduler running", flush=True)
 
     done = threading.Event()
@@ -53,7 +89,10 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     done.wait()
-    sched.stop()
+    # teardown writes before the release, while the fence is valid
+    stop_controllers()
+    if elector is not None:
+        elector.stop(release=True)
     return 0
 
 

@@ -68,8 +68,12 @@ class GCController:
 
     RESYNC_S = 2.0
 
-    def __init__(self, store, resync_s: Optional[float] = None):
+    def __init__(self, store, resync_s: Optional[float] = None, active=None):
         self.store = store
+        #: leadership gate (cluster/election.py LeaderElector.is_leader
+        #: duck type): each loop round re-checks it, so a deposed kcm
+        #: replica never issues deletes.  None = always active.
+        self._active = active
         self.events: Queue = Queue()
         self.resync_s = resync_s if resync_s is not None else self.RESYNC_S
         self._done = threading.Event()
@@ -138,7 +142,8 @@ class GCController:
             ev, ok = self.events.get_or_wait(
                 timeout=min(wait, self.resync_s), done=self._done
             )
-            if ok and ev is not None:
+            gated = self._active is not None and not self._active()
+            if ok and ev is not None and not gated:
                 try:
                     self._handle(ev)
                 except Exception:  # noqa: BLE001 — one event must not kill GC
@@ -151,6 +156,8 @@ class GCController:
             if _time.monotonic() < next_resync:
                 continue
             next_resync = _time.monotonic() + self.resync_s
+            if gated:
+                continue  # standby/deposed: no reaping, no retries
             try:
                 self._refresh_watches()
                 for ns in list(self._terminating):

@@ -124,6 +124,7 @@ class NodeLeaseController:
 
     def release_hold(self, name: str) -> None:
         with self._mut:
+            was_held = name in self._holding
             self._wanted.discard(name)
             self._holding.discard(name)
             if self._queue.cancel(name):
@@ -131,6 +132,42 @@ class NodeLeaseController:
             # else: the worker holds it; it will drop it on next pop
         if self._lane is not None:
             self._lane.unregister(name)
+        if was_held:
+            # proactive handoff: null the holder instead of letting the
+            # lease dangle until expiry, so another instance (a peer
+            # shard or the next elected leader) takes the node over
+            # immediately.  CAS on our own identity — a peer that
+            # already took over legitimately must not be stomped.
+            self._null_holder(name)
+
+    def _null_holder(self, name: str) -> None:
+        """Best-effort CAS release of one lease we held."""
+        try:
+            self.store.patch(
+                "Lease",
+                name,
+                {"spec": {"holderIdentity": None}},
+                patch_type="merge",
+                namespace=NAMESPACE_NODE_LEASE,
+                expect={"spec.holderIdentity": self.holder},
+            )
+        except Exception:  # noqa: BLE001 — releasing is best-effort:
+            # NotFound/Conflict mean the lease moved on without us, and
+            # a transport failure just leaves the expiry path in charge
+            pass
+
+    def release_all(self) -> None:
+        """Null the holder of every lease we hold (graceful-shutdown
+        handoff; the elected-leader step-down path calls this so node
+        ownership transfers in one retry interval, not one expiry)."""
+        with self._mut:
+            held = sorted(self._holding)
+            self._holding.clear()
+            self._wanted.clear()
+        for name in held:
+            if self._lane is not None:
+                self._lane.unregister(name)
+            self._null_holder(name)
 
     def reacquire(self, name: str) -> None:
         """Re-enter the host acquisition path for a node whose lane
@@ -202,9 +239,11 @@ class NodeLeaseController:
         if lease is not None:
             spec = lease.get("spec") or {}
             holder = spec.get("holderIdentity")
-            if holder != self.holder:
-                # someone else's lease: take over only once expired
-                # (node_lease_controller.go:293-306 tryAcquireOrRenew)
+            if holder and holder != self.holder:
+                # someone else's LIVE lease: take over only once expired
+                # (node_lease_controller.go:293-306 tryAcquireOrRenew).
+                # An empty holder is a proactive release (release_hold/
+                # release_all nulled it) — free to claim right now.
                 renew = _parse_micro(spec.get("renewTime") or "")
                 dur = spec.get("leaseDurationSeconds") or self.lease_duration
                 if renew is not None and renew + datetime.timedelta(seconds=dur) > now:

@@ -84,9 +84,15 @@ class Scheduler:
         store,
         recorder: Optional[EventRecorder] = None,
         name: str = "kwok-scheduler",
+        active=None,
     ):
         self.store = store
         self.name = name
+        #: leadership gate (cluster/election.py LeaderElector.is_leader
+        #: duck type): each bind round re-checks it, so a deposed
+        #: replica stops scheduling before it is even torn down.  None
+        #: = always active (in-process single-instance composition).
+        self._active = active
         self.recorder = recorder or EventRecorder(store, source=name)
         self._done = threading.Event()
         self._events: Queue = Queue()
@@ -244,9 +250,13 @@ class Scheduler:
                 continue
             if (obj.get("metadata") or {}).get("deletionTimestamp"):
                 continue
+            if self._active is not None and not self._active():
+                continue  # standby/deposed: track caches, never bind
             self._bind(obj)
 
     def _retry_pending(self) -> None:
+        if self._active is not None and not self._active():
+            return
         try:
             pods, _ = self.store.list("Pod", field_selector="spec.nodeName=")
         except Exception:  # noqa: BLE001 — apiserver outage; informer retries

@@ -126,10 +126,29 @@ def build_tracing_component(port: int) -> Component:
     return Component(name="tracing", args=args, ports={"otlp": port})
 
 
+def replica_name(base: str, replica: int) -> str:
+    """Component instance name for replica ``replica`` (0-based): the
+    primary keeps the canonical name, standbys get ``-2``, ``-3`` ...
+    (instance names double as election identities)."""
+    return base if replica == 0 else f"{base}-{replica + 1}"
+
+
+def _leader_elect_args(lease_name: str, leader_elect: bool) -> List[str]:
+    """The flag family every electable daemon shares (cmd/kcm.py
+    add_leader_elect_flags); the lease name is pinned explicitly so
+    every replica of a component campaigns on the same Lease, and the
+    component spec stays auditable."""
+    if not leader_elect:
+        return ["--no-leader-elect"]
+    return ["--leader-elect", "--leader-elect-lease-name", lease_name]
+
+
 def build_scheduler_component(
     server_url: str,
     secure: bool = False,
     pki_dir: Optional[str] = None,
+    replica: int = 0,
+    leader_elect: bool = True,
 ) -> Component:
     """(reference components/kube_scheduler.go:51 BuildKubeSchedulerComponent)"""
     args = [
@@ -138,7 +157,7 @@ def build_scheduler_component(
         "kwok_tpu.cmd.scheduler",
         "--server",
         server_url,
-    ]
+    ] + _leader_elect_args("kwok-scheduler", leader_elect)
     if secure and pki_dir:
         args += [
             "--ca-cert",
@@ -148,13 +167,19 @@ def build_scheduler_component(
             "--client-key",
             os.path.join(pki_dir, "admin.key"),
         ]
-    return Component(name="scheduler", args=args, depends_on=["apiserver"])
+    return Component(
+        name=replica_name("scheduler", replica),
+        args=args,
+        depends_on=["apiserver"],
+    )
 
 
 def build_kcm_component(
     server_url: str,
     secure: bool = False,
     pki_dir: Optional[str] = None,
+    replica: int = 0,
+    leader_elect: bool = True,
 ) -> Component:
     """Controller-manager seat: ownerRef GC + namespace lifecycle +
     the workload loops (ReplicaSet/Deployment/Job/HPA — the app-level
@@ -169,7 +194,7 @@ def build_kcm_component(
         server_url,
         "--controllers",
         "gc,workloads",
-    ]
+    ] + _leader_elect_args("kube-controller-manager", leader_elect)
     if secure and pki_dir:
         args += [
             "--ca-cert",
@@ -179,7 +204,11 @@ def build_kcm_component(
             "--client-key",
             os.path.join(pki_dir, "admin.key"),
         ]
-    return Component(name="kube-controller-manager", args=args, depends_on=["apiserver"])
+    return Component(
+        name=replica_name("kube-controller-manager", replica),
+        args=args,
+        depends_on=["apiserver"],
+    )
 
 
 def build_kwok_controller_component(
@@ -191,6 +220,8 @@ def build_kwok_controller_component(
     pki_dir: Optional[str] = None,
     backend: str = "host",
     extra_args: Optional[List[str]] = None,
+    replica: int = 0,
+    leader_elect: bool = True,
 ) -> Component:
     """(reference components/kwok_controller.go:54 BuildKwokControllerComponent)"""
     # no --manage-all-nodes here: the daemon defaults to manage-all when
@@ -199,6 +230,7 @@ def build_kwok_controller_component(
     # make a selector in extra_args/--config fail validation at startup
     # (reference components/kwok_controller.go:56-65 passes it only
     # when no selector is configured)
+    name = replica_name("kwok-controller", replica)
     args = [
         sys.executable,
         "-m",
@@ -209,7 +241,11 @@ def build_kwok_controller_component(
         f"127.0.0.1:{kubelet_port}",
         "--backend",
         backend,
-    ]
+        # the instance name is both the election identity and the
+        # node-lease holder identity, so replicas stay distinguishable
+        "--id",
+        name,
+    ] + _leader_elect_args("kwok-controller", leader_elect)
     if secure and pki_dir:
         args += [
             "--ca-cert",
@@ -232,7 +268,7 @@ def build_kwok_controller_component(
         args += ["--config", path]
     args += list(extra_args or [])
     return Component(
-        name="kwok-controller",
+        name=name,
         args=args,
         ports={"kubelet": kubelet_port},
         depends_on=["apiserver"],
@@ -252,13 +288,22 @@ def build_core_components(
     chaos_profile: Optional[str] = None,
     flow_config: Optional[str] = None,
     max_inflight: Optional[int] = None,
+    controller_replicas: int = 1,
+    leader_elect: bool = True,
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
     single source of truth for what a cluster runs — install() and
     ``kwokctl get artifacts`` (on a not-yet-created cluster) both call
-    this, so the two can never drift."""
-    return [
+    this, so the two can never drift.
+
+    ``controller_replicas`` spawns N instances of each controller-tier
+    seat (scheduler, kcm, kwok-controller); replicas campaign on one
+    election Lease per component and only the holder reconciles
+    (cluster/election.py), the HA posture a real control plane gets
+    from ``--leader-elect`` + multiple members."""
+    replicas = max(1, int(controller_replicas))
+    comps = [
         build_apiserver_component(
             workdir,
             apiserver_port,
@@ -268,20 +313,46 @@ def build_core_components(
             chaos_profile=chaos_profile,
             flow_config=flow_config,
             max_inflight=max_inflight,
-        ),
-        build_scheduler_component(server_url, secure=secure, pki_dir=pki_dir),
-        build_kcm_component(server_url, secure=secure, pki_dir=pki_dir),
-        build_kwok_controller_component(
-            workdir,
-            server_url,
-            kubelet_port,
-            config_paths=config_paths,
-            secure=secure,
-            pki_dir=pki_dir,
-            backend=backend,
-            extra_args=extra_args,
-        ),
+        )
     ]
+    for i in range(replicas):
+        comps.append(
+            build_scheduler_component(
+                server_url,
+                secure=secure,
+                pki_dir=pki_dir,
+                replica=i,
+                leader_elect=leader_elect,
+            )
+        )
+    for i in range(replicas):
+        comps.append(
+            build_kcm_component(
+                server_url,
+                secure=secure,
+                pki_dir=pki_dir,
+                replica=i,
+                leader_elect=leader_elect,
+            )
+        )
+    for i in range(replicas):
+        comps.append(
+            build_kwok_controller_component(
+                workdir,
+                server_url,
+                # each replica serves its own kubelet port (the
+                # apiserver's log/exec proxy points at the primary's)
+                kubelet_port if i == 0 else free_port(),
+                config_paths=config_paths,
+                secure=secure,
+                pki_dir=pki_dir,
+                backend=backend,
+                extra_args=extra_args,
+                replica=i,
+                leader_elect=leader_elect,
+            )
+        )
+    return comps
 
 
 def default_components(workdir: str) -> List[Component]:

@@ -112,6 +112,8 @@ class BinaryRuntime:
         chaos_profile: Optional[str] = None,
         flow_config: Optional[str] = None,
         max_inflight: Optional[int] = None,
+        controller_replicas: int = 1,
+        leader_elect: bool = True,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -177,6 +179,8 @@ class BinaryRuntime:
             chaos_profile=stored_chaos,
             flow_config=stored_flow,
             max_inflight=max_inflight,
+            controller_replicas=controller_replicas,
+            leader_elect=leader_elect,
         )
         tracing_port = 0
         if enable_tracing:
@@ -208,6 +212,10 @@ class BinaryRuntime:
             conf["flowConfig"] = stored_flow
         if max_inflight is not None:
             conf["maxInflight"] = int(max_inflight)
+        if int(controller_replicas) > 1:
+            conf["controllerReplicas"] = int(controller_replicas)
+        if not leader_elect:
+            conf["leaderElect"] = False
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:

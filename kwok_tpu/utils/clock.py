@@ -16,6 +16,29 @@ import time
 from typing import List, Optional
 
 
+def wall_age(rfc3339: Optional[str]) -> Optional[float]:
+    """Seconds elapsed since an RFC3339 wall-clock timestamp (the
+    Lease ``renewTime`` display format written by
+    ``cluster.election`` / ``controllers.node_lease_controller``),
+    clamped at 0; None for absent/unparseable values.  Display-only —
+    lease *expiry* decisions use locally-observed monotonic time, never
+    this (see MonotonicClock)."""
+    if not rfc3339:
+        return None
+    import datetime
+
+    try:
+        t = datetime.datetime.fromisoformat(
+            str(rfc3339).replace("Z", "+00:00")
+        )
+    except ValueError:
+        return None
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return max(0.0, (now - t).total_seconds())
+
+
 class Clock:
     """Monotonic-ish wall clock in float seconds."""
 
@@ -35,6 +58,26 @@ class Clock:
 class RealClock(Clock):
     def now(self) -> float:
         return time.time()
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        signal.wait(timeout)
+
+    def subscribe(self, signal: threading.Event) -> None:
+        pass
+
+
+class MonotonicClock(Clock):
+    """``time.monotonic``-based clock for deadline/lease arithmetic.
+
+    Leader-election and lease-expiry math must be immune to wall-clock
+    skew (NTP steps, suspend/resume): client-go measures lease expiry
+    from a *locally observed* monotonic timestamp, never from the
+    renewTime written in the record (leaderelection.go:61-73 "is
+    susceptible to clock skew" caveat).  The kwoklint
+    ``wallclock-deadline`` rule points offenders here."""
+
+    def now(self) -> float:
+        return time.monotonic()
 
     def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
         signal.wait(timeout)

@@ -113,8 +113,14 @@ class WorkloadManager:
         recorder: Optional[EventRecorder] = None,
         bulk_chunk: Optional[int] = None,
         hpa_downscale_stabilization_s: Optional[float] = None,
+        active=None,
     ):
         self.store = store
+        #: leadership gate (cluster/election.py LeaderElector.is_leader
+        #: duck type): every reconcile round re-checks it, so a deposed
+        #: kcm replica stops mutating before teardown.  None = always
+        #: active.
+        self._active = active
         self.resync_s = resync_s if resync_s is not None else self.RESYNC_S
         self.recorder = recorder or EventRecorder(
             store, source="workload-controller"
@@ -238,7 +244,9 @@ class WorkloadManager:
             kind, ns, name = key
             try:
                 ctrl = self._dispatch.get(kind)
-                if ctrl is not None:
+                if ctrl is not None and not (
+                    self._active is not None and not self._active()
+                ):
                     ctrl.reconcile(ns, name)
                     self.reconciles += 1
             except Exception:  # noqa: BLE001 — a bad object must not kill

@@ -994,3 +994,102 @@ def test_positional_queue_maxsize_is_a_bound(tmp_path):
     )
     fs = run_rules(root, ["unbounded-buffer"])
     assert len(fs) == 1 and "Fanout._unbounded" in fs[0].message
+
+
+# -------------------------------------------------------- wallclock-deadline
+
+
+def test_wallclock_deadline_arithmetic_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/lease.py": """
+            import time
+
+            def expired(renewed_at, duration):
+                return time.time() > renewed_at + duration
+
+            def remaining(expiry):
+                return expiry - time.time()
+            """,
+        },
+    )
+    fs = run_rules(root, ["wallclock-deadline"])
+    assert len(fs) == 2
+    assert all(f.rule == "wallclock-deadline" for f in fs)
+
+
+def test_wallclock_deadline_deadline_assignment_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/loop.py": """
+            import time
+
+            class C:
+                def arm(self):
+                    self.renew_deadline = time.time()
+            """,
+        },
+    )
+    fs = run_rules(root, ["wallclock-deadline"])
+    assert len(fs) == 1
+
+
+def test_wallclock_plain_timestamping_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/audit.py": """
+            import json
+            import time
+
+            def line(verb):
+                # dict-value timestamping, even inside concatenation,
+                # is not deadline math
+                return json.dumps({"ts": time.time(), "verb": verb}) + "\\n"
+
+            def stamp():
+                started = time.time()
+                return started
+            """,
+        },
+    )
+    assert run_rules(root, ["wallclock-deadline"]) == []
+
+
+def test_wallclock_outside_scope_and_monotonic_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/server/loop.py": """
+            import time
+
+            def wait(deadline):
+                return time.time() < deadline  # server/ is out of scope
+            """,
+            "kwok_tpu/cluster/ok.py": """
+            import time
+
+            def wait(deadline):
+                return time.monotonic() < deadline
+            """,
+        },
+    )
+    assert run_rules(root, ["wallclock-deadline"]) == []
+
+
+def test_wallclock_suppression_comment_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/ctl/t.py": """
+            import time
+
+            def until(deadline):
+                # wall-clock deliberate here: compares an absolute epoch
+                return deadline - time.time()  # kwoklint: disable=wallclock-deadline
+            """,
+        },
+    )
+    assert run_rules(root, ["wallclock-deadline"]) == []

@@ -105,6 +105,10 @@ def spawn_device_kwok(server_url, ident, lease_s=4):
             str(lease_s),
             "--server-address",
             "",
+            # sharding needs BOTH instances active: node-lease
+            # ownership partitions the rows; process-level leader
+            # election would park one instance as a standby
+            "--no-leader-elect",
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,

@@ -85,3 +85,23 @@ def test_create_cluster_tracing_golden(home):
          "--enable-tracing"],
     )
     check_golden("create_cluster_tracing.txt", got)
+
+
+def test_create_cluster_ha_golden(home):
+    """--controller-replicas: N elected instances per controller seat
+    (primary keeps the canonical name, standbys get -2, -3 ...)."""
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "cluster",
+         "--controller-replicas", "2"],
+    )
+    check_golden("create_cluster_ha.txt", got)
+
+
+def test_create_cluster_no_leader_elect_golden(home):
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "cluster",
+         "--no-leader-elect"],
+    )
+    check_golden("create_cluster_no_leader_elect.txt", got)

@@ -1,0 +1,418 @@
+"""Leader-election state machine (cluster/election.py): fake-clock
+unit coverage for acquire/renew/step-down/contention, write fencing at
+the apiserver boundary, and the APF regression — a best-effort flood
+must not flap leadership because lease traffic rides the system
+priority level (reference semantics:
+vendor/k8s.io/client-go/tools/leaderelection/leaderelection.go)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.election import (
+    ELECTION_NAMESPACE,
+    LeaderElector,
+    build_fence,
+    parse_fence,
+)
+from kwok_tpu.cluster.store import Conflict, ResourceStore
+from kwok_tpu.utils.clock import FakeClock
+
+LEASE = "kwok-test-lease"
+
+
+def make_elector(store, ident, clock, seed=0, **kw):
+    return LeaderElector(
+        store,
+        LEASE,
+        ident,
+        lease_duration=6.0,
+        clock=clock,
+        rng=random.Random(seed),
+        **kw,
+    )
+
+
+# ------------------------------------------------------------ state machine
+
+
+def test_acquire_creates_lease_and_leads():
+    store, clk = ResourceStore(), FakeClock(100.0)
+    started = []
+    a = make_elector(store, "a", clk, on_started_leading=lambda: started.append(1))
+    assert a.try_acquire_or_renew()
+    assert a.is_leader()
+    assert started == [1]
+    lease = store.get("Lease", LEASE, namespace=ELECTION_NAMESPACE)
+    spec = lease["spec"]
+    assert spec["holderIdentity"] == "a"
+    assert spec["leaseTransitions"] == 0
+    assert spec["leaseDurationSeconds"] == 6
+    assert a.fence() == build_fence(ELECTION_NAMESPACE, LEASE, "a", 0)
+
+
+def test_renew_keeps_generation_and_updates_age():
+    store, clk = ResourceStore(), FakeClock(100.0)
+    a = make_elector(store, "a", clk)
+    assert a.try_acquire_or_renew()
+    clk.advance(2.0)
+    assert a.last_renew_age() == pytest.approx(2.0)
+    assert a.renew_once()
+    assert a.last_renew_age() == pytest.approx(0.0)
+    assert a.transitions == 0
+
+
+def test_follower_defers_while_leader_renews():
+    store, clk = ResourceStore(), FakeClock(100.0)
+    a = make_elector(store, "a", clk)
+    b = make_elector(store, "b", clk, seed=1)
+    assert a.try_acquire_or_renew()
+    for _ in range(10):
+        clk.advance(2.0)
+        assert a.renew_once()
+        assert not b.try_acquire_or_renew()
+        assert not b.is_leader()
+
+
+def test_takeover_after_expiry_bumps_transitions():
+    store, clk = ResourceStore(), FakeClock(100.0)
+    new_leaders = []
+    a = make_elector(store, "a", clk)
+    b = make_elector(
+        store, "b", clk, seed=1, on_new_leader=new_leaders.append
+    )
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()  # observes a's record
+    clk.advance(6.1)  # a never renews: expired from b's observation
+    assert b.try_acquire_or_renew()
+    assert b.is_leader()
+    assert b.transitions == 1
+    spec = store.get("Lease", LEASE, namespace=ELECTION_NAMESPACE)["spec"]
+    assert spec["leaseTransitions"] == 1
+    assert new_leaders == ["a", "b"]
+    # the deposed leader notices on its next renew and steps down
+    assert a.renew_once() is False
+    assert not a.is_leader()
+
+
+def test_slow_renew_steps_down_voluntarily():
+    class FlakyStore:
+        """Store proxy whose mutations can be switched off (the
+        unreachable-apiserver case as the elector sees it)."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = False
+
+        def __getattr__(self, name):
+            if self.fail and name in ("get", "create", "update"):
+                def boom(*a, **kw):
+                    raise ConnectionError("injected outage")
+
+                return boom
+            return getattr(self.inner, name)
+
+    store, clk = ResourceStore(), FakeClock(100.0)
+    flaky = FlakyStore(store)
+    stopped = []
+    a = make_elector(
+        store, "a", clk, on_stopped_leading=lambda: stopped.append(1)
+    )
+    a.store = flaky
+    assert a.try_acquire_or_renew()
+    flaky.fail = True
+    clk.advance(2.0)
+    assert a.renew_once()  # failed renew, still inside the deadline
+    assert a.is_leader()
+    clk.advance(2.1)  # past renew_deadline (2/3 * 6 = 4)
+    assert a.renew_once() is False
+    assert not a.is_leader()
+    assert a.stepdowns == 1
+    assert stopped == [1]
+    # the fence survives the step-down, pinning the stale generation
+    assert a.fence() == build_fence(ELECTION_NAMESPACE, LEASE, "a", 0)
+    # outage heals before the lease expires server-side: re-acquire is
+    # a RENEW of our own record (no transition bump — holder unchanged)
+    flaky.fail = False
+    assert a.try_acquire_or_renew()
+    assert a.is_leader() and a.transitions == 0
+
+
+def test_two_elector_contention_never_two_leaders():
+    store, clk = ResourceStore(), FakeClock(0.0)
+    a = make_elector(store, "a", clk, seed=1)
+    b = make_elector(store, "b", clk, seed=2)
+    electors = [a, b]
+    rng = random.Random(7)
+    for step in range(200):
+        clk.advance(rng.uniform(0.5, 2.0))
+        order = [0, 1] if rng.random() < 0.5 else [1, 0]
+        for i in order:
+            el = electors[i]
+            if rng.random() < 0.4:
+                continue  # this replica stalled this whole round
+            if el.is_leader():
+                el.renew_once()
+            else:
+                el.try_acquire_or_renew()
+            assert not (a.is_leader() and b.is_leader()), f"step {step}"
+    # deterministic crash phase: silence whichever replica leads and
+    # the other must take over (with a transition bump) — while the
+    # single-leader invariant keeps holding
+    spec = store.get("Lease", LEASE, namespace=ELECTION_NAMESPACE)["spec"]
+    dead, heir = (a, b) if spec["holderIdentity"] == "a" else (b, a)
+    before = int(spec["leaseTransitions"])
+    for _ in range(20):
+        clk.advance(1.0)
+        heir.try_acquire_or_renew() if not heir.is_leader() else heir.renew_once()
+        assert not (a.is_leader() and b.is_leader())
+        if heir.is_leader():
+            break
+    assert heir.is_leader() and not dead.is_leader()
+    spec = store.get("Lease", LEASE, namespace=ELECTION_NAMESPACE)["spec"]
+    assert int(spec["leaseTransitions"]) == before + 1
+
+
+def test_release_hands_over_in_one_retry():
+    store, clk = ResourceStore(), FakeClock(0.0)
+    a = make_elector(store, "a", clk)
+    b = make_elector(store, "b", clk, seed=1)
+    assert a.try_acquire_or_renew()
+    assert not b.try_acquire_or_renew()
+    assert a.release()
+    # no expiry wait: the nulled holder is immediately claimable
+    clk.advance(0.1)
+    assert b.try_acquire_or_renew()
+    assert b.is_leader() and b.transitions == 1
+
+
+def test_parse_fence_roundtrip_and_malformed():
+    token = build_fence("kube-system", "kcm", "replica/with/slash", 3)
+    assert parse_fence(token) == (
+        "kube-system",
+        "kcm",
+        "replica/with/slash",
+        3,
+    )
+    assert parse_fence("") is None
+    assert parse_fence("too/short") is None
+    assert parse_fence("a/b/c/not-an-int") is None
+
+
+# ----------------------------------------------------------------- fencing
+
+
+def test_apiserver_rejects_stale_fence_with_409():
+    store = ResourceStore()
+    with APIServer(store) as srv:
+        elector = LeaderElector(
+            ClusterClient(srv.url, client_id="system:a"), "kcm", "a",
+            lease_duration=30.0,
+        )
+        assert elector.try_acquire_or_renew()
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "x", "namespace": "default"},
+            "data": {},
+        }
+        # live generation passes
+        live = ClusterClient(srv.url, fence_provider=elector.fence)
+        live.create(dict(cm))
+        # stale transitions → 409
+        stale = ClusterClient(
+            srv.url,
+            fence_provider=lambda: build_fence("kube-system", "kcm", "a", 7),
+        )
+        with pytest.raises(Conflict):
+            stale.patch("ConfigMap", "x", {"data": {"k": "v"}})
+        # wrong holder → 409
+        usurper = ClusterClient(
+            srv.url,
+            fence_provider=lambda: build_fence("kube-system", "kcm", "b", 0),
+        )
+        with pytest.raises(Conflict):
+            usurper.delete("ConfigMap", "x")
+        # vanished lease → 409 (a revoked generation cannot write)
+        ghost = ClusterClient(
+            srv.url,
+            fence_provider=lambda: build_fence("kube-system", "ghost", "a", 0),
+        )
+        with pytest.raises(Conflict):
+            ghost.create({**cm, "metadata": {"name": "y", "namespace": "default"}})
+        # malformed token → 409, not a 500
+        broken = ClusterClient(srv.url, fence_provider=lambda: "garbage")
+        with pytest.raises(Conflict):
+            broken.create({**cm, "metadata": {"name": "z", "namespace": "default"}})
+        # reads never carry the fence: all of them still read fine
+        assert stale.get("ConfigMap", "x")["data"] == {}
+
+
+# ---------------------------------------------------- APF flood regression
+
+
+def test_best_effort_flood_cannot_flap_leadership():
+    """Satellite regression: lease renew traffic classifies as system
+    priority (X-Kwok-Client "system:..."), so a best-effort flood that
+    saturates its own level cannot starve renewals into a step-down."""
+    from kwok_tpu.cluster.flowcontrol import (
+        DEFAULT_LEVELS,
+        FlowConfig,
+        FlowController,
+        PriorityLevel,
+    )
+
+    levels = tuple(
+        lv
+        if lv.name != "best-effort"
+        else PriorityLevel(
+            "best-effort", shares=lv.shares, queues=2,
+            queue_wait_s=0.05, queue_limit=2,
+        )
+        for lv in DEFAULT_LEVELS
+    )
+    flow = FlowController(FlowConfig(max_inflight=4, levels=levels), seed=3)
+    store = ResourceStore()
+    with APIServer(store, flow=flow) as srv:
+        elector = LeaderElector(
+            ClusterClient(srv.url, client_id="system:kcm-1"),
+            "kcm",
+            "kcm-1",
+            lease_duration=1.2,  # renew every ~0.4s while flooded
+        ).start()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if elector.is_leader():
+                break
+            time.sleep(0.02)
+        assert elector.is_leader()
+
+        stop = threading.Event()
+        shed = [0]
+
+        from kwok_tpu.cluster.client import NO_RETRY
+
+        def flood(i):
+            c = ClusterClient(
+                srv.url,
+                client_id=f"flood-{i}",  # unknown → best-effort
+            )
+            while not stop.is_set():
+                try:
+                    c._request("GET", "/r/pods", retry=NO_RETRY)
+                except Exception:
+                    shed[0] += 1
+
+        threads = [
+            threading.Thread(target=flood, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        flapped = False
+        t_end = time.monotonic() + 2.5
+        while time.monotonic() < t_end:
+            if not elector.is_leader():
+                flapped = True
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        try:
+            assert not flapped, "leadership flapped under best-effort flood"
+            assert elector.stepdowns == 0
+            snap = flow.snapshot()
+            # the flood actually pressured the server...
+            assert shed[0] > 0 or snap["best-effort"]["rejected"] > 0 or (
+                snap["best-effort"]["dispatched"] > 50
+            )
+            # ...and not one system-level (lease) request was shed
+            assert snap["system"]["rejected"] == 0
+        finally:
+            elector.stop(release=True)
+
+
+# ------------------------------------------------- node-lease satellite
+
+
+def test_release_hold_nulls_holder_for_immediate_handoff():
+    from kwok_tpu.controllers.node_lease_controller import (
+        NAMESPACE_NODE_LEASE,
+        NodeLeaseController,
+    )
+
+    store = ResourceStore()
+    a = NodeLeaseController(store, "kwok-a", lease_duration_seconds=120)
+    a._wanted.add("n0")
+    assert a._sync("n0") > 0  # acquires
+    assert a.held("n0")
+    a.release_hold("n0")
+    spec = store.get("Lease", "n0", namespace=NAMESPACE_NODE_LEASE)["spec"]
+    assert not spec.get("holderIdentity")
+    # another instance claims it IMMEDIATELY (no expiry wait)
+    b = NodeLeaseController(store, "kwok-b", lease_duration_seconds=120)
+    b._wanted.add("n0")
+    assert b._sync("n0") > 0
+    assert b.held("n0")
+    spec = store.get("Lease", "n0", namespace=NAMESPACE_NODE_LEASE)["spec"]
+    assert spec["holderIdentity"] == "kwok-b"
+
+
+def test_release_all_skips_foreign_holders():
+    from kwok_tpu.controllers.node_lease_controller import (
+        NAMESPACE_NODE_LEASE,
+        NodeLeaseController,
+    )
+
+    store = ResourceStore()
+    a = NodeLeaseController(store, "kwok-a", lease_duration_seconds=120)
+    for n in ("n0", "n1"):
+        a._wanted.add(n)
+        a._sync(n)
+    # a peer legitimately took n1 over after our stall
+    lease = store.get("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)
+    lease["spec"]["holderIdentity"] = "kwok-b"
+    store.update(lease)
+    a.release_all()
+    s0 = store.get("Lease", "n0", namespace=NAMESPACE_NODE_LEASE)["spec"]
+    s1 = store.get("Lease", "n1", namespace=NAMESPACE_NODE_LEASE)["spec"]
+    assert not s0.get("holderIdentity")  # ours: released
+    assert s1["holderIdentity"] == "kwok-b"  # theirs: untouched
+    assert not a.held_nodes()
+
+
+def test_leader_kill_resolves_scheduler_seat_by_holder():
+    """chaos leader-kill must find the scheduler's leader even though
+    the component family is 'scheduler[-N]' while its election lease
+    is named 'kwok-scheduler' (review PR-5): resolution falls back to
+    matching the holder identity against the component's instance
+    names."""
+    from kwok_tpu.chaos.plan import FaultPlan
+    from kwok_tpu.chaos.process_faults import ProcessFaultDriver
+
+    store = ResourceStore()
+    for lease, holder in (
+        ("kwok-scheduler", "scheduler-2"),
+        ("kube-controller-manager", "kube-controller-manager"),
+    ):
+        store.create(
+            {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": lease, "namespace": "kube-system"},
+                "spec": {"holderIdentity": holder},
+            }
+        )
+    driver = ProcessFaultDriver(runtime=None, plan=FaultPlan(), client=store)
+    assert driver._resolve_leader("scheduler") == "scheduler-2"
+    assert (
+        driver._resolve_leader("kube-controller-manager")
+        == "kube-controller-manager"
+    )
+    # no lease at all: fall back to the base name so the fault fires
+    assert driver._resolve_leader("kwok-controller") == "kwok-controller"

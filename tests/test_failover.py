@@ -29,6 +29,11 @@ def spawn_kwok(server_url, ident, lease_s=4):
             str(lease_s),
             "--server-address",
             "",  # no kubelet server needed
+            # this test exercises the NODE-lease sharding/takeover
+            # layer; process-level leader election (which would park
+            # the second instance as a standby) is covered by
+            # test_failover_e2e.py
+            "--no-leader-elect",
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,

@@ -1,0 +1,202 @@
+"""HA control-plane failover e2e (cluster/election.py): two kcm
+replicas over one apiserver.
+
+- SIGKILL the elected leader → the standby holds the lease within
+  2x leaseDuration and resumes reconciling (scale-up converges),
+- SIGSTOP the leader → the standby takes over; SIGCONT the ex-leader →
+  its stale generation is fenced with 409 and it successfully writes
+  NOTHING (zero duplicate reconciles, asserted from the apiserver
+  audit log: every post-resume 2xx mutation is lease traffic).
+
+(reference semantics: vendor/k8s.io/client-go/tools/leaderelection/
+leaderelection.go; the fault model mirrors tests/test_chaos_e2e.py)"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.client import ClusterClient
+from kwok_tpu.cluster.election import build_fence
+from kwok_tpu.cluster.store import Conflict, ResourceStore
+
+pytestmark = pytest.mark.slow
+
+LEASE_S = 2.5
+LEASE_NAME = "kube-controller-manager"
+
+
+def spawn_kcm(server_url, ident):
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "kwok_tpu.cmd.kcm",
+            "--server",
+            server_url,
+            "--controllers",
+            "workloads",
+            "--leader-elect-lease-duration",
+            str(LEASE_S),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={
+            **os.environ,
+            "KWOK_COMPONENT_NAME": ident,
+            "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
+            "JAX_PLATFORMS": "cpu",
+        },
+        start_new_session=True,
+    )
+
+
+def wait_for(cond, timeout):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.1)
+    return cond()
+
+
+def make_rs(replicas):
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "ReplicaSet",
+        "metadata": {"name": "rs", "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": "rs"}},
+            "template": {
+                "metadata": {"labels": {"app": "rs"}},
+                "spec": {"containers": [{"name": "c", "image": "i"}]},
+            },
+        },
+    }
+
+
+def holder_of(store):
+    try:
+        lease = store.get("Lease", LEASE_NAME, namespace="kube-system")
+    except KeyError:
+        return None
+    return (lease.get("spec") or {}).get("holderIdentity") or None
+
+
+def audit_lines(path):
+    out = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+def test_kill_and_pause_failover(tmp_path):
+    audit_path = str(tmp_path / "audit.jsonl")
+    store = ResourceStore()
+    procs = {}
+    with APIServer(store, audit_path=audit_path) as srv:
+        try:
+            procs["kcm-a"] = spawn_kcm(srv.url, "kcm-a")
+            assert wait_for(lambda: holder_of(store) == "kcm-a", 30), (
+                holder_of(store)
+            )
+            procs["kcm-b"] = spawn_kcm(srv.url, "kcm-b")
+            time.sleep(1.0)
+            assert holder_of(store) == "kcm-a"  # standby defers
+
+            store.create(make_rs(3))
+            assert wait_for(lambda: store.count("Pod") == 3, 30)
+
+            # ---- phase 1: SIGKILL the leader → bounded takeover ----
+            t0 = time.monotonic()
+            os.killpg(os.getpgid(procs["kcm-a"].pid), signal.SIGKILL)
+            procs.pop("kcm-a").wait(timeout=10)
+            assert wait_for(
+                lambda: holder_of(store) == "kcm-b", 2 * LEASE_S + 5
+            ), holder_of(store)
+            takeover_s = time.monotonic() - t0
+            assert takeover_s <= 2 * LEASE_S, (
+                f"takeover took {takeover_s:.2f}s > 2x leaseDuration"
+            )
+            # ...and the standby actually reconciles now
+            store.patch("ReplicaSet", "rs", {"spec": {"replicas": 5}})
+            assert wait_for(lambda: store.count("Pod") == 5, 30)
+
+            # ---- phase 2: SIGSTOP the leader, standby takes over ----
+            procs["kcm-a2"] = spawn_kcm(srv.url, "kcm-a2")
+            time.sleep(1.0)
+            lease = store.get("Lease", LEASE_NAME, namespace="kube-system")
+            stale_fence = build_fence(
+                "kube-system",
+                LEASE_NAME,
+                lease["spec"]["holderIdentity"],
+                int(lease["spec"].get("leaseTransitions") or 0),
+            )
+            os.killpg(os.getpgid(procs["kcm-b"].pid), signal.SIGSTOP)
+            assert wait_for(
+                lambda: holder_of(store) == "kcm-a2", 2 * LEASE_S + 5
+            ), holder_of(store)
+            store.patch("ReplicaSet", "rs", {"spec": {"replicas": 6}})
+            assert wait_for(lambda: store.count("Pod") == 6, 30)
+
+            # resume the ex-leader with a now-stale generation
+            marker = len(audit_lines(audit_path))
+            os.killpg(os.getpgid(procs["kcm-b"].pid), signal.SIGCONT)
+            time.sleep(2 * LEASE_S)  # plenty to flail, step down, settle
+
+            # its generation is fenced: same header path a resumed
+            # ex-leader's writes take → 409
+            stale = ClusterClient(
+                srv.url, fence_provider=lambda: stale_fence
+            )
+            with pytest.raises(Conflict):
+                stale.create(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "ConfigMap",
+                        "metadata": {
+                            "name": "split-brain",
+                            "namespace": "default",
+                        },
+                        "data": {},
+                    }
+                )
+
+            # zero duplicate reconciles: pod population untouched, and
+            # every successful post-resume mutation is lease traffic
+            # (election renews) — the resumed ex-leader wrote nothing
+            assert store.count("Pod") == 6
+            time.sleep(1.0)
+            assert store.count("Pod") == 6
+            post = audit_lines(audit_path)[marker:]
+            bad = [
+                line
+                for line in post
+                if line["code"] < 400 and "/leases/" not in line["path"]
+            ]
+            assert not bad, f"non-lease writes after resume: {bad}"
+            fenced = [line for line in post if line["code"] == 409]
+            assert fenced, "no fenced (409) writes observed after resume"
+        finally:
+            for proc in procs.values():
+                if proc.poll() is None:
+                    try:
+                        os.killpg(os.getpgid(proc.pid), signal.SIGCONT)
+                    except OSError:
+                        pass
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()

@@ -26,6 +26,9 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== overload smoke (best-effort flood -> 429s, canary unharmed) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --overload-smoke \
         --flood-seconds "${OVERLOAD_SECONDS:-2}"
+    echo "== failover smoke (leader kill/release -> bounded takeover, fenced writes) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --failover-smoke \
+        --lease-seconds "${FAILOVER_LEASE_SECONDS:-2.5}"
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
