@@ -112,6 +112,7 @@ def all_rules() -> "dict[str, object]":
         swallowed_errors,
         tracer_safety,
         unbounded_buffer,
+        untestable_sleep,
         wallclock_deadline,
     )
 
@@ -123,6 +124,7 @@ def all_rules() -> "dict[str, object]":
         "parity-citations": parity_citations.analyze,
         "swallowed-errors": swallowed_errors.analyze,
         "unbounded-buffer": unbounded_buffer.analyze,
+        "untestable-sleep": untestable_sleep.analyze,
         "wallclock-deadline": wallclock_deadline.analyze,
     }
 

@@ -48,7 +48,7 @@ LAYERS: List[Tuple[str, ...]] = [
     ("cluster",),
     ("controllers", "workloads", "metrics", "snapshot", "cni"),
     ("server", "tools"),
-    ("ctl", "cmd", "chaos"),
+    ("ctl", "cmd", "chaos", "dst"),
 ]
 
 LAYER_OF: Dict[str, int] = {

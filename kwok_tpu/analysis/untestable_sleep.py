@@ -1,0 +1,60 @@
+"""Untestable-sleep analyzer: control-plane pauses must be clockable.
+
+The DST harness (kwok_tpu.dst) runs the whole control plane on a
+:class:`~kwok_tpu.utils.clock.VirtualClock`; a bare ``time.sleep()``
+in a controller or store-layer loop blocks *wall* time the simulation
+cannot advance, so every pause in those layers must ride the injected
+Clock (``Clock.wait_signal`` — exactly what ``cluster/client.py``'s
+retry backoff and ``controllers/device_player.py``'s tick pacing do)
+or an Event wait the component's stop path can interrupt.
+
+Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/controllers/``,
+``kwok_tpu/workloads/`` — the layers the simulation hosts
+(kwok_tpu/dst/harness.py:1; the clockable-pause seam this rule
+protects is kwok_tpu/utils/clock.py:42 ``Clock.wait_signal``).  A
+finding fires on any ``time.sleep(...)`` call.  Deliberate wall-clock
+pauses (e.g. injected chaos latency that must stall a real HTTP
+thread) carry ``# kwoklint: disable=untestable-sleep`` plus the
+reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from kwok_tpu.analysis import Finding, SourceFile, dotted_name
+
+RULE = "untestable-sleep"
+
+#: layers the DST harness hosts on a virtual clock
+SCOPE = (
+    "kwok_tpu/cluster/",
+    "kwok_tpu/controllers/",
+    "kwok_tpu/workloads/",
+)
+
+_MSG = (
+    "bare time.sleep() in a simulation-hosted layer; pause via the "
+    "injected utils.clock Clock (wait_signal) or an interruptible "
+    "Event wait so deterministic-simulation runs (kwok_tpu.dst) can "
+    "virtualize it"
+)
+
+
+def analyze(files: List[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith(SCOPE):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name == "time.sleep" or name == "_time.sleep":
+                findings.append(
+                    Finding(
+                        rule=RULE, path=sf.path, line=node.lineno, message=_MSG
+                    )
+                )
+    return findings

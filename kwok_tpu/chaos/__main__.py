@@ -30,6 +30,13 @@ Three modes over one seeded profile
   kill, ~one renew interval after a graceful release), and that a
   stale leadership generation's writes are fenced with 409 while the
   live leader's pass.  tools/check.sh runs this on every check too.
+- ``--dst``             deterministic simulation testing
+  (kwok_tpu.dst): run the whole control plane in one process on a
+  virtual clock, ``--seeds N`` seeded fault interleavings, Kivi-style
+  invariant checks over every run's trace.  Any violating seed replays
+  exactly (same seed ⇒ byte-identical trace digest).  Exits nonzero on
+  any violation.  ``--dst-bug ungated-writer`` injects the test-only
+  regression the acceptance gate uses to prove violations are caught.
 """
 
 from __future__ import annotations
@@ -494,6 +501,35 @@ def build_parser() -> argparse.ArgumentParser:
         default=2.5,
         help="failover smoke election lease duration",
     )
+    p.add_argument(
+        "--dst",
+        action="store_true",
+        help="deterministic simulation run(s): whole control plane on "
+        "a virtual clock + invariant checks (kwok_tpu.dst)",
+    )
+    p.add_argument(
+        "--seeds", type=int, default=10, help="how many DST seeds to explore"
+    )
+    p.add_argument(
+        "--seed-start", type=int, default=0, help="first DST seed"
+    )
+    p.add_argument(
+        "--dst-duration",
+        type=float,
+        default=40.0,
+        help="virtual seconds of scenario+faults per DST seed",
+    )
+    p.add_argument(
+        "--dst-bug",
+        default=None,
+        choices=[None, "ungated-writer"],
+        help="inject a test-only regression (must be caught)",
+    )
+    p.add_argument(
+        "--dst-verbose",
+        action="store_true",
+        help="print one JSON line per seed as it finishes",
+    )
     p.add_argument("--pods", type=int, default=40, help="smoke population")
     p.add_argument(
         "--flood-seconds",
@@ -504,8 +540,39 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_dst(args) -> int:
+    """Explore N seeds; print the aggregate report; nonzero exit on
+    any invariant violation (the check.sh gate contract)."""
+    from kwok_tpu.dst import SimOptions, run_seed
+
+    opts = SimOptions(duration=args.dst_duration, bug=args.dst_bug)
+    violating = {}
+    runs = []
+    for i in range(args.seeds):
+        seed = args.seed_start + i
+        report = run_seed(seed, opts)
+        runs.append(report)
+        if args.dst_verbose:
+            print(json.dumps(report), flush=True)
+        if report["violations"]:
+            violating[seed] = report["violations"]
+    summary = {
+        "seeds": args.seeds,
+        "start": args.seed_start,
+        "steps": sum(r["steps"] for r in runs),
+        "crashes": sum(r["crashes"] for r in runs),
+        "converged": sum(1 for r in runs if r["converged"]),
+        "violating_seeds": sorted(violating),
+        "violations": violating,
+    }
+    print(json.dumps(summary))
+    return 1 if violating else 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.dst:
+        return run_dst(args)
     if args.smoke:
         report = run_smoke(seed=args.seed if args.seed is not None else 42, pods=args.pods)
         print(json.dumps(report))

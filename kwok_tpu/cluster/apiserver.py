@@ -201,7 +201,10 @@ class _Handler(BaseHTTPRequestHandler):
             return False
         kind = act.get("action")
         if kind == "latency":
-            time.sleep(float(act.get("seconds", 0.0)))
+            # deliberately wall-clock: this stalls a REAL HTTP handler
+            # thread to simulate network latency — never on the DST
+            # virtual-time path (which injects faults in-process)
+            time.sleep(float(act.get("seconds", 0.0)))  # kwoklint: disable=untestable-sleep
             return False
         if kind == "reject":
             code = int(act.get("status", 503))
@@ -255,37 +258,15 @@ class _Handler(BaseHTTPRequestHandler):
         the store.  Reads never carry the header."""
         if self.command in ("GET", "HEAD"):
             return False
-        from kwok_tpu.cluster.election import FENCE_HEADER, parse_fence
+        from kwok_tpu.cluster.election import FENCE_HEADER, validate_fence
 
         raw = self.headers.get(FENCE_HEADER)
         if not raw:
             return False
 
-        parsed = parse_fence(raw)
-        stale = "malformed fence token"
-        if parsed is not None:
-            ns, name, holder, transitions = parsed
-            try:
-                spec = (
-                    self.store.get("Lease", name, namespace=ns) or {}
-                ).get("spec") or {}
-            except Exception:  # noqa: BLE001 — a vanished lease is a
-                # revoked generation, same verdict as a mismatch
-                spec = None
-            if spec is None:
-                stale = f"election lease {ns}/{name} is gone"
-            else:
-                live_holder = spec.get("holderIdentity") or ""
-                try:
-                    live_tr = int(spec.get("leaseTransitions") or 0)
-                except (TypeError, ValueError):
-                    live_tr = 0
-                if live_holder == holder and live_tr == transitions:
-                    return False
-                stale = (
-                    f"lease {ns}/{name} is held by "
-                    f"{live_holder or '<nobody>'} at transition {live_tr}"
-                )
+        stale = validate_fence(self.store, raw)
+        if stale is None:
+            return False
         body = json.dumps(
             {
                 "error": f"stale leader fence ({stale}): write rejected",

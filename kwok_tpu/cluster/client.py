@@ -282,6 +282,7 @@ class ClusterClient:
         retry: Optional[RetryPolicy] = None,
         client_id: Optional[str] = None,
         fence_provider: Optional[Callable[[], Optional[str]]] = None,
+        clock=None,
     ):
         self._https = url.startswith("https://")
         if "://" in url:
@@ -289,6 +290,15 @@ class ClusterClient:
         self._hostport = url.rstrip("/")
         self._timeout = timeout
         self._retry = retry or RetryPolicy()
+        #: injectable clock (utils.clock Clock duck type) for the retry
+        #: backoff / readiness-poll sleeps, so simulated-time runs can
+        #: virtualize them; RealClock's wait_signal on a never-set
+        #: event is exactly time.sleep.
+        from kwok_tpu.utils.clock import RealClock
+
+        self._clock = clock or RealClock()
+        self._sleep_wake = threading.Event()
+        self._clock.subscribe(self._sleep_wake)
         #: identifies this client to the apiserver (X-Kwok-Client) on
         #: EVERY verb — flow control classifies on it and chaos
         #: partitions target it.  Defaults to the component name the
@@ -400,7 +410,12 @@ class ClusterClient:
                     f"{message} (retry budget exhausted)", attempts, last_status
                 ) from cause
             if delay > 0:
-                time.sleep(delay)
+                # through the injected clock so a simulated-time run
+                # can virtualize the backoff; cleared first because a
+                # fake clock's advance() latches subscribed events
+                # (under RealClock nothing sets it: exactly time.sleep)
+                self._sleep_wake.clear()
+                self._clock.wait_signal(self._sleep_wake, delay)
 
         while True:
             attempts += 1
@@ -796,6 +811,7 @@ class ClusterClient:
         while time.monotonic() < deadline:
             if self.healthy():
                 return True
-            time.sleep(delay)
+            self._sleep_wake.clear()
+            self._clock.wait_signal(self._sleep_wake, delay)
             delay = min(delay * 2, 1.0)
         return False

@@ -55,6 +55,7 @@ __all__ = [
     "FENCE_HEADER",
     "build_fence",
     "parse_fence",
+    "validate_fence",
 ]
 
 #: election Leases live where kube components put theirs
@@ -88,6 +89,35 @@ def parse_fence(raw: str) -> Optional[Tuple[str, str, str, int]]:
     return parts[0], parts[1], "/".join(parts[2:-1]), transitions
 
 
+def validate_fence(store, token: str) -> Optional[str]:
+    """The split-brain verdict, shared by every fence enforcement
+    point (the apiserver's ``X-Kwok-Leader-Fence`` gate and the DST
+    harness's in-process store boundary): check one fence token
+    against the live election Lease; returns ``None`` when the
+    writer's generation is current, else the stale-reason string the
+    caller renders into its 409/Conflict."""
+    parsed = parse_fence(token)
+    if parsed is None:
+        return "malformed fence token"
+    ns, name, holder, transitions = parsed
+    try:
+        spec = (store.get("Lease", name, namespace=ns) or {}).get("spec") or {}
+    except Exception:  # noqa: BLE001 — a vanished (or unreadable) lease
+        # is a revoked generation, same verdict as a mismatch
+        return f"election lease {ns}/{name} is gone"
+    live_holder = spec.get("holderIdentity") or ""
+    try:
+        live_tr = int(spec.get("leaseTransitions") or 0)
+    except (TypeError, ValueError):
+        live_tr = 0
+    if live_holder == holder and live_tr == transitions:
+        return None
+    return (
+        f"lease {ns}/{name} is held by "
+        f"{live_holder or '<nobody>'} at transition {live_tr}"
+    )
+
+
 class LeaderElector:
     """Campaign for (then keep renewing) one election Lease.
 
@@ -107,6 +137,7 @@ class LeaderElector:
         retry_period: Optional[float] = None,
         clock: Optional[Clock] = None,
         rng: Optional[random.Random] = None,
+        record_clock: Optional[Clock] = None,
         on_started_leading: Optional[Callable[[], None]] = None,
         on_stopped_leading: Optional[Callable[[], None]] = None,
         on_new_leader: Optional[Callable[[str], None]] = None,
@@ -134,6 +165,12 @@ class LeaderElector:
         )
         self.clock = clock or MonotonicClock()
         self.rng = rng or random.Random()
+        #: clock for the *record's* display timestamps (acquireTime /
+        #: renewTime).  None = wall clock, the production posture;
+        #: simulated-time runs (kwok_tpu.dst) inject their virtual
+        #: clock so the written record is seed-deterministic.  Deadline
+        #: math never reads these timestamps either way.
+        self.record_clock = record_clock
         self._on_started = on_started_leading
         self._on_stopped = on_stopped_leading
         self._on_new_leader = on_new_leader
@@ -202,7 +239,12 @@ class LeaderElector:
     def _now_rfc3339(self) -> str:
         # wall-clock timestamp for the *record* (human/display
         # consumers); deadline math never parses it back
-        t = datetime.datetime.now(datetime.timezone.utc)
+        if self.record_clock is not None:
+            t = datetime.datetime.fromtimestamp(
+                self.record_clock.now(), datetime.timezone.utc
+            )
+        else:
+            t = datetime.datetime.now(datetime.timezone.utc)
         return t.isoformat(timespec="microseconds").replace("+00:00", "Z")
 
     def _observe(self, spec: dict) -> None:

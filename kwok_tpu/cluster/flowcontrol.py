@@ -481,6 +481,13 @@ def expose_metrics(flow: Optional[FlowController], store=None) -> str:
         )
         g.set(getattr(store, "watch_evictions", 0))
         reg.register("kwok_apiserver_watch_evictions_total", g)
+        ao = Gauge(
+            "kwok_apiserver_audit_overflow_total",
+            help="audit-ring entries evicted by the bounded buffer; "
+            "nonzero means audit_log() is a truncated window",
+        )
+        ao.set(getattr(store, "audit_overflow", 0))
+        reg.register("kwok_apiserver_audit_overflow_total", ao)
         rv = Gauge(
             "kwok_apiserver_resource_version",
             help="store resourceVersion",

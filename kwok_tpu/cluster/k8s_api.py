@@ -1169,7 +1169,9 @@ class K8sFacade:
         cur = self._scrape_all()
         prev = getattr(self, "_usage_prev", None)
         if prev is None or now - prev[0] <= 0:
-            time.sleep(0.25)
+            # deliberately wall-clock: a usage *rate* needs two scrapes
+            # separated by real time on this first-call path
+            time.sleep(0.25)  # kwoklint: disable=untestable-sleep
             prev = (now, cur)
             now = time.monotonic()
             cur = self._scrape_all()

@@ -53,6 +53,32 @@ SYNC = "SYNC"  # informer re-list marker, never emitted by the store
 NS_FINALIZER = "kwok.x-k8s.io/namespace"
 
 
+class _AuditRing(deque):
+    """Bounded audit deque that *counts* what it evicts: a full ring
+    silently dropping its oldest entries would let trace-level
+    invariant checks (kwok_tpu.dst) pass vacuously over a truncated
+    window.  ``dropped`` is surfaced as ``ResourceStore.audit_overflow``
+    (and at the apiserver's /metrics); the first overflow logs one
+    warning."""
+
+    def __init__(self, maxlen: int):
+        super().__init__(maxlen=maxlen)
+        self.dropped = 0
+
+    def append(self, item) -> None:
+        if self.maxlen is not None and len(self) == self.maxlen:
+            self.dropped += 1
+            if self.dropped == 1:
+                from kwok_tpu.utils.log import get_logger
+
+                get_logger("store").warn(
+                    "audit ring overflowed; trace-level checks over "
+                    "audit_log() now see a truncated window",
+                    maxlen=self.maxlen,
+                )
+        super().append(item)
+
+
 class NotFound(KeyError):
     pass
 
@@ -571,8 +597,10 @@ class ResourceStore:
         self._history_floor = 0
         self._types: Dict[str, _TypeState] = {}
         #: (verb, key, as_user); bounded — at device-drain rates an
-        #: unbounded list is a slow memory leak
-        self._audit: deque = deque(maxlen=1_000_000)
+        #: unbounded list is a slow memory leak.  Overflow is counted
+        #: (audit_overflow), not silent: trace-replaying invariant
+        #: checks must be able to tell "clean" from "truncated".
+        self._audit: _AuditRing = _AuditRing(maxlen=1_000_000)
         #: per-watcher undelivered-event bound (0 disables eviction)
         self.watch_high_water = (
             self.WATCH_HIGH_WATER
@@ -1849,6 +1877,14 @@ class ResourceStore:
         with self._mut:
             return list(self._audit)
 
+    @property
+    def audit_overflow(self) -> int:
+        """Entries the bounded audit ring has evicted; nonzero means
+        ``audit_log()`` covers a truncated window (scraped at /metrics,
+        checked by the DST invariant runner)."""
+        with self._mut:
+            return self._audit.dropped
+
 
 class EventRecorder:
     """Aggregating k8s Event recorder (reference: controllers emit
@@ -1864,10 +1900,15 @@ class EventRecorder:
         store: ResourceStore,
         source: str = "kwok",
         clock: Optional[Clock] = None,
+        suffix: Optional[Callable[[], str]] = None,
     ):
         self._store = store
         self._source = source
         self._clock = clock or RealClock()
+        #: uniquifying Event-name suffix; default is wall-entropy
+        #: (monotonic ns), simulated-time runs inject a deterministic
+        #: counter so Event names are seed-stable (kwok_tpu.dst)
+        self._suffix = suffix or (lambda: f"{time.monotonic_ns():x}")
         self._mut = threading.Lock()
         self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
 
@@ -1900,7 +1941,7 @@ class EventRecorder:
                     )
                 except NotFound:
                     del self._keys[key]
-            name = f"{meta.get('name', 'unknown')}.{time.monotonic_ns():x}"
+            name = f"{meta.get('name', 'unknown')}.{self._suffix()}"
             ev = {
                 "apiVersion": "v1",
                 "kind": "Event",

@@ -88,6 +88,26 @@ def add_leader_elect_flags(
     )
 
 
+def build_controller_groups(store, groups=("gc", "workloads"), active=None, clock=None, recorder=None):
+    """In-process hosting seam: construct the (unstarted) controller
+    instances exactly as the daemon's ``start_controllers`` does, over
+    any store duck-type.  The daemon calls ``.start()`` on each; the
+    DST harness (kwok_tpu.dst) instead drives their synchronous seams
+    on a virtual clock — same composition, one process."""
+    ctrls = []
+    if "gc" in groups:
+        ctrls.append(GCController(store, active=active))
+    if "workloads" in groups:
+        from kwok_tpu.workloads import WorkloadManager
+
+        ctrls.append(
+            WorkloadManager(
+                store, active=active, clock=clock, recorder=recorder
+            )
+        )
+    return ctrls
+
+
 def run_elected(
     args,
     identity: str,
@@ -157,14 +177,8 @@ def main(argv=None) -> int:
         with run_mut:
             if running:
                 return
-            if "gc" in groups:
-                running.append(GCController(client, active=active).start())
-            if "workloads" in groups:
-                from kwok_tpu.workloads import WorkloadManager
-
-                running.append(
-                    WorkloadManager(client, active=active).start()
-                )
+            for ctrl in build_controller_groups(client, groups, active=active):
+                running.append(ctrl.start())
         print("controller-manager reconciling", flush=True)
 
     def stop_controllers() -> None:

@@ -37,6 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def build_scheduler(store, active=None, recorder=None) -> Scheduler:
+    """In-process hosting seam: the (unstarted) scheduler instance the
+    daemon runs, over any store duck-type — the composition the DST
+    harness (kwok_tpu.dst) drives synchronously on a virtual clock."""
+    return Scheduler(store, active=active, recorder=recorder)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from kwok_tpu.utils.log import setup as log_setup
@@ -62,7 +69,7 @@ def main(argv=None) -> int:
         with run_mut:
             if running:
                 return
-            running.append(Scheduler(client, active=active).start())
+            running.append(build_scheduler(client, active=active).start())
         print("scheduler binding", flush=True)
 
     def stop_controllers() -> None:

@@ -115,6 +115,10 @@ class DeviceStagePlayer:
         self._mut = threading.Lock()
         self._paced = True
         self._done = threading.Event()
+        #: tick-pacing wake signal: pinged when a virtual clock
+        #: advances, so the paced loop never blocks on wall time
+        self._tick_wake = threading.Event()
+        self.clock.subscribe(self._tick_wake)
         self._threads: List[threading.Thread] = []
         self.transitions = 0
         self.patches = 0
@@ -420,7 +424,12 @@ class DeviceStagePlayer:
                 next_tick += dt_s
             sleep = next_tick - self.clock.now()
             if sleep > 0:
-                time.sleep(min(sleep, dt_s))
+                # pace on the injected clock (never bare time.sleep) so
+                # a virtual clock can fast-forward the tick cadence;
+                # the wait is bounded by dt_s, which also bounds stop()
+                # latency exactly like the old bare sleep did
+                self._tick_wake.clear()
+                self.clock.wait_signal(self._tick_wake, min(sleep, dt_s))
         # drain the last in-flight macro-tick so stop() never strands
         # fired rows
         try:

@@ -160,16 +160,30 @@ class GCController:
                 continue  # standby/deposed: no reaping, no retries
             try:
                 self._refresh_watches()
-                for ns in list(self._terminating):
-                    self._reap_namespace(ns)
-                with self._mut:
-                    retry, self._retry = self._retry, set()
-                for child in retry:
-                    self._maybe_collect(child)
+                self.sync_once()
             except Exception:  # noqa: BLE001
                 import traceback
 
                 traceback.print_exc()
+
+    # ------------------------------------------------------- synchronous seams
+
+    def handle_event(self, ev) -> None:
+        """Public synchronous seam: index/collect one informer event.
+        The thread loop feeds this; a simulated-time harness
+        (kwok_tpu.dst) drives it directly from pumped watch events."""
+        self._handle(ev)
+
+    def sync_once(self) -> None:
+        """One resync sweep without the thread loop: reap terminating
+        namespaces, retry failed collections.  The `_loop` resync body
+        and the DST harness share this."""
+        for ns in sorted(self._terminating):
+            self._reap_namespace(ns)
+        with self._mut:
+            retry, self._retry = self._retry, set()
+        for child in sorted(retry):
+            self._maybe_collect(child)
 
     # ---------------------------------------------------------------- indexing
 
@@ -215,7 +229,10 @@ class GCController:
                 dependents: Set[ChildKey] = set()
                 for k in (f"u:{meta.get('uid')}", f"k:{kind}/{ns}/{name}", f"k:{kind}//{name}"):
                     dependents |= self._children.get(k, set())
-            for dep in dependents:
+            # sorted: set order varies with the per-process hash seed,
+            # and deterministic-simulation runs (kwok_tpu.dst) replay
+            # audit traces byte-identically across processes
+            for dep in sorted(dependents):
                 self._maybe_collect(dep)
             return
 

@@ -232,27 +232,33 @@ class Scheduler:
                     pending_retry = 0.0
                     self._retry_pending()
                 continue
-            obj = ev.object
-            if obj.get("kind") == "Node":
-                # cache updated by the informer; drop the sorted view so
-                # the next bind rebuilds it (retry path covers pods)
-                self._sorted_nodes = None
-                continue
-            if ev.type == DELETED:
-                self._untrack(obj)
-                continue
-            node = (obj.get("spec") or {}).get("nodeName")
-            if node:
-                if (obj.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
-                    self._untrack(obj)  # terminal pods free their slot
-                else:
-                    self._track(obj, node)
-                continue
-            if (obj.get("metadata") or {}).get("deletionTimestamp"):
-                continue
-            if self._active is not None and not self._active():
-                continue  # standby/deposed: track caches, never bind
-            self._bind(obj)
+            self.handle_event(ev)
+
+    def handle_event(self, ev) -> None:
+        """Process one node/pod event (the `_loop` body, factored out
+        so a simulated-time harness can drive the same state machine
+        synchronously — kwok_tpu.dst)."""
+        obj = ev.object
+        if obj.get("kind") == "Node":
+            # cache updated by the informer; drop the sorted view so
+            # the next bind rebuilds it (retry path covers pods)
+            self._sorted_nodes = None
+            return
+        if ev.type == DELETED:
+            self._untrack(obj)
+            return
+        node = (obj.get("spec") or {}).get("nodeName")
+        if node:
+            if (obj.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                self._untrack(obj)  # terminal pods free their slot
+            else:
+                self._track(obj, node)
+            return
+        if (obj.get("metadata") or {}).get("deletionTimestamp"):
+            return
+        if self._active is not None and not self._active():
+            return  # standby/deposed: track caches, never bind
+        self._bind(obj)
 
     def _retry_pending(self) -> None:
         if self._active is not None and not self._active():

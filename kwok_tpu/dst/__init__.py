@@ -1,0 +1,32 @@
+"""Deterministic simulation testing (DST) for the kwok-tpu control
+plane.
+
+Runs the whole control plane in one process on a
+:class:`~kwok_tpu.utils.clock.VirtualClock`, with a seeded interleaving
+scheduler injecting the chaos fault vocabulary at virtual instants, and
+Kivi-style invariant checkers replaying the trace afterwards — turning
+the chaos subsystem from smoke tests into a reproducible bug search
+(``python -m kwok_tpu.chaos --dst --seeds N``; ROADMAP.md:101).
+
+Layout: :mod:`~kwok_tpu.dst.harness` owns the simulation loop,
+:mod:`~kwok_tpu.dst.actors` the synchronous component drivers,
+:mod:`~kwok_tpu.dst.faults` the fault timeline and the per-actor store
+boundary, :mod:`~kwok_tpu.dst.invariants` the checkers, and
+:mod:`~kwok_tpu.dst.trace` the canonical hashable run trace.
+"""
+
+from kwok_tpu.dst.harness import RunRecord, SimOptions, Simulation, run_seed, run_seeds
+from kwok_tpu.dst.invariants import INVARIANTS, run_checks
+from kwok_tpu.dst.trace import Trace, TraceEvent
+
+__all__ = [
+    "RunRecord",
+    "SimOptions",
+    "Simulation",
+    "run_seed",
+    "run_seeds",
+    "INVARIANTS",
+    "run_checks",
+    "Trace",
+    "TraceEvent",
+]

@@ -1,0 +1,666 @@
+"""Simulation actors: the control-plane components driven synchronously.
+
+Each actor wraps one real component through its synchronous seams — the
+same state machines the daemons run on threads, stepped by the
+simulation scheduler instead:
+
+- electors step ``renew_once``/``try_acquire_or_renew``
+  (``kwok_tpu/cluster/election.py:335``, the fake-clock drive mode its
+  docstring names);
+- the kcm seat drives ``GCController.handle_event``/``sync_once`` and
+  ``WorkloadManager.map_event``/``resync_once``/``drain_queue``
+  (``kwok_tpu/controllers/gc_controller.py:99``,
+  ``kwok_tpu/workloads/manager.py:103``), composed via the daemon's own
+  factory (``kwok_tpu/cmd/kcm.py:91``);
+- the scheduler seat drives ``Scheduler.handle_event`` and
+  ``_retry_pending`` (``kwok_tpu/controllers/scheduler.py:79``);
+- the kwok seat replays the stage hot loop — select → delay → play —
+  against the compiled Lifecycle, mirroring
+  ``kwok_tpu/controllers/base.py:41`` StagePlayer without its queue
+  threads;
+- watch pumps replay the informer reflector contract
+  (``kwok_tpu/cluster/informer.py:133``): list-then-watch, resume at
+  the last delivered resourceVersion, full re-list (with synthesized
+  DELETEDs) on ``Expired``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import random
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kwok_tpu.cluster.election import LeaderElector
+from kwok_tpu.cluster.informer import InformerEvent
+from kwok_tpu.cluster.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    EventRecorder,
+    Expired,
+    NotFound,
+)
+from kwok_tpu.controllers.utils import should_retry
+from kwok_tpu.dst.faults import ActorStore
+from kwok_tpu.engine.lifecycle import Lifecycle, to_json_standard
+from kwok_tpu.utils.backoff import Backoff
+from kwok_tpu.utils.patch import is_noop_patch
+
+__all__ = [
+    "Actor",
+    "Replica",
+    "WatchPump",
+    "ElectorActor",
+    "KcmActor",
+    "SchedulerActor",
+    "LifecycleActor",
+    "ObserverActor",
+]
+
+#: kinds the GC seat pumps (the interesting owner graph; the daemon
+#: watches every registered kind, which at sim scale is just overhead)
+GC_KINDS = ("Namespace", "Deployment", "ReplicaSet", "Job", "Pod")
+
+#: kinds the workload manager pumps (workloads/manager.py _WATCHED)
+WORKLOAD_KINDS = ("Deployment", "ReplicaSet", "Job", "HorizontalPodAutoscaler", "Pod")
+
+
+class Actor:
+    """One schedulable unit: a step function with a jittered cadence."""
+
+    def __init__(self, sim, name: str, replica: Optional["Replica"], period: float):
+        self.sim = sim
+        self.name = name
+        self.replica = replica
+        self.period = period
+        self.next_due = sim.clock.now()
+
+    def runnable(self) -> bool:
+        r = self.replica
+        return r is None or (r.alive and not r.paused)
+
+    def schedule_next(self) -> None:
+        jitter = 1.0 + 0.2 * self.sim.rng.random()
+        self.next_due = self.sim.clock.now() + self.period * jitter
+
+    def step(self) -> None:
+        raise NotImplementedError
+
+
+class Replica:
+    """One simulated control-plane process: a seat's elector plus the
+    controllers gated on it (the run_elected composition,
+    kwok_tpu/cmd/kcm.py:110)."""
+
+    def __init__(self, sim, seat: str, lease_name: str, idx: int, lease_duration: float):
+        self.sim = sim
+        self.seat = seat
+        self.lease_name = lease_name
+        self.name = f"{seat}-{idx}"
+        self.lease_duration = lease_duration
+        self.alive = True
+        self.paused = False
+        self.leading = False
+        self.elector: Optional[LeaderElector] = None
+        self.build_elector()
+
+    def build_elector(self) -> None:
+        sim = self.sim
+        store = ActorStore(sim, f"{self.name}/elector", f"system:{self.name}")
+
+        def on_started() -> None:
+            self.leading = True
+            sim.trace.add(
+                sim.clock.now(),
+                self.name,
+                "elected",
+                f"{self.lease_name} transitions={self.elector.transitions}",
+            )
+
+        def on_stopped() -> None:
+            self.leading = False
+            sim.trace.add(
+                sim.clock.now(), self.name, "deposed", self.lease_name
+            )
+
+        self.elector = LeaderElector(
+            store,
+            self.lease_name,
+            self.name,
+            lease_duration=self.lease_duration,
+            clock=sim.clock,
+            rng=random.Random(sim.rng.randrange(2**31)),
+            record_clock=sim.clock,
+            on_started_leading=on_started,
+            on_stopped_leading=on_stopped,
+        )
+
+    def fence(self) -> Optional[str]:
+        return self.elector.fence() if self.elector is not None else None
+
+    def is_leader(self) -> bool:
+        return (
+            self.alive
+            and not self.paused
+            and self.elector is not None
+            and self.elector.is_leader()
+        )
+
+    def kill(self) -> None:
+        """Silent death (SIGKILL analog): no release, the lease must
+        expire before a standby takes over."""
+        self.alive = False
+        self.leading = False
+
+    def revive(self) -> None:
+        """Process restart: a fresh elector campaigns from scratch."""
+        self.alive = True
+        self.paused = False
+        self.build_elector()
+
+
+class WatchPump:
+    """Synchronous list+watch mirror of the informer reflector
+    (cluster/informer.py:133): resume-at-rv across reconnects, full
+    re-list with synthesized DELETEDs on Expired, frozen while the
+    owner is partitioned.  Single consumer; `drain` returns the events
+    since the last call."""
+
+    def __init__(self, sim, kind: str, client_id: str):
+        self.sim = sim
+        self.kind = kind
+        self.client_id = client_id
+        self._mirror: Dict[Tuple[str, str], dict] = {}
+        self._w = None
+        self._rv: Optional[int] = None
+        self._gen: Optional[int] = None
+
+    def reset(self) -> None:
+        if self._w is not None:
+            self._w.stop()
+        self._w = None
+        self._rv = None
+        self._gen = None
+        self._mirror.clear()
+
+    @staticmethod
+    def _key(obj: dict) -> Tuple[str, str]:
+        meta = obj.get("metadata") or {}
+        return (meta.get("namespace") or "", meta.get("name") or "")
+
+    def _relist(self, out: List[InformerEvent]) -> None:
+        store = self.sim.store
+        items, rv = store.list(self.kind)
+        fresh = {self._key(o): o for o in items}
+        for key, old in list(self._mirror.items()):
+            if key not in fresh:
+                del self._mirror[key]
+                out.append(InformerEvent(DELETED, old))
+        for key, obj in fresh.items():
+            prev = self._mirror.get(key)
+            if prev is not None and (prev.get("metadata") or {}).get(
+                "resourceVersion"
+            ) == (obj.get("metadata") or {}).get("resourceVersion"):
+                continue
+            self._mirror[key] = obj
+            out.append(InformerEvent(ADDED if prev is None else MODIFIED, obj))
+        self._rv = rv
+
+    def _attach(self, out: List[InformerEvent]) -> None:
+        sim = self.sim
+        self._gen = sim.store_generation
+        if self._rv is not None:
+            try:
+                self._w = sim.store.watch(self.kind, since_rv=self._rv)
+                return
+            except Expired:
+                self._w = None  # gap or rollback: heal via re-list
+            except NotFound:
+                self._w = None
+                return
+        self._relist(out)
+        try:
+            self._w = sim.store.watch(self.kind, since_rv=self._rv)
+        except Expired:
+            self._w = None
+
+    def drain(self) -> List[InformerEvent]:
+        sim = self.sim
+        if sim.faults.partitioned(self.client_id, sim.clock.now()):
+            return []  # the stream is dark; events buffer server-side
+        out: List[InformerEvent] = []
+        if (
+            self._gen != sim.store_generation
+            or self._w is None
+            or self._w.stopped
+        ):
+            self._attach(out)
+        if self._w is not None:
+            for ev in self._w.drain():
+                rv = getattr(ev, "rv", 0) or 0
+                if self._rv is None or rv > self._rv:
+                    self._rv = rv
+                obj = ev.object
+                if ev.type == DELETED:
+                    self._mirror.pop(self._key(obj), None)
+                else:
+                    self._mirror[self._key(obj)] = obj
+                out.append(InformerEvent(ev.type, obj))
+        return out
+
+
+class ElectorActor(Actor):
+    """Steps one replica's election state machine at its retry
+    cadence (the elector `_run` loop body)."""
+
+    def __init__(self, sim, replica: Replica):
+        period = replica.lease_duration / 3.0
+        super().__init__(sim, f"{replica.name}/elector", replica, period)
+
+    def step(self) -> None:
+        el = self.replica.elector
+        if el is None:
+            return
+        if el.is_leader():
+            el.renew_once()
+        else:
+            el.try_acquire_or_renew()
+
+
+class _GatedControllerActor(Actor):
+    """Shared leader-gating shell: build the component set on
+    acquisition, tear it down (fresh state) on deposition — the
+    start_controllers/stop_controllers shape of the daemons."""
+
+    def __init__(self, sim, name, replica, period):
+        super().__init__(sim, name, replica, period)
+        self._built = False
+
+    def _build(self) -> None:
+        raise NotImplementedError
+
+    def _teardown(self) -> None:
+        raise NotImplementedError
+
+    def _leader_ok(self) -> bool:
+        return self.replica.is_leader()
+
+    def step(self) -> None:
+        if not self._leader_ok():
+            if self._built:
+                self._teardown()
+                self._built = False
+            return
+        if not self._built:
+            self._build()
+            self._built = True
+        self._step_leading()
+
+    def _step_leading(self) -> None:
+        raise NotImplementedError
+
+
+class KcmActor(_GatedControllerActor):
+    """The kube-controller-manager seat: gc + workloads, composed via
+    the daemon's own factory (cmd/kcm.py build_controller_groups)."""
+
+    RESYNC_S = 2.0
+
+    def __init__(self, sim, replica: Replica, ungated: bool = False):
+        super().__init__(sim, replica.name, replica, period=0.8)
+        #: deliberate test-only regression ("ungated-writer"): this
+        #: replica reconciles even while NOT holding the lease — the
+        #: bug class the single-reconciler invariant exists to catch
+        self.ungated = ungated
+        self.gc = None
+        self.mgr = None
+        self._gc_pumps: List[WatchPump] = []
+        self._wl_pumps: List[WatchPump] = []
+        self._next_resync = 0.0
+
+    def _leader_ok(self) -> bool:
+        if self.ungated:
+            return self.replica.alive and not self.replica.paused
+        return super()._leader_ok()
+
+    def _build(self) -> None:
+        from kwok_tpu.cmd.kcm import build_controller_groups
+
+        sim = self.sim
+        r = self.replica
+        store = ActorStore(
+            sim, r.name, f"controller:{r.name}", fence_provider=r.fence
+        )
+        active = None if self.ungated else r.is_leader
+        recorder = EventRecorder(
+            store, source=r.seat, clock=sim.clock, suffix=sim.next_suffix
+        )
+        self.gc, self.mgr = build_controller_groups(
+            store,
+            ("gc", "workloads"),
+            active=active,
+            clock=sim.clock,
+            recorder=recorder,
+        )
+        cid = f"controller:{r.name}"
+        self._gc_pumps = [WatchPump(sim, k, cid) for k in GC_KINDS]
+        self._wl_pumps = [WatchPump(sim, k, cid) for k in WORKLOAD_KINDS]
+        self._next_resync = sim.clock.now()
+
+    def _teardown(self) -> None:
+        for p in self._gc_pumps + self._wl_pumps:
+            p.reset()
+        self.gc = None
+        self.mgr = None
+
+    def _step_leading(self) -> None:
+        sim = self.sim
+        for pump in self._gc_pumps:
+            for ev in pump.drain():
+                try:
+                    self.gc.handle_event(ev)
+                except Exception:  # noqa: BLE001 — partition mid-index
+                    pass
+        for pump in self._wl_pumps:
+            for ev in pump.drain():
+                try:
+                    self.mgr.map_event(ev.object)
+                except Exception:  # noqa: BLE001
+                    pass
+        now = sim.clock.now()
+        if now >= self._next_resync:
+            self._next_resync = now + self.RESYNC_S
+            self.mgr.resync_once()
+            try:
+                self.gc.sync_once()
+            except Exception:  # noqa: BLE001 — partition mid-sweep
+                pass
+        self.mgr.drain_queue()
+
+
+class SchedulerActor(_GatedControllerActor):
+    """The scheduler seat (cmd/scheduler.py build_scheduler), fed by
+    node/pod pumps instead of informer threads."""
+
+    RETRY_S = 2.0
+
+    def __init__(self, sim, replica: Replica):
+        super().__init__(sim, replica.name, replica, period=0.7)
+        self.sched = None
+        self._node_pump: Optional[WatchPump] = None
+        self._pod_pump: Optional[WatchPump] = None
+        self._next_retry = 0.0
+
+    def _build(self) -> None:
+        from kwok_tpu.cmd.scheduler import build_scheduler
+
+        sim = self.sim
+        r = self.replica
+        store = ActorStore(
+            sim, r.name, f"controller:{r.name}", fence_provider=r.fence
+        )
+        recorder = EventRecorder(
+            store, source=r.seat, clock=sim.clock, suffix=sim.next_suffix
+        )
+        self.sched = build_scheduler(
+            store, active=r.is_leader, recorder=recorder
+        )
+        cid = f"controller:{r.name}"
+        self._node_pump = WatchPump(sim, "Node", cid)
+        self._pod_pump = WatchPump(sim, "Pod", cid)
+        self._next_retry = sim.clock.now()
+
+    def _teardown(self) -> None:
+        for p in (self._node_pump, self._pod_pump):
+            if p is not None:
+                p.reset()
+        self.sched = None
+
+    def _step_leading(self) -> None:
+        sim = self.sim
+        sched = self.sched
+        for ev in self._node_pump.drain():
+            # the informer thread would maintain the node cache; the
+            # pump stands in for it (same CacheGetter contract)
+            sched._nodes._apply(ev.type, ev.object)
+            self._safe_handle(ev)
+        for ev in self._pod_pump.drain():
+            self._safe_handle(ev)
+        now = sim.clock.now()
+        if now >= self._next_retry:
+            self._next_retry = now + self.RETRY_S
+            try:
+                sched._retry_pending()
+            except Exception:  # noqa: BLE001 — partitioned mid-list
+                pass
+
+    def _safe_handle(self, ev) -> None:
+        try:
+            self.sched.handle_event(ev)
+        except Exception:  # noqa: BLE001 — a failed bind logs + retries
+            pass
+
+
+class _StageJob:
+    __slots__ = ("obj", "rv", "stage", "due", "retries")
+
+    def __init__(self, obj, rv, stage, due, retries=0):
+        self.obj = obj
+        self.rv = rv
+        self.stage = stage
+        self.due = due
+        self.retries = retries
+
+
+class LifecycleActor(_GatedControllerActor):
+    """The kwok-controller seat for one kind: the stage hot loop
+    (select → delay → play, controllers/base.py:150 preprocess and
+    :220 play_stage) with the delay queue virtualized into a due-time
+    map keyed like delayQueueMapping."""
+
+    def __init__(
+        self,
+        sim,
+        replica: Replica,
+        kind: str,
+        stages,
+        funcs_for: Optional[Callable[[dict], Dict[str, Callable]]] = None,
+        on_delete: Optional[Callable[[dict], None]] = None,
+    ):
+        super().__init__(sim, f"{replica.name}/{kind.lower()}", replica, period=0.4)
+        self.kind = kind
+        self.lc = Lifecycle(stages)
+        self.funcs_for = funcs_for or (lambda obj: {})
+        self.on_delete = on_delete
+        self.rng = random.Random(sim.rng.randrange(2**31))
+        self.backoff = Backoff(duration=0.5, cap=8.0)
+        self.transitions = 0
+        self.store = None
+        self.recorder = None
+        self._pump: Optional[WatchPump] = None
+        self._jobs: Dict[str, _StageJob] = {}
+
+    def _build(self) -> None:
+        sim = self.sim
+        r = self.replica
+        self.store = ActorStore(
+            sim, self.name, f"controller:{r.name}", fence_provider=r.fence
+        )
+        self.recorder = EventRecorder(
+            self.store, source=r.seat, clock=sim.clock, suffix=sim.next_suffix
+        )
+        self._pump = WatchPump(sim, self.kind, f"controller:{r.name}")
+
+    def _teardown(self) -> None:
+        if self._pump is not None:
+            self._pump.reset()
+        self._jobs.clear()
+        self.store = None
+        self.recorder = None
+
+    # ------------------------------------------------------------ hot loop
+
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+    def _now_dt(self) -> datetime.datetime:
+        return datetime.datetime.fromtimestamp(
+            self.sim.clock.now(), datetime.timezone.utc
+        )
+
+    def _now_func(self) -> str:
+        return (
+            self._now_dt()
+            .isoformat(timespec="microseconds")
+            .replace("+00:00", "Z")
+        )
+
+    def _preprocess(self, obj: dict) -> None:
+        key = self._key(obj)
+        meta = obj.get("metadata") or {}
+        rv = meta.get("resourceVersion")
+        cur = self._jobs.get(key)
+        if cur is not None and cur.rv == rv:
+            return
+        data = to_json_standard(obj)
+        stage = self.lc.select(
+            meta.get("labels") or {},
+            meta.get("annotations") or {},
+            data,
+            rng=self.rng,
+        )
+        if stage is None:
+            self._jobs.pop(key, None)
+            return
+        delay, _ = stage.delay(data, self._now_dt(), rng=self.rng)
+        self._jobs[key] = _StageJob(
+            obj, rv, stage, self.sim.clock.now() + delay
+        )
+
+    def _step_leading(self) -> None:
+        now = self.sim.clock.now()
+        for ev in self._pump.drain():
+            if ev.type == DELETED:
+                self._jobs.pop(self._key(ev.object), None)
+                if self.on_delete is not None:
+                    self.on_delete(ev.object)
+                continue
+            self._preprocess(ev.object)
+        # due jobs, in deterministic key order
+        due = sorted(
+            (key for key, job in self._jobs.items() if job.due <= now)
+        )
+        for key in due:
+            job = self._jobs.pop(key, None)
+            if job is None:
+                continue
+            try:
+                need_retry = self._play(job.obj, job.stage)
+            except Exception:  # noqa: BLE001 — partition/shed mid-play
+                need_retry = True
+            if need_retry and key not in self._jobs:
+                job.retries += 1
+                job.due = now + self.backoff.delay(job.retries, self.rng)
+                self._jobs[key] = job
+
+    def _play(self, obj: dict, stage) -> bool:
+        """One stage application (StagePlayer._play_stage_inner,
+        controllers/base.py:234, minus the thread plumbing)."""
+        effects = self.lc.effects(stage)
+        if effects is None:
+            return False
+        meta = obj.get("metadata") or {}
+        name = meta.get("name") or ""
+        ns = meta.get("namespace")
+        result: Optional[dict] = None
+
+        if effects.event is not None and self.recorder is not None:
+            ev = effects.event
+            self.recorder.event(
+                obj, ev.type or "Normal", ev.reason, ev.message
+            )
+
+        fin = effects.finalizers_patch(meta.get("finalizers") or [])
+        if fin is not None:
+            try:
+                result = self.store.patch(
+                    self.kind, name, fin.data, fin.type, namespace=ns
+                )
+            except NotFound:
+                return False
+            except Exception as e:  # noqa: BLE001
+                return should_retry(e)
+
+        if effects.delete:
+            try:
+                self.store.delete(self.kind, name, namespace=ns)
+            except NotFound:
+                pass
+            except Exception as e:  # noqa: BLE001
+                return should_retry(e)
+            result = None
+        else:
+            funcs = dict(self.funcs_for(obj))
+            funcs.setdefault("Now", self._now_func)
+            base = result if result is not None else obj
+            for patch in effects.patches(base, funcs):
+                if is_noop_patch(base, patch.data, patch.type):
+                    continue
+                try:
+                    result = self.store.patch(
+                        self.kind,
+                        name,
+                        patch.data,
+                        patch.type,
+                        namespace=ns,
+                        subresource=patch.subresource,
+                        as_user=patch.impersonation,
+                    )
+                    base = result
+                except NotFound:
+                    return False
+                except Exception as e:  # noqa: BLE001
+                    return should_retry(e)
+
+        self.transitions += 1
+        if result is not None and stage.immediate_next_stage:
+            self._preprocess(result)
+        return False
+
+
+class ObserverActor(Actor):
+    """Passive watch consumer recording per-stream resourceVersion
+    sequences for the rv-monotonicity invariant; reconnects across
+    crashes like any reflector (a rollback shows up as Expired and a
+    fresh stream, never as a silent rv regression)."""
+
+    def __init__(self, sim, kind: str = "Pod"):
+        super().__init__(sim, "observer", None, period=0.5)
+        self.kind = kind
+        self.streams: List[List[int]] = []
+        self._w = None
+        self._gen: Optional[int] = None
+        self._rv: Optional[int] = None
+
+    def step(self) -> None:
+        sim = self.sim
+        if self._gen != sim.store_generation or self._w is None or self._w.stopped:
+            self._gen = sim.store_generation
+            self._w = None
+            if self._rv is not None:
+                try:
+                    self._w = sim.store.watch(self.kind, since_rv=self._rv)
+                except Expired:
+                    self._w = None
+            if self._w is None:
+                _items, rv = sim.store.list(self.kind)
+                self._rv = rv
+                self._w = sim.store.watch(self.kind, since_rv=rv)
+            self.streams.append([])
+        for ev in self._w.drain():
+            rv = getattr(ev, "rv", 0) or 0
+            self.streams[-1].append(rv)
+            if self._rv is None or rv > self._rv:
+                self._rv = rv

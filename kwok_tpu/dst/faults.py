@@ -1,0 +1,393 @@
+"""Fault vocabulary for deterministic-simulation runs.
+
+Re-expresses the chaos subsystem's existing faults in virtual time, at
+the in-process store boundary instead of the HTTP one:
+
+- **crash points** arm the store's commit-boundary hook
+  (``kwok_tpu/cluster/store.py:606``) and the harness then recovers a
+  fresh store from the WAL, exactly like the durability smoke
+  (``kwok_tpu/chaos/__main__.py:48``);
+- **partitions / 429 shedding / eaten acks** mirror the HTTP
+  injector's per-request decisions (``kwok_tpu/chaos/http_faults.py:1``)
+  as seeded draws on each store call;
+- **leader kills / pauses** depose replicas the way the process driver
+  SIGKILLs/SIGSTOPs daemons (``kwok_tpu/chaos/process_faults.py:1``);
+- **write fencing** revalidates each mutation's leadership generation
+  against the live election Lease, the apiserver's
+  ``X-Kwok-Leader-Fence`` check (``kwok_tpu/cluster/apiserver.py:248``)
+  replayed in-process.
+
+Every decision draws from one seeded rng, so a fault schedule is a
+pure function of the seed.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from kwok_tpu.cluster.client import ApiUnavailable
+from kwok_tpu.cluster.store import Conflict
+
+__all__ = ["SimCrash", "FaultTimeline", "ActorStore"]
+
+
+class SimCrash(BaseException):
+    """Simulated process death at a store commit boundary.
+
+    BaseException on purpose: component code catches broad
+    ``Exception`` around its loops (a real process would still die),
+    so the crash must unwind through all of it to the harness."""
+
+    def __init__(self, phase: str):
+        super().__init__(f"simulated crash at {phase}")
+        self.phase = phase
+
+
+@dataclass(frozen=True)
+class _Window:
+    """One scheduled fault window.  ``target`` names a replica (its
+    whole process goes dark — both its ``controller:`` and ``system:``
+    client identities), or is empty to cover every below-system client
+    (the overload-shed shape)."""
+
+    kind: str  # "partition" | "shed"
+    target: str
+    at: float
+    duration: float
+    p: float = 1.0  # per-call probability inside the window
+
+    def covers(self, client_id: str, t: float) -> bool:
+        if not (self.at <= t < self.at + self.duration):
+            return False
+        if self.target:
+            return client_id.endswith(f":{self.target}") or client_id == self.target
+        return not client_id.startswith("system:")
+
+
+@dataclass
+class _Scheduled:
+    """A point fault the harness applies when virtual time reaches it."""
+
+    t: float
+    kind: str  # "crash" | "leader-kill" | "pause" | "resume" | "restart"
+    params: Dict[str, Any] = field(default_factory=dict)
+    fired: bool = False
+
+
+class FaultTimeline:
+    """The seed-derived schedule of every fault in one run."""
+
+    #: probability an acked mutation's response is "eaten" (applied,
+    #: ack lost) while inside the active fault window
+    ACK_EATEN_P = 0.02
+
+    def __init__(
+        self,
+        seed: int,
+        t0: float,
+        window_s: float,
+        seats: List[str],
+        replica_clients: List[str],
+        enable: bool = True,
+    ):
+        self.rng = random.Random((seed << 1) ^ 0x5F5E5F)
+        self.windows: List[_Window] = []
+        self.scheduled: List[_Scheduled] = []
+        self.ack_window = (t0, t0 + window_s)
+        self.enabled = enable
+        if not enable:
+            return
+        rng = self.rng
+        # 1-2 partition windows against seeded replicas
+        for _ in range(rng.randint(1, 2)):
+            target = rng.choice(replica_clients)
+            at = t0 + rng.uniform(0.0, window_s * 0.7)
+            self.windows.append(
+                _Window("partition", target, at, rng.uniform(2.0, 6.0))
+            )
+        # one overload/shed window against everything below system
+        at = t0 + rng.uniform(0.0, window_s * 0.6)
+        self.windows.append(
+            _Window("shed", "", at, rng.uniform(2.0, 5.0), p=0.3)
+        )
+        # one store crash
+        self.scheduled.append(
+            _Scheduled(
+                t=t0 + rng.uniform(2.0, window_s * 0.8),
+                kind="crash",
+                params={
+                    "phase": rng.choice(["before-commit", "after-commit"]),
+                    # let N commits pass after arming before firing
+                    "skip": rng.randint(0, 8),
+                },
+            )
+        )
+        # one leader kill (silent death) with a later replica restart
+        seat = rng.choice(seats)
+        t_kill = t0 + rng.uniform(2.0, window_s * 0.7)
+        self.scheduled.append(
+            _Scheduled(t=t_kill, kind="leader-kill", params={"seat": seat})
+        )
+        self.scheduled.append(
+            _Scheduled(
+                t=t_kill + rng.uniform(6.0, 12.0),
+                kind="restart",
+                params={"seat": seat},
+            )
+        )
+        # one pause/resume (SIGSTOP/SIGCONT zombie) on a seeded seat
+        seat2 = rng.choice(seats)
+        t_pause = t0 + rng.uniform(2.0, window_s * 0.8)
+        dur = rng.uniform(1.0, 8.0)
+        self.scheduled.append(
+            _Scheduled(t=t_pause, kind="pause", params={"seat": seat2})
+        )
+        self.scheduled.append(
+            _Scheduled(t=t_pause + dur, kind="resume", params={"seat": seat2})
+        )
+        self.scheduled.sort(key=lambda s: s.t)
+
+    # ------------------------------------------------------------ queries
+
+    def due(self, t: float) -> List[_Scheduled]:
+        out = []
+        for s in self.scheduled:
+            if not s.fired and s.t <= t:
+                s.fired = True
+                out.append(s)
+        return out
+
+    def next_time(self) -> Optional[float]:
+        pending = [s.t for s in self.scheduled if not s.fired]
+        return min(pending) if pending else None
+
+    def partitioned(self, client_id: str, t: float) -> bool:
+        return any(
+            w.kind == "partition" and w.covers(client_id, t)
+            for w in self.windows
+        )
+
+    def shed(self, client_id: str, t: float) -> bool:
+        for w in self.windows:
+            if w.kind == "shed" and w.covers(client_id, t):
+                if self.rng.random() < w.p:
+                    return True
+        return False
+
+    def ack_eaten(self, t: float) -> bool:
+        lo, hi = self.ack_window
+        return (
+            self.enabled
+            and lo <= t < hi
+            and self.rng.random() < self.ACK_EATEN_P
+        )
+
+
+class ActorStore:
+    """Per-actor store facade — the simulated process/network boundary.
+
+    Duck-typed to ResourceStore like ClusterClient is: reads and writes
+    forward to the harness's *current* store (so a crash-recovered
+    store is picked up transparently, the way a reconnecting HTTP
+    client would), with the fault timeline consulted on every call and
+    mutations (a) attributed via ``as_user`` for the audit stream,
+    (b) fence-checked against the live election Lease, and (c) traced.
+    """
+
+    def __init__(self, sim, actor: str, client_id: str, fence_provider=None):
+        self._sim = sim
+        self._actor = actor
+        self.client_id = client_id
+        self.fence_provider = fence_provider
+
+    # ------------------------------------------------------------- gates
+
+    def _now(self) -> float:
+        return self._sim.clock.now()
+
+    def _gate(self, mutating: bool) -> None:
+        sim = self._sim
+        t = self._now()
+        if sim.faults.partitioned(self.client_id, t):
+            raise ApiUnavailable(f"partitioned ({self.client_id})")
+        if sim.faults.shed(self.client_id, t):
+            raise ApiUnavailable("shed with 429 Retry-After")
+        if mutating and self.fence_provider is not None:
+            token = self.fence_provider()
+            if token:
+                self._check_fence(token)
+
+    def _check_fence(self, token: str) -> None:
+        """The apiserver's stale-generation rejection, in-process —
+        the SAME validator the HTTP gate runs
+        (cluster/election.py validate_fence), so DST verifies exactly
+        the contract production enforces."""
+        from kwok_tpu.cluster.election import validate_fence
+
+        stale = validate_fence(self._sim.store, token)
+        if stale is not None:
+            raise Conflict(f"stale leader fence: {stale}")
+
+    # ------------------------------------------------------------- reads
+
+    def get(self, *a, **kw):
+        self._gate(False)
+        return self._sim.store.get(*a, **kw)
+
+    def list(self, *a, **kw):
+        self._gate(False)
+        return self._sim.store.list(*a, **kw)
+
+    def list_paged(self, *a, **kw):
+        self._gate(False)
+        return self._sim.store.list_paged(*a, **kw)
+
+    def list_page(self, *a, **kw):
+        self._gate(False)
+        return self._sim.store.list_page(*a, **kw)
+
+    def kinds(self):
+        self._gate(False)
+        return self._sim.store.kinds()
+
+    def count(self, kind):
+        self._gate(False)
+        return self._sim.store.count(kind)
+
+    def resource_type(self, kind):
+        return self._sim.store.resource_type(kind)
+
+    def watch(self, *a, **kw):
+        self._gate(False)
+        return self._sim.store.watch(*a, **kw)
+
+    # ----------------------------------------------------------- mutators
+
+    def _mutate(self, verb: str, fn, detail_fn, *a, **kw):
+        sim = self._sim
+        self._gate(True)
+        if kw.get("as_user") is None:
+            kw["as_user"] = self.client_id
+        result = fn(*a, **kw)
+        t = self._now()
+        for action, detail in detail_fn(result):
+            sim.trace.add(t, self._actor, action, detail)
+        if sim.faults.ack_eaten(t):
+            # applied, but the caller never learns: NOT an acked write
+            sim.trace.add(t, self._actor, "ack-eaten", verb)
+            raise ApiUnavailable("response lost after apply")
+        sim.note_ack()
+        return result
+
+    @staticmethod
+    def _obj_detail(verb: str, obj: Optional[dict]) -> List:
+        if not isinstance(obj, dict):
+            return [(verb, "")]
+        kind = obj.get("kind") or ""
+        meta = obj.get("metadata") or {}
+        key = f"{kind} {meta.get('namespace') or ''}/{meta.get('name') or ''}"
+        extra = ""
+        if kind == "Pod":
+            refs = meta.get("ownerReferences") or []
+            if refs:
+                extra = f" owner={refs[0].get('kind')}:{refs[0].get('name')}"
+        spec = obj.get("spec") or {}
+        if kind in ("ReplicaSet", "Deployment") and "replicas" in spec:
+            extra = f" replicas={spec.get('replicas')}"
+        return [(verb, key + extra)]
+
+    def create(self, obj, **kw):
+        return self._mutate(
+            "create",
+            self._sim.store.create,
+            lambda res: self._obj_detail("create", res),
+            obj,
+            **kw,
+        )
+
+    def update(self, obj, **kw):
+        return self._mutate(
+            "update",
+            self._sim.store.update,
+            lambda res: self._obj_detail("update", res),
+            obj,
+            **kw,
+        )
+
+    def patch(self, kind, name, data, patch_type="merge", **kw):
+        return self._mutate(
+            "patch",
+            self._sim.store.patch,
+            lambda res: self._obj_detail("patch", res),
+            kind,
+            name,
+            data,
+            patch_type,
+            **kw,
+        )
+
+    def delete(self, kind, name, **kw):
+        ns = kw.get("namespace") or ""
+
+        def details(_res):
+            return [("delete", f"{kind} {ns}/{name}")]
+
+        return self._mutate(
+            "delete", self._sim.store.delete, details, kind, name, **kw
+        )
+
+    def apply(self, *a, **kw):
+        return self._mutate(
+            "apply",
+            self._sim.store.apply,
+            lambda res: self._obj_detail(
+                "apply", res[0] if isinstance(res, tuple) else res
+            ),
+            *a,
+            **kw,
+        )
+
+    def bulk(self, ops, **kw):
+        def details(results):
+            out = []
+            okn = sum(1 for r in results if r.get("status") == "ok")
+            out.append(("bulk", f"{len(ops)} ok={okn}"))
+            for op, res in zip(ops, results):
+                if res.get("status") != "ok" or not isinstance(op, dict):
+                    continue
+                verb = op.get("verb")
+                if verb == "create":
+                    # result object, not op data: generateName pods get
+                    # their final name at commit time
+                    out.extend(self._obj_detail("create", res.get("object")))
+                elif verb == "delete":
+                    ns = op.get("namespace") or ""
+                    out.append(
+                        ("delete", f"{op.get('kind')} {ns}/{op.get('name')}")
+                    )
+                elif verb == "patch":
+                    data = op.get("data") or {}
+                    extra = ""
+                    if isinstance(data, dict):
+                        spec = data.get("spec") or {}
+                        if isinstance(spec, dict) and "replicas" in spec:
+                            extra = f" replicas={spec.get('replicas')}"
+                    ns = op.get("namespace") or ""
+                    out.append(
+                        (
+                            "patch",
+                            f"{op.get('kind')} {ns}/{op.get('name')}" + extra,
+                        )
+                    )
+            return out
+
+        return self._mutate("bulk", self._sim.store.bulk, details, ops, **kw)
+
+    # ----------------------------------------------------------- fallback
+
+    def __getattr__(self, name):
+        # anything else (audit_log, resource_version, ...) is a
+        # harness-side read, not simulated traffic
+        return getattr(self._sim.store, name)

@@ -1,0 +1,546 @@
+"""Whole-cluster deterministic simulation: one process, virtual time.
+
+Composes the full control plane — WAL-backed store
+(``kwok_tpu/cluster/store.py:529``, ``kwok_tpu/cluster/wal.py:67``),
+three elected controller seats with hot standbys
+(``kwok_tpu/cluster/election.py:91``), the kcm controller groups
+(``kwok_tpu/cmd/kcm.py:91``), the scheduler
+(``kwok_tpu/cmd/scheduler.py:40``) and the kwok stage machinery
+(``kwok_tpu/stages/__init__.py:53`` default stage sets) — onto one
+:class:`~kwok_tpu.utils.clock.VirtualClock`, stepped by a seeded
+interleaving scheduler that injects the chaos fault vocabulary at
+chosen virtual instants (``kwok_tpu/dst/faults.py:1``).  After the
+run, Kivi-style invariant checkers replay the trace
+(``kwok_tpu/dst/invariants.py:1``).
+
+Everything observable derives from the seed: same seed ⇒ byte-identical
+trace (``Trace.digest``), so any violating seed is a reproducible bug
+report, not a flake — the ROADMAP.md:101 safety net for the
+sharding/fleet refactors.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kwok_tpu.cluster.client import ApiUnavailable
+from kwok_tpu.cluster.store import Conflict, NotFound, ResourceStore
+from kwok_tpu.cluster.wal import WriteAheadLog
+from kwok_tpu.dst.actors import (
+    ElectorActor,
+    KcmActor,
+    LifecycleActor,
+    ObserverActor,
+    Replica,
+    SchedulerActor,
+)
+from kwok_tpu.dst.faults import ActorStore, FaultTimeline, SimCrash
+from kwok_tpu.dst.invariants import run_checks
+from kwok_tpu.dst.trace import Trace
+from kwok_tpu.utils.clock import VirtualClock
+
+__all__ = ["SimOptions", "RunRecord", "Simulation", "run_seed", "run_seeds"]
+
+#: virtual epoch the simulation starts at (a fixed instant, so every
+#: rendered timestamp is seed-stable)
+EPOCH = 1_600_000_000.0
+
+#: seats: (short name, election lease)
+SEATS = (
+    ("kwok", "kwok-controller"),
+    ("kcm", "kube-controller-manager"),
+    ("sched", "kwok-scheduler"),
+)
+
+
+@dataclass
+class SimOptions:
+    seed: int = 0
+    #: virtual seconds of active scenario + faults
+    duration: float = 40.0
+    #: extra virtual seconds allowed for convergence after the faults
+    quiesce: float = 60.0
+    #: replicas per seat (leader + standbys)
+    replicas: int = 2
+    #: election lease duration (virtual seconds)
+    lease_duration: float = 6.0
+    faults: bool = True
+    #: test-only injected regression: "ungated-writer" makes one kcm
+    #: standby reconcile without holding the lease
+    bug: Optional[str] = None
+    nodes: int = 4
+    deployment_replicas: int = 6
+    scale_to: int = 9
+    scale_back: int = 4
+
+
+@dataclass
+class RunRecord:
+    """Everything the invariant checkers see about one finished run."""
+
+    seed: int
+    trace: Trace
+    streams: List[List[int]] = field(default_factory=list)
+    crash_checks: List[dict] = field(default_factory=list)
+    replay_matches: Optional[bool] = None
+    replay_detail: str = ""
+    converged: bool = False
+    convergence_detail: str = ""
+    audit_overflow: int = 0
+    #: write-trace actor name -> its replica name (leader-gated actors)
+    gated_writers: Dict[str, str] = field(default_factory=dict)
+    final_counts: Dict[str, int] = field(default_factory=dict)
+    steps: int = 0
+    virtual_end: float = 0.0
+
+
+class Simulation:
+    """One seeded whole-cluster run on a virtual clock."""
+
+    def __init__(self, opts: SimOptions, wal_dir: str):
+        self.opts = opts
+        self.clock = VirtualClock(EPOCH)
+        self.rng = random.Random(opts.seed)
+        self.trace = Trace()
+        self.store_generation = 0
+        self.max_acked_rv = 0
+        self.crash_checks: List[dict] = []
+        self._crash_arm: Optional[dict] = None
+        self._suffix_n = 0
+        self.steps = 0
+
+        # per-run template randomness (sprig rand*/shuffle funcs)
+        from kwok_tpu.utils import sprig
+
+        sprig.set_default_rng(random.Random(opts.seed ^ 0x517A1))
+
+        self.wal_path = os.path.join(wal_dir, "dst-wal.jsonl")
+        self.wal = WriteAheadLog(self.wal_path, fsync="off")
+        self.store = ResourceStore(clock=self.clock)
+        self.store.attach_wal(self.wal)
+        self.store.set_crash_hook(self._crash_dispatch)
+
+        # ----- replicas + actors ------------------------------------
+        self.seats: Dict[str, List[Replica]] = {}
+        self.actors: List = []
+        self.record = RunRecord(seed=opts.seed, trace=self.trace)
+        for seat, lease in SEATS:
+            reps = [
+                Replica(self, seat, lease, i, opts.lease_duration)
+                for i in range(opts.replicas)
+            ]
+            self.seats[seat] = reps
+            for i, r in enumerate(reps):
+                self.actors.append(ElectorActor(self, r))
+                if seat == "kcm":
+                    ungated = opts.bug == "ungated-writer" and i == 1
+                    self.actors.append(KcmActor(self, r, ungated=ungated))
+                    self.record.gated_writers[r.name] = r.name
+                elif seat == "sched":
+                    self.actors.append(SchedulerActor(self, r))
+                    self.record.gated_writers[r.name] = r.name
+                elif seat == "kwok":
+                    from kwok_tpu.controllers.node_controller import node_funcs
+                    from kwok_tpu.controllers.pod_controller import PodEnv
+                    from kwok_tpu.stages import (
+                        default_node_stages,
+                        default_pod_stages,
+                    )
+
+                    nf = node_funcs("10.0.0.1", r.name, 10247)
+                    env = PodEnv()
+                    self.actors.append(
+                        LifecycleActor(
+                            self,
+                            r,
+                            "Node",
+                            default_node_stages(lease=False),
+                            funcs_for=lambda obj, _nf=nf: _nf,
+                        )
+                    )
+                    self.actors.append(
+                        LifecycleActor(
+                            self,
+                            r,
+                            "Pod",
+                            default_pod_stages(),
+                            funcs_for=env.funcs,
+                            on_delete=env.release,
+                        )
+                    )
+                    self.record.gated_writers[f"{r.name}/node"] = r.name
+                    self.record.gated_writers[f"{r.name}/pod"] = r.name
+        self.observer = ObserverActor(self, "Pod")
+        self.actors.append(self.observer)
+
+        self.faults = FaultTimeline(
+            seed=opts.seed,
+            t0=EPOCH + 4.0,
+            window_s=max(4.0, opts.duration - 10.0),
+            seats=[s for s, _ in SEATS],
+            replica_clients=[
+                r.name for reps in self.seats.values() for r in reps
+            ],
+            enable=opts.faults,
+        )
+        self._killed: Dict[str, Replica] = {}
+        self._paused: Dict[str, Replica] = {}
+        self._scenario = self._build_scenario()
+        # the scenario/operator writes ride the system level, like
+        # kwokctl traffic under APF
+        self._op_store = ActorStore(self, "scenario", "system:scenario")
+
+    # -------------------------------------------------------------- plumbing
+
+    def next_suffix(self) -> str:
+        """Deterministic Event-name uniquifier shared by every
+        recorder (the monotonic-ns stand-in)."""
+        self._suffix_n += 1
+        return f"{self._suffix_n:x}"
+
+    def note_ack(self) -> None:
+        self.max_acked_rv = max(
+            self.max_acked_rv, self.store.resource_version
+        )
+
+    def _crash_dispatch(self, phase: str) -> None:
+        arm = self._crash_arm
+        if arm is None or phase != arm["phase"]:
+            return
+        if arm["skip"] > 0:
+            arm["skip"] -= 1
+            return
+        self._crash_arm = None
+        raise SimCrash(phase)
+
+    def _restart_store(self, crash: SimCrash) -> None:
+        """Simulated store-process death: lose the in-memory state,
+        recover from the WAL (the chaos --smoke recovery path, run
+        mid-simulation)."""
+        t = self.clock.now()
+        self.trace.add(t, "store", "crash", crash.phase)
+        self.wal.close()
+        recovered = ResourceStore(clock=self.clock)
+        n = recovered.replay_wal(self.wal_path)
+        self.crash_checks.append(
+            {
+                "acked_rv": self.max_acked_rv,
+                "recovered_rv": recovered.resource_version,
+                "records": n,
+            }
+        )
+        self.wal = WriteAheadLog(self.wal_path, fsync="off")
+        recovered.attach_wal(self.wal)
+        recovered.set_crash_hook(self._crash_dispatch)
+        self.store = recovered
+        self.store_generation += 1
+        self.trace.add(
+            t, "store", "recovered", f"rv={recovered.resource_version} records={n}"
+        )
+
+    # -------------------------------------------------------------- scenario
+
+    def _build_scenario(self) -> List[tuple]:
+        o = self.opts
+        t0 = EPOCH
+        steps: List[tuple] = []
+        for i in range(o.nodes):
+            steps.append((t0 + 0.5, "node", f"node-{i}"))
+        steps.append((t0 + 2.0, "deployment", ("web", o.deployment_replicas)))
+        steps.append((t0 + o.duration * 0.4, "scale", ("web", o.scale_to)))
+        steps.append((t0 + o.duration * 0.7, "scale", ("web", o.scale_back)))
+        return steps
+
+    def _apply_scenario(self, kind: str, arg) -> None:
+        if kind == "node":
+            obj = {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "metadata": {"name": arg},
+                "spec": {},
+                "status": {
+                    "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                    "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                },
+            }
+            self._must(lambda: self._op_store.create(dict(obj)))
+        elif kind == "deployment":
+            name, replicas = arg
+            obj = {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {"matchLabels": {"app": name}},
+                    "template": {
+                        "metadata": {"labels": {"app": name}},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "app",
+                                    "image": "fake",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "100m",
+                                            "memory": "64Mi",
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                },
+            }
+            self._must(lambda: self._op_store.create(dict(obj)))
+        elif kind == "scale":
+            name, replicas = arg
+            self._must(
+                lambda: self._op_store.patch(
+                    "Deployment",
+                    name,
+                    {"spec": {"replicas": replicas}},
+                    "merge",
+                    namespace="default",
+                )
+            )
+
+    def _must(self, fn) -> None:
+        """Drive an operator mutation to an acknowledged outcome, the
+        chaos-smoke `must` contract: ApiUnavailable may mean applied —
+        replay, treating already-applied answers as success."""
+        for _ in range(30):
+            try:
+                fn()
+                return
+            except SimCrash as c:
+                self._restart_store(c)
+            except ApiUnavailable:
+                continue
+            except Conflict:
+                return
+            except NotFound:
+                return
+        self.trace.add(self.clock.now(), "scenario", "gave-up", "")
+
+    # ------------------------------------------------------------------ faults
+
+    def _apply_fault(self, sched) -> None:
+        t = self.clock.now()
+        kind, params = sched.kind, sched.params
+        if kind == "crash":
+            self._crash_arm = dict(params)
+            self.trace.add(
+                t, "faults", "arm-crash", f"{params['phase']} skip={params['skip']}"
+            )
+        elif kind == "leader-kill":
+            seat = params["seat"]
+            reps = self.seats[seat]
+            target = next((r for r in reps if r.leading), reps[0])
+            target.kill()
+            self._killed[seat] = target
+            self.trace.add(t, "faults", "leader-kill", target.name)
+        elif kind == "restart":
+            seat = params["seat"]
+            target = self._killed.pop(seat, None)
+            if target is not None:
+                target.revive()
+                self.trace.add(t, "faults", "restart", target.name)
+        elif kind == "pause":
+            seat = params["seat"]
+            reps = self.seats[seat]
+            target = next(
+                (r for r in reps if r.leading and r.alive),
+                next((r for r in reps if r.alive), None),
+            )
+            if target is not None:
+                target.paused = True
+                self._paused[seat] = target
+                self.trace.add(t, "faults", "pause", target.name)
+        elif kind == "resume":
+            seat = params["seat"]
+            target = self._paused.pop(seat, None)
+            if target is not None:
+                target.paused = False
+                self.trace.add(t, "faults", "resume", target.name)
+
+    # ------------------------------------------------------------- main loop
+
+    def run(self) -> RunRecord:
+        o = self.opts
+        t_end = EPOCH + o.duration
+        t_hard = t_end + o.quiesce
+        scenario = sorted(self._scenario, key=lambda s: s[0])
+        si = 0
+        while True:
+            now = self.clock.now()
+            # next instant anything happens
+            times = [a.next_due for a in self.actors if a.runnable()]
+            if si < len(scenario):
+                times.append(scenario[si][0])
+            ft = self.faults.next_time()
+            if ft is not None:
+                times.append(ft)
+            if not times:
+                break
+            t_next = max(min(times), now)
+            if t_next > t_hard:
+                break
+            self.clock.set(t_next)
+            now = self.clock.now()
+
+            while si < len(scenario) and scenario[si][0] <= now:
+                _, kind, arg = scenario[si]
+                si += 1
+                self._apply_scenario(kind, arg)
+            for sched in self.faults.due(now):
+                self._apply_fault(sched)
+
+            due = [
+                a
+                for a in self.actors
+                if a.runnable() and a.next_due <= now
+            ]
+            self.rng.shuffle(due)
+            for actor in due:
+                if not actor.runnable():
+                    continue  # a fault just killed/paused its replica
+                self.steps += 1
+                try:
+                    actor.step()
+                except SimCrash as c:
+                    self._restart_store(c)
+                # partition/shed surfacing above a component's own
+                # retry seam: the next scheduled step retries it
+                except ApiUnavailable:  # kwoklint: disable=swallowed-errors
+                    pass
+                except Exception as exc:  # noqa: BLE001 — an actor bug
+                    # must fail the run loudly, not hang it
+                    self.trace.add(
+                        now, actor.name, "actor-error", repr(exc)
+                    )
+                actor.schedule_next()
+
+            if now >= t_end and si >= len(scenario):
+                ok, detail = self._converged()
+                if ok:
+                    break
+        return self._finish()
+
+    # ---------------------------------------------------------- verification
+
+    def _converged(self) -> tuple:
+        store = self.store
+        for seat, reps in self.seats.items():
+            if not any(r.is_leader() for r in reps):
+                return False, f"seat {seat} has no live leader"
+        deps, _ = store.list("Deployment")
+        for d in deps:
+            name = (d.get("metadata") or {}).get("name")
+            want = (d.get("spec") or {}).get("replicas", 1)
+            st = d.get("status") or {}
+            if (
+                st.get("replicas") != want
+                or st.get("readyReplicas", 0) != want
+                or st.get("updatedReplicas", 0) != want
+            ):
+                return False, (
+                    f"deployment {name}: status {st.get('replicas')}/"
+                    f"{st.get('readyReplicas', 0)} ready, want {want}"
+                )
+        hpas, _ = store.list("HorizontalPodAutoscaler")
+        for h in hpas:
+            spec = h.get("spec") or {}
+            cur = (h.get("status") or {}).get("currentReplicas")
+            lo = spec.get("minReplicas", 1)
+            hi = spec.get("maxReplicas", lo)
+            if cur is None or not (lo <= cur <= hi):
+                return False, "hpa outside [min,max]"
+        pods, _ = store.list("Pod")
+        for p in pods:
+            meta = p.get("metadata") or {}
+            if meta.get("deletionTimestamp"):
+                return False, f"pod {meta.get('name')} still terminating"
+            if not (p.get("spec") or {}).get("nodeName"):
+                return False, f"pod {meta.get('name')} unbound"
+            if (p.get("status") or {}).get("phase") != "Running":
+                return False, f"pod {meta.get('name')} not Running"
+        return True, ""
+
+    def _finish(self) -> RunRecord:
+        rec = self.record
+        rec.converged, rec.convergence_detail = self._converged()
+        rec.streams = self.observer.streams
+        rec.crash_checks = self.crash_checks
+        rec.audit_overflow = self.store.audit_overflow
+        rec.steps = self.steps
+        rec.virtual_end = self.clock.now() - EPOCH
+        for kind in ("Node", "Pod", "Deployment", "ReplicaSet"):
+            rec.final_counts[kind] = self.store.count(kind)
+        # durability epilogue: the WAL alone must reproduce the live
+        # state (the chaos --smoke recovery assertion, end-of-run form)
+        self.wal.close()
+        replayed = ResourceStore()
+        replayed.replay_wal(self.wal_path)
+        live, fresh = self.store.dump_state(), replayed.dump_state()
+        rec.replay_matches = live == fresh
+        if not rec.replay_matches:
+            rec.replay_detail = (
+                f"live rv={live['resourceVersion']} objects="
+                f"{len(live['objects'])}; replayed "
+                f"rv={fresh['resourceVersion']} objects={len(fresh['objects'])}"
+            )
+        return rec
+
+
+def run_seed(
+    seed: int, opts: Optional[SimOptions] = None
+) -> Dict:
+    """Run one seeded simulation; returns the JSON-able report
+    (violations, trace digest, convergence, counters)."""
+    from kwok_tpu.utils import sprig
+
+    o = opts or SimOptions()
+    o = SimOptions(**{**o.__dict__, "seed": seed})
+    # Simulation seeds the process-global template rng; scope that to
+    # this run so shared-process callers (pytest) are not left with a
+    # DST-seeded sprig
+    prev_rng = sprig.set_default_rng(random.Random(seed ^ 0x517A1))
+    try:
+        with tempfile.TemporaryDirectory(prefix="kwok-dst-") as tmp:
+            sim = Simulation(o, tmp)
+            rec = sim.run()
+            violations = run_checks(rec)
+    finally:
+        sprig.set_default_rng(prev_rng)
+    return {
+        "seed": seed,
+        "trace_digest": rec.trace.digest(),
+        "trace_events": len(rec.trace),
+        "steps": rec.steps,
+        "virtual_s": round(rec.virtual_end, 3),
+        "converged": rec.converged,
+        "crashes": len(rec.crash_checks),
+        "counts": rec.final_counts,
+        "violations": violations,
+    }
+
+
+def run_seeds(
+    seeds: int, opts: Optional[SimOptions] = None, start: int = 0
+) -> Dict:
+    """Explore ``seeds`` consecutive seeds; returns the aggregate
+    report (per-seed lines + any violating seeds)."""
+    runs = [run_seed(start + i, opts) for i in range(seeds)]
+    violating = [r for r in runs if r["violations"]]
+    return {
+        "seeds": seeds,
+        "start": start,
+        "violating_seeds": [r["seed"] for r in violating],
+        "violations": {r["seed"]: r["violations"] for r in violating},
+        "runs": runs,
+    }

@@ -1,0 +1,54 @@
+"""Canonical run trace for deterministic-simulation runs.
+
+Every observable decision of a simulation — writes crossing the
+per-actor store boundary, leadership changes, injected faults, crash
+recoveries — lands here as one ordered line, and the sha256 of the
+canonical rendering is the run's identity: same seed ⇒ byte-identical
+trace ⇒ equal digest (the reproducibility contract ROADMAP.md:101-115
+assigns the DST harness; the audit-log precedent is
+``kwok_tpu/cluster/store.py:575`` — this trace is its cross-component,
+crash-surviving twin, kept on the harness side so a simulated process
+death cannot lose it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    """One trace line: virtual time, acting component, what happened."""
+
+    t: float
+    actor: str
+    action: str
+    detail: str = ""
+
+    def render(self) -> str:
+        return f"{self.t:.6f} {self.actor} {self.action} {self.detail}"
+
+
+class Trace:
+    """Append-only event list with a canonical digest."""
+
+    def __init__(self) -> None:
+        self.events: List[TraceEvent] = []
+
+    def add(self, t: float, actor: str, action: str, detail: str = "") -> None:
+        self.events.append(TraceEvent(t=t, actor=actor, action=action, detail=detail))
+
+    def lines(self) -> List[str]:
+        return [ev.render() for ev in self.events]
+
+    def digest(self) -> str:
+        h = hashlib.sha256()
+        for line in self.lines():
+            h.update(line.encode("utf-8"))
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def __len__(self) -> int:
+        return len(self.events)

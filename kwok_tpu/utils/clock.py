@@ -11,6 +11,7 @@ the device tick's virtual-time column.
 
 from __future__ import annotations
 
+import heapq
 import threading
 import time
 from typing import List, Optional
@@ -169,3 +170,64 @@ class FakeClock(Clock):
         # subscribed signals, so just wait on the signal (bounded so a
         # missing advance in a test cannot hang forever).
         signal.wait(5.0)
+
+
+class VirtualClock(FakeClock):
+    """Deterministic-simulation clock (the DST harness,
+    :mod:`kwok_tpu.dst`): FakeClock plus a registry of parked timeout
+    deadlines, so the simulation scheduler can see the earliest instant
+    any waiter is due to wake (:meth:`next_deadline`) and advance
+    virtual time exactly there.  Time moves only when the simulation
+    steps — a thread parked in :meth:`wait_signal` wakes when its
+    signal fires or its *virtual* deadline passes, never because wall
+    time elapsed.
+
+    ``poll_s`` bounds the real-time wait per wakeup check: ``advance``
+    pings every subscribed signal, and an un-advanced clock must never
+    hang a waiter forever (the FakeClock posture, kept here).
+    """
+
+    def __init__(self, start: float = 0.0, poll_s: float = 0.02):
+        super().__init__(start)
+        self.poll_s = poll_s
+        #: min-heap of virtual instants some waiter is due to wake at
+        self._deadlines: List[float] = []
+
+    #: real-seconds bound on one wait: a clock nobody advances anymore
+    #: must not hang a waiter forever (the FakeClock 5s posture)
+    REAL_WAIT_CAP_S = 5.0
+
+    def wait_signal(self, signal: threading.Event, timeout: Optional[float]) -> None:
+        if timeout is None:
+            # no virtual deadline to honor: wake on advance() pings
+            signal.wait(self.poll_s)
+            return
+        with self._mut:
+            deadline = self._now + timeout
+            heapq.heappush(self._deadlines, deadline)
+        give_up = time.monotonic() + self.REAL_WAIT_CAP_S
+        while (
+            not signal.is_set()
+            and self.now() < deadline
+            and time.monotonic() < give_up
+        ):
+            signal.wait(self.poll_s)
+
+    def next_deadline(self) -> Optional[float]:
+        """Earliest still-pending parked deadline, or None.  Deadlines
+        at/below the current instant are expired and dropped."""
+        with self._mut:
+            while self._deadlines and self._deadlines[0] <= self._now:
+                heapq.heappop(self._deadlines)
+            return self._deadlines[0] if self._deadlines else None
+
+    def advance_to_next(self, limit: Optional[float] = None) -> bool:
+        """Advance to the earliest parked deadline (bounded by
+        ``limit``); returns False when there is none (or it lies past
+        the limit).  The step-the-world primitive for tests migrating
+        off wall-clock sleeps."""
+        nxt = self.next_deadline()
+        if nxt is None or (limit is not None and nxt > limit):
+            return False
+        self.set(nxt)
+        return True

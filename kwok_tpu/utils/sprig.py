@@ -31,6 +31,22 @@ from typing import Any, Callable, Dict, List
 
 import yaml
 
+#: randomness seam for the rand*/shuffle template funcs: an explicit
+#: instance (never the module-global ``random`` state) so seeded runs
+#: — chaos plans, the DST harness (kwok_tpu.dst) — fully determine
+#: template randomness.  Default is an unseeded instance, matching
+#: sprig's process-global behavior for ordinary use.
+_RNG = random.Random()
+
+
+def set_default_rng(rng: random.Random) -> "random.Random":
+    """Seed the template-function randomness (one rng per process; the
+    DST harness calls this per simulation run).  Returns the previous
+    rng so a scoped caller can restore it afterwards."""
+    global _RNG
+    prev, _RNG = _RNG, rng
+    return prev
+
 
 # ---------------------------------------------------------------- helpers
 
@@ -284,7 +300,7 @@ def sprig_funcs() -> Dict[str, Callable]:
         "nospace": lambda s: re.sub(r"\s", "", _to_str(s)),
         "swapcase": lambda s: _to_str(s).swapcase(),
         "shuffle": lambda s: "".join(
-            random.sample(_to_str(s), len(_to_str(s)))
+            _RNG.sample(_to_str(s), len(_to_str(s)))
         ),
         "wrap": lambda n, s: "\n".join(
             _to_str(s)[i : i + _to_int(n)]
@@ -314,20 +330,20 @@ def sprig_funcs() -> Dict[str, Callable]:
         "toString": _to_str,
         "toStrings": lambda l: [_to_str(x) for x in l],
         "randAlphaNum": lambda n: "".join(
-            random.choices(
+            _RNG.choices(
                 "0123456789abcdefghijklmnopqrstuvwxyz"
                 "ABCDEFGHIJKLMNOPQRSTUVWXYZ",
                 k=_to_int(n),
             )
         ),
         "randAlpha": lambda n: "".join(
-            random.choices(
+            _RNG.choices(
                 "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ",
                 k=_to_int(n),
             )
         ),
         "randNumeric": lambda n: "".join(
-            random.choices("0123456789", k=_to_int(n))
+            _RNG.choices("0123456789", k=_to_int(n))
         ),
         # math ----------------------------------------------------------
         "add": lambda *a: sum(_to_int(x) for x in a),

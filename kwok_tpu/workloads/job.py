@@ -52,10 +52,25 @@ def _condition(job: dict, ctype: str) -> Optional[dict]:
 
 
 class JobController:
-    def __init__(self, store, recorder=None, bulk_chunk: Optional[int] = None):
+    def __init__(
+        self,
+        store,
+        recorder=None,
+        bulk_chunk: Optional[int] = None,
+        now=None,
+    ):
         self.store = store
         self.recorder = recorder
         self.bulk_chunk = bulk_chunk
+        #: injectable wall-time source (hpa.py carries the same seam):
+        #: simulated-time runs stamp startTime/completionTime on the
+        #: virtual clock so a seed fully determines the written status
+        self._now = now
+
+    def _ts(self) -> str:
+        """Status timestamp on the injected time source (wall when
+        none): the one place the now-seam is consulted."""
+        return now_string(self._now() if self._now else None)
 
     def _writer(self) -> BulkWriter:
         if self.bulk_chunk:
@@ -206,7 +221,7 @@ class JobController:
             "active": len(active),
             "succeeded": succeeded,
             "failed": failed,
-            "startTime": cur.get("startTime") or now_string(),
+            "startTime": cur.get("startTime") or self._ts(),
         }
         conditions = [
             dict(c)
@@ -218,10 +233,10 @@ class JobController:
                 {
                     "type": "Complete",
                     "status": "True",
-                    "lastTransitionTime": now_string(),
+                    "lastTransitionTime": self._ts(),
                 }
             )
-            status["completionTime"] = cur.get("completionTime") or now_string()
+            status["completionTime"] = cur.get("completionTime") or self._ts()
             if self.recorder is not None:
                 self.recorder.event(
                     job, "Normal", "Completed", "Job completed"
@@ -236,7 +251,7 @@ class JobController:
                     "type": "Failed",
                     "status": "True",
                     "reason": "BackoffLimitExceeded",
-                    "lastTransitionTime": now_string(),
+                    "lastTransitionTime": self._ts(),
                 }
             )
             if self.recorder is not None:

@@ -114,6 +114,7 @@ class WorkloadManager:
         bulk_chunk: Optional[int] = None,
         hpa_downscale_stabilization_s: Optional[float] = None,
         active=None,
+        clock=None,
     ):
         self.store = store
         #: leadership gate (cluster/election.py LeaderElector.is_leader
@@ -121,6 +122,11 @@ class WorkloadManager:
         #: kcm replica stops mutating before teardown.  None = always
         #: active.
         self._active = active
+        #: injectable time source (utils.clock Clock duck type) threaded
+        #: into the time-stamping sub-controllers (HPA stabilization
+        #: windows, Job start/completion times) so a simulated-time run
+        #: is seed-deterministic; None keeps wall time.
+        now = clock.now if clock is not None else None
         self.resync_s = resync_s if resync_s is not None else self.RESYNC_S
         self.recorder = recorder or EventRecorder(
             store, source="workload-controller"
@@ -130,12 +136,13 @@ class WorkloadManager:
         )
         self.deployments = DeploymentController(store, recorder=self.recorder)
         self.jobs = JobController(
-            store, recorder=self.recorder, bulk_chunk=bulk_chunk
+            store, recorder=self.recorder, bulk_chunk=bulk_chunk, now=now
         )
         self.hpas = HPAController(
             store,
             recorder=self.recorder,
             downscale_stabilization_s=hpa_downscale_stabilization_s,
+            now=now,
         )
         self._dispatch: Dict[str, object] = {
             "Deployment": self.deployments,
@@ -236,22 +243,61 @@ class WorkloadManager:
 
     # -------------------------------------------------------------- workers
 
+    def _reconcile_one(self, key: Key) -> None:
+        """Dispatch one queued key (leadership re-checked), never
+        letting a bad object kill the caller — shared by the worker
+        threads and the synchronous drain."""
+        kind, ns, name = key
+        try:
+            ctrl = self._dispatch.get(kind)
+            if ctrl is not None and not (
+                self._active is not None and not self._active()
+            ):
+                ctrl.reconcile(ns, name)
+                self.reconciles += 1
+        except Exception as exc:  # noqa: BLE001 — a bad object must not kill
+            from kwok_tpu.cluster.client import ApiUnavailable
+
+            if isinstance(exc, ApiUnavailable):
+                # transient outage/shed: the resync sweep re-enqueues;
+                # a full traceback per deferred key is just noise
+                logger.info("reconcile deferred", key=f"{kind}/{ns}/{name}", err=str(exc))
+            else:
+                import traceback
+
+                traceback.print_exc()
+        finally:
+            self._queue.done(key)
+
     def _worker_loop(self) -> None:
         while not self._done.is_set():
             key = self._queue.get(timeout=0.2)
             if key is None:
                 continue
-            kind, ns, name = key
-            try:
-                ctrl = self._dispatch.get(kind)
-                if ctrl is not None and not (
-                    self._active is not None and not self._active()
-                ):
-                    ctrl.reconcile(ns, name)
-                    self.reconciles += 1
-            except Exception:  # noqa: BLE001 — a bad object must not kill
-                import traceback
+            self._reconcile_one(key)
 
-                traceback.print_exc()
-            finally:
-                self._queue.done(key)
+    # ------------------------------------------------------ synchronous seams
+    # (the DST harness — kwok_tpu.dst — drives these directly, no threads)
+
+    def map_event(self, obj: dict) -> None:
+        """Public seam: enqueue the reconcile keys one object event
+        implies (the mapper-loop body)."""
+        self._map_event(obj)
+
+    def resync_once(self) -> None:
+        """Public seam: one full resync sweep (enqueue every workload
+        object)."""
+        self._resync()
+
+    def drain_queue(self, budget: Optional[int] = None) -> int:
+        """Public seam: synchronously reconcile everything queued (the
+        worker-loop body without the threads); returns how many keys
+        were processed."""
+        n = 0
+        while budget is None or n < budget:
+            key = self._queue.get(timeout=0.0)
+            if key is None:
+                return n
+            self._reconcile_one(key)
+            n += 1
+        return n

@@ -1093,3 +1093,61 @@ def test_wallclock_suppression_comment_works(tmp_path):
         },
     )
     assert run_rules(root, ["wallclock-deadline"]) == []
+
+
+# ---------------------------------------------------------- untestable-sleep
+
+
+def test_untestable_sleep_fires_in_scope(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            import time
+
+            def loop(done):
+                while not done.is_set():
+                    time.sleep(0.2)
+            """,
+        },
+    )
+    fs = run_rules(root, ["untestable-sleep"])
+    assert len(fs) == 1 and "injected utils.clock Clock" in fs[0].message
+
+
+def test_untestable_sleep_clock_wait_and_out_of_scope_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def loop(clock, wake, done):
+                while not done.is_set():
+                    wake.clear()
+                    clock.wait_signal(wake, 0.2)
+            """,
+            # ctl/ is outside the simulation-hosted layers
+            "kwok_tpu/ctl/tool.py": """
+            import time
+
+            def poll():
+                time.sleep(0.1)
+            """,
+        },
+    )
+    assert run_rules(root, ["untestable-sleep"]) == []
+
+
+def test_untestable_sleep_suppression(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/c.py": """
+            import time
+
+            def inject_latency(seconds):
+                # stalls a REAL handler thread on purpose
+                time.sleep(seconds)  # kwoklint: disable=untestable-sleep
+            """,
+        },
+    )
+    assert run_rules(root, ["untestable-sleep"]) == []

@@ -29,6 +29,8 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== failover smoke (leader kill/release -> bounded takeover, fenced writes) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --failover-smoke \
         --lease-seconds "${FAILOVER_LEASE_SECONDS:-2.5}"
+    echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
