@@ -28,6 +28,7 @@ self-contained durability smoke used by tools/check.sh).
 """
 
 from kwok_tpu.chaos.plan import (  # noqa: F401
+    DiskFaultSpec,
     FaultPlan,
     HttpFaultSpec,
     OverloadWindow,
@@ -41,6 +42,7 @@ from kwok_tpu.chaos.http_faults import (  # noqa: F401
 )
 
 __all__ = [
+    "DiskFaultSpec",
     "FaultPlan",
     "HttpFaultSpec",
     "OverloadWindow",

@@ -24,6 +24,15 @@ Three modes over one seeded profile
   latency, the flood is shed with well-formed 429+Retry-After (zero
   connection errors), and no system-level request was rejected.
   tools/check.sh runs this on every check too.
+- ``--corruption-smoke``  self-contained storage-integrity check:
+  seeded disk faults (bit-flip, truncate, torn multi-record write,
+  fsync-boundary crash, snapshot corruption —
+  :mod:`kwok_tpu.chaos.disk_faults`) against the checksummed WAL and
+  snapshot files.  Asserts every fault is *detected* (never silently
+  absorbed), recovery is bounded and honest (recovered state +
+  reported-lost set account for every acked write), and
+  point-in-time recovery rebuilds a mid-run capture byte-identically.
+  tools/check.sh runs this on every check too.
 - ``--failover-smoke``  self-contained HA check: three leader electors
   (cluster/election.py) on one APF-armed apiserver.  Asserts a single
   leader at a time, bounded takeover (2x leaseDuration after a silent
@@ -162,6 +171,315 @@ def run_smoke(seed: int = 42, pods: int = 40, duration: float = 30.0) -> dict:
         "faults": inj.snapshot(),
         "recovery_s": round(t_recovered - t_start, 3),
         "lost_writes": 0,
+    }
+
+
+def run_corruption_smoke(seed: int = 42, pods: int = 24) -> dict:
+    """In-process storage-integrity smoke: every seeded disk fault —
+    bit-flip, truncate, torn multi-record write, fsync-boundary crash,
+    snapshot corruption — must be *detected* (never silently absorbed)
+    and recovery must be bounded and honest: the recovered state plus
+    the reported-lost set together account for every acked write.
+    Also proves PITR: ``build_state(to_rv)`` reproduces a mid-run live
+    capture byte-identically.  Raises on any silent loss."""
+    import random
+    import shutil
+
+    from kwok_tpu.chaos import disk_faults
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.cluster.wal import (
+        WriteAheadLog,
+        fsck,
+        segment_files,
+        write_state_file,
+    )
+    from kwok_tpu.snapshot.pitr import PitrArchive, boot_recover
+
+    rng = random.Random(seed)
+    t_start = time.monotonic()
+
+    def fail(msg):
+        raise SystemExit(f"corruption smoke FAILED: {msg}")
+
+    def accounted(acked, boot):
+        """Split acked rvs into (reported_lost, silent_lost) via the
+        RecoveryReport's own honesty classification — the SAME
+        predicate the DST recovery-honesty invariant audits."""
+        rep = boot["recovery"]
+        if rep is None:
+            return [], sorted(acked)
+        return rep.account(acked)
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        wal_p = os.path.join(tmp, "wal.jsonl")
+        state_p = os.path.join(tmp, "state.json")
+        pitr_root = os.path.join(tmp, "pitr")
+        store = ResourceStore()
+        store.attach_wal(
+            WriteAheadLog(
+                wal_p, fsync="off", segment_bytes=1500, archive_dir=pitr_root
+            )
+        )
+        archive = PitrArchive(pitr_root)
+        acked: set = set()
+
+        def track(fn, *a, **kw):
+            rv0 = store.resource_version
+            out = fn(*a, **kw)
+            acked.update(range(rv0 + 1, store.resource_version + 1))
+            return out
+
+        def daemon_save():
+            state = store.dump_state(copy=False)
+            write_state_file(state_p, state)
+            archive.add_snapshot(state)
+            store.compact_wal(int(state["resourceVersion"]))
+
+        pod = lambda n: {  # noqa: E731
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": n, "namespace": "default"},
+            "spec": {"nodeName": f"node-{rng.randrange(4)}"},
+            "status": {},
+        }
+        cut = None
+        for i in range(pods):
+            track(store.create, pod(f"smoke-{i}"))
+            if i == pods // 3:
+                daemon_save()
+            if i == pods // 2:
+                track(
+                    store.bulk,
+                    [
+                        {
+                            "verb": "patch",
+                            "kind": "Pod",
+                            "name": f"smoke-{j}",
+                            "data": {"status": {"phase": "Running"}},
+                            "subresource": "status",
+                        }
+                        for j in range(i)
+                    ],
+                )
+                cut = (store.resource_version, store.dump_state())
+        for i in range(0, pods, 5):
+            track(store.delete, "Pod", f"smoke-{i}")
+        track(
+            store.apply_status_batch,
+            "Pod",
+            [
+                ("default", f"smoke-{i}", {"phase": "Succeeded"})
+                for i in range(1, pods, 7)
+            ],
+        )
+        live = store.dump_state()
+
+        # ---- point-in-time recovery: byte-identical rebuild ---------
+        built, info = archive.build_state(cut[0], live_wal=wal_p)
+        if json.dumps(built, sort_keys=True) != json.dumps(
+            cut[1], sort_keys=True
+        ):
+            fail(
+                f"PITR rebuild at rv {cut[0]} diverged from the live "
+                f"capture (base rv {info['base_rv']})"
+            )
+        results["pitr"] = {
+            "to_rv": cut[0],
+            "base_rv": info["base_rv"],
+            "byte_identical": True,
+        }
+
+        # pristine fsck must pass
+        clean = fsck(wal_p, snapshot=state_p, archive=pitr_root)
+        if not clean["ok"]:
+            fail(f"fsck flagged a pristine log: {clean}")
+
+        def clone(name):
+            d = os.path.join(tmp, name)
+            os.makedirs(d)
+            for fp in segment_files(wal_p):
+                shutil.copy(fp, os.path.join(d, os.path.basename(fp)))
+            shutil.copy(state_p, os.path.join(d, "state.json"))
+            shutil.copytree(pitr_root, os.path.join(d, "pitr"))
+            return (
+                os.path.join(d, "wal.jsonl"),
+                os.path.join(d, "state.json"),
+                os.path.join(d, "pitr"),
+            )
+
+        def recover(paths):
+            t0 = time.monotonic()
+            fresh = ResourceStore()
+            boot = boot_recover(fresh, paths[1], paths[0], pitr_root=paths[2])
+            return fresh, boot, time.monotonic() - t0
+
+        # ---- bit-flip: mid-log corruption must be DETECTED ----------
+        paths = clone("bitflip")
+        target = rng.choice(
+            [f for f in segment_files(paths[0]) if os.path.getsize(f) > 0]
+        )
+        flip = disk_faults.bit_flip_line(target, rng, exclude_last=True)
+        fresh, boot, dt = recover(paths)
+        rep = boot["recovery"]
+        if not rep.corruptions and not rep.torn_tail:
+            fail(f"bit-flip at {target}:{flip} was silently absorbed")
+        bad = fsck(paths[0], snapshot=paths[1], archive=paths[2])
+        if bad["ok"]:
+            fail("fsck passed a bit-flipped log")
+        reported, silent = accounted(acked, boot)
+        if silent:
+            fail(f"bit-flip: acked rvs {silent[:10]} lost WITHOUT report")
+        results["bit-flip"] = {
+            "detected": True,
+            "acked_lost_reported": len(reported),
+            "silent_lost": 0,
+            "recovery_s": round(dt, 3),
+        }
+
+        # ---- truncate: lost tail cut mid-record ---------------------
+        paths = clone("truncate")
+        disk_faults.truncate_mid_record(paths[0], rng)
+        fresh, boot, dt = recover(paths)
+        rep = boot["recovery"]
+        if not rep.torn_tail and not rep.corruptions:
+            fail("truncation was silently absorbed")
+        if rep.tail_after_rv is None:
+            fail("truncation did not bound the possible tail loss")
+        reported, silent = accounted(acked, boot)
+        if silent:
+            fail(f"truncate: acked rvs {silent[:10]} lost WITHOUT report")
+        results["truncate"] = {
+            "detected": True,
+            "acked_lost_reported": len(reported),
+            "silent_lost": 0,
+            "recovery_s": round(dt, 3),
+        }
+
+        # ---- snapshot corruption: fall back + replay, zero loss -----
+        paths = clone("snapcorrupt")
+        disk_faults.bit_flip(paths[1], rng, 0.2, 0.8)
+        fresh, boot, dt = recover(paths)
+        if not boot["fell_back"]:
+            fail("corrupt snapshot was loaded without detection")
+        if fresh.dump_state() != live:
+            fail(
+                "snapshot-fallback recovery diverged from live state "
+                f"(fallback rv {boot['fallback_rv']})"
+            )
+        results["snapshot-corrupt"] = {
+            "detected": True,
+            "fallback_rv": boot["fallback_rv"],
+            "silent_lost": 0,
+            "recovery_s": round(dt, 3),
+        }
+
+    # ---- torn multi-record write (standalone scene) -----------------
+    with tempfile.TemporaryDirectory() as tmp:
+        wal_p = os.path.join(tmp, "wal.jsonl")
+        s2 = ResourceStore()
+        s2.attach_wal(WriteAheadLog(wal_p, fsync="off"))
+        # one deferred batch -> one multi-record append_many write
+        s2.bulk(
+            [
+                {
+                    "verb": "create",
+                    "data": {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "metadata": {
+                            "name": f"torn-{i}",
+                            "namespace": "default",
+                        },
+                        "spec": {},
+                        "status": {},
+                    },
+                }
+                for i in range(8)
+            ]
+        )
+        offsets, size = disk_faults.line_offsets(wal_p)
+        keep_lines = rng.randrange(2, len(offsets) - 1)
+        cut_off = offsets[keep_lines] + rng.randrange(
+            1, offsets[keep_lines + 1] - offsets[keep_lines] - 1
+        )
+        disk_faults.cut_at(wal_p, cut_off)
+        t0 = time.monotonic()
+        fresh = ResourceStore()
+        rep = fresh.recover_wal(wal_p)
+        dt = time.monotonic() - t0
+        if not rep.torn_tail:
+            fail("torn multi-record write was silently absorbed")
+        if fresh.count("Pod") != keep_lines:
+            fail(
+                f"torn write: {fresh.count('Pod')} records survive, "
+                f"want the batch prefix {keep_lines}"
+            )
+        results["torn-write"] = {
+            "detected": True,
+            "batch_prefix_kept": keep_lines,
+            "silent_lost": 0,
+            "recovery_s": round(dt, 3),
+        }
+
+    # ---- fsync-boundary crash (standalone scene) --------------------
+    with tempfile.TemporaryDirectory() as tmp:
+        wal_p = os.path.join(tmp, "wal.jsonl")
+        s3 = ResourceStore()
+        wal = WriteAheadLog(wal_p, fsync="off")
+        s3.attach_wal(wal)
+        for i in range(10):
+            s3.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"sync-{i}", "namespace": "default"},
+                    "spec": {},
+                    "status": {},
+                }
+            )
+        wal.sync()
+        synced_state = s3.dump_state()
+        synced_size = os.path.getsize(wal_p)
+        for i in range(10, 16):
+            s3.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"sync-{i}", "namespace": "default"},
+                    "spec": {},
+                    "status": {},
+                }
+            )
+        wal.close()
+        # machine crash: the unsynced tail vanishes, typically leaving
+        # a partial frame behind
+        offsets, size = disk_faults.line_offsets(wal_p)
+        first_unsynced = next(o for o in offsets if o >= synced_size)
+        disk_faults.cut_at(
+            wal_p, first_unsynced + rng.randrange(1, 20)
+        )
+        t0 = time.monotonic()
+        fresh = ResourceStore()
+        rep = fresh.recover_wal(wal_p)
+        dt = time.monotonic() - t0
+        if fresh.dump_state() != synced_state:
+            fail("fsync-boundary crash lost SYNCED data")
+        if not rep.torn_tail:
+            fail("fsync-boundary crash tail was silently absorbed")
+        results["fsync-crash"] = {
+            "detected": True,
+            "synced_rv_preserved": rep.recovered_rv,
+            "silent_lost": 0,
+            "recovery_s": round(dt, 3),
+        }
+
+    return {
+        "seed": seed,
+        "acked_writes": len(acked),
+        "faults": results,
+        "total_s": round(time.monotonic() - t_start, 3),
+        "silently_lost_acked_writes": 0,
     }
 
 
@@ -428,6 +746,7 @@ def run_failover_smoke(seed: int = 42, lease_duration: float = 2.5) -> dict:
 
 
 def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
+    from kwok_tpu.chaos.disk_faults import DiskFaultDriver
     from kwok_tpu.chaos.process_faults import ProcessFaultDriver
     from kwok_tpu.ctl.runtime import BinaryRuntime, ComponentSupervisor
 
@@ -440,8 +759,16 @@ def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
 
         sup = ComponentSupervisor(rt, rng=random.Random(plan.seed)).start()
     driver = ProcessFaultDriver(rt, plan, client=rt.client(timeout=5.0))
+    disk = DiskFaultDriver(rt, plan).start() if plan.disk else None
     try:
         driver.run()
+        if disk is not None:
+            # the process schedule may finish first; scheduled disk
+            # faults still fire at their own offsets
+            disk.wait(
+                timeout=max((s.at for s in plan.disk), default=0.0) + 15.0
+            )
+            disk.stop()
         if supervise:
             # let the supervisor finish recovering what the last fault
             # broke before reporting
@@ -451,10 +778,13 @@ def drive_cluster(plan: FaultPlan, cluster: str, supervise: bool) -> dict:
                     break
                 time.sleep(0.25)
     finally:
+        if disk is not None:
+            disk.stop()
         if sup is not None:
             sup.stop()
     return {
         "process_events": driver.events,
+        "disk_events": disk.events if disk is not None else [],
         "supervisor_events": sup.events if sup is not None else [],
         "recovery_times_s": (
             [round(r, 3) for r in sup.recovery_times] if sup is not None else []
@@ -487,6 +817,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="run the in-process overload/graceful-shedding smoke "
         "(used by tools/check.sh)",
+    )
+    p.add_argument(
+        "--corruption-smoke",
+        action="store_true",
+        help="run the in-process storage-integrity smoke: seeded disk "
+        "faults (bit-flip/truncate/torn-write/fsync-crash/snapshot "
+        "corruption) must be detected, recovery bounded and honest, "
+        "PITR byte-identical (used by tools/check.sh)",
     )
     p.add_argument(
         "--failover-smoke",
@@ -581,6 +919,13 @@ def main(argv=None) -> int:
         report = run_overload_smoke(
             seed=args.seed if args.seed is not None else 42,
             duration=args.flood_seconds,
+        )
+        print(json.dumps(report))
+        return 0
+    if args.corruption_smoke:
+        report = run_corruption_smoke(
+            seed=args.seed if args.seed is not None else 42,
+            pods=args.pods,
         )
         print(json.dumps(report))
         return 0

@@ -1,0 +1,237 @@
+"""Seeded disk-fault injection for WAL segments and snapshot files.
+
+The fourth injection layer (after HTTP, process, and commit-boundary —
+``kwok_tpu/chaos/__init__.py:1``): the *storage* underneath the store
+fails.  Each helper applies one deterministic, seeded corruption to a
+file the durability layer owns, modeling the disk's real failure
+modes:
+
+- **bit-flip** — silent media corruption mid-file; the checksummed
+  frame format (``kwok_tpu/cluster/wal.py:104``) must *detect* it and
+  recovery must report the exact lost resourceVersions, never skip.
+- **truncate** — a lost tail cut mid-record (torn final frame): the
+  legal crash debris shape, but recovery must still flag the torn
+  frame and bound the possible loss.
+- **torn-write** — a multi-record batched append (the store bulk
+  lane's single ``append_many`` write,
+  ``kwok_tpu/cluster/store.py:1597``) persisted only partially: the
+  batch's prefix must survive, the cut must be detected.
+- **fsync-crash** — machine death at the fsync boundary: everything
+  after the last fsync vanishes; nothing synced may be lost.
+
+All offsets derive from the caller's ``random.Random``, so a fault
+schedule is a pure function of the seed — the chaos-plan contract
+(``kwok_tpu/chaos/plan.py:1``) extended to the disk.  Exercised by
+``python -m kwok_tpu.chaos --corruption-smoke`` and the DST harness's
+``disk-corrupt`` fault (``kwok_tpu/dst/faults.py:1``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "DISK_FAULT_KINDS",
+    "DiskFaultDriver",
+    "bit_flip",
+    "bit_flip_line",
+    "truncate_mid_record",
+    "cut_at",
+    "line_offsets",
+    "mid_line_offset",
+]
+
+DISK_FAULT_KINDS = ("bit-flip", "truncate", "torn-write", "fsync-crash")
+
+
+def line_offsets(path: str):
+    """Byte offsets of each line start (the frame boundaries)."""
+    offsets = [0]
+    with open(path, "rb") as f:
+        data = f.read()
+    for i, b in enumerate(data):
+        if b == 0x0A and i + 1 < len(data):
+            offsets.append(i + 1)
+    return offsets, len(data)
+
+
+def bit_flip(
+    path: str,
+    rng: random.Random,
+    lo_frac: float = 0.0,
+    hi_frac: float = 1.0,
+) -> Dict[str, int]:
+    """Flip one seeded bit inside ``[lo_frac, hi_frac)`` of the file.
+    Returns ``{"offset", "bit"}`` for the report/trace."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return {"offset": -1, "bit": -1}
+    lo = int(size * lo_frac)
+    hi = max(lo + 1, int(size * hi_frac))
+    offset = rng.randrange(lo, min(hi, size))
+    bit = rng.randrange(8)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (1 << bit)]))
+        f.flush()
+        os.fsync(f.fileno())
+    return {"offset": offset, "bit": bit}
+
+
+def bit_flip_line(
+    path: str, rng: random.Random, exclude_last: bool = True
+) -> Dict[str, int]:
+    """Flip one seeded bit inside a seeded record line — excluding the
+    final line by default, so the damage is unambiguous *mid-log*
+    corruption (a flipped final line is indistinguishable from torn
+    crash debris and gets the torn-tail treatment instead)."""
+    offsets, size = line_offsets(path)
+    if size == 0:
+        return {"offset": -1, "bit": -1}
+    if exclude_last and len(offsets) > 1:
+        offsets = offsets[:-1]
+    start = rng.choice(offsets)
+    with open(path, "rb") as f:
+        f.seek(start)
+        line = f.readline()
+    span = max(1, len(line.rstrip(b"\n")))
+    offset = start + rng.randrange(span)
+    bit = rng.randrange(8)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (1 << bit)]))
+        f.flush()
+        os.fsync(f.fileno())
+    return {"offset": offset, "bit": bit}
+
+
+def mid_line_offset(
+    path: str, rng: random.Random, exclude_last: bool = False
+) -> Optional[int]:
+    """A seeded offset strictly inside a record line (never at a line
+    boundary), so a cut there produces a torn frame the scanner can
+    see — a truncation at an exact boundary is indistinguishable from
+    a log that simply ends there."""
+    offsets, size = line_offsets(path)
+    if size == 0:
+        return None
+    if exclude_last and len(offsets) > 1:
+        offsets = offsets[:-1]
+    start = rng.choice(offsets)
+    # find this line's end
+    with open(path, "rb") as f:
+        f.seek(start)
+        line = f.readline()
+    if len(line) < 3:
+        return None
+    return start + rng.randrange(1, len(line) - 1)
+
+
+def cut_at(path: str, offset: int) -> None:
+    """Truncate ``path`` to ``offset`` bytes (the crash/torn-write
+    primitive)."""
+    with open(path, "r+b") as f:
+        f.truncate(max(0, offset))
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def truncate_mid_record(path: str, rng: random.Random) -> Dict[str, int]:
+    """Cut the file mid-way through a seeded record line.  Returns the
+    cut offset (or -1 when the file is too small to cut)."""
+    off = mid_line_offset(path, rng)
+    if off is None:
+        return {"offset": -1}
+    cut_at(path, off)
+    return {"offset": off}
+
+
+class DiskFaultDriver:
+    """Execute a plan's ``disk:`` faults against a live cluster's
+    storage files — the wall-clock twin of
+    :class:`~kwok_tpu.chaos.process_faults.ProcessFaultDriver`,
+    scheduled from the same plan ``at`` offsets.
+
+    ``target: wal`` hits the apiserver's live log, ``target: snapshot``
+    its state file (paths by the kwokctl workdir convention,
+    ``kwok_tpu/ctl/components.py:61``).  ``fsync-crash`` SIGKILLs the
+    apiserver first (no final save), then cuts the log tail mid-record
+    — the closest external approximation of machine death at the fsync
+    boundary; the supervisor's restart then exercises the tolerant
+    recovery path end to end."""
+
+    def __init__(self, runtime, plan, rng: Optional[random.Random] = None):
+        self.runtime = runtime
+        self.plan = plan
+        self.rng = rng or random.Random(plan.seed ^ 0xD15C)
+        #: [{"t", "kind", "target", "path", ...injection info}]
+        self.events: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DiskFaultDriver":
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the schedule is exhausted (without cancelling
+        pending faults the way :meth:`stop` does)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def run(self) -> None:
+        t0 = time.monotonic()
+        pending = sorted(self.plan.disk, key=lambda s: s.at)
+        while pending and not self._stop.is_set():
+            now = time.monotonic() - t0
+            if now >= pending[0].at:
+                self._apply(pending.pop(0), now)
+                continue
+            self._stop.wait(min(max(pending[0].at - now, 0.0), 0.25))
+
+    def _target_path(self, target: str) -> str:
+        from kwok_tpu.ctl.components import state_path, wal_path
+
+        if target == "snapshot":
+            return state_path(self.runtime.workdir)
+        return wal_path(self.runtime.workdir)
+
+    def _apply(self, spec, now: float) -> None:
+        path = self._target_path(spec.target)
+        info: Dict[str, int] = {"offset": -1}
+        try:
+            if spec.kind == "fsync-crash":
+                self.runtime.signal_component("apiserver", signal.SIGKILL)
+                info = truncate_mid_record(path, self.rng)
+            elif spec.kind == "bit-flip":
+                info = bit_flip_line(path, self.rng, exclude_last=True)
+            elif spec.kind in ("truncate", "torn-write"):
+                info = truncate_mid_record(path, self.rng)
+        except OSError as exc:
+            info = {"offset": -1, "error": str(exc)}  # type: ignore[dict-item]
+        self.events.append(
+            {
+                "t": round(now, 3),
+                "kind": spec.kind,
+                "target": spec.target,
+                "path": path,
+                **info,
+            }
+        )

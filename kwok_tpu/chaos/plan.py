@@ -26,6 +26,9 @@ Profile YAML::
       - {component: apiserver, at: 8, action: kill}
       - {component: kube-controller-manager, at: 12, action: stop, resumeAfter: 2}
       - {component: kwok-controller, at: 20, action: leader-kill}
+    disk:
+      - {at: 15, kind: bit-flip, target: wal}
+      - {at: 25, kind: truncate, target: snapshot}
 
 ``action`` is ``kill`` (SIGKILL; the supervisor restarts), ``stop``
 (SIGSTOP, SIGCONT after ``resumeAfter``), ``restart`` (graceful
@@ -47,11 +50,21 @@ __all__ = [
     "OverloadWindow",
     "PartitionWindow",
     "ProcessFaultSpec",
+    "DiskFaultSpec",
+    "DISK_FAULT_KINDS",
     "FaultPlan",
     "load_profile",
 ]
 
 PROCESS_ACTIONS = ("kill", "stop", "restart", "leader-kill")
+
+# storage-layer fault vocabulary: media bit flips, lost tails,
+# partially-persisted batched appends, machine death at the fsync
+# boundary — owned by the module that implements the kinds, so a new
+# kind is automatically valid in profiles
+from kwok_tpu.chaos.disk_faults import DISK_FAULT_KINDS  # noqa: E402
+
+DISK_TARGETS = ("wal", "snapshot")
 
 
 @dataclass(frozen=True)
@@ -170,6 +183,36 @@ class HttpFaultSpec:
 
 
 @dataclass(frozen=True)
+class DiskFaultSpec:
+    """One scheduled storage fault against the cluster's WAL or
+    snapshot files (kwok_tpu.chaos.disk_faults applies it; the exact
+    byte offset is drawn from the plan seed at injection time, so
+    ``--print-schedule`` shows when/what and the run stays
+    reproducible)."""
+
+    at: float
+    kind: str  # bit-flip | truncate | torn-write | fsync-crash
+    target: str = "wal"  # wal | snapshot
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DiskFaultSpec":
+        kind = str(d.get("kind") or "bit-flip")
+        if kind not in DISK_FAULT_KINDS:
+            raise ValueError(
+                f"disk fault kind {kind!r} not in {DISK_FAULT_KINDS}"
+            )
+        target = str(d.get("target") or "wal")
+        if target not in DISK_TARGETS:
+            raise ValueError(
+                f"disk fault target {target!r} not in {DISK_TARGETS}"
+            )
+        return cls(at=float(d.get("at", 0.0)), kind=kind, target=target)
+
+    def to_dict(self) -> dict:
+        return {"at": self.at, "kind": self.kind, "target": self.target}
+
+
+@dataclass(frozen=True)
 class ProcessFaultSpec:
     """One scheduled process-layer fault."""
 
@@ -209,6 +252,7 @@ class FaultPlan:
     duration: float = 30.0
     http: HttpFaultSpec = field(default_factory=HttpFaultSpec)
     process: List[ProcessFaultSpec] = field(default_factory=list)
+    disk: List[DiskFaultSpec] = field(default_factory=list)
 
     @classmethod
     def from_dict(cls, d: dict) -> "FaultPlan":
@@ -223,6 +267,10 @@ class FaultPlan:
                 (ProcessFaultSpec.from_dict(p) for p in d.get("process") or []),
                 key=lambda p: (p.at, p.component),
             ),
+            disk=sorted(
+                (DiskFaultSpec.from_dict(p) for p in d.get("disk") or []),
+                key=lambda p: (p.at, p.kind),
+            ),
         )
 
     def to_dict(self) -> dict:
@@ -232,6 +280,7 @@ class FaultPlan:
             "duration": self.duration,
             "http": self.http.to_dict(),
             "process": [p.to_dict() for p in self.process],
+            "disk": [p.to_dict() for p in self.disk],
         }
 
 

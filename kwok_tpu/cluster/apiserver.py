@@ -394,10 +394,17 @@ class _Handler(BaseHTTPRequestHandler):
                 counts = {
                     t.plural: self.store.count(t.kind) for t in self.store.kinds()
                 }
-                self._send_json(
-                    200,
-                    {"resourceVersion": self.store.resource_version, "counts": counts},
-                )
+                body = {
+                    "resourceVersion": self.store.resource_version,
+                    "counts": counts,
+                }
+                wal = self.store.wal_health()
+                if wal is not None:
+                    # storage-integrity surface: segment count, live
+                    # bytes, last-fsync age, recovery/corruption
+                    # counters (kwokctl get components reads these)
+                    body["wal"] = wal
+                self._send_json(200, body)
             elif head == "r" and len(rest) == 1:
                 # canonical watch values only — must stay in lockstep
                 # with _dispatch's long-running classification, or a

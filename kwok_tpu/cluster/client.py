@@ -720,6 +720,13 @@ class ClusterClient:
         """Raw store snapshot from a live cluster (etcd-save analog)."""
         return self._request("GET", "/state")
 
+    def stats(self) -> dict:
+        """The apiserver's /stats block: resourceVersion, per-kind
+        counts, and (when a WAL is attached) the storage-integrity
+        health surface (``wal``: segments/bytes/last-fsync age plus
+        recovery counters)."""
+        return self._request("GET", "/stats")
+
     def restore_state(self, state: dict) -> int:
         """Load a raw snapshot into a live cluster (etcd-restore
         analog); watchers see ADDED for every restored object."""

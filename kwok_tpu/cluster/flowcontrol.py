@@ -494,8 +494,35 @@ def expose_metrics(flow: Optional[FlowController], store=None) -> str:
         )
         rv.set(store.resource_version)
         reg.register("kwok_apiserver_resource_version", rv)
+        _expose_wal(reg, store, Gauge)
         _expose_election(reg, store, Gauge)
     return reg.expose()
+
+
+def _expose_wal(reg, store, Gauge) -> None:
+    """Storage-integrity gauges from the store's attached WAL
+    (cluster/wal.py health surface): segment count, live bytes,
+    last-fsync age, and the recovery/corruption counters — the
+    observability half of the disaster-recovery contract."""
+    health = getattr(store, "wal_health", lambda: None)()
+    if health is None:
+        return
+    spec = [
+        ("kwok_apiserver_wal_segments", "segments", "live WAL files (sealed segments + active)"),
+        ("kwok_apiserver_wal_bytes", "bytes", "live WAL bytes on disk"),
+        ("kwok_apiserver_wal_last_fsync_age_seconds", "last_fsync_age_s", "seconds since the WAL was last fsynced"),
+        ("kwok_apiserver_wal_recoveries_total", "recoveries", "tolerant WAL recoveries run"),
+        ("kwok_apiserver_wal_corruptions_total", "corruptions", "mid-log corruptions detected (never silently absorbed)"),
+        ("kwok_apiserver_wal_missing_rvs_total", "missing_rvs", "resourceVersions recovery reported as lost"),
+        ("kwok_apiserver_snapshot_fallbacks_total", "snapshot_fallbacks", "boots that fell back to an archived snapshot"),
+    ]
+    for mname, key, help_ in spec:
+        val = health.get(key)
+        if val is None:
+            continue
+        g = Gauge(mname, help=help_)
+        g.set(val)
+        reg.register(mname, g)
 
 
 def _expose_election(reg, store, Gauge) -> None:

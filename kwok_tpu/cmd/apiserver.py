@@ -48,6 +48,26 @@ def build_parser() -> argparse.ArgumentParser:
         "them; machine-crash safety wants 'always')",
     )
     p.add_argument(
+        "--wal-segment-bytes",
+        type=int,
+        default=0,
+        help="WAL segment rotation threshold (0 = library default)",
+    )
+    p.add_argument(
+        "--pitr-dir",
+        default="",
+        help="point-in-time-recovery archive: retired WAL segments + "
+        "periodic snapshots land here, enabling `kwokctl snapshot "
+        "restore --to-rv` and boot fallback past a corrupt state file",
+    )
+    p.add_argument(
+        "--pitr-keep",
+        type=int,
+        default=5,
+        help="archived snapshots to retain (older ones and the "
+        "segments they cover are pruned after each save)",
+    )
+    p.add_argument(
         "--chaos-profile",
         default="",
         help="arm the HTTP fault injector from this seeded profile YAML",
@@ -93,24 +113,65 @@ def main(argv=None) -> int:
     # namespace finalizers ON: cluster compositions always include the
     # controller-manager seat that finalizes them (ctl/runtime.py)
     store = ResourceStore(namespace_finalizers=True)
-    if args.state_file and os.path.exists(args.state_file):
-        n = store.load_file(args.state_file)
-        print(f"restored {n} objects from {args.state_file}", flush=True)
+    pitr = None
+    if args.pitr_dir:
+        from kwok_tpu.snapshot.pitr import PitrArchive
+
+        pitr = PitrArchive(args.pitr_dir)
+    if args.state_file or args.wal_file:
+        # snapshot-then-WAL boot with integrity: a corrupt state file
+        # falls back to the newest verifiable archived snapshot, and
+        # WAL recovery is tolerant — every verifiable record applies,
+        # corruption and missing resourceVersions are REPORTED (the
+        # recovery-honesty contract), never silently skipped
+        from kwok_tpu.snapshot.pitr import boot_recover
+
+        boot = boot_recover(
+            store,
+            args.state_file or None,
+            args.wal_file or None,
+            pitr_root=args.pitr_dir or None,
+        )
+        if boot["state_loaded"]:
+            where = (
+                f"archived snapshot rv={boot['fallback_rv']} "
+                f"(state file corrupt: {boot['snapshot_error']})"
+                if boot["fell_back"]
+                else args.state_file
+            )
+            print(f"restored state from {where}", flush=True)
+        rec = boot["recovery"]
+        if rec is not None and rec.applied:
+            print(
+                f"replayed {rec.applied} WAL records from {args.wal_file} "
+                f"(rv {store.resource_version})",
+                flush=True,
+            )
+        if rec is not None and not rec.clean:
+            import json as _json
+
+            print(
+                "WAL recovery was lossy (detected, bounded): "
+                + _json.dumps(rec.summary()),
+                flush=True,
+            )
     if args.wal_file:
-        # order matters: replay what the last process crashed on, THEN
-        # attach for appending — the log keeps covering its records
+        # attach AFTER replay — the log keeps covering its records
         # until a snapshot compacts them
         from kwok_tpu.cluster.wal import WriteAheadLog
 
-        if os.path.exists(args.wal_file):
-            n = store.replay_wal(args.wal_file)
-            if n:
-                print(
-                    f"replayed {n} WAL records from {args.wal_file} "
-                    f"(rv {store.resource_version})",
-                    flush=True,
-                )
-        store.attach_wal(WriteAheadLog(args.wal_file, fsync=args.wal_fsync))
+        store.attach_wal(
+            WriteAheadLog(
+                args.wal_file,
+                fsync=args.wal_fsync,
+                **(
+                    {"segment_bytes": args.wal_segment_bytes}
+                    if args.wal_segment_bytes
+                    else {}
+                ),
+                archive_dir=args.pitr_dir or None,
+            )
+        )
 
     injector = None
     plan = None
@@ -182,13 +243,29 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
+    def save_once() -> None:
+        # online consistent cut: refs captured under one brief mutex
+        # hold (copy-on-write store), serialized outside the lock —
+        # live writers are never stalled for the disk write
+        from kwok_tpu.cluster.wal import write_state_file
+
+        # (without a WAL the in-place status lane may mutate stored
+        # objects — keep the deep-copy capture there)
+        state = store.dump_state(copy=not args.wal_file)
+        write_state_file(args.state_file, state)
+        if pitr is not None:
+            pitr.add_snapshot(state)
+        store.compact_wal(int(state["resourceVersion"]))
+        if pitr is not None:
+            pitr.prune(keep_snapshots=args.pitr_keep)
+
     saved_rv = -1
     while not done.wait(args.save_interval):
         if args.state_file and store.resource_version != saved_rv:
             saved_rv = store.resource_version
-            store.save_file(args.state_file)
+            save_once()
     if args.state_file and store.resource_version != saved_rv:
-        store.save_file(args.state_file)
+        save_once()
     if overload is not None:
         overload.stop()
         print(f"chaos: overload flood {overload.snapshot()}", flush=True)

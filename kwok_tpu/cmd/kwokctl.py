@@ -123,9 +123,12 @@ def cmd_get_components(args) -> int:
     """Component liveness plus per-component election state: which
     instance holds each election Lease, its transition count, and the
     renew age (cluster/election.py publishes these as the Lease spec;
-    the kube-scheduler/kcm expose the same through their leases)."""
+    the kube-scheduler/kcm expose the same through their leases) —
+    and, for the apiserver, its WAL health (segment count + last-fsync
+    age from the /stats storage-integrity surface)."""
     rt = _require_cluster(args)
     election = {}  # holder instance -> (lease, transitions, renew age)
+    wal = None
     try:
         client = rt.client(timeout=2.0)
         leases, _rv = client.list("Lease", namespace="kube-system")
@@ -144,6 +147,7 @@ def cmd_get_components(args) -> int:
                 transitions,
                 age,
             )
+        wal = (client.stats() or {}).get("wal")
     except Exception:  # noqa: BLE001 — a down apiserver degrades to
         # the plain liveness listing rather than failing the command
         pass
@@ -154,6 +158,16 @@ def cmd_get_components(args) -> int:
             line += f"\tleader({lease})\ttransitions={transitions}"
             if age is not None:
                 line += f"\trenewed={age:.1f}s ago"
+        if name == "apiserver" and wal:
+            line += (
+                f"\twal={wal.get('segments')}seg/"
+                f"{int(wal.get('bytes') or 0) // 1024}KB"
+            )
+            fs_age = wal.get("last_fsync_age_s")
+            if fs_age is not None:
+                line += f"\tfsynced={fs_age:.1f}s ago"
+            if wal.get("corruptions"):
+                line += f"\tcorruptions={wal['corruptions']}"
         print(line)
     return 0
 
@@ -371,13 +385,25 @@ def cmd_snapshot_export(args) -> int:
 
 def cmd_snapshot_save(args) -> int:
     """Raw store snapshot — the etcd-level save (reference
-    kwokctl snapshot save, pkg/kwokctl/etcd/save.go)."""
-    from kwok_tpu.cluster.store import atomic_write_json
+    kwokctl snapshot save, pkg/kwokctl/etcd/save.go) — written with an
+    embedded integrity checksum; ``--pitr`` also registers it in the
+    cluster's point-in-time-recovery archive so ``snapshot restore
+    --to-rv`` can target any later retained resourceVersion."""
+    from kwok_tpu.cluster.wal import write_state_file
 
     rt = _require_cluster(args)
     state = rt.client().dump_state()
-    atomic_write_json(args.path, state)
+    write_state_file(args.path, state)
     print(f"saved {len(state.get('objects', []))} objects (raw) to {args.path}")
+    if getattr(args, "pitr", False):
+        from kwok_tpu.ctl.components import pitr_dir
+        from kwok_tpu.snapshot.pitr import PitrArchive
+
+        archived = PitrArchive(pitr_dir(rt.workdir)).add_snapshot(state)
+        print(
+            f"archived as {archived} "
+            f"(rv {state.get('resourceVersion')})"
+        )
     return 0
 
 
@@ -385,10 +411,35 @@ def cmd_snapshot_restore(args) -> int:
     """Restore a snapshot: a stock-kwok etcd snapshot (bbolt database,
     reference cluster_snapshot.go:28-36 — the ``--format etcd`` file),
     raw JSON state, or YAML export (k8s-level with owner-ref re-link),
-    detected by content."""
+    detected by content.  ``--to-rv N`` instead rebuilds the state as
+    of resourceVersion N from the PITR archive + WAL segments
+    (kwok_tpu.snapshot.pitr) and loads that."""
     from kwok_tpu.snapshot import load
 
     rt = _require_cluster(args)
+    if getattr(args, "to_rv", 0):
+        from kwok_tpu.ctl.components import pitr_dir, wal_path
+        from kwok_tpu.snapshot.pitr import PitrArchive
+
+        archive = PitrArchive(pitr_dir(rt.workdir))
+        state, info = archive.build_state(
+            args.to_rv, live_wal=wal_path(rt.workdir)
+        )
+        n = rt.client().restore_state(state)
+        print(
+            f"restored {n} objects at rv {info['built_rv']} "
+            f"(snapshot rv {info['base_rv']} + {info['applied_records']} "
+            f"WAL records)"
+        )
+        if info["corruptions"]:
+            print(
+                f"warning: {len(info['corruptions'])} corrupt WAL "
+                "region(s) were detected and skipped during the rebuild",
+                file=sys.stderr,
+            )
+        return 0
+    if not args.path:
+        raise SystemExit("snapshot restore needs --path or --to-rv")
     with open(args.path, "rb") as f:
         raw = f.read()
     # a real etcd snapshot is a bolt database: magic at page offset 16
@@ -425,6 +476,11 @@ def cmd_snapshot_restore(args) -> int:
     except (json.JSONDecodeError, UnicodeDecodeError):
         pass
     if state is not None:
+        # integrity-checked saves embed a checksum; refuse a snapshot
+        # that fails it instead of restoring silently corrupt objects
+        from kwok_tpu.cluster.wal import verify_state
+
+        verify_state(state, source=args.path)
         n = rt.client().restore_state(state)
         print(f"restored {n} objects (raw) from {args.path}")
         return 0
@@ -1433,9 +1489,23 @@ def build_parser() -> argparse.ArgumentParser:
     e.set_defaults(fn=cmd_snapshot_export)
     sv = pns.add_parser("save")
     sv.add_argument("--path", required=True)
+    sv.add_argument(
+        "--pitr",
+        action="store_true",
+        help="also register the snapshot in the cluster's "
+        "point-in-time-recovery archive (restore --to-rv targets)",
+    )
     sv.set_defaults(fn=cmd_snapshot_save)
     r = pns.add_parser("restore")
-    r.add_argument("--path", required=True)
+    r.add_argument("--path", default="")
+    r.add_argument(
+        "--to-rv",
+        type=int,
+        default=0,
+        dest="to_rv",
+        help="point-in-time restore: rebuild the state as of this "
+        "resourceVersion from the PITR archive + WAL (no --path needed)",
+    )
     r.set_defaults(fn=cmd_snapshot_restore)
     rec = pns.add_parser("record")
     rec.add_argument("--path", required=True)

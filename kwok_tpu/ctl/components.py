@@ -58,6 +58,22 @@ def free_port() -> int:
 DEFAULT_MAX_INFLIGHT = 64
 
 
+def wal_path(workdir: str) -> str:
+    """The cluster apiserver's live WAL (kwokctl tooling — fsck,
+    ``snapshot restore --to-rv`` — reads it by this convention)."""
+    return os.path.join(workdir, "wal.jsonl")
+
+
+def state_path(workdir: str) -> str:
+    return os.path.join(workdir, "state.json")
+
+
+def pitr_dir(workdir: str) -> str:
+    """Point-in-time-recovery archive: retired WAL segments plus
+    periodic integrity-checked snapshots (kwok_tpu.snapshot.pitr)."""
+    return os.path.join(workdir, "pitr")
+
+
 def build_apiserver_component(
     workdir: str,
     port: int,
@@ -78,11 +94,17 @@ def build_apiserver_component(
         "--port",
         str(port),
         "--state-file",
-        os.path.join(workdir, "state.json"),
+        state_path(workdir),
         # etcd-WAL seat: snapshot + log together make every acked write
         # survive a crash (and the supervisor's restart resume watches)
         "--wal-file",
-        os.path.join(workdir, "wal.jsonl"),
+        wal_path(workdir),
+        # point-in-time recovery: retired segments + periodic snapshots
+        # archive here, so `kwokctl snapshot restore --to-rv N` can
+        # rebuild any retained resourceVersion and a corrupt state file
+        # falls back to the newest verifiable archived snapshot
+        "--pitr-dir",
+        pitr_dir(workdir),
         "--audit-file",
         os.path.join(workdir, "logs", "audit.log"),
         # overload protection on by default (the reference apiserver's

@@ -147,6 +147,18 @@ class FaultTimeline:
         self.scheduled.append(
             _Scheduled(t=t_pause + dur, kind="resume", params={"seat": seat2})
         )
+        # one disk corruption against the store's WAL (the storage
+        # fault vocabulary of kwok_tpu.chaos.disk_faults, in virtual
+        # time): the harness corrupts the log file at a seeded offset
+        # and recovery must be detected + honest (recovery-honesty
+        # invariant)
+        self.scheduled.append(
+            _Scheduled(
+                t=t0 + rng.uniform(3.0, window_s * 0.85),
+                kind="disk-corrupt",
+                params={"mode": rng.choice(["bit-flip", "truncate"])},
+            )
+        )
         self.scheduled.sort(key=lambda s: s.t)
 
     # ------------------------------------------------------------ queries
@@ -270,6 +282,7 @@ class ActorStore:
         self._gate(True)
         if kw.get("as_user") is None:
             kw["as_user"] = self.client_id
+        rv_before = sim.store.resource_version
         result = fn(*a, **kw)
         t = self._now()
         for action, detail in detail_fn(result):
@@ -278,7 +291,10 @@ class ActorStore:
             # applied, but the caller never learns: NOT an acked write
             sim.trace.add(t, self._actor, "ack-eaten", verb)
             raise ApiUnavailable("response lost after apply")
-        sim.note_ack()
+        # the sim is single-threaded: every rv in (rv_before, now] was
+        # committed by THIS call — the acked set the recovery-honesty
+        # invariant audits disk-fault recoveries against
+        sim.note_ack(rv_before)
         return result
 
     @staticmethod

@@ -86,6 +86,9 @@ class RunRecord:
     trace: Trace
     streams: List[List[int]] = field(default_factory=list)
     crash_checks: List[dict] = field(default_factory=list)
+    #: disk-fault probes: per injected storage corruption, how every
+    #: acked rv was accounted for (recovery-honesty invariant)
+    disk_checks: List[dict] = field(default_factory=list)
     replay_matches: Optional[bool] = None
     replay_detail: str = ""
     converged: bool = False
@@ -108,7 +111,12 @@ class Simulation:
         self.trace = Trace()
         self.store_generation = 0
         self.max_acked_rv = 0
+        #: every rv some actor's mutation was acknowledged at (pruned
+        #: to the recovered baseline after a lossy disk recovery —
+        #: resourceVersion numbering restarts below the rollback point)
+        self.acked_rvs: set = set()
         self.crash_checks: List[dict] = []
+        self.disk_checks: List[dict] = []
         self._crash_arm: Optional[dict] = None
         self._suffix_n = 0
         self.steps = 0
@@ -202,10 +210,11 @@ class Simulation:
         self._suffix_n += 1
         return f"{self._suffix_n:x}"
 
-    def note_ack(self) -> None:
-        self.max_acked_rv = max(
-            self.max_acked_rv, self.store.resource_version
-        )
+    def note_ack(self, rv_before: Optional[int] = None) -> None:
+        rv = self.store.resource_version
+        self.max_acked_rv = max(self.max_acked_rv, rv)
+        if rv_before is not None and rv > rv_before:
+            self.acked_rvs.update(range(rv_before + 1, rv + 1))
 
     def _crash_dispatch(self, phase: str) -> None:
         arm = self._crash_arm
@@ -217,30 +226,96 @@ class Simulation:
         self._crash_arm = None
         raise SimCrash(phase)
 
-    def _restart_store(self, crash: SimCrash) -> None:
-        """Simulated store-process death: lose the in-memory state,
-        recover from the WAL (the chaos --smoke recovery path, run
-        mid-simulation)."""
+    def _recover(self):
+        """Lose the in-memory store, recover a fresh one from the WAL
+        through the tolerant path (recover_wal — a previously-injected
+        disk fault must be detected and reported, never crash the
+        recovery), and swap it in.  Returns the RecoveryReport."""
         t = self.clock.now()
-        self.trace.add(t, "store", "crash", crash.phase)
         self.wal.close()
         recovered = ResourceStore(clock=self.clock)
-        n = recovered.replay_wal(self.wal_path)
-        self.crash_checks.append(
-            {
-                "acked_rv": self.max_acked_rv,
-                "recovered_rv": recovered.resource_version,
-                "records": n,
-            }
-        )
+        rep = recovered.recover_wal(self.wal_path)
         self.wal = WriteAheadLog(self.wal_path, fsync="off")
         recovered.attach_wal(self.wal)
         recovered.set_crash_hook(self._crash_dispatch)
         self.store = recovered
         self.store_generation += 1
         self.trace.add(
-            t, "store", "recovered", f"rv={recovered.resource_version} records={n}"
+            t,
+            "store",
+            "recovered",
+            f"rv={recovered.resource_version} records={rep.applied}",
         )
+        return rep
+
+    def _restart_store(self, crash: SimCrash) -> None:
+        """Simulated store-process death: lose the in-memory state,
+        recover from the WAL (the chaos --smoke recovery path, run
+        mid-simulation)."""
+        self.trace.add(self.clock.now(), "store", "crash", crash.phase)
+        rep = self._recover()
+        self.crash_checks.append(
+            {
+                "acked_rv": self.max_acked_rv,
+                "recovered_rv": rep.recovered_rv,
+                "records": rep.applied,
+            }
+        )
+
+    def _disk_fault(self, mode: str) -> None:
+        """Seeded storage corruption against the live WAL, then an
+        immediate crash-recovery through the tolerant path.  The probe
+        records, at fault time, how every acked rv was accounted for —
+        applied, reported lost, or (a violation) silently gone — and
+        then prunes the ack bookkeeping to the recovered baseline,
+        because resourceVersion numbering restarts below the rollback
+        point."""
+        from kwok_tpu.chaos import disk_faults
+
+        t = self.clock.now()
+        if mode == "bit-flip":
+            info = disk_faults.bit_flip_line(
+                self.wal_path, self.faults.rng, exclude_last=True
+            )
+        else:
+            info = disk_faults.truncate_mid_record(
+                self.wal_path, self.faults.rng
+            )
+        noop = info.get("offset", -1) < 0
+        self.trace.add(
+            t, "faults", "disk-corrupt", f"{mode} offset={info.get('offset', -1)}"
+        )
+        rep = self._recover()
+        missing = set(rep.missing_rvs)
+        # the RecoveryReport's own honesty classification — the same
+        # predicate the corruption smoke asserts
+        reported, silent = rep.account(self.acked_rvs)
+        self.disk_checks.append(
+            {
+                "mode": mode,
+                "noop": noop,
+                "reported_lost": reported,
+                "silent_lost": silent,
+                "recovered_rv": rep.recovered_rv,
+                "corruptions": len(rep.corruptions),
+                "torn_tail": rep.torn_tail,
+            }
+        )
+        self.trace.add(
+            t,
+            "store",
+            "disk-recovered",
+            f"rv={rep.recovered_rv} reported={len(reported)} "
+            f"silent={len(silent)}",
+        )
+        # prune to the post-rollback world: lost rvs were accounted
+        # above, and their numbers will be re-issued by new commits
+        self.acked_rvs = {
+            rv
+            for rv in self.acked_rvs
+            if rv <= rep.recovered_rv and rv not in missing
+        }
+        self.max_acked_rv = min(self.max_acked_rv, rep.recovered_rv)
 
     # -------------------------------------------------------------- scenario
 
@@ -367,6 +442,8 @@ class Simulation:
             if target is not None:
                 target.paused = False
                 self.trace.add(t, "faults", "resume", target.name)
+        elif kind == "disk-corrupt":
+            self._disk_fault(params["mode"])
 
     # ------------------------------------------------------------- main loop
 
@@ -476,16 +553,20 @@ class Simulation:
         rec.converged, rec.convergence_detail = self._converged()
         rec.streams = self.observer.streams
         rec.crash_checks = self.crash_checks
+        rec.disk_checks = self.disk_checks
         rec.audit_overflow = self.store.audit_overflow
         rec.steps = self.steps
         rec.virtual_end = self.clock.now() - EPOCH
         for kind in ("Node", "Pod", "Deployment", "ReplicaSet"):
             rec.final_counts[kind] = self.store.count(kind)
         # durability epilogue: the WAL alone must reproduce the live
-        # state (the chaos --smoke recovery assertion, end-of-run form)
+        # state (the chaos --smoke recovery assertion, end-of-run form).
+        # Tolerant recovery: an injected disk fault earlier in the run
+        # left detected (and already-probed) damage mid-log — the final
+        # replay must deterministically apply the same verifiable set.
         self.wal.close()
         replayed = ResourceStore()
-        replayed.replay_wal(self.wal_path)
+        replayed.recover_wal(self.wal_path)
         live, fresh = self.store.dump_state(), replayed.dump_state()
         rec.replay_matches = live == fresh
         if not rec.replay_matches:
@@ -525,6 +606,7 @@ def run_seed(
         "virtual_s": round(rec.virtual_end, 3),
         "converged": rec.converged,
         "crashes": len(rec.crash_checks),
+        "disk_faults": len(rec.disk_checks),
         "counts": rec.final_counts,
         "violations": violations,
     }

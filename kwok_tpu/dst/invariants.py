@@ -20,7 +20,10 @@ specifies for this repo:
   (``kwok_tpu/cluster/store.py:1307`` resume semantics),
 - Deployment/HPA convergence once faults stop,
 - trace completeness (the audit ring must not have overflowed —
-  a truncated trace must fail loudly, never pass vacuously).
+  a truncated trace must fail loudly, never pass vacuously),
+- recovery honesty (disk-fault recoveries are detected and the
+  recovered state + reported-lost set account for every acked rv —
+  the storage-integrity contract of ``kwok_tpu/cluster/wal.py:1``).
 
 Pluggable: ``INVARIANTS`` maps name → checker; ``run_checks`` runs a
 selection and returns ``{name: [violations]}``.
@@ -44,6 +47,13 @@ def check_single_reconciler(record) -> List[str]:
     open_epochs: Dict[str, bool] = {}  # replica name -> leading now
     last_transitions: Dict[str, int] = {}  # lease -> last elected gen
     for ev in record.trace.events:
+        if ev.action == "disk-recovered":
+            # a lossy storage recovery legitimately rolls Lease state
+            # (and its transition counter) back below what was acked —
+            # the loss was detected and probed; re-baseline instead of
+            # flagging a phantom regression
+            last_transitions.clear()
+            continue
         if ev.action == "elected":
             m = _ELECTED_RE.match(ev.detail)
             if m:
@@ -104,13 +114,14 @@ def check_no_duplicate_reconcile(record) -> List[str]:
     live: Dict[str, set] = {}
     pod_owner: Dict[str, str] = {}
     for ev in record.trace.events:
-        if ev.action == "crash":
-            # the crashed operation committed durably but its
+        if ev.action in ("crash", "disk-recovered"):
+            # crash: the crashed operation committed durably but its
             # completion (and trace line) was lost — the one legal
-            # applied-but-untraced window.  Re-derive from scratch:
-            # stale knowledge here would be a false positive, and a
-            # post-crash undercount only weakens detection, never
-            # fabricates a violation.
+            # applied-but-untraced window.  disk-recovered: a lossy
+            # recovery rolled objects back without DELETED traces.
+            # Either way, re-derive from scratch: stale knowledge here
+            # would be a false positive, and an undercount only
+            # weakens detection, never fabricates a violation.
             target.clear()
             live.clear()
             pod_owner.clear()
@@ -164,6 +175,31 @@ def check_convergence(record) -> List[str]:
     return []
 
 
+def check_recovery_honesty(record) -> List[str]:
+    """Disk-fault recoveries must be *detected* and *honest*: the
+    recovered state plus the reported-lost set together account for
+    every acked resourceVersion (``RunRecord.disk_checks`` probes,
+    evaluated at fault time against the storage-integrity layer's
+    RecoveryReport — ``kwok_tpu/cluster/store.py:2024``)."""
+    out: List[str] = []
+    for i, probe in enumerate(record.disk_checks):
+        if probe["silent_lost"]:
+            out.append(
+                f"disk fault #{i} ({probe['mode']}): acked rvs "
+                f"{probe['silent_lost'][:5]} lost WITHOUT being reported"
+            )
+        if (
+            not probe.get("noop")
+            and not probe["corruptions"]
+            and not probe["torn_tail"]
+        ):
+            out.append(
+                f"disk fault #{i} ({probe['mode']}): injected corruption "
+                "was silently absorbed (no detection signal)"
+            )
+    return out
+
+
 def check_trace_complete(record) -> List[str]:
     if record.audit_overflow:
         return [
@@ -180,6 +216,7 @@ INVARIANTS: Dict[str, Callable] = {
     "watch-rv-monotonic": check_watch_rv_monotonic,
     "convergence": check_convergence,
     "trace-complete": check_trace_complete,
+    "recovery-honesty": check_recovery_honesty,
 }
 
 

@@ -10,10 +10,22 @@ Three levels, mirroring SURVEY §5 "checkpoint/resume":
 - :func:`replay` + :class:`PlaybackHandle` — re-apply the patch stream
   on its original timeline with pause/speed control
   (reference replay + recording/{handle,speed}.go).
+- :class:`PitrArchive` / :func:`boot_recover` — point-in-time recovery
+  over archived snapshots + WAL segments (kwok_tpu/snapshot/pitr.py:1).
 """
 
 from kwok_tpu.snapshot.snapshot import load, save, save_to
 from kwok_tpu.snapshot.record import Recorder
 from kwok_tpu.snapshot.replay import PlaybackHandle, replay
+from kwok_tpu.snapshot.pitr import PitrArchive, boot_recover
 
-__all__ = ["save", "save_to", "load", "Recorder", "replay", "PlaybackHandle"]
+__all__ = [
+    "save",
+    "save_to",
+    "load",
+    "Recorder",
+    "replay",
+    "PlaybackHandle",
+    "PitrArchive",
+    "boot_recover",
+]

@@ -1,0 +1,219 @@
+"""Point-in-time recovery units: byte-identical rebuilds at arbitrary
+retained rvs, the retention floor, boot fallback past a corrupt state
+file, archive pruning, and the DST recovery-honesty checker."""
+
+import json
+import os
+import random
+
+import pytest
+
+from kwok_tpu.chaos import disk_faults
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.cluster.wal import (
+    SnapshotCorruption,
+    WriteAheadLog,
+    write_state_file,
+)
+from kwok_tpu.snapshot.pitr import PitrArchive, boot_recover
+
+
+def pod(name, ns="default"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"nodeName": "n0"},
+        "status": {},
+    }
+
+
+@pytest.fixture
+def scene(tmp_path):
+    """A store with segmented WAL + PITR archive, a seeded workload,
+    and capture points to restore to."""
+    wal_p = str(tmp_path / "wal.jsonl")
+    state_p = str(tmp_path / "state.json")
+    root = str(tmp_path / "pitr")
+    archive = PitrArchive(root)
+    s = ResourceStore()
+    s.attach_wal(
+        WriteAheadLog(wal_p, fsync="off", segment_bytes=1200, archive_dir=root)
+    )
+    captures = {}
+
+    def daemon_save():
+        st = s.dump_state(copy=False)
+        write_state_file(state_p, st)
+        archive.add_snapshot(st)
+        s.compact_wal(int(st["resourceVersion"]))
+
+    for i in range(24):
+        s.create(pod(f"p{i}"))
+        if i == 7:
+            captures["early"] = (s.resource_version, s.dump_state())
+        if i == 11:
+            daemon_save()
+        if i == 16:
+            s.patch(
+                "Pod", "p3", {"status": {"phase": "Running"}},
+                "merge", subresource="status",
+            )
+            s.delete("Pod", "p5")
+            captures["mid"] = (s.resource_version, s.dump_state())
+    daemon_save()
+    s.create(pod("tail-a"))
+    s.create(pod("tail-b"))
+    captures["head"] = (s.resource_version, s.dump_state())
+    return {
+        "store": s,
+        "wal": wal_p,
+        "state": state_p,
+        "archive": archive,
+        "captures": captures,
+    }
+
+
+def test_build_state_byte_identical_at_every_capture(scene):
+    for name, (rv, want) in scene["captures"].items():
+        built, info = scene["archive"].build_state(rv, live_wal=scene["wal"])
+        assert json.dumps(built, sort_keys=True) == json.dumps(
+            want, sort_keys=True
+        ), f"capture {name!r} (rv {rv}) diverged"
+    # the early capture predates every archived snapshot: the rebuild
+    # must fall back to the empty base + full retained history
+    built, info = scene["archive"].build_state(
+        scene["captures"]["early"][0], live_wal=scene["wal"]
+    )
+    assert info["base_rv"] == 0
+
+
+def test_build_state_excludes_types_registered_after_cut(scene):
+    """Review regression: a kind registered after the target rv must
+    not appear in the rebuilt registry (byte-identity includes the
+    type list)."""
+    from kwok_tpu.cluster.store import ResourceType
+
+    rv, want = scene["captures"]["head"]
+    scene["store"].register_type(
+        ResourceType("kwok.x-k8s.io/v1alpha1", "Widget", "widgets")
+    )
+    built, _ = scene["archive"].build_state(rv, live_wal=scene["wal"])
+    assert json.dumps(built, sort_keys=True) == json.dumps(
+        want, sort_keys=True
+    )
+    assert "Widget" not in [t["kind"] for t in built["types"]]
+
+
+def test_build_state_below_retention_floor_refuses(scene):
+    # drop the full-history segments: rv 1 is no longer covered
+    for seg in scene["archive"].segments():
+        os.unlink(seg)
+    with pytest.raises(SnapshotCorruption):
+        scene["archive"].build_state(1, live_wal=scene["wal"])
+
+
+def test_boot_fallback_past_corrupt_state_file(scene):
+    disk_faults.bit_flip(scene["state"], random.Random(11), 0.2, 0.8)
+    fresh = ResourceStore()
+    boot = boot_recover(
+        fresh, scene["state"], scene["wal"], pitr_root=scene["archive"].root
+    )
+    assert boot["fell_back"]
+    assert boot["snapshot_error"]
+    assert fresh.dump_state() == scene["store"].dump_state()
+    assert fresh.snapshot_fallbacks == 1
+
+
+def test_boot_fallback_when_state_file_missing(scene):
+    """Review regression: a MISSING state file (not just a corrupt
+    one) must fall back to the archive — compaction already retired
+    most records behind the archived snapshots, so replaying only the
+    live WAL would silently boot a partial cluster."""
+    os.unlink(scene["state"])
+    fresh = ResourceStore()
+    boot = boot_recover(
+        fresh, scene["state"], scene["wal"], pitr_root=scene["archive"].root
+    )
+    assert boot["fell_back"]
+    assert fresh.dump_state() == scene["store"].dump_state()
+
+
+def test_boot_fresh_when_nothing_anywhere(tmp_path):
+    """First boot (no state file, empty archive, no wal) stays a
+    normal fresh start, not an error."""
+    fresh = ResourceStore()
+    boot = boot_recover(
+        fresh,
+        str(tmp_path / "state.json"),
+        str(tmp_path / "wal.jsonl"),
+        pitr_root=str(tmp_path / "pitr"),
+    )
+    assert not boot["fell_back"]
+    assert boot["snapshot_error"] is None
+    assert fresh.resource_version == 0
+
+
+def test_boot_refuses_when_nothing_verifiable(scene):
+    disk_faults.bit_flip(scene["state"], random.Random(11), 0.2, 0.8)
+    for rv, path in scene["archive"].snapshots():
+        disk_faults.bit_flip(path, random.Random(rv), 0.2, 0.8)
+    with pytest.raises(SnapshotCorruption):
+        boot_recover(
+            ResourceStore(),
+            scene["state"],
+            scene["wal"],
+            pitr_root=scene["archive"].root,
+        )
+
+
+def test_prune_bounds_the_archive(scene):
+    archive = scene["archive"]
+    n_snaps = len(archive.snapshots())
+    assert n_snaps == 2
+    dropped = archive.prune(keep_snapshots=1)
+    assert dropped["snapshots"] == 1
+    assert len(archive.snapshots()) == 1
+    # restores below the kept snapshot are now refused, not wrong
+    kept_rv = archive.snapshots()[0][0]
+    if dropped["segments"]:
+        with pytest.raises(SnapshotCorruption):
+            archive.build_state(1, live_wal=scene["wal"])
+    # ...but the head still rebuilds
+    head_rv, want = scene["captures"]["head"]
+    built, _ = archive.build_state(head_rv, live_wal=scene["wal"])
+    assert json.dumps(built, sort_keys=True) == json.dumps(
+        want, sort_keys=True
+    )
+
+
+def test_recovery_honesty_checker_flags_silent_loss():
+    from kwok_tpu.dst.harness import RunRecord
+    from kwok_tpu.dst.invariants import run_checks
+    from kwok_tpu.dst.trace import Trace
+
+    base = dict(
+        mode="bit-flip",
+        noop=False,
+        reported_lost=[7],
+        silent_lost=[],
+        recovered_rv=10,
+        corruptions=1,
+        torn_tail=0,
+    )
+    ok = RunRecord(seed=0, trace=Trace(), converged=True)
+    ok.replay_matches = True
+    ok.disk_checks = [dict(base)]
+    assert "recovery-honesty" not in run_checks(ok)
+
+    bad = RunRecord(seed=0, trace=Trace(), converged=True)
+    bad.replay_matches = True
+    bad.disk_checks = [dict(base, silent_lost=[9])]
+    assert "recovery-honesty" in run_checks(bad)
+
+    absorbed = RunRecord(seed=0, trace=Trace(), converged=True)
+    absorbed.replay_matches = True
+    absorbed.disk_checks = [
+        dict(base, corruptions=0, torn_tail=0, reported_lost=[])
+    ]
+    assert "recovery-honesty" in run_checks(absorbed)

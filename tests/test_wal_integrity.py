@@ -1,0 +1,454 @@
+"""Storage-integrity units: checksummed/segmented WAL framing, torn
+tail vs mid-log corruption, crash-safe compaction, snapshot checksums,
+the offline fsck verifier, and the online (rv-consistent) snapshot cut
+under concurrent write load."""
+
+import json
+import os
+import random
+import threading
+
+import pytest
+
+from kwok_tpu.chaos import disk_faults
+from kwok_tpu.cluster.store import ResourceStore
+from kwok_tpu.cluster.wal import (
+    SnapshotCorruption,
+    WalCorruption,
+    WriteAheadLog,
+    fsck,
+    read_records,
+    read_state_file,
+    scan,
+    segment_files,
+    write_state_file,
+)
+
+
+def pod(name, ns="default"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"nodeName": "n0"},
+        "status": {},
+    }
+
+
+def wal_store(path, **kw):
+    s = ResourceStore()
+    kw.setdefault("fsync", "off")
+    s.attach_wal(WriteAheadLog(str(path), **kw))
+    return s
+
+
+# ------------------------------------------------- corruption classification
+
+
+def test_corrupt_middle_line_raises_not_skipped(tmp_path):
+    """Regression (the PR-3 reader `continue`d past ANY undecodable
+    line): a damaged MIDDLE record is mid-log corruption and must
+    raise, never be silently conflated with a torn tail."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    for i in range(5):
+        s.create(pod(f"p{i}"))
+    lines = open(wal_path).read().splitlines(True)
+    lines[2] = lines[2][:15] + ("X" if lines[2][15] != "X" else "Y") + lines[2][16:]
+    open(wal_path, "w").writelines(lines)
+    with pytest.raises(WalCorruption):
+        list(read_records(wal_path))
+    with pytest.raises(WalCorruption):
+        ResourceStore().replay_wal(wal_path)
+
+
+def test_recover_wal_reports_exact_missing_rvs(tmp_path):
+    """Tolerant recovery applies every verifiable record (including
+    those AFTER the damage) and names the exact lost rvs."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    for i in range(6):
+        s.create(pod(f"p{i}"))
+    lines = open(wal_path).read().splitlines(True)
+    del lines[3]  # rv 4 vanishes wholesale (a seq gap, no parse debris)
+    open(wal_path, "w").writelines(lines)
+    r = ResourceStore()
+    rep = r.recover_wal(wal_path)
+    assert rep.missing_rvs == [4]
+    assert rep.corruptions  # the seq gap was detected
+    assert rep.applied == 5
+    assert r.count("Pod") == 5  # post-gap records still applied
+    assert r.resource_version == 6
+
+
+def test_torn_tail_is_tolerated_and_bounded(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    s.create(pod("b"))
+    with open(wal_path, "a", encoding="utf-8") as f:
+        f.write('99 deadbeef {"t": "ev", "rv": 3')  # torn (no newline)
+    assert len(list(read_records(wal_path))) == 2  # strict reader tolerates
+    r = ResourceStore()
+    rep = r.recover_wal(wal_path)
+    assert rep.torn_tail == 1
+    assert rep.tail_after_rv == 2  # "writes beyond rv 2 may be lost"
+    assert not rep.missing_rvs
+
+
+def test_append_after_torn_tail_repairs_first(tmp_path):
+    """Latent-bug regression: appending after an unterminated torn
+    tail used to MERGE the next record into the debris, destroying it
+    on the following boot.  Open-for-append now repairs the tail."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    with open(wal_path, "a", encoding="utf-8") as f:
+        f.write('99 deadbeef {"torn": ')  # crash mid-append
+    s2 = ResourceStore()
+    s2.recover_wal(wal_path)
+    s2.attach_wal(WriteAheadLog(wal_path, fsync="off"))
+    s2.create(pod("b"))  # must NOT merge into the torn line
+    r = ResourceStore()
+    assert r.replay_wal(wal_path) == 2
+    assert r.count("Pod") == 2
+
+
+def test_repair_survives_oversized_torn_tail(tmp_path):
+    """Review regression: a torn line larger than the repair scan
+    window must not truncate the whole log to zero — earlier acked
+    records stay intact."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    s.create(pod("b"))
+    with open(wal_path, "a", encoding="utf-8") as f:
+        f.write("3 deadbeef " + "x" * (2 << 20))  # 2MB torn line, no \n
+    WriteAheadLog(wal_path, fsync="off").close()  # open repairs
+    r = ResourceStore()
+    assert r.replay_wal(wal_path) == 2
+    assert r.count("Pod") == 2
+
+
+def test_seq_continues_from_archive_after_full_compaction(tmp_path):
+    """Review regression: after compaction retired every segment into
+    the archive and the process restarted, sequence numbering must
+    continue from the archived tail — a restart at seq 1 reads as a
+    sequence gap to fsck --archive and the PITR rebuild."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    arch = str(tmp_path / "arch")
+    state = str(tmp_path / "state.json")
+    s = wal_store(wal_path, archive_dir=arch)
+    for i in range(5):
+        s.create(pod(f"p{i}"))
+    s.save_file(state)  # everything archived; live log empty
+    # daemon restart: fresh log object over the same paths
+    s2 = ResourceStore()
+    s2.load_file(state)
+    s2.recover_wal(wal_path)
+    s2.attach_wal(WriteAheadLog(wal_path, fsync="off", archive_dir=arch))
+    s2.create(pod("post"))
+    rep = fsck(wal_path, snapshot=state, archive=arch)
+    assert rep["ok"], rep
+    assert not rep["corruptions"]
+
+
+def test_legacy_bare_json_lines_still_readable(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    with open(wal_path, "w", encoding="utf-8") as f:
+        f.write('{"t": "ev", "rv": 1, "u": 1, "e": "ADDED", "o": '
+                + json.dumps(pod("old")) + "}\n")
+    r = ResourceStore()
+    assert r.replay_wal(wal_path) == 1
+    assert r.count("Pod") == 1
+    assert scan(wal_path).legacy == 1
+
+
+# ------------------------------------------------------------- segmentation
+
+
+def test_segment_rotation_and_replay(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path, segment_bytes=1200)
+    for i in range(30):
+        s.create(pod(f"p{i}"))
+    assert len(segment_files(wal_path)) > 2  # rotation happened
+    live = s.dump_state()
+    r = ResourceStore()
+    r.replay_wal(wal_path)
+    assert r.dump_state() == live
+
+
+def test_sequence_numbers_resume_across_reopen(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    s2 = ResourceStore()
+    s2.recover_wal(wal_path)
+    s2.attach_wal(WriteAheadLog(wal_path, fsync="off"))
+    s2.create(pod("b"))
+    rep = scan(wal_path)
+    assert rep.clean
+    seqs = [q for q in rep.seqs if q is not None]
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+
+
+# -------------------------------------------------------- compaction safety
+
+
+def test_compact_archives_covered_segments(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    arch = str(tmp_path / "arch")
+    state = str(tmp_path / "state.json")
+    s = wal_store(wal_path, segment_bytes=1200, archive_dir=arch)
+    for i in range(30):
+        s.create(pod(f"p{i}"))
+    s.save_file(state)
+    assert list(read_records(wal_path)) == []  # fully covered -> retired
+    assert os.listdir(arch)  # ...into the archive, not the void
+    s.create(pod("post"))
+    live = s.dump_state()
+    r = ResourceStore()
+    r.load_file(state)
+    r.replay_wal(wal_path)
+    assert r.dump_state() == live
+
+
+@pytest.mark.parametrize(
+    "phase",
+    ["compact-begin", "compact-sealed", "compact-mid-archive", "compact-done"],
+)
+def test_compact_crash_never_loses_precompaction_log(tmp_path, phase):
+    """A crash at ANY compaction phase leaves snapshot + live log
+    covering everything (sealed segments are renamed whole — there is
+    no rewrite window to die inside)."""
+
+    class Crash(BaseException):
+        pass
+
+    wal_path = str(tmp_path / "wal.jsonl")
+    state = str(tmp_path / "state.json")
+    s = ResourceStore()
+    wal = WriteAheadLog(
+        wal_path, fsync="off", segment_bytes=700,
+        archive_dir=str(tmp_path / "arch"),
+    )
+    s.attach_wal(wal)
+    for i in range(20):
+        s.create(pod(f"p{i}"))
+    live = s.dump_state()
+
+    def hook(ph):
+        if ph == phase:
+            raise Crash(ph)
+
+    wal.set_crash_hook(hook)
+    with pytest.raises(Crash):
+        s.save_file(state)
+    r = ResourceStore()
+    if os.path.exists(state):
+        r.load_file(state)
+    r.replay_wal(wal_path)
+    assert r.dump_state() == live
+
+
+def test_stale_reset_in_straddling_segment_does_not_wipe_snapshot(tmp_path):
+    """Segments are retired whole, so a straddling segment can retain
+    a reset record the snapshot already covers — replay must skip it,
+    not wipe snapshot-loaded objects whose re-ADD records were
+    legitimately compacted away."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    state = str(tmp_path / "state.json")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    s.restore_state(s.dump_state())  # reset record lands in the log
+    s.create(pod("b"))
+    s.create(pod("c"))
+    write_state_file(state, s.dump_state())  # snapshot covers the reset
+    s.create(pod("d"))  # rv 4 keeps the sealed segment straddling
+    s.compact_wal(3)
+    live = s.dump_state()
+    r = ResourceStore()
+    r.load_file(state)
+    r.replay_wal(wal_path)
+    assert r.dump_state() == live
+    assert r.count("Pod") == 4
+
+
+# --------------------------------------------------------- snapshot integrity
+
+
+def test_state_file_checksum_roundtrip_and_detection(tmp_path):
+    state = str(tmp_path / "state.json")
+    s = ResourceStore()
+    s.create(pod("a"))
+    write_state_file(state, s.dump_state())
+    assert read_state_file(state)["resourceVersion"] == 1
+    r = ResourceStore()
+    assert r.load_file(state) == 1
+    # a flipped bit inside the payload must be DETECTED at load
+    disk_faults.bit_flip(state, random.Random(7), 0.3, 0.7)
+    with pytest.raises(SnapshotCorruption):
+        read_state_file(state)
+    with pytest.raises(SnapshotCorruption):
+        ResourceStore().load_file(state)
+
+
+# ------------------------------------------------------------------- fsck
+
+
+def test_fsck_clean_and_corrupt(tmp_path):
+    wal_path = str(tmp_path / "wal.jsonl")
+    state = str(tmp_path / "state.json")
+    s = wal_store(wal_path)
+    for i in range(6):
+        s.create(pod(f"p{i}"))
+    write_state_file(state, s.dump_state())
+    rep = fsck(wal_path, snapshot=state)
+    assert rep["ok"] and not rep["missing_rvs"]
+    disk_faults.bit_flip_line(wal_path, random.Random(3), exclude_last=True)
+    rep = fsck(wal_path, snapshot=state)
+    assert not rep["ok"]
+    assert rep["corruptions"] or rep["missing_rv_count"]
+
+
+def test_fsck_cli_exit_codes(tmp_path, capsys):
+    from kwok_tpu.cluster.wal import main
+
+    wal_path = str(tmp_path / "wal.jsonl")
+    s = wal_store(wal_path)
+    s.create(pod("a"))
+    assert main(["--fsck", wal_path]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["records"] == 1
+    disk_faults.bit_flip_line(wal_path, random.Random(5), exclude_last=False)
+    # a single-line log: the flip hits the only line; either torn-tail
+    # (final line) handling or corruption — add a second record to pin
+    s2 = wal_store(str(tmp_path / "w2.jsonl"))
+    s2.create(pod("a"))
+    s2.create(pod("b"))
+    disk_faults.bit_flip_line(
+        str(tmp_path / "w2.jsonl"), random.Random(5), exclude_last=True
+    )
+    assert main(["--fsck", str(tmp_path / "w2.jsonl")]) == 1
+
+
+# ------------------------------------------------- snapshot under write load
+
+
+def test_snapshot_under_load_is_rv_consistent(tmp_path):
+    """Satellite: the online snapshot cut under concurrent bulk-lane
+    writers must be rv-consistent — no object newer than the cut rv,
+    none missing below it.  Proven the strong way: the WAL replayed up
+    to the cut rv reproduces the snapshot byte-identically."""
+    wal_path = str(tmp_path / "wal.jsonl")
+    state = str(tmp_path / "state.json")
+    s = wal_store(wal_path, segment_bytes=4096)
+    stop = threading.Event()
+    errs = []
+
+    def writer(w):
+        i = 0
+        while not stop.is_set():
+            try:
+                s.bulk(
+                    [
+                        {"verb": "create", "data": pod(f"w{w}-{i}-{j}")}
+                        for j in range(3)
+                    ]
+                    + [
+                        {
+                            "verb": "patch",
+                            "kind": "Pod",
+                            "name": f"w{w}-{i}-0",
+                            "data": {"status": {"phase": "Running"}},
+                            "subresource": "status",
+                        }
+                    ]
+                )
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    snaps = []
+    for _ in range(10):
+        s.save_file(state)
+        snaps.append(read_state_file(state))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errs, errs[0]
+    s.save_file(state)  # final compacting save for archive hygiene
+
+    # every mid-flight cut: nothing newer than its cut rv, keys unique
+    for snap in snaps:
+        cut_rv = int(snap["resourceVersion"])
+        for obj in snap["objects"]:
+            assert int(obj["metadata"]["resourceVersion"]) <= cut_rv
+        keys = [
+            (o["metadata"].get("namespace"), o["metadata"]["name"])
+            for o in snap["objects"]
+        ]
+        assert len(keys) == len(set(keys))
+
+
+def test_snapshot_under_load_matches_wal_replay(tmp_path):
+    """The "none missing below the cut" half, proven the strong way:
+    with the final snapshot removed from the archive, an rv-filtered
+    replay from an EARLIER base over archived + live WAL records must
+    land byte-identically on the final cut — any object the cut missed
+    (or tore) would diverge."""
+    from kwok_tpu.snapshot.pitr import PitrArchive
+
+    wal_path = str(tmp_path / "wal.jsonl")
+    state = str(tmp_path / "state.json")
+    arch = str(tmp_path / "arch")
+    s = wal_store(wal_path, segment_bytes=4096, archive_dir=arch)
+    stop = threading.Event()
+    errs = []
+
+    def writer(w):
+        i = 0
+        while not stop.is_set():
+            try:
+                s.bulk(
+                    [
+                        {"verb": "create", "data": pod(f"w{w}-{i}-{j}")}
+                        for j in range(3)
+                    ]
+                )
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    archive = PitrArchive(arch)
+    for _ in range(6):
+        st = s.dump_state(copy=False)
+        write_state_file(state, st)
+        archive.add_snapshot(st)
+        s.compact_wal(int(st["resourceVersion"]))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errs, errs[0]
+
+    snap = read_state_file(state)
+    cut_rv = int(snap["resourceVersion"])
+    # drop the final archived snapshot so the rebuild starts from an
+    # EARLIER base and must genuinely replay records up to the cut
+    os.unlink(archive.snapshots()[-1][1])
+    built, info = archive.build_state(cut_rv, live_wal=wal_path)
+    assert info["base_rv"] < cut_rv
+    assert info["applied_records"] > 0
+    snap.pop("integrity", None)
+    assert json.dumps(built, sort_keys=True) == json.dumps(
+        snap, sort_keys=True
+    )

@@ -23,6 +23,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/ "${PYTEST_ARGS[@]}"
 if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== chaos smoke (seeded faults -> WAL recovery, zero lost writes) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --smoke --pods "${CHAOS_PODS:-40}"
+    echo "== corruption smoke (seeded disk faults -> detected, bounded, honest recovery) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --corruption-smoke
     echo "== overload smoke (best-effort flood -> 429s, canary unharmed) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --overload-smoke \
         --flood-seconds "${OVERLOAD_SECONDS:-2}"
