@@ -48,7 +48,7 @@ PER_FILE_RULES = frozenset(
 )
 
 #: bump when any rule's semantics change — invalidates the on-disk cache
-CACHE_VERSION = 6
+CACHE_VERSION = 7
 
 
 def repo_root(start: Optional[str] = None) -> str:

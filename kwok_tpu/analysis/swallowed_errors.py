@@ -22,6 +22,20 @@ excluded — it runs on some other stack):
   ``KeyboardInterrupt``/``SystemExit`` too, which is how a daemon
   becomes unkillable; flagged regardless of what the body does.
 
+A third trigger covers the *storage* paths regardless of loop
+context (the resource-exhaustion lesson: five ``except OSError``
+sites in the first-generation WAL absorbed ENOSPC/EIO, which is how
+a full disk silently acks writes — the fsyncgate failure class):
+
+- **swallowed-os-error**: inside ``cluster/wal.py``,
+  ``cluster/store.py`` and ``kwok_tpu/snapshot/``, an ``except
+  OSError`` (or ``IOError``/``EnvironmentError``, incl. tuples
+  containing them) whose body only ``pass``es / ``continue``s /
+  ``return``s a constant is flagged anywhere in the file.  Classify
+  and count the error (``cluster/wal.py`` ``classify_os_error`` /
+  ``_note_os_error``) or suppress with the reason tolerance is
+  correct.
+
 ``# kwoklint: disable=swallowed-errors`` plus a reason comment is the
 escape hatch, same as every other rule.
 """
@@ -34,6 +48,18 @@ from typing import Iterable, List
 from kwok_tpu.analysis import Finding, SourceFile
 
 RULE = "swallowed-errors"
+
+#: files whose OSError handling IS the durability story: a swallowed
+#: ENOSPC here is a silently-lost acked write, so the stricter
+#: variant applies file-wide, not just inside daemon loops
+STORAGE_PATHS = (
+    "kwok_tpu/cluster/wal.py",
+    "kwok_tpu/cluster/store.py",
+    "kwok_tpu/snapshot/",
+)
+
+#: exception names treated as the OS-error family
+_OS_ERROR_NAMES = {"OSError", "IOError", "EnvironmentError"}
 
 
 def _iter_loop_statements(loop: ast.While):
@@ -95,6 +121,66 @@ def _check_try(sf: SourceFile, node: ast.Try) -> List[Finding]:
     return findings
 
 
+def _handler_names(handler: ast.ExceptHandler) -> List[str]:
+    t = handler.type
+    if t is None:
+        return []
+    elems = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for e in elems:
+        if isinstance(e, ast.Name):
+            out.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.append(e.attr)
+    return out
+
+
+def _swallow_only(body: List[ast.stmt]) -> bool:
+    """True when the handler body only drops the error on the floor:
+    pass / continue / bare-or-constant return (no call, no logging,
+    no counter)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+            stmt.value is None
+            or isinstance(
+                stmt.value, (ast.Constant, ast.Name, ast.List, ast.Dict)
+            )
+        ):
+            continue
+        return False
+    return True
+
+
+def _check_storage_os_error(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            names = _handler_names(handler)
+            if not any(n in _OS_ERROR_NAMES for n in names):
+                continue
+            if _swallow_only(handler.body):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=handler.lineno,
+                        message=(
+                            "OSError swallowed in a storage path — a "
+                            "dropped ENOSPC/EIO here is a silently-"
+                            "lost acked write; classify + count it "
+                            "(cluster/wal.py classify_os_error / "
+                            "_note_os_error) or suppress with the "
+                            "reason tolerance is correct"
+                        ),
+                    )
+                )
+    return findings
+
+
 def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
     findings: List[Finding] = []
     for sf in files:
@@ -108,4 +194,17 @@ def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
                 if isinstance(stmt, ast.Try) and id(stmt) not in seen:
                     seen.add(id(stmt))
                     findings.extend(_check_try(sf, stmt))
-    return findings
+        if any(
+            sf.path == p or sf.path.startswith(p) for p in STORAGE_PATHS
+        ):
+            findings.extend(_check_storage_os_error(sf))
+    # a storage-path `except OSError: pass` inside a daemon loop trips
+    # both variants with different messages; one handler line is one
+    # defect, so key on position alone (first message wins)
+    uniq, out = set(), []
+    for f in findings:
+        key = (f.path, f.line)
+        if key not in uniq:
+            uniq.add(key)
+            out.append(f)
+    return out

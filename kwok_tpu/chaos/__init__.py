@@ -20,6 +20,11 @@ by one deterministic seeded :class:`~kwok_tpu.chaos.plan.FaultPlan`:
 - **store commit path**: ``ResourceStore.set_crash_hook`` fires at the
   before-/after-commit boundaries so WAL recovery is testable at the
   exact instants a crash hurts.
+- **storage exhaustion** (:mod:`kwok_tpu.chaos.fs_pressure`): seeded
+  disk-full / fsync-error / quota windows against the WAL's own
+  syscalls (the disk *refuses*; :mod:`kwok_tpu.chaos.disk_faults` is
+  the disk *lying*), driving degraded read-only mode, the emergency
+  reserve, and the re-arm probe end to end.
 
 Profiles are YAML (``kwokctl create cluster --chaos-profile`` wires
 them into the apiserver daemon); ``python -m kwok_tpu.chaos`` is the
@@ -40,6 +45,11 @@ from kwok_tpu.chaos.http_faults import (  # noqa: F401
     HttpFaultInjector,
     OverloadDriver,
 )
+from kwok_tpu.chaos.fs_pressure import (  # noqa: F401
+    EXHAUSTION_KINDS,
+    FsPressure,
+    PressureDriver,
+)
 
 __all__ = [
     "DiskFaultSpec",
@@ -51,4 +61,7 @@ __all__ = [
     "load_profile",
     "HttpFaultInjector",
     "OverloadDriver",
+    "EXHAUSTION_KINDS",
+    "FsPressure",
+    "PressureDriver",
 ]

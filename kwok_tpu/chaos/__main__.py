@@ -33,6 +33,14 @@ Three modes over one seeded profile
   reported-lost set account for every acked write), and
   point-in-time recovery rebuilds a mid-run capture byte-identically.
   tools/check.sh runs this on every check too.
+- ``--exhaustion-smoke``  self-contained resource-exhaustion check:
+  seeded disk-full/fsync-error windows (:mod:`kwok_tpu.chaos.fs_pressure`)
+  against a live apiserver+WAL.  Asserts degraded read-only mode
+  (mutations 503+Retry-After with reason StorageDegraded; reads,
+  watches and lease renewals stay live via the emergency reserve),
+  /healthz-alive with zero supervisor restarts, re-arm on space
+  return, and — after a crash — that durable ∪ visibly-rejected
+  accounts for every acked write.  tools/check.sh runs this too.
 - ``--failover-smoke``  self-contained HA check: three leader electors
   (cluster/election.py) on one APF-armed apiserver.  Asserts a single
   leader at a time, bounded takeover (2x leaseDuration after a silent
@@ -483,6 +491,276 @@ def run_corruption_smoke(seed: int = 42, pods: int = 24) -> dict:
     }
 
 
+def run_exhaustion_smoke(seed: int = 42, pods: int = 16) -> dict:
+    """In-process resource-exhaustion smoke: seeded disk-full and
+    fsync-error windows against a live apiserver+WAL.  Asserts the
+    acceptance contract of the degraded read-only mode:
+
+    - zero silently-lost acked writes: after a crash at the end,
+      durable-after-recovery ∪ visibly-rejected accounts for every ack
+      (the ``RecoveryReport.account`` predicate, same as the DST
+      ``exhaustion-honesty`` invariant);
+    - during a window, mutations are refused with 503 + Retry-After +
+      machine-readable reason StorageDegraded while reads, watches and
+      lease renewals (via the emergency reserve) stay live;
+    - /healthz stays 200 and the component supervisor performs ZERO
+      restarts (degraded is tracked, not "fixed");
+    - writes re-arm once pressure clears (``wait_writable``), and the
+      degraded-aware client retry rides the window out.
+    """
+    import random
+    import threading
+
+    from kwok_tpu.chaos.fs_pressure import FsPressure
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import APIError, ClusterClient, RetryPolicy
+    from kwok_tpu.cluster.election import LeaderElector
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.cluster.wal import WriteAheadLog
+    from kwok_tpu.ctl.runtime import ComponentSupervisor
+    from kwok_tpu.snapshot.pitr import boot_recover
+    from kwok_tpu.utils.backoff import Backoff
+
+    rng = random.Random(seed)
+    t_start = time.monotonic()
+
+    def fail(msg):
+        raise SystemExit(f"exhaustion smoke FAILED: {msg}")
+
+    def pod(n):
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": n, "namespace": "default"},
+            "spec": {"nodeName": f"node-{rng.randrange(4)}"},
+            "status": {},
+        }
+
+    class _LiveRuntime:
+        """In-process runtime stub over the live server: alive, never
+        restartable — start_component firing at all IS the failure."""
+
+        def __init__(self, client):
+            self._client = client
+            self.restarts = 0
+
+        def load_components(self):
+            from kwok_tpu.ctl.components import Component
+
+            return [Component(name="apiserver", args=[])]
+
+        def component_alive(self, name):
+            return True
+
+        def start_component(self, comp):
+            self.restarts += 1
+
+        def client(self, timeout=2.0):
+            return self._client
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        wal_p = os.path.join(tmp, "wal.jsonl")
+        store = ResourceStore()
+        wal = WriteAheadLog(wal_p, fsync="always")
+        store.attach_wal(wal)
+        acked: set = set()
+
+        def track(fn, *a, **kw):
+            rv0 = store.resource_version
+            out = fn(*a, **kw)
+            acked.update(range(rv0 + 1, store.resource_version + 1))
+            return out
+
+        with APIServer(store) as srv:
+            client = ClusterClient(
+                srv.url,
+                retry=RetryPolicy(
+                    seed=seed,
+                    max_attempts=20,
+                    budget_s=30.0,
+                    backoff=Backoff(duration=0.02, cap=0.2),
+                    # production clients honor the degraded Retry-After
+                    # (~5s); the smoke polls fast so the whole gate
+                    # stays inside check.sh's budget
+                    honor_retry_after=False,
+                ),
+                client_id="kwokctl",
+            )
+            # raw client sees the 503s instead of retrying them
+            raw = ClusterClient(
+                srv.url,
+                retry=RetryPolicy(
+                    max_attempts=1,
+                    budget_s=5.0,
+                    backoff=Backoff(duration=0.0, cap=0.0),
+                    retry_statuses=(),
+                ),
+                client_id="exhaustion-raw",
+            )
+            elector = LeaderElector(
+                ClusterClient(srv.url, client_id="system:smoke"),
+                "kwok-controller",
+                "smoke-replica",
+                lease_duration=30.0,
+                rng=random.Random(seed),
+            )
+            elector.try_acquire_or_renew()
+            if not elector.is_leader():
+                fail("elector never acquired its lease pre-window")
+            rt = _LiveRuntime(client)
+            sup = ComponentSupervisor(rt, rng=random.Random(seed))
+
+            for i in range(pods):
+                track(client.create, pod(f"pre-{i}"))
+            watcher = client.watch("Lease", namespace="kube-system")
+
+            def run_window(kind, tag, t0):
+                # t0: per-window supervisor time base — ticks must stay
+                # monotonic across windows (the supervisor's budget
+                # bookkeeping assumes a forward clock)
+                shim = FsPressure(kind)
+                wal.set_pressure(shim)
+                # the in-flight write rides the reserve: acked + durable
+                track(raw.create, pod(f"{tag}-inflight"))
+                if store.storage_degraded() is None:
+                    fail(f"{kind}: window did not degrade storage")
+                okz, reason = client.readiness()
+                if okz or reason != "StorageDegraded":
+                    fail(f"{kind}: /readyz did not report degraded "
+                         f"({okz}, {reason})")
+                if not client.healthy():
+                    fail(f"{kind}: /healthz went down — degraded must "
+                         "stay alive")
+                rejected = 0
+                for i in range(4):
+                    try:
+                        raw.create(pod(f"{tag}-rej-{i}"))
+                        fail(f"{kind}: mutation acked while degraded")
+                    except APIError as exc:
+                        if exc.code != 503 or exc.reason != "StorageDegraded":
+                            fail(
+                                f"{kind}: rejection was {exc.code}/"
+                                f"{exc.reason}, want 503/StorageDegraded"
+                            )
+                        rejected += 1
+                if not rejected:
+                    fail(f"{kind}: no visible rejections in the window")
+                # Retry-After must ride the 503 (parseable back-off)
+                import http.client as hc
+
+                host, port = srv.address
+                c = hc.HTTPConnection(host, port, timeout=5)
+                c.request(
+                    "POST",
+                    "/r/pods",
+                    body=json.dumps(pod(f"{tag}-ra")),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = c.getresponse()
+                resp.read()
+                if resp.status != 503 or not resp.getheader("Retry-After"):
+                    fail(f"{kind}: 503 without Retry-After")
+                c.close()
+                # lease renewals ride the reserve: HA must not collapse
+                rv0 = store.resource_version
+                for _ in range(3):
+                    elector.renew_once()
+                if not elector.is_leader():
+                    fail(f"{kind}: leader lost its lease in the window")
+                acked.update(range(rv0 + 1, store.resource_version + 1))
+                # reads and watches stay live
+                items, _ = client.list("Pod")
+                if not items:
+                    fail(f"{kind}: reads went dark while degraded")
+                ev = watcher.next(timeout=5.0)
+                if ev is None:
+                    fail(f"{kind}: watch stream starved while degraded")
+                # supervisor: degraded is tracked, never restarted
+                for t in (0.0, 0.5, 1.0, 1.5):
+                    sup.tick(now=t0 + t)
+                if rt.restarts:
+                    fail(f"{kind}: supervisor restarted a degraded "
+                         "component")
+                if sup.degraded.get("apiserver") != "StorageDegraded":
+                    fail(f"{kind}: supervisor did not track degraded "
+                         f"state ({sup.degraded})")
+                # degraded-aware retry: a retrying client rides it out
+                done = {}
+
+                def late_write():
+                    done["obj"] = client.create(pod(f"{tag}-retried"))
+
+                th = threading.Thread(target=late_write, daemon=True)
+                th.start()
+                time.sleep(0.3)
+                wal.set_pressure(None)
+                if not client.wait_writable(timeout=10.0):
+                    fail(f"{kind}: writes never re-armed after the "
+                         "window cleared")
+                th.join(timeout=10.0)
+                if th.is_alive() or "obj" not in done:
+                    fail(f"{kind}: retrying client never converged "
+                         "after re-arm")
+                rv = int(
+                    (done["obj"].get("metadata") or {}).get(
+                        "resourceVersion", 0
+                    )
+                )
+                acked.add(rv)
+                # post-window writes flow normally again
+                track(client.create, pod(f"{tag}-post"))
+                for t in (2.0, 2.5):
+                    sup.tick(now=t0 + t)
+                if sup.degraded:
+                    fail(f"{kind}: supervisor still sees degraded "
+                         "after re-arm")
+                return {
+                    "rejected": rejected,
+                    "retry_stats": client.retry_stats(),
+                    "shim": shim.snapshot(),
+                }
+
+            results["disk-full"] = run_window("disk-full", "df", t0=0.0)
+            results["fsync-error"] = run_window(
+                "fsync-error", "fe", t0=100.0
+            )
+            if client.retry_stats()["degraded"] == 0:
+                fail("degraded retries were never counted distinctly")
+            watcher.stop()
+            elector.stop(release=True)
+            live = store.dump_state()
+
+        # crash: recover from the WAL alone; every ack must be
+        # accounted durable (nothing was reported lost, nothing silent)
+        wal.close()
+        fresh = ResourceStore()
+        boot = boot_recover(fresh, None, wal_p)
+        rep = boot["recovery"]
+        if rep is None:
+            fail("no recovery report from boot_recover")
+        reported, silent = rep.account(acked)
+        if silent:
+            fail(f"acked rvs {silent[:10]} lost WITHOUT report")
+        if reported:
+            fail(
+                f"acked rvs {reported[:10]} reported lost — exhaustion "
+                "windows must not lose acked writes at all"
+            )
+        if fresh.dump_state() != live:
+            fail("post-crash recovery diverged from live state")
+
+    return {
+        "seed": seed,
+        "acked_writes": len(acked),
+        "windows": results,
+        "rearms": 2,
+        "supervisor_restarts": 0,
+        "silently_lost_acked_writes": 0,
+        "total_s": round(time.monotonic() - t_start, 3),
+    }
+
+
 def run_overload_smoke(
     seed: int = 42, duration: float = 2.0
 ) -> dict:
@@ -827,6 +1105,15 @@ def build_parser() -> argparse.ArgumentParser:
         "PITR byte-identical (used by tools/check.sh)",
     )
     p.add_argument(
+        "--exhaustion-smoke",
+        action="store_true",
+        help="run the in-process resource-exhaustion smoke: seeded "
+        "disk-full/fsync-error windows -> degraded read-only mode "
+        "(503+Retry-After, reads/watches/lease renewals live, zero "
+        "supervisor restarts), re-arm on space return, zero "
+        "silently-lost acked writes (used by tools/check.sh)",
+    )
+    p.add_argument(
         "--failover-smoke",
         action="store_true",
         help="run the in-process leader-election failover smoke: "
@@ -924,6 +1211,13 @@ def main(argv=None) -> int:
         return 0
     if args.corruption_smoke:
         report = run_corruption_smoke(
+            seed=args.seed if args.seed is not None else 42,
+            pods=args.pods,
+        )
+        print(json.dumps(report))
+        return 0
+    if args.exhaustion_smoke:
+        report = run_exhaustion_smoke(
             seed=args.seed if args.seed is not None else 42,
             pods=args.pods,
         )

@@ -214,6 +214,20 @@ class DiskFaultDriver:
         return wal_path(self.runtime.workdir)
 
     def _apply(self, spec, now: float) -> None:
+        if spec.kind not in DISK_FAULT_KINDS:
+            # exhaustion windows (disk-full/fsync-error/quota) are
+            # armed INSIDE the apiserver daemon (chaos/fs_pressure.py
+            # PressureDriver): pressure must hit the process that owns
+            # the file handles, not the files from outside
+            self.events.append(
+                {
+                    "t": round(now, 3),
+                    "kind": spec.kind,
+                    "target": spec.target,
+                    "armed": "in-daemon",
+                }
+            )
+            return
         path = self._target_path(spec.target)
         info: Dict[str, int] = {"offset": -1}
         try:

@@ -64,6 +64,11 @@ PROCESS_ACTIONS = ("kill", "stop", "restart", "leader-kill")
 # kind is automatically valid in profiles
 from kwok_tpu.chaos.disk_faults import DISK_FAULT_KINDS  # noqa: E402
 
+# exhaustion vocabulary (the disk *refuses* instead of lying):
+# disk-full / fsync-error / quota windows, armed inside the apiserver
+# daemon against its own WAL handles (kwok_tpu.chaos.fs_pressure)
+from kwok_tpu.chaos.fs_pressure import EXHAUSTION_KINDS  # noqa: E402
+
 DISK_TARGETS = ("wal", "snapshot")
 
 
@@ -185,31 +190,58 @@ class HttpFaultSpec:
 @dataclass(frozen=True)
 class DiskFaultSpec:
     """One scheduled storage fault against the cluster's WAL or
-    snapshot files (kwok_tpu.chaos.disk_faults applies it; the exact
-    byte offset is drawn from the plan seed at injection time, so
-    ``--print-schedule`` shows when/what and the run stays
-    reproducible)."""
+    snapshot files.  Corruption kinds (bit-flip / truncate / torn-write
+    / fsync-crash) are point faults kwok_tpu.chaos.disk_faults applies
+    from outside (the exact byte offset is drawn from the plan seed at
+    injection time); exhaustion kinds (disk-full / fsync-error / quota)
+    are *windows* — ``duration`` seconds of refused syscalls — armed
+    inside the apiserver daemon via kwok_tpu.chaos.fs_pressure.  Either
+    way ``--print-schedule`` shows when/what and the run stays
+    reproducible."""
 
     at: float
     kind: str  # bit-flip | truncate | torn-write | fsync-crash
+    #           | disk-full | fsync-error | quota
     target: str = "wal"  # wal | snapshot
+    #: window length for exhaustion kinds (ignored by point faults)
+    duration: float = 0.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "DiskFaultSpec":
         kind = str(d.get("kind") or "bit-flip")
-        if kind not in DISK_FAULT_KINDS:
+        if kind not in DISK_FAULT_KINDS + EXHAUSTION_KINDS:
             raise ValueError(
-                f"disk fault kind {kind!r} not in {DISK_FAULT_KINDS}"
+                f"disk fault kind {kind!r} not in "
+                f"{DISK_FAULT_KINDS + EXHAUSTION_KINDS}"
             )
         target = str(d.get("target") or "wal")
         if target not in DISK_TARGETS:
             raise ValueError(
                 f"disk fault target {target!r} not in {DISK_TARGETS}"
             )
-        return cls(at=float(d.get("at", 0.0)), kind=kind, target=target)
+        if kind in EXHAUSTION_KINDS and target != "wal":
+            raise ValueError(
+                f"exhaustion fault {kind!r} only targets the wal"
+            )
+        duration = float(d.get("duration", 0.0))
+        if kind in EXHAUSTION_KINDS and duration <= 0:
+            # a zero-length window installs and removes the shim in the
+            # same instant — a fault that "ran" without testing anything
+            raise ValueError(
+                f"exhaustion fault {kind!r} needs a positive duration"
+            )
+        return cls(
+            at=float(d.get("at", 0.0)),
+            kind=kind,
+            target=target,
+            duration=duration,
+        )
 
     def to_dict(self) -> dict:
-        return {"at": self.at, "kind": self.kind, "target": self.target}
+        out = {"at": self.at, "kind": self.kind, "target": self.target}
+        if self.kind in EXHAUSTION_KINDS:
+            out["duration"] = self.duration
+        return out
 
 
 @dataclass(frozen=True)

@@ -147,19 +147,27 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------- plumbing
 
-    def _send_json(self, code: int, payload) -> None:
+    def _send_json(self, code: int, payload, retry_after=None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def _send_error(self, exc: Exception) -> None:
         # same exception→code mapping as the k8s Status path, rendered
-        # in the legacy body shape clients of this dialect expect
+        # in the legacy body shape clients of this dialect expect.
+        # Degraded read-only rejections carry Retry-After, same as the
+        # APF shed path — a parseable back-off signal, never a bare 503
         code, reason = error_code_reason(exc)
-        self._send_json(code, {"error": str(exc), "reason": reason})
+        self._send_json(
+            code,
+            {"error": str(exc), "reason": reason},
+            retry_after=getattr(exc, "retry_after", None),
+        )
 
     def _read_body(self):
         length = int(self.headers.get("Content-Length") or 0)
@@ -360,8 +368,30 @@ class _Handler(BaseHTTPRequestHandler):
         if head in _K8S_HEADS and self.server.k8s.handle(self, "GET", head, rest, q):
             return
         try:
-            if head == "healthz" or head == "readyz" or head == "livez":
+            if head == "healthz" or head == "livez":
+                # liveness: the process is up and serving.  Deliberately
+                # NOT readiness — a daemon on a full disk is alive, and
+                # the supervisor must not restart-loop it (a restart
+                # cannot fix the disk)
                 self._send_json(200, {"status": "ok"})
+            elif head == "readyz":
+                # readiness: liveness AND storage can accept writes.
+                # Split from /healthz so degraded mode is visible to
+                # kwokctl / the supervisor without reading as "crashed";
+                # polling it doubles as the throttled re-arm probe.
+                deg = self.store.storage_degraded()
+                if deg is None:
+                    self._send_json(200, {"status": "ok"})
+                else:
+                    self._send_json(
+                        503,
+                        {
+                            "status": "degraded",
+                            "reason": "StorageDegraded",
+                            "storage": deg,
+                        },
+                        retry_after=5,
+                    )
             elif head == "metrics":
                 # per-priority-level flow-control gauges + watch
                 # eviction counters, Prometheus text format
@@ -774,6 +804,12 @@ class APIServer:
     def flow(self):
         """The attached FlowController (None when admission is off)."""
         return self._httpd.flow
+
+    def ensure_namespaces(self) -> None:
+        """Re-run the bootstrap namespace creation (idempotent) — the
+        daemon calls this when degraded storage re-arms, because a boot
+        onto a full disk skipped it (K8sFacade.ensure_namespaces)."""
+        self._httpd.k8s.ensure_namespaces()
 
     def set_fault_injector(self, injector) -> None:
         """Attach/detach (None) the chaos fault injector on a live

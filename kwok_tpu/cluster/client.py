@@ -128,6 +128,16 @@ NO_RETRY = RetryPolicy(
     max_attempts=2, budget_s=1.0, backoff=Backoff(duration=0.0, cap=0.0)
 )
 
+#: readiness probes must SEE the 503, not retry it — a degraded
+#: apiserver answers /readyz with 503 + a machine-readable reason, and
+#: the caller (wait_writable, the supervisor) owns the poll loop
+READY_PROBE = RetryPolicy(
+    max_attempts=1,
+    budget_s=1.0,
+    backoff=Backoff(duration=0.0, cap=0.0),
+    retry_statuses=(),
+)
+
 
 def parse_retry_after(raw: Optional[str]) -> Optional[float]:
     """Seconds to wait from a ``Retry-After`` header value.
@@ -320,6 +330,17 @@ class ClusterClient:
         self._local = threading.local()
         self._types: Dict[str, ResourceType] = {}
         self._types_mut = threading.Lock()
+        #: retry accounting by cause — degraded-storage 503s counted
+        #: distinctly from APF overload 429s and plain unavailability,
+        #: so operators (and tests) can tell WHY a client was backing
+        #: off; read with :meth:`retry_stats`
+        self._retry_mut = threading.Lock()
+        self._retry_counts: Dict[str, int] = {
+            "overload": 0,       # 429 (APF shed)
+            "degraded": 0,       # 503 with reason StorageDegraded
+            "unavailable": 0,    # other 503s
+            "transport": 0,      # socket-level send failures
+        }
         self._ssl_ctx = None
         if self._https:
             import ssl
@@ -428,6 +449,7 @@ class ClusterClient:
                 # cause: the server closed an idle keep-alive connection,
                 # or a chaos reset/partition)
                 self._drop_conn(conn)
+                self._note_retry("transport")
                 _wait_or_raise(f"{method} {path}: {exc}", cause=exc)
                 continue
             try:
@@ -449,6 +471,23 @@ class ClusterClient:
             if resp.status in policy.retry_statuses:
                 last_status = resp.status
                 retry_after = parse_retry_after(resp.getheader("Retry-After"))
+                # classify the rejection for retry accounting: APF
+                # overload (429) vs degraded storage (503 with reason
+                # StorageDegraded) vs plain unavailability — the
+                # Retry-After of each is honored identically, but WHY
+                # the client is waiting must stay distinguishable
+                reason = None
+                if raw:
+                    try:
+                        reason = (json.loads(raw) or {}).get("reason")
+                    except ValueError:
+                        reason = None
+                if resp.status == 429:
+                    self._note_retry("overload")
+                elif reason == "StorageDegraded":
+                    self._note_retry("degraded")
+                else:
+                    self._note_retry("unavailable")
                 # a shed/reject response closes the connection (the
                 # server broke keep-alive framing on purpose); start
                 # the retry on a fresh socket
@@ -799,6 +838,17 @@ class ClusterClient:
         plural = self.resource_type(kind).plural
         return int(self._request("GET", "/stats")["counts"].get(plural, 0))
 
+    def _note_retry(self, cause: str) -> None:
+        with self._retry_mut:
+            self._retry_counts[cause] = self._retry_counts.get(cause, 0) + 1
+
+    def retry_stats(self) -> Dict[str, int]:
+        """Retry accounting by cause: ``overload`` (429 shed),
+        ``degraded`` (503 with reason StorageDegraded), ``unavailable``
+        (other 503s), ``transport`` (socket-level send failures)."""
+        with self._retry_mut:
+            return dict(self._retry_counts)
+
     def healthy(self) -> bool:
         try:
             # NO_RETRY: a health probe must answer fast; its caller owns
@@ -810,15 +860,42 @@ class ClusterClient:
         except Exception:  # noqa: BLE001 — health probe
             return False
 
+    def readiness(self) -> Tuple[bool, Optional[str]]:
+        """``(ready, reason)`` from the apiserver's /readyz.  Ready
+        means storage accepts writes; a degraded server answers 503
+        with reason ``StorageDegraded`` (alive but read-only — the
+        supervisor must NOT treat this as crashed).  ``reason`` is None
+        when ready or unreachable."""
+        try:
+            data = self._request("GET", "/readyz", retry=READY_PROBE)
+            return (data or {}).get("status") == "ok", None
+        except APIError as exc:
+            return False, exc.reason
+        except Exception:  # noqa: BLE001 — readiness probe
+            return False, None
+
+    def ready(self) -> bool:
+        """True when the apiserver is serving AND storage is armed."""
+        return self.readiness()[0]
+
     def wait_ready(self, timeout: float = 30.0) -> bool:
         """Poll /healthz with backoff (reference kwok waits for the
         apiserver the same way, pkg/kwok/cmd/root.go:434-460)."""
+        return self._poll(self.healthy, timeout)
+
+    def wait_writable(self, timeout: float = 30.0) -> bool:
+        """The /readyz twin of :meth:`wait_ready`: poll until storage
+        accepts writes again (degraded mode re-armed).  Each poll rides
+        the server's throttled re-arm probe, so waiting IS probing."""
+        return self._poll(self.ready, timeout)
+
+    def _poll(self, probe: Callable[[], bool], timeout: float) -> bool:
         deadline = time.monotonic() + timeout
         delay = 0.05
         while time.monotonic() < deadline:
-            if self.healthy():
+            if probe():
                 return True
             self._sleep_wake.clear()
             self._clock.wait_signal(self._sleep_wake, delay)
             delay = min(delay * 2, 1.0)
-        return False
+        return probe()

@@ -515,6 +515,10 @@ def _expose_wal(reg, store, Gauge) -> None:
         ("kwok_apiserver_wal_corruptions_total", "corruptions", "mid-log corruptions detected (never silently absorbed)"),
         ("kwok_apiserver_wal_missing_rvs_total", "missing_rvs", "resourceVersions recovery reported as lost"),
         ("kwok_apiserver_snapshot_fallbacks_total", "snapshot_fallbacks", "boots that fell back to an archived snapshot"),
+        ("kwok_apiserver_wal_enospc_total", "enospc_total", "append/fsync failures classified as disk-full or quota"),
+        ("kwok_apiserver_wal_fsync_failures_total", "fsync_failures_total", "poisoned-fsync events (handle sealed and reopened)"),
+        ("kwok_apiserver_wal_io_errors_total", "io_errors_total", "storage I/O errors classified as media failure"),
+        ("kwok_apiserver_wal_rearms_total", "rearms_total", "times degraded mode re-armed after space returned"),
     ]
     for mname, key, help_ in spec:
         val = health.get(key)
@@ -523,6 +527,14 @@ def _expose_wal(reg, store, Gauge) -> None:
         g = Gauge(mname, help=help_)
         g.set(val)
         reg.register(mname, g)
+    # degraded read-only mode: 1 while mutations are refused with 503
+    # (the exhaustion twin of the shed counters above)
+    dg = Gauge(
+        "kwok_apiserver_storage_degraded",
+        help="1 while storage is degraded (read-only mode), else 0",
+    )
+    dg.set(1 if health.get("degraded") else 0)
+    reg.register("kwok_apiserver_storage_degraded", dg)
 
 
 def _expose_election(reg, store, Gauge) -> None:

@@ -48,6 +48,7 @@ from kwok_tpu.cluster.store import (
     NotFound,
     ResourceStore,
     ResourceType,
+    StorageDegraded,
     selector_to_string,
 )
 from kwok_tpu.cluster.tables import to_table, wants_table
@@ -146,6 +147,11 @@ def error_code_reason(exc: Exception) -> Tuple[int, str]:
         return 409, "Conflict"
     if isinstance(exc, Expired):
         return 410, "Expired"
+    if isinstance(exc, StorageDegraded):
+        # degraded read-only mode (disk full / poisoned fsync): the
+        # machine-readable rejection clients key their degraded-aware
+        # retry on — 503 + Retry-After, distinct from APF's 429
+        return 503, "StorageDegraded"
     if isinstance(exc, (ValueError, KeyError, json.JSONDecodeError)):
         return 400, "BadRequest"
     return 500, "InternalError"
@@ -203,11 +209,13 @@ class K8sFacade:
     def __init__(self, store: ResourceStore, kubelet_url: Optional[str] = None):
         self.store = store
         self.kubelet_url = kubelet_url
-        self._ensure_namespaces()
+        self.ensure_namespaces()
 
-    def _ensure_namespaces(self) -> None:
+    def ensure_namespaces(self) -> None:
         """A fresh cluster exposes the conventional namespaces, like a
-        real control plane after bootstrap."""
+        real control plane after bootstrap.  Idempotent — the daemon
+        re-runs it when degraded storage re-arms (a boot onto a full
+        disk skips the creates below)."""
         try:
             self.store.resource_type("Namespace")
         except (KeyError, NotFound):
@@ -225,6 +233,11 @@ class K8sFacade:
                 )
             except Conflict:
                 pass
+            except StorageDegraded:
+                # booting onto a full disk: reads must still come up;
+                # the daemon's re-arm loop calls ensure_namespaces()
+                # again once space returns (cmd/apiserver.py)
+                return
 
     # ------------------------------------------------------------ discovery
 
@@ -379,7 +392,14 @@ class K8sFacade:
             return self._handle(handler, method, head, rest, q)
         except Exception as exc:  # noqa: BLE001 — becomes a Status
             st = status_for(exc)
-            self._send(handler, st["code"], st)
+            # degraded read-only mode carries a Retry-After so stock
+            # clients back off instead of hammering a full disk
+            self._send(
+                handler,
+                st["code"],
+                st,
+                retry_after=getattr(exc, "retry_after", None),
+            )
             return True
 
     def _handle(self, handler, method, head, rest, q) -> bool:
@@ -1355,10 +1375,12 @@ class K8sFacade:
         return json.loads(raw)
 
     @staticmethod
-    def _send(handler, code: int, payload) -> None:
+    def _send(handler, code: int, payload, retry_after=None) -> None:
         body = json.dumps(payload).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            handler.send_header("Retry-After", str(retry_after))
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)

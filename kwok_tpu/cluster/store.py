@@ -34,6 +34,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
+from kwok_tpu.cluster.wal import StorageDegraded, WalExhausted
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.patch import apply_patch
 
@@ -51,6 +52,21 @@ SYNC = "SYNC"  # informer re-list marker, never emitted by the store
 #: ``spec.finalizers: [kubernetes]`` analog; consumed by
 #: controllers/gc_controller.py)
 NS_FINALIZER = "kwok.x-k8s.io/namespace"
+
+#: kinds still writable in degraded (storage-exhausted) read-only mode:
+#: leader-election Leases ride the WAL's emergency reserve so HA does
+#: not collapse while the disk is full (cluster/election.py renews
+#: through the same store verbs everything else uses).  Scoped to the
+#: election namespace: per-node heartbeats (kube-node-lease, one per
+#: node) would drain the small reserve in minutes on a big cluster and
+#: starve the very renewals the exemption exists to protect.
+DEGRADED_EXEMPT_KINDS = frozenset({"lease", "leases"})
+
+#: the namespace whose Leases stay writable while degraded — the
+#: election Leases live here (cluster/election.py ELECTION_NAMESPACE;
+#: duplicated as a literal because election sits above the store in
+#: the layer map)
+DEGRADED_EXEMPT_NAMESPACE = "kube-system"
 
 
 class _AuditRing(deque):
@@ -672,6 +688,85 @@ class ResourceStore:
             {"t": "ev", "rv": rv, "u": self._uid, "e": etype, "o": obj}
         )
 
+    def _check_writable(
+        self, kind: str = "", namespace: Optional[str] = None
+    ) -> None:
+        """Degraded read-only gate: while the attached WAL cannot make
+        writes durable (disk full / quota / poisoned fsync), mutations
+        are refused with :class:`~kwok_tpu.cluster.wal.StorageDegraded`
+        (the apiserver renders 503 + Retry-After) instead of being
+        acked into a log that silently drops them.  kube-system Lease
+        writes stay exempt — they ride the emergency reserve so leader
+        election (and with it bounded failover) survives the pressure
+        window; per-node heartbeat leases (kube-node-lease) are NOT
+        exempt, or a big cluster's heartbeats would drain the reserve.
+        Re-arming is NOT probed here: the gate must stay deterministic
+        under the DST virtual clock (a wall-throttled probe would fire
+        run-dependently), so probing lives behind /readyz polls
+        (:meth:`storage_degraded`), the daemon's background loop, and
+        explicit :meth:`probe_writable` calls.  Caller holds the
+        mutex."""
+        wal = self._wal
+        if wal is None:
+            return
+        deg = wal.degraded
+        if deg is None:
+            return
+        if (
+            kind
+            and kind.lower() in DEGRADED_EXEMPT_KINDS
+            and namespace == DEGRADED_EXEMPT_NAMESPACE
+        ):
+            return
+        raise StorageDegraded(
+            deg.get("reason", "degraded"), deg.get("detail", "")
+        )
+
+    def _wal_event_or_rollback(
+        self, etype: str, obj: dict, rv: int, undo: Callable[[], None]
+    ) -> None:
+        """Append the commit's WAL record; if the log cannot make it
+        durable even through the emergency reserve, run ``undo`` (the
+        in-memory commit has not been observed yet — no event was
+        emitted, the ack was not sent) and surface StorageDegraded.
+        This is what keeps a full disk from acking writes that never
+        existed: the fsyncgate failure class, closed at the commit
+        boundary."""
+        try:
+            self._wal_event(etype, obj, rv)
+        except WalExhausted as exc:
+            undo()
+            self._rv -= 1
+            raise StorageDegraded(exc.reason, str(exc)) from exc
+
+    def storage_degraded(self) -> Optional[dict]:
+        """The degraded-storage surface for /readyz: None when writes
+        are armed, else ``{"reason", "detail", "for_s"}``.  Polling it
+        doubles as the throttled re-arm probe."""
+        with self._mut:
+            wal = self._wal
+            if wal is None:
+                return None
+            wal.maybe_rearm()
+            deg = wal.degraded
+            if deg is None:
+                return None
+            return {
+                "reason": deg.get("reason", "degraded"),
+                "detail": deg.get("detail", ""),
+                "for_s": max(
+                    0.0, time.monotonic() - deg.get("since", 0.0)
+                ),
+            }
+
+    def probe_writable(self) -> bool:
+        """Unthrottled re-arm attempt under the store mutex (the
+        daemon's background probe and tests call this)."""
+        with self._mut:
+            if self._wal is None:
+                return True
+            return self._wal.try_rearm()
+
     # ------------------------------------------------------------------ registry
 
     def register_type(self, rtype: ResourceType) -> None:
@@ -799,6 +894,10 @@ class ResourceStore:
         kind = obj.get("kind") or ""
         with self._mut:
             st = self._state(kind)
+            self._check_writable(
+                kind,
+                (obj.get("metadata") or {}).get("namespace") or namespace,
+            )
             meta = obj.setdefault("metadata", {})
             if st.rtype.namespaced and not meta.get("namespace"):
                 meta["namespace"] = namespace or "default"
@@ -825,7 +924,12 @@ class ResourceStore:
             st.objects[key] = obj
             self._index_update(st, key, None, obj)
             if self._wal is not None:
-                self._wal_event(ADDED, obj, rv)
+
+                def undo(st=st, key=key, obj=obj):
+                    del st.objects[key]
+                    self._index_update(st, key, obj, None)
+
+                self._wal_event_or_rollback(ADDED, obj, rv, undo)
             self._commit_point("after-commit")
             self._emit(st, ADDED, obj, rv)
             return obj if not copy_result else copy_json(obj)
@@ -994,6 +1098,7 @@ class ResourceStore:
         with self._mut:
             st = self._state(kind)
             key = self._key(st, obj)
+            self._check_writable(kind, key[0] or None)
             cur = st.objects.get(key)
             if cur is None:
                 raise NotFound(f"{kind} {key} not found")
@@ -1034,6 +1139,7 @@ class ResourceStore:
         with self._mut:
             st = self._state(kind)
             ns = (namespace or "default") if st.rtype.namespaced else ""
+            self._check_writable(kind, ns or None)
             key = (ns, name)
             cur = st.objects.get(key)
             if cur is None:
@@ -1108,6 +1214,7 @@ class ResourceStore:
         with self._mut:
             st = self._state(kind)
             ns = (namespace or "default") if st.rtype.namespaced else ""
+            self._check_writable(kind, ns or None)
             body_meta = applied.get("metadata") or {}
             if body_meta.get("name") and body_meta["name"] != name:
                 raise ValueError(
@@ -1255,7 +1362,12 @@ class ResourceStore:
             del st.objects[key]
             self._index_update(st, key, old, None)
             if self._wal is not None:
-                self._wal_event(DELETED, new, rv)
+
+                def undo_reap(st=st, key=key, old=old):
+                    st.objects[key] = old
+                    self._index_update(st, key, None, old)
+
+                self._wal_event_or_rollback(DELETED, new, rv, undo_reap)
             self._commit_point("after-commit")
             self._emit(st, DELETED, new, rv)
             return new if not copy_result else copy_json(new)
@@ -1263,7 +1375,16 @@ class ResourceStore:
         st.objects[key] = new
         self._index_update(st, key, old, new)
         if self._wal is not None:
-            self._wal_event(MODIFIED, new, rv)
+
+            def undo_mod(st=st, key=key, old=old, new=new):
+                if old is None:
+                    del st.objects[key]
+                    self._index_update(st, key, new, None)
+                else:
+                    st.objects[key] = old
+                    self._index_update(st, key, new, old)
+
+            self._wal_event_or_rollback(MODIFIED, new, rv, undo_mod)
         self._commit_point("after-commit")
         self._emit(st, MODIFIED, new, rv)
         return new if not copy_result else copy_json(new)
@@ -1281,24 +1402,30 @@ class ResourceStore:
         with self._mut:
             st = self._state(kind)
             ns = (namespace or "default") if st.rtype.namespaced else ""
+            self._check_writable(kind, ns or None)
             key = (ns, name)
-            cur = st.objects.get(key)
-            if cur is None:
+            orig = st.objects.get(key)
+            if orig is None:
                 raise NotFound(f"{kind} {ns}/{name} not found")
             self._audit.append(("delete", f"{kind}:{key}", as_user))
             # copy-on-write: stored instances may be shared with watch
             # histories and informer caches (apply_status_batch hands
             # them out by reference) — never mutate one in place
-            cur = dict(cur)
+            cur = dict(orig)
             meta = cur["metadata"] = dict(cur.get("metadata") or {})
             self._commit_point("before-commit")
+
+            def undo(st=st, key=key, orig=orig, cur=cur):
+                st.objects[key] = orig
+                self._index_update(st, key, cur, orig)
+
             if meta.get("finalizers"):
                 if meta.get("deletionTimestamp") is None:
                     meta["deletionTimestamp"] = self._now_string()
                     rv = self._bump(cur)
                     st.objects[key] = cur
                     if self._wal is not None:
-                        self._wal_event(MODIFIED, cur, rv)
+                        self._wal_event_or_rollback(MODIFIED, cur, rv, undo)
                     self._commit_point("after-commit")
                     self._emit(st, MODIFIED, cur, rv)
                 return cur if not copy_result else copy_json(cur)
@@ -1306,7 +1433,12 @@ class ResourceStore:
             del st.objects[key]
             self._index_update(st, key, cur, None)
             if self._wal is not None:
-                self._wal_event(DELETED, cur, rv)
+
+                def undo_del(st=st, key=key, orig=orig, cur=cur):
+                    st.objects[key] = orig
+                    self._index_update(st, key, None, orig)
+
+                self._wal_event_or_rollback(DELETED, cur, rv, undo_del)
             self._commit_point("after-commit")
             self._emit(st, DELETED, cur, rv)
             return None
@@ -1419,6 +1551,7 @@ class ResourceStore:
         consumer's staleness filter drops them, as before)."""
         with self._mut:
             st = self._state(kind)
+            self._check_writable(kind)
             namespaced = st.rtype.namespaced
             status_indexed = any(p.startswith("status.") for p in st.indexes)
             if (
@@ -1501,16 +1634,23 @@ class ResourceStore:
 
     def _wal_status_batch(self, kind: str, items, out) -> None:
         """One WAL record for a whole status batch; caller holds the
-        mutex.  ``items``/``out`` align per apply_status_batch."""
+        mutex.  ``items``/``out`` align per apply_status_batch.
+
+        A :class:`WalExhausted` here (reserve spent mid-batch) surfaces
+        as StorageDegraded: the batch is committed in memory but its
+        ack is refused, the same contract as bulk's deferred flush."""
         pairs = [
             [ns, name, status, res[0]]
             for (ns, name, status), res in zip(items, out)
             if res is not None
         ]
         if pairs:
-            self._wal_put(
-                {"t": "status", "rv": pairs[-1][3], "k": kind, "i": pairs}
-            )
+            try:
+                self._wal_put(
+                    {"t": "status", "rv": pairs[-1][3], "k": kind, "i": pairs}
+                )
+            except WalExhausted as exc:
+                raise StorageDegraded(exc.reason, str(exc)) from exc
 
     def status_lane(self, kind: str, exclude: Optional[Watcher]):
         """Grant the caller the zero-copy status-commit lane for one
@@ -1592,6 +1732,11 @@ class ResourceStore:
         # measurable cost at device-drain rates
         defer_wal = self._wal is not None
         if defer_wal:
+            # degraded read-only gate up front: refusing the whole batch
+            # before any op commits keeps memory and log in lockstep
+            # (the per-op gates still cover windows opening mid-call)
+            with self._mut:
+                self._check_writable()
             self._wal_local.buf = []
         try:
             self._bulk_ops(ops, results, copy_results)
@@ -1604,7 +1749,18 @@ class ResourceStore:
                 # closes and reopens the log file)
                 with self._mut:
                     if self._wal is not None:
-                        self._wal.append_many(buf)
+                        try:
+                            self._wal.append_many(buf)
+                        except WalExhausted as exc:
+                            # the batch is committed in memory but could
+                            # not be made durable even via the reserve:
+                            # refuse the ACK (503).  A crash before space
+                            # returns rolls these ops back, and watchers
+                            # that ran ahead heal through the future-rv
+                            # Expired re-list (see watch()).
+                            raise StorageDegraded(
+                                exc.reason, str(exc)
+                            ) from exc
         return results
 
     def _bulk_ops(self, ops, results, copy_results) -> None:
@@ -1648,6 +1804,17 @@ class ResourceStore:
             except Conflict as exc:
                 results.append(
                     {"status": "error", "reason": "Conflict", "error": str(exc)}
+                )
+            except StorageDegraded as exc:
+                # a pressure window opened mid-batch: the remaining ops
+                # get the same machine-readable rejection a fresh
+                # request would
+                results.append(
+                    {
+                        "status": "error",
+                        "reason": "StorageDegraded",
+                        "error": str(exc),
+                    }
                 )
             except Exception as exc:  # noqa: BLE001 — per-op isolation
                 results.append(
@@ -1698,6 +1865,12 @@ class ResourceStore:
         removed state and ADDED for every restored object (a restore
         behaves like a fresh re-list)."""
         with self._mut:
+            # gated like every other mutation: a restore rewrites the
+            # WAL wholesale (reset + full re-ADD), and starting that on
+            # a disk that cannot take writes would leave the log
+            # partially rewritten behind an in-memory state it no
+            # longer covers
+            self._check_writable()
             for t in state.get("types", []):
                 self.register_type(
                     ResourceType(
@@ -1730,25 +1903,32 @@ class ResourceStore:
             if self._wal is not None:
                 # the log's old coverage is superseded wholesale; make
                 # the restored keyspace itself durable so a crash before
-                # the next snapshot cannot roll it back
-                self._wal.reset()
-                self._wal.append({"t": "reset", "rv": self._rv})
-                for rt in self.kinds():
-                    self._wal.append(
-                        {
-                            "t": "type",
-                            "rv": self._rv,
-                            "api_version": rt.api_version,
-                            "kind": rt.kind,
-                            "plural": rt.plural,
-                            "namespaced": rt.namespaced,
-                        }
-                    )
-                for rt in self.kinds():
-                    st = self._state(rt.kind)
-                    for obj in st.objects.values():
-                        self._wal_event(ADDED, obj, self._rv)
-                self._wal.sync()
+                # the next snapshot cannot roll it back.  A pressure
+                # window opening mid-rewrite surfaces as StorageDegraded
+                # (the restore was never acked — the operator retries
+                # once writes re-arm and the idempotent reset rewrites
+                # the log whole again), never as a raw 500.
+                try:
+                    self._wal.reset()
+                    self._wal.append({"t": "reset", "rv": self._rv})
+                    for rt in self.kinds():
+                        self._wal.append(
+                            {
+                                "t": "type",
+                                "rv": self._rv,
+                                "api_version": rt.api_version,
+                                "kind": rt.kind,
+                                "plural": rt.plural,
+                                "namespaced": rt.namespaced,
+                            }
+                        )
+                    for rt in self.kinds():
+                        st = self._state(rt.kind)
+                        for obj in st.objects.values():
+                            self._wal_event(ADDED, obj, self._rv)
+                    self._wal.sync()
+                except WalExhausted as exc:
+                    raise StorageDegraded(exc.reason, str(exc)) from exc
             return n
 
     def save_file(self, path: str) -> None:

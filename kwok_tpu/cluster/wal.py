@@ -32,6 +32,25 @@ is the etcd-grade seat:
 - **fsck**: ``python -m kwok_tpu.cluster.wal --fsck PATH`` verifies
   frame integrity, sequence continuity and (with ``--snapshot``) the
   compaction floor offline, exiting nonzero on any integrity failure.
+- **resource exhaustion**: every append/fsync/seal site classifies
+  ENOSPC/EIO/EDQUOT instead of absorbing it.  A failed *write* is
+  retried once on a repaired fresh handle after the preallocated
+  **emergency reserve** (``<path>.reserve``) is released — the
+  in-flight record still becomes durable on a full disk — and the log
+  enters a **degraded** state (:attr:`WriteAheadLog.degraded`) the
+  store turns into read-only mode (503 + Retry-After) instead of
+  silently acking writes that never hit the disk (the fsyncgate
+  failure class).  A failed *fsync* poisons the file handle (the
+  kernel may have dropped the dirty pages and consumed the error):
+  the active file is sealed whole and a fresh handle opened — the
+  poisoned fd is never fsynced again and the unsynced tail is never
+  called machine-crash durable; if its pages were in fact lost, the
+  CRC framing converts that into *detected* corruption at recovery,
+  never silent loss.  :meth:`WriteAheadLog.try_rearm` re-arms writes
+  (and the reserve) once space returns.  Seeded exhaustion windows
+  inject through the duck-typed pressure-shim seam
+  (:meth:`WriteAheadLog.set_pressure`; the shim lives in
+  ``kwok_tpu/chaos/fs_pressure.py:1``).
 - **snapshot integrity**: :func:`write_state_file` embeds a CRC32 over
   the canonical state JSON so a bit-flipped snapshot is *detected* at
   load instead of silently restoring corrupt objects
@@ -52,6 +71,7 @@ as ``legacy`` frames by the scanner and flagged by fsck.
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import time
@@ -62,8 +82,11 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "WalCorruption",
     "SnapshotCorruption",
+    "WalExhausted",
+    "StorageDegraded",
     "WalScan",
     "WriteAheadLog",
+    "classify_os_error",
     "read_records",
     "scan",
     "scan_files",
@@ -79,6 +102,59 @@ SEG_INFIX = ".seg-"
 
 #: default rotation threshold for the active segment
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
+
+#: emergency-reserve suffix: preallocated headroom released on the
+#: first ENOSPC so sealing, the retried in-flight append, and lease
+#: renewals still complete on a full disk
+RESERVE_SUFFIX = ".reserve"
+
+#: default emergency-reserve size (enough for thousands of small
+#: records — lease renewals and degraded markers, not bulk traffic)
+DEFAULT_RESERVE_BYTES = 256 * 1024
+
+
+def classify_os_error(exc: OSError) -> str:
+    """Exhaustion taxonomy for an append/fsync/seal failure: the three
+    errnos the resource-exhaustion layer treats distinctly, plus a
+    catch-all.  ``disk-full``/``quota`` mean space may come back (the
+    degraded probe re-arms); ``io-error`` means the media itself
+    failed (fsyncgate territory: never trust the poisoned handle)."""
+    eno = getattr(exc, "errno", None)
+    if eno == errno.ENOSPC:
+        return "disk-full"
+    if eno == getattr(errno, "EDQUOT", -1):
+        return "quota"
+    # EIO and every other errno: the media failed, space will not help
+    return "io-error"
+
+
+class WalExhausted(OSError):
+    """An append could not be made durable even through the emergency
+    reserve.  Internal signal: the store rolls the in-memory commit
+    back and surfaces :class:`StorageDegraded` instead of acking."""
+
+    def __init__(self, message: str, reason: str = "disk-full"):
+        super().__init__(message)
+        self.reason = reason
+
+
+class StorageDegraded(RuntimeError):
+    """The storage layer cannot make new writes durable (disk full,
+    quota, poisoned fsync).  The apiserver maps this to 503 +
+    Retry-After with the machine-readable reason ``StorageDegraded``;
+    reads, watches and lease renewals keep working."""
+
+    def __init__(
+        self, reason: str, detail: str = "", retry_after: float = 5.0
+    ):
+        super().__init__(
+            f"storage degraded ({reason})" + (f": {detail}" if detail else "")
+        )
+        self.reason = reason
+        # integer seconds: RFC 9110 Retry-After is 1*DIGIT, and stock
+        # client stacks drop fractional values — the whole point of the
+        # header is that THEY back off
+        self.retry_after = max(1, int(round(retry_after)))
 
 
 class WalCorruption(ValueError):
@@ -132,6 +208,27 @@ def _parse_frame(line: str) -> Tuple[Optional[int], Dict[str, Any], bool]:
     return seq, rec, False
 
 
+#: tolerated-OSError tally by site — helper probes that legitimately
+#: stay tolerant (directory listings, size probes) still count and log
+#: what they absorbed instead of hiding an EIO behind an ENOENT
+IO_TOLERATED: Dict[str, int] = {}
+
+
+def _note_os_error(site: str, exc: OSError) -> None:
+    """Record a tolerated OSError: count it per site, and log anything
+    that is not plain absence (a missing archive dir is normal; an EIO
+    from ``listdir`` is the disk failing and must be visible)."""
+    IO_TOLERATED[site] = IO_TOLERATED.get(site, 0) + 1
+    if getattr(exc, "errno", None) in (errno.ENOENT, errno.ENOTDIR):
+        return
+    from kwok_tpu.utils.log import get_logger
+
+    get_logger("wal").warn(
+        "tolerated I/O error", site=site, kind=classify_os_error(exc),
+        error=str(exc),
+    )
+
+
 # ---------------------------------------------------------------- scanning
 
 
@@ -181,7 +278,10 @@ def segment_files(path: str) -> List[str]:
     base = os.path.basename(path) + SEG_INFIX
     try:
         names = os.listdir(d)
-    except OSError:
+    # directory probe stays tolerant (a not-yet-created workdir is
+    # normal), but classified + counted — never silently absorbed
+    except OSError as exc:
+        _note_os_error("segment_files.listdir", exc)
         names = []
     for n in sorted(names):
         if n.startswith(base):
@@ -213,7 +313,10 @@ def scan_files(files: List[str]) -> WalScan:
             # invalid UTF-8, which must classify as a damaged frame,
             # not blow up the whole scan
             f = open(fp, "rb")
-        except OSError:
+        # a file that vanished between listing and open (compaction
+        # raced the scan) is normal; an EIO open is counted + logged
+        except OSError as exc:
+            _note_os_error("scan_files.open", exc)
             continue
         with f:
             for lineno, raw in enumerate(f, 1):
@@ -296,16 +399,25 @@ def read_records(path: str) -> Iterator[Dict[str, Any]]:
 
 def _fsync_dir(path: str) -> None:
     """fsync the directory entry so a rename/create is durable, not
-    just the file contents (the atomic-rename half of crash safety)."""
+    just the file contents (the atomic-rename half of crash safety).
+
+    Deliberately tolerant: directory fsync is a best-effort durability
+    upgrade — some filesystems reject O_RDONLY dir fsync outright, and
+    failing the *rename itself* over it would turn a working log
+    unusable.  Both sites classify + count what they absorb."""
     d = os.path.dirname(path) or "."
     try:
         fd = os.open(d, os.O_RDONLY)
-    except OSError:
+    # reason: dirs unopenable for fsync (e.g. permissions, exotic fs)
+    # must not fail the already-completed rename
+    except OSError as exc:
+        _note_os_error("fsync_dir.open", exc)
         return
     try:
         os.fsync(fd)
-    except OSError:
-        pass
+    # reason: same best-effort posture as the open above
+    except OSError as exc:
+        _note_os_error("fsync_dir.fsync", exc)
     finally:
         os.close(fd)
 
@@ -393,6 +505,7 @@ class WriteAheadLog:
         fsync_interval: float = 0.5,
         segment_bytes: int = DEFAULT_SEGMENT_BYTES,
         archive_dir: Optional[str] = None,
+        reserve_bytes: int = DEFAULT_RESERVE_BYTES,
     ):
         if fsync not in self.FSYNC_POLICIES:
             raise ValueError(
@@ -408,6 +521,25 @@ class WriteAheadLog:
         self._last_sync = 0.0
         #: monotonic instant of the last real fsync (health surface)
         self._last_fsync_at: Optional[float] = None
+        #: emergency reserve: preallocated headroom released on the
+        #: first ENOSPC so the in-flight append, sealing, and lease
+        #: renewals still complete on a full disk; 0 disables
+        self.reserve_bytes = int(reserve_bytes)
+        self._reserve_path = path + RESERVE_SUFFIX
+        #: degraded state: None (healthy) or {"reason", "detail",
+        #: "since"} — the store turns this into read-only mode
+        self._degraded: Optional[Dict[str, Any]] = None
+        self._last_rearm_probe = 0.0
+        #: exhaustion counters (health surface / metrics)
+        self.enospc_total = 0
+        self.fsync_failures_total = 0
+        self.io_errors_total = 0
+        self.rearms_total = 0
+        #: duck-typed filesystem-pressure shim (chaos/fs_pressure.py):
+        #: consulted before this log's own write/fsync syscalls —
+        #: ``on_write(nbytes)``/``on_fsync()`` raise the injected
+        #: OSError, ``freed(nbytes)`` credits released reserve space
+        self._pressure = None
         #: chaos crash points inside compaction/rotation (phase names:
         #: compact-begin, compact-sealed, compact-mid-archive,
         #: compact-done) — a hook that raises leaves the files exactly
@@ -432,13 +564,23 @@ class WriteAheadLog:
         self._active_max_rv: Optional[int] = None
         self._active_records = 0
         self._f = open(path, "a", encoding="utf-8")
+        # arm the emergency reserve (best-effort at open: a disk that
+        # is ALREADY full boots straight into degraded on first append)
+        try:
+            self._arm_reserve()
+        except OSError as exc:
+            self._count_error(exc)
+            self._enter_degraded(classify_os_error(exc), str(exc))
 
     # ------------------------------------------------------------ discovery
 
     def _repair_tail(self) -> None:
         try:
             size = os.path.getsize(self.path)
-        except OSError:
+        # size probe stays tolerant (no log file yet is the normal
+        # first-boot case) but is classified + counted
+        except OSError as exc:
+            _note_os_error("repair_tail.getsize", exc)
             return
         if size == 0:
             return
@@ -480,8 +622,10 @@ class WriteAheadLog:
                     ),
                     reverse=True,
                 )
-            except OSError:
-                pass
+            # a missing archive dir is normal before the first
+            # compaction; counted + logged when it is anything else
+            except OSError as exc:
+                _note_os_error("discover_seq.listdir", exc)
         for fp in candidates:
             s = scan_files([fp])
             if s.last_seq is not None:
@@ -497,7 +641,9 @@ class WriteAheadLog:
         for d in dirs:
             try:
                 names = os.listdir(d)
-            except OSError:
+            # same tolerant-but-counted posture as _discover_seq
+            except OSError as exc:
+                _note_os_error("discover_seg_index.listdir", exc)
                 continue
             for n in names:
                 if n.startswith(base):
@@ -532,16 +678,20 @@ class WriteAheadLog:
         self._active_records += 1
 
     def append(self, record: Dict[str, Any]) -> None:
-        self._f.write(encode_record(self._seq, record))
-        self._seq += 1
-        self._note_rv(record)
-        self._flush()
-        self._maybe_rotate()
+        self.append_many([record])
 
     def append_many(self, records) -> None:
         """One write + one flush for a whole mutation batch (the store's
         bulk lane defers its per-op records here — per-op flushes were
-        the WAL's only measurable cost at drain rates)."""
+        the WAL's only measurable cost at drain rates).
+
+        Exhaustion contract: a write-path OSError (ENOSPC/EDQUOT/EIO)
+        is classified and retried once on a repaired fresh handle with
+        the emergency reserve released; success still enters the
+        degraded state (the store stops admitting non-lease mutations
+        until :meth:`try_rearm` confirms space), failure raises
+        :class:`WalExhausted` so the caller can refuse the ack instead
+        of pretending the record is durable."""
         if not records:
             return
         lines = []
@@ -549,33 +699,366 @@ class WriteAheadLog:
             lines.append(encode_record(self._seq, r))
             self._seq += 1
             self._note_rv(r)
-        self._f.write("".join(lines))
-        self._flush()
+        self._write_frames(lines)
         self._maybe_rotate()
 
-    def _flush(self) -> None:
-        # flush python buffer -> fd: acked writes survive process death
-        self._f.flush()
+    # ------------------------------------------------- exhaustion-safe I/O
+
+    def _guard_write(self, nbytes: int) -> None:
+        p = self._pressure
+        if p is not None:
+            p.on_write(nbytes)
+
+    def _guard_fsync(self) -> None:
+        p = self._pressure
+        if p is not None:
+            p.on_fsync()
+
+    def _write_frames(self, lines: List[str]) -> None:
+        data = "".join(lines)
+        try:
+            self._guard_write(len(data))
+            self._f.write(data)
+            self._f.flush()
+        except OSError as exc:
+            self._recover_append(exc, lines)
+            return  # the recovery path flushed + fsynced what it wrote
+        try:
+            self._policy_fsync()
+        except OSError as exc:
+            # the frames are written (process-crash durable); machine-
+            # crash durability of the unsynced tail is now unknown —
+            # poison-handle handling, never a silent absorb
+            self._on_fsync_failure(exc)
+
+    def _policy_fsync(self) -> None:
         if self.fsync == "always":
+            self._guard_fsync()
             os.fsync(self._f.fileno())
             self._last_fsync_at = time.monotonic()
         elif self.fsync == "interval":
             now = time.monotonic()
             if now - self._last_sync >= self.fsync_interval:
                 self._last_sync = now
+                self._guard_fsync()
                 os.fsync(self._f.fileno())
                 self._last_fsync_at = now
 
-    def sync(self) -> None:
+    def _flush(self) -> None:
+        # flush python buffer -> fd: acked writes survive process death
         self._f.flush()
-        os.fsync(self._f.fileno())
+        self._policy_fsync()
+
+    def sync(self) -> None:
+        """Force durability now.  An fsync failure here gets the same
+        fsyncgate treatment as the policy path: the handle is poisoned
+        (sealed + reopened, never re-fsynced) and the log degrades —
+        the written frames stay process-crash durable, and lost pages
+        surface as CRC-detected corruption at recovery."""
+        self._f.flush()
+        try:
+            self._guard_fsync()
+            os.fsync(self._f.fileno())
+        except OSError as exc:
+            self._on_fsync_failure(exc)
+            return
         self._last_fsync_at = time.monotonic()
+
+    # ------------------------------------------------- exhaustion handling
+
+    def _count_error(self, exc: OSError) -> str:
+        kind = classify_os_error(exc)
+        if kind == "disk-full":
+            self.enospc_total += 1
+        elif kind == "quota":
+            self.enospc_total += 1
+        else:
+            self.io_errors_total += 1
+        return kind
+
+    @property
+    def degraded(self) -> Optional[Dict[str, Any]]:
+        """None when writes are armed; else ``{"reason", "detail",
+        "since"}`` (reason: disk-full | quota | fsync-error |
+        io-error).  The store's read-only gate keys on this."""
+        return self._degraded
+
+    def _enter_degraded(self, reason: str, detail: str) -> None:
+        if self._degraded is not None:
+            return  # already degraded; keep the first cause
+        self._degraded = {
+            "reason": reason,
+            "detail": detail,
+            "since": time.monotonic(),
+        }
+        from kwok_tpu.utils.log import get_logger
+
+        get_logger("wal").warn(
+            "entering degraded (read-only) mode", reason=reason, detail=detail
+        )
+        # best-effort marker record so the window is visible to offline
+        # fsck and recovery tooling; rides the freed reserve headroom
+        self._append_marker(
+            {"t": "degraded", "rv": 0, "reason": reason}
+        )
+
+    def _append_marker(self, record: Dict[str, Any]) -> None:
+        """Append a bookkeeping record outside the normal recovery
+        machinery (no recursion): failure rolls the sequence number
+        back after a tail repair so continuity survives."""
+        seq = self._seq
+        line = encode_record(seq, record)
+        try:
+            self._guard_write(len(line))
+            self._f.write(line)
+            self._f.flush()
+        except OSError as exc:
+            self._count_error(exc)
+            # the marker (possibly a torn prefix of it) must not leave
+            # debris: repair the tail and reuse its sequence number
+            try:
+                self._f.close()
+            except OSError as close_exc:
+                _note_os_error("marker.close", close_exc)
+            self._repair_tail()
+            self._f = open(self.path, "a", encoding="utf-8")
+            return
+        self._seq = seq + 1
+        try:
+            self._guard_fsync()
+            os.fsync(self._f.fileno())
+            self._last_fsync_at = time.monotonic()
+        # reason: the marker is best-effort observability — an unsynced
+        # marker is still process-crash durable, and failing the append
+        # that triggered it over marker fsync would invert priorities
+        except OSError as exc:
+            self._count_error(exc)
+
+    def _active_tail_seq(self) -> Optional[int]:
+        """Last complete frame's sequence number in the active file
+        (None when it holds none) — what a failed batch write must
+        resume after.  Bounded: callers run :meth:`_repair_tail` first
+        (the file ends at a newline), so reading one tail window
+        suffices — a full CRC scan per failed append would hammer an
+        already-struggling disk under a long pressure window.  Falls
+        back to the full scan only when the window holds no parseable
+        frame (e.g. one oversized record)."""
+        try:
+            size = os.path.getsize(self.path)
+        # size probe, tolerant by design (no active file yet)
+        except OSError as exc:
+            _note_os_error("tail_seq.getsize", exc)
+            return None
+        if size == 0:
+            return None
+        window = min(size, 256 * 1024)
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(size - window)
+                data = f.read(window)
+        except OSError as exc:
+            _note_os_error("tail_seq.read", exc)
+            return scan_files([self.path]).last_seq
+        # the first split piece may be a mid-frame cut from the window
+        # boundary; walk back over the complete lines
+        for raw in reversed(data.split(b"\n")):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                seq, _rec, _legacy = _parse_frame(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if seq is not None:
+                return seq
+        return scan_files([self.path]).last_seq
+
+    def _recover_append(self, exc: OSError, lines: List[str]) -> None:
+        """A write-path failure mid-append: classify, free the
+        emergency reserve, repair the (possibly torn) tail on a fresh
+        handle — fsyncgate: the old handle is never trusted again —
+        and rewrite the frames that did not land.  Success means the
+        in-flight records ARE durable; the log still enters degraded
+        so the store stops admitting non-exempt mutations.  A second
+        failure raises :class:`WalExhausted`: the caller must not ack."""
+        kind = self._count_error(exc)
+        self.release_reserve()
+        try:
+            self._f.close()
+        except OSError as close_exc:
+            _note_os_error("recover_append.close", close_exc)
+        self._repair_tail()
+        durable = self._active_tail_seq()
+        # frames at seq <= durable landed whole before the failure
+        remaining = []
+        for line in lines:
+            seq = int(line.split(" ", 1)[0])
+            if durable is None or seq > durable:
+                remaining.append(line)
+        self._f = open(self.path, "a", encoding="utf-8")
+        data = "".join(remaining)
+        try:
+            if data:
+                self._guard_write(len(data))
+                self._f.write(data)
+                self._f.flush()
+            self._guard_fsync()
+            os.fsync(self._f.fileno())
+            self._last_fsync_at = time.monotonic()
+        except OSError as exc2:
+            self._count_error(exc2)
+            # roll the sequence back over the frames that never landed
+            # BEFORE entering degraded: the degraded marker append must
+            # continue the durable sequence, not straddle the hole of
+            # the frames the caller is about to un-commit
+            try:
+                self._f.close()
+            except OSError as close_exc:
+                _note_os_error("recover_append.close2", close_exc)
+            self._repair_tail()
+            tail = self._active_tail_seq()
+            if tail is not None:
+                self._seq = tail + 1
+            elif remaining:
+                self._seq = int(remaining[0].split(" ", 1)[0])
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._enter_degraded(kind, str(exc))
+            raise WalExhausted(
+                f"append not durable even via reserve: {exc2}", kind
+            ) from exc2
+        self._enter_degraded(kind, str(exc))
+
+    def _on_fsync_failure(self, exc: OSError) -> None:
+        """fsyncgate-correct fsync-failure handling: the kernel may
+        have dropped the dirty pages AND consumed the error, so
+        retrying fsync on the same fd can report success for data that
+        never reached the disk.  Seal the active file whole (rename —
+        no fsync on the poisoned fd, ever) and open a fresh handle; if
+        the sealed tail's pages were in fact lost, recovery sees CRC
+        damage and *reports* the loss — detected, never silent."""
+        self.fsync_failures_total += 1
+        self._count_error(exc)
+        try:
+            self._f.close()
+        except OSError as close_exc:
+            _note_os_error("fsync_failure.close", close_exc)
+        if self._active_records:
+            seg = f"{self.path}{SEG_INFIX}{self._seg_index:08d}"
+            self._seg_index += 1
+            try:
+                os.replace(self.path, seg)
+                _fsync_dir(self.path)
+                self._sealed_meta[seg] = (
+                    self._active_min_rv or 0,
+                    self._active_max_rv or 0,
+                    self._active_records,
+                )
+                self._active_min_rv = None
+                self._active_max_rv = None
+                self._active_records = 0
+            except OSError as seal_exc:
+                # rename failed too: keep appending to the same file on
+                # a fresh fd; the classification below still degrades
+                _note_os_error("fsync_failure.seal", seal_exc)
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._enter_degraded("fsync-error", str(exc))
+
+    # ------------------------------------------------------------- reserve
+
+    def _arm_reserve(self) -> None:
+        """(Re)create the preallocated emergency reserve.  Raises
+        OSError when the disk cannot hold it — which is exactly the
+        rearm probe's signal that space has not come back."""
+        if not self.reserve_bytes:
+            return
+        try:
+            if os.path.getsize(self._reserve_path) >= self.reserve_bytes:
+                return
+        # absent or unreadable reserve: (re)create below
+        except OSError as exc:
+            _note_os_error("arm_reserve.getsize", exc)
+        self._guard_write(self.reserve_bytes)
+        with open(self._reserve_path, "wb") as f:
+            f.write(b"\0" * self.reserve_bytes)
+            f.flush()
+            self._guard_fsync()
+            os.fsync(f.fileno())
+
+    def release_reserve(self) -> int:
+        """Free the emergency reserve (delete the preallocated file);
+        returns the bytes released.  The pressure shim, when armed, is
+        credited so simulated full disks gain the same headroom a real
+        unlink frees."""
+        try:
+            n = os.path.getsize(self._reserve_path)
+            os.unlink(self._reserve_path)
+        except OSError as exc:
+            _note_os_error("release_reserve", exc)
+            return 0
+        p = self._pressure
+        if p is not None:
+            p.freed(n)
+        return n
+
+    # --------------------------------------------------------------- rearm
+
+    def set_pressure(self, shim) -> None:
+        """Install/remove (None) the duck-typed filesystem-pressure
+        shim consulted before this log's own write/fsync syscalls
+        (chaos/fs_pressure.py; the DST harness toggles it at virtual
+        instants)."""
+        self._pressure = shim
+
+    def maybe_rearm(self, min_interval: float = 0.5) -> bool:
+        """Throttled rearm probe — cheap enough to sit behind every
+        rejected mutation and readiness poll.  Returns True when
+        writes are (now) armed."""
+        if self._degraded is None:
+            return True
+        now = time.monotonic()
+        if now - self._last_rearm_probe < min_interval:
+            return False
+        self._last_rearm_probe = now
+        return self.try_rearm()
+
+    def try_rearm(self) -> bool:
+        """Attempt to leave degraded mode: re-arm the emergency
+        reserve and prove the active handle can fsync.  Both must
+        succeed — a probe that passes on leftovers of the freed
+        reserve would re-arm writes onto a still-full disk."""
+        if self._degraded is None:
+            return True
+        try:
+            self._arm_reserve()
+            self._f.flush()
+            self._guard_fsync()
+            os.fsync(self._f.fileno())
+            self._last_fsync_at = time.monotonic()
+        except OSError as exc:
+            self._count_error(exc)
+            return False
+        reason = self._degraded.get("reason", "")
+        self._degraded = None
+        self.rearms_total += 1
+        from kwok_tpu.utils.log import get_logger
+
+        get_logger("wal").info(
+            "storage re-armed: leaving degraded mode", was=reason
+        )
+        self._append_marker({"t": "rearmed", "rv": 0, "was": reason})
+        return True
 
     # ------------------------------------------------------------- segments
 
     def _maybe_rotate(self) -> None:
         if self.segment_bytes and self._f.tell() >= self.segment_bytes:
-            self._rotate()
+            try:
+                self._rotate()
+            except OSError as exc:
+                # rotation's pre-seal fsync failed: poison-handle
+                # handling seals what it can; the appended frames are
+                # already written, so the append itself still holds
+                self._on_fsync_failure(exc)
 
     def _rotate(self) -> None:
         """Seal the active file into a read-only segment and start a
@@ -585,6 +1068,7 @@ class WriteAheadLog:
         if self._active_records == 0:
             return
         self._f.flush()
+        self._guard_fsync()
         os.fsync(self._f.fileno())
         self._last_fsync_at = time.monotonic()
         self._f.close()
@@ -641,11 +1125,19 @@ class WriteAheadLog:
         crash at any :meth:`set_crash_hook` phase leaves the union of
         snapshot + live log complete."""
         self._crash_point("compact-begin")
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._last_fsync_at = time.monotonic()
-        if self._active_records:
-            self._rotate()
+        try:
+            self._f.flush()
+            self._guard_fsync()
+            os.fsync(self._f.fileno())
+            self._last_fsync_at = time.monotonic()
+            if self._active_records:
+                self._rotate()
+        except OSError as exc:
+            # a failing disk mid-compaction: poison-handle handling,
+            # then skip this tick — compaction is optional work and the
+            # un-retired segments stay covered by the snapshot
+            self._on_fsync_failure(exc)
+            return 0
         self._crash_point("compact-sealed")
         remaining = 0
         for seg in segment_files(self.path):
@@ -687,12 +1179,17 @@ class WriteAheadLog:
         self._f.flush()
         try:
             os.fsync(self._f.fileno())
-        except OSError:
-            pass
+        # classified + counted: reset() proceeds regardless (the log is
+        # being superseded wholesale), but an EIO here must be visible
+        except OSError as exc:
+            self._count_error(exc)
+            _note_os_error("reset.fsync", exc)
         self._f.close()
         try:
             size = os.path.getsize(self.path)
-        except OSError:
+        # size probe, tolerant by design (empty/new log)
+        except OSError as exc:
+            _note_os_error("reset.getsize", exc)
             size = 0
         if size:
             seg = f"{self.path}{SEG_INFIX}{self._seg_index:08d}"
@@ -711,8 +1208,11 @@ class WriteAheadLog:
         try:
             self._f.flush()
             self._f.close()
-        except OSError:
-            pass
+        # best-effort teardown, but classified + counted — a close-time
+        # ENOSPC is the same signal the append path surfaces loudly
+        except OSError as exc:
+            self._count_error(exc)
+            _note_os_error("close", exc)
 
     # -------------------------------------------------------------- health
 
@@ -724,19 +1224,35 @@ class WriteAheadLog:
         for fp in files:
             try:
                 total += os.path.getsize(fp)
-            except OSError:
-                pass
+            # size probe over a file compaction may have just retired;
+            # tolerant but counted
+            except OSError as exc:
+                _note_os_error("health.getsize", exc)
         age = (
             None
             if self._last_fsync_at is None
             else max(0.0, time.monotonic() - self._last_fsync_at)
         )
-        return {
+        deg = self._degraded
+        out = {
             "segments": len(files),
             "bytes": total,
             "last_fsync_age_s": age,
             "next_seq": self._seq,
+            "enospc_total": self.enospc_total,
+            "fsync_failures_total": self.fsync_failures_total,
+            "io_errors_total": self.io_errors_total,
+            "rearms_total": self.rearms_total,
+            "reserve_armed": os.path.exists(self._reserve_path),
+            "degraded": None,
         }
+        if deg is not None:
+            out["degraded"] = {
+                "reason": deg["reason"],
+                "detail": deg["detail"],
+                "for_s": max(0.0, time.monotonic() - deg["since"]),
+            }
+        return out
 
     def __enter__(self) -> "WriteAheadLog":
         return self
@@ -773,14 +1289,22 @@ def fsck(
                 for n in os.listdir(archive)
                 if n.startswith(base)
             )
-        except OSError:
+        # tolerant: fsck of a log without an archive yet; counted
+        except OSError as exc:
+            _note_os_error("fsck.archive_listdir", exc)
             arch = []
         files = arch + files
     s = scan_files(files)
     observed: set = set()
     max_rv = 0
     min_rv: Optional[int] = None
+    markers = 0
     for rec in s.records:
+        if rec.get("t") in ("degraded", "rearmed"):
+            # exhaustion bookkeeping frames: visible in the report so
+            # an operator can see the pressure windows offline
+            markers += 1
+            continue
         try:
             rv = int(rec.get("rv", 0) or 0)
         except (TypeError, ValueError):
@@ -817,7 +1341,9 @@ def fsck(
                 n for n in os.listdir(archive)
                 if n.startswith("snap-") and n.endswith(".json")
             )
-        except OSError:
+        # tolerant twin of the segment listing above; counted
+        except OSError as exc:
+            _note_os_error("fsck.snap_listdir", exc)
             snaps = []
         for n in reversed(snaps):
             try:
@@ -827,7 +1353,12 @@ def fsck(
                     )
                 )
                 break
-            except (OSError, SnapshotCorruption, TypeError, ValueError):
+            except (OSError, SnapshotCorruption, TypeError, ValueError) as exc:
+                # walking back past an unreadable/corrupt snapshot to
+                # an older verifiable one IS the fallback; OS-level
+                # failures are still counted on the way past
+                if isinstance(exc, OSError):
+                    _note_os_error("fsck.snap_read", exc)
                 continue
     floors = [f for f in (snap_rv, archive_snap_rv) if f is not None]
     floor = max(floors) if floors else (min_rv - 1 if min_rv else 0)
@@ -851,6 +1382,7 @@ def fsck(
         "files": s.files,
         "records": len(s.records),
         "legacy_frames": s.legacy,
+        "exhaustion_markers": markers,
         "torn_tail": s.torn_tail,
         "corruptions": s.corruptions,
         "snapshot_rv": snap_rv,

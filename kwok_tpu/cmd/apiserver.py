@@ -155,23 +155,23 @@ def main(argv=None) -> int:
                 + _json.dumps(rec.summary()),
                 flush=True,
             )
+    wal = None
     if args.wal_file:
         # attach AFTER replay — the log keeps covering its records
         # until a snapshot compacts them
         from kwok_tpu.cluster.wal import WriteAheadLog
 
-        store.attach_wal(
-            WriteAheadLog(
-                args.wal_file,
-                fsync=args.wal_fsync,
-                **(
-                    {"segment_bytes": args.wal_segment_bytes}
-                    if args.wal_segment_bytes
-                    else {}
-                ),
-                archive_dir=args.pitr_dir or None,
-            )
+        wal = WriteAheadLog(
+            args.wal_file,
+            fsync=args.wal_fsync,
+            **(
+                {"segment_bytes": args.wal_segment_bytes}
+                if args.wal_segment_bytes
+                else {}
+            ),
+            archive_dir=args.pitr_dir or None,
         )
+        store.attach_wal(wal)
 
     injector = None
     plan = None
@@ -235,6 +235,21 @@ def main(argv=None) -> int:
             flush=True,
         )
 
+    pressure = None
+    if plan is not None and wal is not None:
+        from kwok_tpu.chaos import PressureDriver
+
+        if PressureDriver.specs(plan):
+            # exhaustion windows (disk-full/fsync-error/quota) run
+            # inside this process against the live WAL handles — the
+            # external DiskFaultDriver only applies corruption kinds
+            pressure = PressureDriver(plan, wal, store=store).start()
+            print(
+                "chaos: filesystem pressure armed "
+                f"({len(PressureDriver.specs(plan))} windows)",
+                flush=True,
+            )
+
     done = threading.Event()
 
     def _stop(signum, frame):
@@ -243,7 +258,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
-    def save_once() -> None:
+    def save_once() -> bool:
         # online consistent cut: refs captured under one brief mutex
         # hold (copy-on-write store), serialized outside the lock —
         # live writers are never stalled for the disk write
@@ -252,20 +267,54 @@ def main(argv=None) -> int:
         # (without a WAL the in-place status lane may mutate stored
         # objects — keep the deep-copy capture there)
         state = store.dump_state(copy=not args.wal_file)
-        write_state_file(args.state_file, state)
-        if pitr is not None:
-            pitr.add_snapshot(state)
-        store.compact_wal(int(state["resourceVersion"]))
-        if pitr is not None:
-            pitr.prune(keep_snapshots=args.pitr_keep)
+        try:
+            write_state_file(args.state_file, state)
+            if pitr is not None:
+                pitr.add_snapshot(state)
+            store.compact_wal(int(state["resourceVersion"]))
+            if pitr is not None:
+                pitr.prune(keep_snapshots=args.pitr_keep)
+        except OSError as exc:
+            # a full/failing disk cannot take a snapshot — skip this
+            # tick instead of killing the daemon (the WAL keeps its
+            # coverage because compaction only retires what a durable
+            # snapshot covers)
+            print(f"snapshot save skipped: {exc}", flush=True)
+            return False
+        return True
+
+    def rearm_loop() -> None:
+        # background re-arm probe: degraded mode also clears when NO
+        # traffic is hitting the /readyz probe (an idle cluster on a
+        # disk that freed up must not stay read-only).  probe_writable
+        # returns immediately when healthy, so one call per tick is
+        # one probe, not two.  On the degraded→armed transition,
+        # re-run the bootstrap namespace creation — a boot onto a full
+        # disk skipped it.
+        while not done.wait(1.0):
+            # read the flag without probing (wal_health is probe-free)
+            # so the transition is observable
+            was = bool((store.wal_health() or {}).get("degraded"))
+            if store.probe_writable() and was:
+                srv.ensure_namespaces()
+
+    if args.wal_file:
+        threading.Thread(target=rearm_loop, daemon=True).start()
 
     saved_rv = -1
     while not done.wait(args.save_interval):
         if args.state_file and store.resource_version != saved_rv:
-            saved_rv = store.resource_version
-            save_once()
+            # capture BEFORE the dump: writes landing while the
+            # snapshot serializes must re-trigger the next tick (and
+            # the shutdown save), not be marked covered
+            rv = store.resource_version
+            if save_once():
+                saved_rv = rv
     if args.state_file and store.resource_version != saved_rv:
         save_once()
+    if pressure is not None:
+        pressure.stop()
+        print(f"chaos: pressure windows {pressure.events}", flush=True)
     if overload is not None:
         overload.stop()
         print(f"chaos: overload flood {overload.snapshot()}", flush=True)

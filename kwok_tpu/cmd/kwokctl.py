@@ -152,7 +152,13 @@ def cmd_get_components(args) -> int:
         # the plain liveness listing rather than failing the command
         pass
     for name, alive in rt.running_components().items():
-        line = f"{name}\t{'Running' if alive else 'Stopped'}"
+        status = "Running" if alive else "Stopped"
+        if name == "apiserver" and alive and wal and wal.get("degraded"):
+            # alive but read-only: the disk is full / fsync poisoned.
+            # Shown as its own state so nobody "fixes" it with restarts
+            deg = wal["degraded"]
+            status = f"DEGRADED({deg.get('reason', 'storage')})"
+        line = f"{name}\t{status}"
         if name in election:
             lease, transitions, age = election[name]
             line += f"\tleader({lease})\ttransitions={transitions}"

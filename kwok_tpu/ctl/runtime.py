@@ -519,6 +519,14 @@ class ComponentSupervisor:
       counts as recovered (a bound process that cannot serve is still
       down).  SIGSTOPped components look alive — pausing is the chaos
       plan's business, not ours to "fix".
+    - **readiness-gated, not readiness-restarted**: a serving apiserver
+      whose /readyz answers 503 (storage degraded: full disk, poisoned
+      fsync) is *alive but read-only* — a restart cannot fix the disk,
+      so degraded components are tracked in :attr:`degraded` (and as
+      ``degraded``/``ready`` events) without consuming restart budget
+      or counting toward crash-loop parking.  The liveness/readiness
+      split exists precisely so this loop never restart-loops a daemon
+      whose only problem is ENOSPC.
     - **restart with backoff**: per-component jittered exponential
       backoff (shared :class:`kwok_tpu.utils.backoff.Backoff`; the rng
       is explicit so a seeded chaos run replays the same schedule).
@@ -549,6 +557,9 @@ class ComponentSupervisor:
         self.events: List[dict] = []
         self.recovery_times: List[float] = []
         self.crash_looped: set = set()
+        #: component -> degraded reason (e.g. "StorageDegraded") while
+        #: its /readyz fails with the process alive and serving
+        self.degraded: Dict[str, str] = {}
         self._restart_times: Dict[str, List[float]] = {}
         self._death_time: Dict[str, float] = {}
         self._restart_due: Dict[str, float] = {}
@@ -609,6 +620,10 @@ class ComponentSupervisor:
                     self.recovery_times.append(now - death)
                     self._record(now, name, "recovered")
                 self._restart_due.pop(name, None)
+                # alive and serving: readiness is a separate axis.  A
+                # degraded (read-only) apiserver is tracked, never
+                # restarted — no restart budget, no crash-loop credit.
+                self._track_readiness(now, name)
                 continue
             if self.runtime.component_alive(name):
                 # alive-but-not-serving (apiserver mid-boot): keep the
@@ -636,6 +651,27 @@ class ComponentSupervisor:
                 self._restart_times.setdefault(name, []).append(now)
                 self._restart_due.pop(name, None)
                 self._record(now, name, "restarted")
+
+    def _track_readiness(self, now: float, name: str) -> None:
+        """Probe /readyz for the apiserver (the only component with a
+        storage axis today) and record degraded/ready transitions.
+        Degraded is explicitly NOT death: the restart machinery is
+        never touched from here."""
+        if name != "apiserver" or self._client is None:
+            return
+        probe = getattr(self._client, "readiness", None)
+        if probe is None:
+            return
+        ok, reason = probe()
+        was = self.degraded.get(name)
+        if ok and was is not None:
+            del self.degraded[name]
+            self._record(now, name, "ready")
+        elif not ok and reason is not None and was is None:
+            # reason None means unreachable — the liveness probe owns
+            # that case; only a *served* not-ready marks degraded
+            self.degraded[name] = reason
+            self._record(now, name, "degraded")
 
     def _record(self, now: float, component: str, action: str) -> None:
         self.events.append(

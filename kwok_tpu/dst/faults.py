@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from kwok_tpu.cluster.client import ApiUnavailable
-from kwok_tpu.cluster.store import Conflict
+from kwok_tpu.cluster.store import Conflict, StorageDegraded
 
 __all__ = ["SimCrash", "FaultTimeline", "ActorStore"]
 
@@ -159,6 +159,28 @@ class FaultTimeline:
                 params={"mode": rng.choice(["bit-flip", "truncate"])},
             )
         )
+        # one storage-exhaustion window (kwok_tpu.chaos.fs_pressure, in
+        # virtual time): the WAL's writes are refused for the window;
+        # the store must go honestly read-only and re-arm at the end
+        # (exhaustion-honesty invariant).  Only the write-path kinds:
+        # fsync-error needs a fsync *policy* to trigger, and the DST
+        # WAL runs fsync="off" to stay off the wall clock — that shape
+        # is covered by --exhaustion-smoke instead.
+        p_mode = rng.choice(["disk-full", "quota"])
+        t_p = t0 + rng.uniform(3.0, window_s * 0.8)
+        p_dur = rng.uniform(1.5, 4.0)
+        self.scheduled.append(
+            _Scheduled(
+                t=t_p,
+                kind="pressure-start",
+                params={"mode": p_mode, "duration": p_dur},
+            )
+        )
+        self.scheduled.append(
+            _Scheduled(
+                t=t_p + p_dur, kind="pressure-end", params={"mode": p_mode}
+            )
+        )
         self.scheduled.sort(key=lambda s: s.t)
 
     # ------------------------------------------------------------ queries
@@ -174,6 +196,16 @@ class FaultTimeline:
     def next_time(self) -> Optional[float]:
         pending = [s.t for s in self.scheduled if not s.fired]
         return min(pending) if pending else None
+
+    def pressure_end_after(self, t: float) -> float:
+        """The earliest unfired pressure-end instant (scenario writes
+        refused by the degraded gate reschedule to just past it)."""
+        ends = [
+            s.t
+            for s in self.scheduled
+            if s.kind == "pressure-end" and not s.fired and s.t > t
+        ]
+        return min(ends) if ends else t + 1.0
 
     def partitioned(self, client_id: str, t: float) -> bool:
         return any(
@@ -283,7 +315,14 @@ class ActorStore:
         if kw.get("as_user") is None:
             kw["as_user"] = self.client_id
         rv_before = sim.store.resource_version
-        result = fn(*a, **kw)
+        try:
+            result = fn(*a, **kw)
+        except StorageDegraded:
+            # degraded read-only mode refused the mutation — a VISIBLE
+            # rejection (the exhaustion-honesty invariant counts these
+            # against silently-lost acks)
+            sim.note_degraded_rejection(self._actor, verb)
+            raise
         t = self._now()
         for action, detail in detail_fn(result):
             sim.trace.add(t, self._actor, action, detail)

@@ -28,7 +28,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from kwok_tpu.cluster.client import ApiUnavailable
-from kwok_tpu.cluster.store import Conflict, NotFound, ResourceStore
+from kwok_tpu.cluster.store import (
+    Conflict,
+    NotFound,
+    ResourceStore,
+    StorageDegraded,
+)
 from kwok_tpu.cluster.wal import WriteAheadLog
 from kwok_tpu.dst.actors import (
     ElectorActor,
@@ -89,6 +94,10 @@ class RunRecord:
     #: disk-fault probes: per injected storage corruption, how every
     #: acked rv was accounted for (recovery-honesty invariant)
     disk_checks: List[dict] = field(default_factory=list)
+    #: exhaustion probes: per pressure window, every ack inside it
+    #: accounted durable-in-log ∪ visibly-rejected, and writes re-armed
+    #: at window end (exhaustion-honesty invariant)
+    exhaustion_checks: List[dict] = field(default_factory=list)
     replay_matches: Optional[bool] = None
     replay_detail: str = ""
     converged: bool = False
@@ -117,6 +126,12 @@ class Simulation:
         self.acked_rvs: set = set()
         self.crash_checks: List[dict] = []
         self.disk_checks: List[dict] = []
+        self.exhaustion_checks: List[dict] = []
+        #: live pressure shim (chaos/fs_pressure.py) while a window is
+        #: open — reinstalled onto recovered WALs so a crash inside a
+        #: window does not silently lift the pressure
+        self._active_pressure = None
+        self._pressure_probe: Optional[dict] = None
         self._crash_arm: Optional[dict] = None
         self._suffix_n = 0
         self.steps = 0
@@ -216,6 +231,13 @@ class Simulation:
         if rv_before is not None and rv > rv_before:
             self.acked_rvs.update(range(rv_before + 1, rv + 1))
 
+    def note_degraded_rejection(self, actor: str, verb: str) -> None:
+        """A mutation visibly refused by the degraded read-only gate
+        (ActorStore records it here + in the trace)."""
+        self.trace.add(self.clock.now(), actor, "degraded-rejected", verb)
+        if self._pressure_probe is not None:
+            self._pressure_probe["rejections"] += 1
+
     def _crash_dispatch(self, phase: str) -> None:
         arm = self._crash_arm
         if arm is None or phase != arm["phase"]:
@@ -236,6 +258,10 @@ class Simulation:
         recovered = ResourceStore(clock=self.clock)
         rep = recovered.recover_wal(self.wal_path)
         self.wal = WriteAheadLog(self.wal_path, fsync="off")
+        if self._active_pressure is not None:
+            # a crash inside a pressure window: the disk is still full
+            # when the process comes back
+            self.wal.set_pressure(self._active_pressure)
         recovered.attach_wal(self.wal)
         recovered.set_crash_hook(self._crash_dispatch)
         self.store = recovered
@@ -330,7 +356,10 @@ class Simulation:
         steps.append((t0 + o.duration * 0.7, "scale", ("web", o.scale_back)))
         return steps
 
-    def _apply_scenario(self, kind: str, arg) -> None:
+    def _apply_scenario(self, kind: str, arg):
+        """Returns "degraded" when the write was refused by the
+        degraded read-only gate (the run loop reschedules the step to
+        just past the pressure window), else None."""
         if kind == "node":
             obj = {
                 "apiVersion": "v1",
@@ -342,7 +371,7 @@ class Simulation:
                     "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
                 },
             }
-            self._must(lambda: self._op_store.create(dict(obj)))
+            return self._must(lambda: self._op_store.create(dict(obj)))
         elif kind == "deployment":
             name, replicas = arg
             obj = {
@@ -371,10 +400,10 @@ class Simulation:
                     },
                 },
             }
-            self._must(lambda: self._op_store.create(dict(obj)))
+            return self._must(lambda: self._op_store.create(dict(obj)))
         elif kind == "scale":
             name, replicas = arg
-            self._must(
+            return self._must(
                 lambda: self._op_store.patch(
                     "Deployment",
                     name,
@@ -384,23 +413,29 @@ class Simulation:
                 )
             )
 
-    def _must(self, fn) -> None:
+    def _must(self, fn):
         """Drive an operator mutation to an acknowledged outcome, the
         chaos-smoke `must` contract: ApiUnavailable may mean applied —
-        replay, treating already-applied answers as success."""
+        replay, treating already-applied answers as success.  Returns
+        "degraded" when storage is in read-only mode (retrying in-place
+        would spin inside one virtual instant; the caller reschedules
+        the step past the pressure window instead)."""
         for _ in range(30):
             try:
                 fn()
-                return
+                return None
             except SimCrash as c:
                 self._restart_store(c)
+            except StorageDegraded:
+                return "degraded"
             except ApiUnavailable:
                 continue
             except Conflict:
-                return
+                return None
             except NotFound:
-                return
+                return None
         self.trace.add(self.clock.now(), "scenario", "gave-up", "")
+        return None
 
     # ------------------------------------------------------------------ faults
 
@@ -444,6 +479,78 @@ class Simulation:
                 self.trace.add(t, "faults", "resume", target.name)
         elif kind == "disk-corrupt":
             self._disk_fault(params["mode"])
+        elif kind == "pressure-start":
+            self._pressure_start(params["mode"])
+        elif kind == "pressure-end":
+            self._pressure_end(params["mode"])
+
+    def _pressure_start(self, mode: str) -> None:
+        """Open a storage-exhaustion window: the WAL's writes start
+        being refused (disk-full/quota semantics, fs_pressure shim);
+        the first failing append releases the emergency reserve and
+        flips the store into degraded read-only mode."""
+        from kwok_tpu.chaos.fs_pressure import FsPressure
+
+        t = self.clock.now()
+        shim = FsPressure(mode)
+        self._active_pressure = shim
+        self.wal.set_pressure(shim)
+        self._pressure_probe = {
+            "mode": mode,
+            "start_acked": set(self.acked_rvs),
+            "rejections": 0,
+        }
+        self.trace.add(t, "faults", "pressure-start", mode)
+
+    def _pressure_end(self, mode: str) -> None:
+        """Close the window, force the re-arm probe, and record the
+        exhaustion-honesty evidence: every rv acked during the window
+        must be present in the log (durable) — anything else was a
+        visible rejection, never a silent ack."""
+        from kwok_tpu.cluster import wal as walmod
+
+        t = self.clock.now()
+        self.wal.set_pressure(None)
+        self._active_pressure = None
+        rearmed = self.wal.try_rearm()
+        probe = self._pressure_probe or {
+            "mode": mode, "start_acked": set(), "rejections": 0,
+        }
+        self._pressure_probe = None
+        acked_during = self.acked_rvs - probe["start_acked"]
+        s = walmod.scan(self.wal_path)
+        observed: set = set()
+        for rec in s.records:
+            rt = rec.get("t")
+            if rt == "ev":
+                try:
+                    observed.add(int(rec.get("rv", 0) or 0))
+                except (TypeError, ValueError):
+                    continue
+            elif rt == "status":
+                for item in rec.get("i") or []:
+                    try:
+                        observed.add(int(item[3]))
+                    except (LookupError, TypeError, ValueError):
+                        continue
+        silent = sorted(rv for rv in acked_during if rv not in observed)
+        self.exhaustion_checks.append(
+            {
+                "mode": mode,
+                "acked_during": len(acked_during),
+                "rejections": probe["rejections"],
+                "silent_lost": silent,
+                "rearmed": bool(rearmed),
+            }
+        )
+        self.trace.add(
+            t,
+            "store",
+            "pressure-end",
+            f"{mode} acked={len(acked_during)} "
+            f"rejected={probe['rejections']} silent={len(silent)} "
+            f"rearmed={int(bool(rearmed))}",
+        )
 
     # ------------------------------------------------------------- main loop
 
@@ -473,7 +580,15 @@ class Simulation:
             while si < len(scenario) and scenario[si][0] <= now:
                 _, kind, arg = scenario[si]
                 si += 1
-                self._apply_scenario(kind, arg)
+                if self._apply_scenario(kind, arg) == "degraded":
+                    # storage is read-only: re-run this step just past
+                    # the pressure window instead of spinning now
+                    import bisect
+
+                    retry_at = self.faults.pressure_end_after(now) + 0.5
+                    bisect.insort(
+                        scenario, (retry_at, kind, arg), lo=si
+                    )
             for sched in self.faults.due(now):
                 self._apply_fault(sched)
 
@@ -491,9 +606,10 @@ class Simulation:
                     actor.step()
                 except SimCrash as c:
                     self._restart_store(c)
-                # partition/shed surfacing above a component's own
-                # retry seam: the next scheduled step retries it
-                except ApiUnavailable:  # kwoklint: disable=swallowed-errors
+                # partition/shed/degraded surfacing above a component's
+                # own retry seam: the next scheduled step retries it
+                # (degraded rejections are already traced by ActorStore)
+                except (ApiUnavailable, StorageDegraded):  # kwoklint: disable=swallowed-errors
                     pass
                 except Exception as exc:  # noqa: BLE001 — an actor bug
                     # must fail the run loudly, not hang it
@@ -554,6 +670,7 @@ class Simulation:
         rec.streams = self.observer.streams
         rec.crash_checks = self.crash_checks
         rec.disk_checks = self.disk_checks
+        rec.exhaustion_checks = self.exhaustion_checks
         rec.audit_overflow = self.store.audit_overflow
         rec.steps = self.steps
         rec.virtual_end = self.clock.now() - EPOCH
@@ -607,6 +724,7 @@ def run_seed(
         "converged": rec.converged,
         "crashes": len(rec.crash_checks),
         "disk_faults": len(rec.disk_checks),
+        "pressure_windows": len(rec.exhaustion_checks),
         "counts": rec.final_counts,
         "violations": violations,
     }

@@ -23,7 +23,11 @@ specifies for this repo:
   a truncated trace must fail loudly, never pass vacuously),
 - recovery honesty (disk-fault recoveries are detected and the
   recovered state + reported-lost set account for every acked rv —
-  the storage-integrity contract of ``kwok_tpu/cluster/wal.py:1``).
+  the storage-integrity contract of ``kwok_tpu/cluster/wal.py:1``),
+- exhaustion honesty (every write acked inside a storage-pressure
+  window is durable in the log or was visibly rejected, and writes
+  re-arm when the window closes — the degraded read-only contract of
+  ``kwok_tpu/chaos/fs_pressure.py:1``).
 
 Pluggable: ``INVARIANTS`` maps name → checker; ``run_checks`` runs a
 selection and returns ``{name: [violations]}``.
@@ -200,6 +204,30 @@ def check_recovery_honesty(record) -> List[str]:
     return out
 
 
+def check_exhaustion_honesty(record) -> List[str]:
+    """Storage-exhaustion windows must degrade *honestly*: every rv
+    acked while the disk refused writes is durable in the log
+    (reserve-powered) — anything not durable must have been a visible
+    rejection, never a silent ack — and writes must re-arm the moment
+    the window closes (``RunRecord.exhaustion_checks`` probes, taken at
+    each window's end against the live WAL —
+    ``kwok_tpu/chaos/fs_pressure.py:1``)."""
+    out: List[str] = []
+    for i, probe in enumerate(record.exhaustion_checks):
+        if probe["silent_lost"]:
+            out.append(
+                f"pressure window #{i} ({probe['mode']}): acked rvs "
+                f"{probe['silent_lost'][:5]} were never made durable "
+                "and never rejected"
+            )
+        if not probe["rearmed"]:
+            out.append(
+                f"pressure window #{i} ({probe['mode']}): writes did "
+                "not re-arm after the window closed"
+            )
+    return out
+
+
 def check_trace_complete(record) -> List[str]:
     if record.audit_overflow:
         return [
@@ -217,6 +245,7 @@ INVARIANTS: Dict[str, Callable] = {
     "convergence": check_convergence,
     "trace-complete": check_trace_complete,
     "recovery-honesty": check_recovery_honesty,
+    "exhaustion-honesty": check_exhaustion_honesty,
 }
 
 

@@ -32,6 +32,7 @@ from kwok_tpu.cluster.store import ResourceStore
 from kwok_tpu.cluster.wal import (
     SEG_INFIX,
     SnapshotCorruption,
+    _note_os_error,
     read_state_file,
     scan_files,
     segment_files,
@@ -68,7 +69,10 @@ class PitrArchive:
         out: List[Tuple[int, str]] = []
         try:
             names = os.listdir(self.root)
-        except OSError:
+        # a not-yet-created archive is normal; counted + logged when
+        # it is anything else (cluster/wal.py tolerated-I/O tally)
+        except OSError as exc:
+            _note_os_error("pitr.snapshots.listdir", exc)
             return out
         for n in names:
             if n.startswith(SNAP_PREFIX) and n.endswith(".json"):
@@ -85,7 +89,9 @@ class PitrArchive:
         in write order)."""
         try:
             names = os.listdir(self.root)
-        except OSError:
+        # same tolerant-but-counted posture as snapshots()
+        except OSError as exc:
+            _note_os_error("pitr.segments.listdir", exc)
             return []
         return sorted(
             os.path.join(self.root, n) for n in names if SEG_INFIX in n
@@ -244,8 +250,10 @@ class PitrArchive:
                 try:
                     os.unlink(path)
                     dropped["snapshots"] += 1
-                except OSError:
-                    pass
+                # prune is best-effort by design (a vanished file IS
+                # pruned); anything else is counted + logged
+                except OSError as exc:
+                    _note_os_error("pitr.prune.snapshot", exc)
             snaps = snaps[len(snaps) - keep_snapshots:]
         if not snaps:
             return dropped
@@ -265,8 +273,9 @@ class PitrArchive:
                     os.unlink(seg)
                     dropped["segments"] += 1
                     del self._seg_max_rv[seg]
-                except OSError:
-                    pass
+                # same best-effort prune posture as the snapshot loop
+                except OSError as exc:
+                    _note_os_error("pitr.prune.segment", exc)
         return dropped
 
 

@@ -257,13 +257,15 @@ class WorkloadManager:
                 self.reconciles += 1
         except Exception as exc:  # noqa: BLE001 — a bad object must not kill
             from kwok_tpu.cluster.client import ApiUnavailable
-            from kwok_tpu.cluster.store import Conflict
+            from kwok_tpu.cluster.store import Conflict, StorageDegraded
 
-            if isinstance(exc, (ApiUnavailable, Conflict)):
+            if isinstance(exc, (ApiUnavailable, Conflict, StorageDegraded)):
                 # transient: an outage/shed defers to the resync sweep,
-                # and a Conflict is either an rv race or a stale leader
+                # a Conflict is either an rv race or a stale leader
                 # fence (this replica is about to be deposed — e.g.
-                # after a lossy storage recovery rolled the Lease back);
+                # after a lossy storage recovery rolled the Lease back),
+                # and StorageDegraded is the read-only window (full
+                # disk) — the resync sweep retries once writes re-arm;
                 # a full traceback per deferred key is just noise
                 logger.info("reconcile deferred", key=f"{kind}/{ns}/{name}", err=str(exc))
             else:

@@ -781,6 +781,88 @@ def test_swallowed_outside_loop_is_clean(tmp_path):
     assert run_rules(root, ["swallowed-errors"]) == []
 
 
+def test_swallowed_os_error_in_storage_path_fires(tmp_path):
+    """The exhaustion variant: an OSError dropped in cluster/wal.py
+    (pass / continue / bare return — no loop required) is how a full
+    disk silently acks writes; must be flagged file-wide."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/wal.py": """
+            import os
+
+            def probe(path):
+                try:
+                    return os.path.getsize(path)
+                except OSError:
+                    return 0
+
+            def walk(paths):
+                out = []
+                for p in paths:
+                    try:
+                        out.append(open(p))
+                    except (ValueError, IOError):
+                        continue
+                return out
+            """,
+        },
+    )
+    fs = run_rules(root, ["swallowed-errors"])
+    assert len(fs) == 2 and all(
+        "storage path" in f.message for f in fs
+    ), [f.render() for f in fs]
+
+
+def test_swallowed_os_error_outside_storage_path_is_clean(tmp_path):
+    """The same shape outside the storage files (e.g. a socket
+    teardown in the client) stays the loop rule's business only."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/client.py": """
+            def drop(conn):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []
+
+
+def test_swallowed_os_error_storage_handler_that_counts_is_clean(tmp_path):
+    """Classify-and-count (the _note_os_error posture) satisfies the
+    storage variant; so does an explicit suppression with a reason."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/wal.py": """
+            import os
+
+            def probe(path, note):
+                try:
+                    return os.path.getsize(path)
+                except OSError as exc:
+                    note("probe", exc)
+                    return 0
+
+            def sizes(paths):
+                total = 0
+                for p in paths:
+                    try:
+                        total += os.path.getsize(p)
+                    # reason: races with compaction are normal
+                    except OSError:  # kwoklint: disable=swallowed-errors
+                        continue
+                return total
+            """,
+        },
+    )
+    assert run_rules(root, ["swallowed-errors"]) == []
+
+
 def test_swallowed_nested_def_in_loop_is_clean(tmp_path):
     """Code inside a function defined in the loop runs on another
     stack; only the loop's own statements count."""

@@ -25,6 +25,8 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --smoke --pods "${CHAOS_PODS:-40}"
     echo "== corruption smoke (seeded disk faults -> detected, bounded, honest recovery) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --corruption-smoke
+    echo "== exhaustion smoke (disk-full/fsync-error windows -> degraded read-only, zero lost acks) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --exhaustion-smoke
     echo "== overload smoke (best-effort flood -> 429s, canary unharmed) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --overload-smoke \
         --flood-seconds "${OVERLOAD_SECONDS:-2}"
