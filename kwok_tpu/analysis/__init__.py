@@ -24,8 +24,10 @@ import ast
 import dataclasses
 from typing import List, Optional
 
-#: severity vocabulary: ``error`` findings gate CI (non-zero exit);
-#: ``warning`` findings are reported but do not fail the run.
+#: severity vocabulary: both gate the repo (any remaining finding is a
+#: non-zero CLI exit and fails tests/test_analysis.py) — ``warning``
+#: marks hygiene-class findings (e.g. the driver's suppression audit)
+#: for prioritization and maps to SARIF's warning level
 ERROR = "error"
 WARNING = "warning"
 
@@ -68,6 +70,11 @@ class SourceFile:
     #: disable-file=<rule>`` comment anywhere in the file (comment
     #: tokens only — the same text inside a string literal is inert)
     file_suppressions: "set"
+    #: raw directive comments for the suppression audit: each entry is
+    #: {"row", "rules", "file_wide", "has_reason"} — has_reason is True
+    #: when the comment carries prose beyond the directive or the line
+    #: above it is a non-directive comment
+    suppression_comments: "list[dict]" = dataclasses.field(default_factory=list)
 
     def suppressed(self, finding: Finding) -> bool:
         if finding.rule in self.file_suppressions or "all" in self.file_suppressions:
@@ -107,6 +114,7 @@ def all_rules() -> "dict[str, object]":
     from kwok_tpu.analysis import (
         layering,
         lock_discipline,
+        lock_order,
         parity_citations,
         store_boundary,
         swallowed_errors,
@@ -120,6 +128,7 @@ def all_rules() -> "dict[str, object]":
         "layering": layering.analyze,
         "store-boundary": store_boundary.analyze,
         "lock-discipline": lock_discipline.analyze,
+        "lock-order": lock_order.analyze,
         "tracer-safety": tracer_safety.analyze,
         "parity-citations": parity_citations.analyze,
         "swallowed-errors": swallowed_errors.analyze,

@@ -10,6 +10,8 @@ Usage::
 
     python -m kwok_tpu.analysis                      # text, exit 1 on findings
     python -m kwok_tpu.analysis --format json        # machine-readable
+    python -m kwok_tpu.analysis --format sarif       # CI annotation format
+    python -m kwok_tpu.analysis --changed-only       # git-diff-scoped pre-commit path
     python -m kwok_tpu.analysis --baseline           # subtract tools/kwoklint-baseline.json
     python -m kwok_tpu.analysis --update-baseline    # rewrite the baseline from current findings
     python -m kwok_tpu.analysis --rules layering,lock-discipline
@@ -27,6 +29,7 @@ from typing import List, Optional
 from kwok_tpu.analysis import Finding, all_rules
 from kwok_tpu.analysis.driver import (
     Config,
+    collect_changed_files,
     load_baseline,
     run,
     save_baseline,
@@ -36,13 +39,62 @@ from kwok_tpu.analysis.driver import (
 DEFAULT_BASELINE = os.path.join("tools", "kwoklint-baseline.json")
 
 
+def _sarif(findings: List[Finding]) -> dict:
+    """SARIF 2.1.0 document — the shape CI annotators (GitHub code
+    scanning et al.) ingest natively."""
+    rule_ids = sorted({f.rule for f in findings})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "kwoklint",
+                        "informationUri": (
+                            "https://sigs.k8s.io/kwok"  # parity tooling
+                        ),
+                        "rules": [{"id": r} for r in rule_ids],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error" if f.severity == "error" else "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {"startLine": f.line},
+                                }
+                            }
+                        ],
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m kwok_tpu.analysis",
         description="kwoklint: repo-native static analysis for kwok_tpu",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text", dest="fmt"
+        "--format",
+        choices=("text", "json", "sarif"),
+        default="text",
+        dest="fmt",
+    )
+    parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="analyze only git-changed files (pre-commit path; falls "
+        "back to the full walk outside a git repo; whole-graph "
+        "conclusions and the suppression audit need the full run)",
     )
     parser.add_argument(
         "--root",
@@ -84,8 +136,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         reference_root=args.reference,
         rules=args.rules.split(",") if args.rules else None,
     )
+    if args.changed_only and args.update_baseline:
+        # a baseline rewritten from the changed-file subset would drop
+        # every entry for unchanged files — always refuse
+        print(
+            "kwoklint: --update-baseline needs the full walk; "
+            "drop --changed-only",
+            file=sys.stderr,
+        )
+        return 2
+    files = None
+    if args.changed_only:
+        files = collect_changed_files(config.root)
+        # None = not a git repo -> full walk (documented fallback)
     try:
-        findings = run(config, cache_path=args.cache)
+        findings = run(config, files=files, cache_path=args.cache)
     except ValueError as exc:
         print(f"kwoklint: {exc}", file=sys.stderr)
         return 2
@@ -104,6 +169,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings = subtract_baseline(findings, load_baseline(baseline_path))
 
     if args.fmt == "json":
+        cg = getattr(config, "_callgraph", None)
         print(
             json.dumps(
                 {
@@ -118,10 +184,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                         for f in findings
                     ],
                     "count": len(findings),
+                    # analysis-pass cost surface: the shared call graph
+                    # (kwok_tpu/analysis/callgraph.py) is the expensive
+                    # artifact; None when no lock rule ran
+                    "callgraph_build_seconds": (
+                        round(cg.build_seconds, 3) if cg is not None else None
+                    ),
                 },
                 indent=2,
             )
         )
+    elif args.fmt == "sarif":
+        print(json.dumps(_sarif(findings), indent=2))
     else:
         for f in findings:
             print(f.render())
@@ -131,7 +205,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             if findings
             else "kwoklint: clean"
         )
-    return 1 if any(f.severity == "error" for f in findings) else 0
+    # ANY remaining finding fails the run — warnings included — so this
+    # exit code, tools/check.sh's lint stage, and the tier-1 gate
+    # (tests/test_analysis.py asserts findings == []) agree on the same
+    # verdict; severity stays in the output for prioritization and
+    # SARIF levels, and a warning can be baselined like anything else
+    return 1 if findings else 0
 
 
 if __name__ == "__main__":

@@ -23,22 +23,32 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from kwok_tpu.analysis import Finding, SourceFile, all_rules
+from kwok_tpu.analysis import WARNING, Finding, SourceFile, all_rules
 
-#: ``# kwoklint: disable=rule-a,rule-b`` — trailing or standalone
-_SUPPRESS_RE = re.compile(r"#\s*kwoklint:\s*disable=([\w\-,\s]+)")
-_SUPPRESS_FILE_RE = re.compile(r"#\s*kwoklint:\s*disable-file=([\w\-,\s]+)")
+#: ``# kwoklint: disable=<rule-a>,<rule-b>`` — trailing or standalone.
+#: The rule list stops at the first token that is not a rule name, so
+#: a same-comment reason (``disable=<rule> — single owner thread``)
+#: reads as reason prose, not as a bogus rule.  (The examples here
+#: use ``<...>`` so this comment is not itself a directive.)
+_SUPPRESS_RE = re.compile(
+    r"#\s*kwoklint:\s*disable=((?:[\w\-]+\s*,\s*)*[\w\-]+)"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*kwoklint:\s*disable-file=((?:[\w\-]+\s*,\s*)*[\w\-]+)"
+)
 
 #: rules whose findings depend only on one file's AST (cacheable per
 #: content hash).  parity-citations is deliberately NOT here: its
 #: findings depend on the files a docstring CITES (their existence and
 #: line counts), so caching on the citing file's hash would replay a
 #: clean verdict after the cited file rots — the exact drift the rule
-#: exists to catch.  Layering needs the whole import graph.
+#: exists to catch.  Layering needs the whole import graph;
+#: lock-discipline and lock-order close over the project call graph
+#: (kwok_tpu/analysis/callgraph.py), so a change in ANY file can
+#: create findings in an unchanged one.
 PER_FILE_RULES = frozenset(
     [
         "store-boundary",
-        "lock-discipline",
         "tracer-safety",
         "swallowed-errors",
         "unbounded-buffer",
@@ -48,7 +58,7 @@ PER_FILE_RULES = frozenset(
 )
 
 #: bump when any rule's semantics change — invalidates the on-disk cache
-CACHE_VERSION = 7
+CACHE_VERSION = 8
 
 
 def repo_root(start: Optional[str] = None) -> str:
@@ -59,35 +69,75 @@ def repo_root(start: Optional[str] = None) -> str:
     return here
 
 
-def _parse_suppressions(source: str) -> Tuple[Dict[int, set], set]:
+def _parse_suppressions(
+    source: str,
+) -> Tuple[Dict[int, set], set, List[dict]]:
     """Suppressions come from real COMMENT tokens only — the same text
     inside a docstring or string literal (e.g. documentation quoting
-    the syntax) must not disable anything."""
+    the syntax) must not disable anything.  The third return is the
+    raw directive list for the hygiene audit (unused / reason-less
+    suppressions become driver warnings)."""
     per_line: Dict[int, set] = {}
     file_wide: set = set()
+    comments: List[dict] = []
     try:
         tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
     except (tokenize.TokenError, IndentationError, SyntaxError):
-        return per_line, file_wide
+        return per_line, file_wide, comments
+    #: rows carrying a comment that is NOT itself a directive — the
+    #: "reason on the line above" convention
+    plain_comment_rows: set = set()
+    directives: List[Tuple[object, object, bool]] = []  # (tok, match, file_wide)
     for tok in tokens:
         if tok.type != tokenize.COMMENT:
             continue
         m = _SUPPRESS_FILE_RE.search(tok.string)
         if m:
-            file_wide.update(r.strip() for r in m.group(1).split(",") if r.strip())
+            directives.append((tok, m, True))
             continue
         m = _SUPPRESS_RE.search(tok.string)
-        if not m:
-            continue
+        if m:
+            directives.append((tok, m, False))
+        else:
+            plain_comment_rows.add(tok.start[0])
+    #: directive rows whose reason is established — a directive
+    #: directly below one of these inherits it (the adjacent-lines
+    #: pattern: one reason block vouching for a write+flush pair)
+    reasoned_rows: set = set()
+    for tok, m, is_file in sorted(directives, key=lambda d: d[0].start[0]):
         rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
         row = tok.start[0]
-        per_line.setdefault(row, set()).update(rules)
-        # a standalone suppression comment covers the next line's
-        # statement; a trailing one covers its own line (both recorded —
-        # rule granularity keeps the extra coverage harmless)
-        if tok.line[: tok.start[1]].strip() == "":
-            per_line.setdefault(row + 1, set()).update(rules)
-    return per_line, file_wide
+        standalone = tok.line[: tok.start[1]].strip() == ""
+        if is_file:
+            file_wide.update(rules)
+        else:
+            per_line.setdefault(row, set()).update(rules)
+            # a standalone suppression comment covers the next line's
+            # statement; a trailing one covers its own line (both
+            # recorded — rule granularity keeps the extra coverage
+            # harmless)
+            if standalone:
+                per_line.setdefault(row + 1, set()).update(rules)
+        trailing = tok.string[m.end():].strip(" \t-—:;,.")
+        leading = tok.string[: m.start()].strip("# \t-—:;,.")
+        has_reason = bool(
+            trailing
+            or leading
+            or (row - 1) in plain_comment_rows
+            or (row - 1) in reasoned_rows
+        )
+        if has_reason:
+            reasoned_rows.add(row)
+        comments.append(
+            {
+                "row": row,
+                "rules": rules,
+                "file_wide": is_file,
+                "standalone": standalone,
+                "has_reason": has_reason,
+            }
+        )
+    return per_line, file_wide, comments
 
 
 def load_file(abspath: str, rel: str) -> Optional[SourceFile]:
@@ -98,7 +148,7 @@ def load_file(abspath: str, rel: str) -> Optional[SourceFile]:
     except (OSError, SyntaxError):
         return None
     lines = source.splitlines()
-    per_line, file_wide = _parse_suppressions(source)
+    per_line, file_wide, comments = _parse_suppressions(source)
     return SourceFile(
         path=rel.replace(os.sep, "/"),
         abspath=abspath,
@@ -107,6 +157,7 @@ def load_file(abspath: str, rel: str) -> Optional[SourceFile]:
         lines=lines,
         suppressions=per_line,
         file_suppressions=file_wide,
+        suppression_comments=comments,
     )
 
 
@@ -126,6 +177,59 @@ def collect_files(root: str, package: str = "kwok_tpu") -> List[SourceFile]:
             sf = load_file(abspath, rel)
             if sf is not None:
                 out.append(sf)
+    return out
+
+
+def collect_changed_files(
+    root: str, package: str = "kwok_tpu"
+) -> Optional[List[SourceFile]]:
+    """Parse only the files git reports as changed (worktree +  index
+    vs HEAD, plus untracked) — the sub-second pre-commit walk.
+
+    Returns None when ``root`` is not a git repository (callers fall
+    back to the full walk).  Cross-file context is intentionally
+    absent: rules still run, and anything they CAN conclude from the
+    subset is sound (per-file findings, upward imports), but
+    whole-graph conclusions (import cycles, lock-order cycles,
+    cross-module blocking chains into unchanged files) wait for the
+    full run — which is why the suppression audit is also skipped on
+    this path."""
+    import subprocess
+
+    def git(*args: str) -> Optional[List[str]]:
+        try:
+            proc = subprocess.run(
+                ["git", "-C", root, *args],
+                capture_output=True,
+                text=True,
+                timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        return [ln.strip() for ln in proc.stdout.splitlines() if ln.strip()]
+
+    # --relative: diff paths come back toplevel-relative by default,
+    # which silently resolves to nothing when root is a subdirectory of
+    # the git toplevel (ls-files is already cwd-relative)
+    changed = git("diff", "--relative", "--name-only", "HEAD", "--", package)
+    if changed is None:
+        return None
+    untracked = git(
+        "ls-files", "--others", "--exclude-standard", "--", package
+    )
+    rels = sorted(set(changed) | set(untracked or []))
+    out: List[SourceFile] = []
+    for rel in rels:
+        if not rel.endswith(".py"):
+            continue
+        abspath = os.path.join(root, rel)
+        if not os.path.isfile(abspath):
+            continue  # deleted in the worktree
+        sf = load_file(abspath, rel)
+        if sf is not None:
+            out.append(sf)
     return out
 
 
@@ -189,6 +293,7 @@ def run(
         if unknown:
             raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
         rules = {k: v for k, v in rules.items() if k in config.rules}
+    full_walk = files is None
     if files is None:
         files = collect_files(config.root)
     by_path = {sf.path: sf for sf in files}
@@ -236,13 +341,77 @@ def run(
     for name in cross_rules:
         findings.extend(rules[name](files, config))
 
-    findings = [
-        f
-        for f in findings
-        if not (by_path.get(f.path) is not None and by_path[f.path].suppressed(f))
-    ]
+    kept: List[Finding] = []
+    suppressed_hits: Dict[str, List[Tuple[str, int]]] = {}
+    for f in findings:
+        sf = by_path.get(f.path)
+        if sf is not None and sf.suppressed(f):
+            suppressed_hits.setdefault(f.path, []).append((f.rule, f.line))
+        else:
+            kept.append(f)
+    findings = kept
+    # the hygiene audit needs the FULL picture — every rule over every
+    # file — or live suppressions would be misreported as unused, so
+    # --rules subsets and --changed-only walks skip it
+    if full_walk and config.rules is None:
+        findings.extend(_audit_suppressions(files, suppressed_hits))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
+
+
+AUDIT_RULE = "suppression-hygiene"
+
+
+def _audit_suppressions(
+    files: List[SourceFile],
+    suppressed_hits: Dict[str, List[Tuple[str, int]]],
+) -> List[Finding]:
+    """Driver-level hygiene over the ``# kwoklint: disable=`` comments
+    themselves: a suppression that no longer absorbs any finding is
+    dead weight to drop, and a live one without a stated reason is an
+    unreviewable waiver.  Both surface as warnings."""
+    out: List[Finding] = []
+    for sf in files:
+        hits = suppressed_hits.get(sf.path, [])
+        for c in sf.suppression_comments:
+            rules = c["rules"]
+            rows = {c["row"]} | ({c["row"] + 1} if c["standalone"] else set())
+            if c["file_wide"]:
+                used = any(r in rules or "all" in rules for r, _ in hits)
+            else:
+                used = any(
+                    (r in rules or "all" in rules) and ln in rows
+                    for r, ln in hits
+                )
+            label = ",".join(sorted(rules))
+            if not used:
+                out.append(
+                    Finding(
+                        rule=AUDIT_RULE,
+                        path=sf.path,
+                        line=c["row"],
+                        message=(
+                            f"suppression 'disable={label}' no longer "
+                            "matches any finding — drop it"
+                        ),
+                        severity=WARNING,
+                    )
+                )
+            if not c["has_reason"]:
+                out.append(
+                    Finding(
+                        rule=AUDIT_RULE,
+                        path=sf.path,
+                        line=c["row"],
+                        message=(
+                            f"suppression 'disable={label}' carries no "
+                            "reason — add prose in the comment or on "
+                            "the line above"
+                        ),
+                        severity=WARNING,
+                    )
+                )
+    return out
 
 
 # ------------------------------------------------------------------ baseline

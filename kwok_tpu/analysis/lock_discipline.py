@@ -26,10 +26,14 @@ precedent):
 Lock receivers are recognized lexically: a ``with`` context expression
 whose terminal identifier matches ``lock``/``mutex``/``mut``/``cv``/
 ``cond`` (``self._wlock``, ``store._mut``, ``self._cv`` ...).  The
-blocking-call set closes over same-class helpers one level deep: a
-method whose body performs blocking I/O (``_send_locked`` wrapping
-``sock.sendall``) taints its ``self.<name>`` call sites, iterated to a
-fixpoint within the module.
+blocking-call set closes over the **project-wide call graph**
+(:mod:`kwok_tpu.analysis.callgraph`): a function whose body performs
+blocking I/O taints every resolvable call chain that reaches it, so a
+``with self._mut:`` body calling ``self._client.request`` that bottoms
+out in ``sock.sendall`` three modules away fires here — the same
+cross-module chains the per-shard lock families of ROADMAP.md:53-82
+will multiply.  A same-module lexical fixpoint (the pre-callgraph
+behavior) is kept as a fallback for receivers too dynamic to resolve.
 """
 
 from __future__ import annotations
@@ -39,6 +43,7 @@ import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from kwok_tpu.analysis import Finding, SourceFile, dotted_name, terminal_name
+from kwok_tpu.analysis.callgraph import _body_calls, get_callgraph
 
 RULE = "lock-discipline"
 
@@ -267,12 +272,92 @@ def _releases(body: List[ast.stmt], recv: str) -> bool:
     return False
 
 
+def _direct_blocking_qnames(cg) -> Set[str]:
+    """Project functions whose own bodies perform blocking I/O."""
+    out: Set[str] = set()
+    for q, fi in cg.functions.items():
+        for call in _body_calls(fi.node):
+            desc = _direct_blocking_call(call)
+            if desc is not None and not desc.endswith(".wait"):
+                out.add(q)
+                break
+    return out
+
+
+def _check_with_blocks_interproc(
+    sf: SourceFile, cg, qnames: List[str], tainted: Set[str],
+    direct: Set[str], flagged: Set[Tuple[str, int]],
+) -> List[Finding]:
+    """The call-graph half of blocking-under-lock: a call under a
+    lockish ``with`` whose resolvable callee can reach blocking I/O
+    anywhere in the project fires with the witness chain."""
+    findings: List[Finding] = []
+    for q in qnames:
+        fi = cg.functions[q]
+        ctx = None
+        for node in ast.walk(fi.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_text = None
+            for item in node.items:
+                recv = item.context_expr
+                if isinstance(recv, ast.Call):
+                    recv = recv.func
+                if _lockish(recv):
+                    lock_text = _recv_text(item.context_expr)
+                    break
+            if lock_text is None:
+                continue
+            for call in _body_calls(node):
+                if (sf.path, call.lineno) in flagged:
+                    continue
+                if _direct_blocking_call(call) is not None:
+                    continue  # the lexical pass owns direct calls
+                if ctx is None:
+                    ctx = cg.ctx(q)
+                callees, _ = ctx.resolve_call(call)
+                hot = sorted(c for c in callees if c in tainted)
+                if not hot:
+                    continue
+                chain = cg.sample_path(hot[0], direct) or [hot[0]]
+                short = [c.split(".", 1)[-1] for c in chain]
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=call.lineno,
+                        message=(
+                            f"call while holding {lock_text} reaches "
+                            f"blocking I/O via {' -> '.join(short)} — "
+                            "move the I/O outside the critical section "
+                            "or suppress with the reason it must stay"
+                        ),
+                    )
+                )
+                flagged.add((sf.path, call.lineno))
+    return findings
+
+
 def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    files = [sf for sf in files if sf.path.startswith("kwok_tpu/")]
+    if not files:
+        return []
+    cg = get_callgraph(files, config)
+    direct = _direct_blocking_qnames(cg)
+    tainted = cg.closure_reaching(direct)
+    by_path: Dict[str, List[str]] = {}
+    for q in sorted(cg.functions):
+        by_path.setdefault(cg.functions[q].path, []).append(q)
     findings: List[Finding] = []
     for sf in files:
-        if not sf.path.startswith("kwok_tpu/"):
-            continue
         helpers = _blocking_helper_names(sf.tree)
         findings.extend(_check_raw_acquire(sf, sf.tree))
-        findings.extend(_check_with_blocks(sf, sf.tree, helpers))
+        lexical = _check_with_blocks(sf, sf.tree, helpers)
+        findings.extend(lexical)
+        flagged = {(f.path, f.line) for f in lexical}
+        findings.extend(
+            _check_with_blocks_interproc(
+                sf, cg, by_path.get(sf.path, []), tainted, direct, flagged
+            )
+        )
     return findings

@@ -48,6 +48,7 @@ from typing import Callable, Optional, Tuple
 
 from kwok_tpu.cluster.store import Conflict, NotFound
 from kwok_tpu.utils.clock import Clock, MonotonicClock
+from kwok_tpu.utils.locks import make_lock
 
 __all__ = [
     "LeaderElector",
@@ -175,7 +176,7 @@ class LeaderElector:
         self._on_stopped = on_stopped_leading
         self._on_new_leader = on_new_leader
 
-        self._mut = threading.Lock()
+        self._mut = make_lock("cluster.election.LeaderElector._mut")
         self._leading = False
         #: last-generation fence token (see :meth:`fence`)
         self._fence_value: Optional[str] = None

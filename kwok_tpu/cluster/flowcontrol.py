@@ -39,6 +39,8 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from kwok_tpu.utils.locks import make_lock
+
 __all__ = [
     "PriorityLevel",
     "FlowRule",
@@ -265,7 +267,7 @@ class FlowController:
     def __init__(self, config: Optional[FlowConfig] = None, seed: int = 0):
         self.config = config or FlowConfig()
         self.seed = seed
-        self._mut = threading.Lock()
+        self._mut = make_lock("cluster.flowcontrol.FlowController._mut")
         total_shares = sum(lv.shares for lv in self.config.levels) or 1
         self._levels: Dict[str, _Level] = {}
         for spec in self.config.levels:

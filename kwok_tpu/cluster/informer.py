@@ -29,6 +29,7 @@ from kwok_tpu.cluster.store import (
     ResourceStore,
     Selector,
 )
+from kwok_tpu.utils.locks import make_lock
 from kwok_tpu.utils.queue import Queue
 
 # drain accelerator (native/kwok_fastdrain.c); None -> pure Python
@@ -63,7 +64,7 @@ class CacheGetter:
     """Read access to the informer's local mirror (informer.go Getter)."""
 
     def __init__(self):
-        self._mut = threading.Lock()
+        self._mut = make_lock("cluster.informer.CacheGetter._mut")
         self._items: Dict[Tuple[str, str], dict] = {}
 
     def get(self, name: str, namespace: str = "") -> Optional[dict]:

@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from kwok_tpu.cluster.wal import StorageDegraded, WalExhausted
 from kwok_tpu.utils.clock import Clock, RealClock
+from kwok_tpu.utils.locks import make_lock, make_rlock
 from kwok_tpu.utils.patch import apply_patch
 
 # drain accelerator (native/kwok_fastdrain.c); None -> pure Python
@@ -594,7 +595,11 @@ class ResourceStore:
         #: before the finalizer lands, orphaning its contents.
         self.namespace_finalizers = namespace_finalizers
         self._clock = clock or RealClock()
-        self._mut = threading.RLock()
+        # KWOK_LOCK_SENTINEL=1 swaps in the order-checking wrapper
+        # (utils/locks.py); the WAL deliberately has no lock of its own
+        # — every append/rotate happens under THIS mutex, so the store
+        # lock class is also the WAL's ordering identity
+        self._mut = make_rlock("cluster.store.ResourceStore._mut")
         self._rv = 0
         self._uid = 0
         #: durability hooks (kwok_tpu.cluster.wal): None keeps every
@@ -2290,7 +2295,7 @@ class EventRecorder:
         #: (monotonic ns), simulated-time runs inject a deterministic
         #: counter so Event names are seed-stable (kwok_tpu.dst)
         self._suffix = suffix or (lambda: f"{time.monotonic_ns():x}")
-        self._mut = threading.Lock()
+        self._mut = make_lock("cluster.store.EventRecorder._mut")
         self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
 
     def _now_string(self) -> str:

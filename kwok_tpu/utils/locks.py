@@ -1,0 +1,294 @@
+"""Runtime deadlock sentinel: named locks that learn the process-wide
+acquisition order and fail loudly on an inversion.
+
+The static half of the concurrency gate
+(``kwok_tpu/analysis/lock_order.py``) derives the
+may-hold-while-acquiring graph lexically; this is the dynamic
+complement for the holds a lexical view cannot see — locks carried
+across context-manager boundaries (``cluster/store.py`` ``_LaneGrant``
+holds the store mutex from ``__enter__`` to ``__exit__``), receivers
+too dynamic to type, and whatever the sharded-store refactor
+(ROADMAP.md:53-82) wires up at runtime.  Modeled on what the reference
+gets from ``go test -race`` in CI (PARITY.md:175): every chaos/DST run
+doubles as a deadlock detector.
+
+Usage: the shared-state lock sites (store, flowcontrol, election,
+informer) create their mutexes through :func:`make_lock` /
+:func:`make_rlock` instead of calling ``threading`` directly.  With
+``KWOK_LOCK_SENTINEL`` unset the factories return the plain
+``threading`` primitive — zero wrapping, zero overhead, byte-identical
+behavior.  With ``KWOK_LOCK_SENTINEL=1`` they return instrumented
+wrappers that record, per thread, which named lock classes were held
+at each blocking acquire, merge those orders into one process-global
+order graph, and raise :class:`LockInversion` at the acquire that
+would close a cycle — BEFORE blocking on it, so the report fires
+instead of the hang.
+
+Determinism contract: the sentinel reads no clock and no RNG and emits
+nothing into any trace, so DST runs produce byte-identical trace
+digests sentinel-on vs sentinel-off (tests/test_locks.py pins this) —
+which is what lets ``tools/check.sh`` keep its DST stage permanently
+armed.
+
+Lock identity is the NAME (the ``module.Class.attr`` lock class, same
+granularity as the static analyzer), not the instance: holding
+instance A of a class while acquiring instance B of the same class is
+re-entrancy by name and records no edge, exactly like the static
+rule's RLock self-edge exemption.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockInversion",
+    "make_lock",
+    "make_rlock",
+    "make_condition",
+    "sentinel_enabled",
+    "reset_sentinel",
+    "sentinel_order_graph",
+]
+
+
+class LockInversion(RuntimeError):
+    """Two threads acquired the same lock classes in opposite orders.
+
+    Raised in the acquiring thread before it blocks — the process gets
+    a traceback naming both orders instead of a silent deadlock."""
+
+
+def sentinel_enabled() -> bool:
+    return os.environ.get("KWOK_LOCK_SENTINEL", "") == "1"
+
+
+class _Registry:
+    """Process-global acquisition-order graph.
+
+    ``_edges[held][acquired]`` exists when some thread blocked on
+    ``acquired`` while holding ``held``; the value is the first
+    witness (thread name, held-stack snapshot).  A cycle can only
+    appear at the instant its final edge is inserted, so the (locked)
+    path check runs on NEW edges only — repeat acquisitions take the
+    lock-free dict-hit fast path."""
+
+    def __init__(self) -> None:
+        self._mut = threading.Lock()
+        self._edges: Dict[str, Dict[str, Tuple[str, Tuple[str, ...]]]] = {}
+        self._local = threading.local()
+
+    # ------------------------------------------------------- held stack
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = []
+            self._local.stack = st
+        return st
+
+    def push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def pop(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+        # release of a lock this thread never tracked (cross-thread
+        # release): nothing to unwind
+
+    # ------------------------------------------------------ order graph
+
+    def before_blocking_acquire(self, name: str) -> None:
+        st = self._stack()
+        if not st or name in st:
+            # nothing held, or re-entrancy by name: no ordering fact
+            return
+        held = []
+        seen = set()
+        for h in st:
+            if h not in seen:
+                seen.add(h)
+                held.append(h)
+        snapshot = tuple(st)
+        tname = threading.current_thread().name
+        for h in held:
+            bucket = self._edges.get(h)
+            if bucket is not None and name in bucket:
+                continue  # known-good order, lock-free fast path
+            with self._mut:
+                bucket = self._edges.setdefault(h, {})
+                if name in bucket:
+                    continue
+                cycle = self._path(name, h)
+                if cycle is not None:
+                    # deliberately NOT recorded: if this raise is
+                    # absorbed by a broad handler upstream, the next
+                    # occurrence must miss the fast path and re-raise —
+                    # otherwise retry number two blocks into the real
+                    # deadlock with no diagnostic
+                    raise LockInversion(
+                        self._render(h, name, cycle, tname, snapshot)
+                    )
+                bucket[name] = (tname, snapshot)
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """Edge path src -> ... -> dst in the current graph, or None."""
+        if src == dst:
+            return [src]
+        prev: Dict[str, str] = {}
+        seen = {src}
+        queue = [src]
+        while queue:
+            nxt: List[str] = []
+            for n in queue:
+                for m in self._edges.get(n, ()):
+                    if m in seen:
+                        continue
+                    prev[m] = n
+                    if m == dst:
+                        path = [m]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    seen.add(m)
+                    nxt.append(m)
+            queue = nxt
+        return None
+
+    def _render(self, held: str, acquiring: str, cycle: List[str],
+                tname: str, snapshot: Tuple[str, ...]) -> str:
+        lines = [
+            f"lock order inversion: thread {tname!r} holds {held} "
+            f"(stack: {' -> '.join(snapshot)}) and is acquiring {acquiring},",
+            "but the opposite order is already established: "
+            + " -> ".join(cycle),
+        ]
+        for a, b in zip(cycle, cycle[1:]):
+            wt, wstack = self._edges[a][b]
+            lines.append(
+                f"  {a} -> {b} first seen in thread {wt!r} "
+                f"(held: {' -> '.join(wstack) or '-'})"
+            )
+        lines.append(
+            "one of these acquisition chains must reorder or narrow its hold"
+        )
+        return "\n".join(lines)
+
+    def graph(self) -> Dict[str, Dict[str, Tuple[str, Tuple[str, ...]]]]:
+        with self._mut:
+            return {h: dict(b) for h, b in self._edges.items()}
+
+    def reset(self) -> None:
+        with self._mut:
+            self._edges.clear()
+        # per-thread held stacks intentionally survive: live holds are
+        # still live; tests reset between scenarios on fresh threads
+
+
+_registry = _Registry()
+
+
+def sentinel_order_graph():
+    """Snapshot of the learned order graph (diagnostics/tests)."""
+    return _registry.graph()
+
+
+def reset_sentinel() -> None:
+    """Forget all learned edges (test isolation)."""
+    _registry.reset()
+
+
+class _SentinelLock:
+    """Instrumented non-reentrant lock."""
+
+    _factory = staticmethod(threading.Lock)
+
+    __slots__ = ("_name", "_inner")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._inner = self._factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking:
+            # raises LockInversion BEFORE blocking when this acquire
+            # would close an order cycle
+            _registry.before_blocking_acquire(self._name)
+        # this IS the lock implementation: release pairs in release(),
+        # driven by the caller's with/try-finally
+        ok = self._inner.acquire(blocking, timeout)  # kwoklint: disable=lock-discipline
+        if ok:
+            _registry.push(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _registry.pop(self._name)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        # the context-manager face of the wrapper — __exit__ releases
+        self.acquire()  # kwoklint: disable=lock-discipline
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug surface
+        return f"<{type(self).__name__} {self._name} {self._inner!r}>"
+
+
+class _SentinelRLock(_SentinelLock):
+    """Instrumented re-entrant lock.  The ``_release_save`` /
+    ``_acquire_restore`` / ``_is_owned`` trio keeps
+    ``threading.Condition`` working on top of it (wait() fully
+    releases the hold, and the held-stack follows suit so no false
+    edges are recorded while waiting)."""
+
+    _factory = staticmethod(threading.RLock)
+
+    __slots__ = ()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        _registry.pop(self._name)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        _registry.push(self._name)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — instrumented under KWOK_LOCK_SENTINEL=1.
+
+    ``name`` is the lock class, conventionally the static analyzer's
+    identity ``module.Class.attr`` without the ``kwok_tpu.`` prefix."""
+    if sentinel_enabled():
+        return _SentinelLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` — instrumented under KWOK_LOCK_SENTINEL=1."""
+    if sentinel_enabled():
+        return _SentinelRLock(name)
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose inner RLock is instrumented
+    under KWOK_LOCK_SENTINEL=1."""
+    if sentinel_enabled():
+        return threading.Condition(_SentinelRLock(name))
+    return threading.Condition()

@@ -265,7 +265,7 @@ class SpdySession:
             # stateful deflater pins compress+send order to wire order
             block = _encode_headers(headers, self._deflate)
             payload = struct.pack(">I", stream_id & 0x7FFFFFFF) + block
-            return self._send_locked(self._control(SYN_REPLY, 0, payload))  # kwoklint: disable=lock-discipline
+            return self._send_locked(self._control(SYN_REPLY, 0, payload))  # kwoklint: disable=lock-discipline — stateful deflater pins compress+send to wire order
 
     def rst_stream(self, stream_id: int, status: int = 1) -> bool:
         payload = struct.pack(">II", stream_id & 0x7FFFFFFF, status)
@@ -295,7 +295,7 @@ class SpdySession:
             payload = (
                 struct.pack(">II", sid & 0x7FFFFFFF, 0) + b"\x00\x00" + block
             )
-            self._send_locked(  # kwoklint: disable=lock-discipline
+            self._send_locked(  # kwoklint: disable=lock-discipline — stateful deflater pins compress+send to wire order
                 self._control(SYN_STREAM, FLAG_FIN if fin else 0, payload)
             )
         return stream

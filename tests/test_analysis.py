@@ -707,6 +707,198 @@ def test_cli_findings_exit_1_and_baseline_flow(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+# ------------------------------------------------- suppression hygiene audit
+
+
+def test_unused_suppression_warns(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(x):\n"
+                "    # nothing fires here anymore\n"
+                "    return x  # kwoklint: disable=store-boundary\n"
+            ),
+        },
+    )
+    (tmp_path / "SURVEY.md").write_text("doc\n")
+    fs = run(Config(root=root, reference_root="/nonexistent-reference"))
+    assert [f.rule for f in fs] == ["suppression-hygiene"]
+    assert "no longer matches" in fs[0].message
+    assert fs[0].severity == "warning"
+
+
+def test_reasonless_suppression_warns_and_reason_forms_accepted(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            # no reason anywhere: warns
+            "kwok_tpu/utils/bare.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(store):\n"
+                "    return store._types  # kwoklint: disable=store-boundary\n"
+            ),
+            # reason as prose in the same comment: clean
+            "kwok_tpu/utils/inline.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(store):\n"
+                "    return store._types  # kwoklint: disable=store-boundary — simulator owns this store\n"
+            ),
+            # reason as a plain comment on the line above: clean
+            "kwok_tpu/utils/above.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(store):\n"
+                "    # the simulator owns this store's internals\n"
+                "    return store._types  # kwoklint: disable=store-boundary\n"
+            ),
+        },
+    )
+    (tmp_path / "SURVEY.md").write_text("doc\n")
+    fs = run(Config(root=root, reference_root="/nonexistent-reference"))
+    assert [(f.path, f.rule) for f in fs] == [
+        ("kwok_tpu/utils/bare.py", "suppression-hygiene")
+    ], [f.render() for f in fs]
+    assert "carries no reason" in fs[0].message
+
+
+def test_audit_skipped_for_rule_subsets(tmp_path):
+    """--rules runs can't tell used from unused (the other rules never
+    fired), so the audit stays out of them."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/a.py": (
+                "def f(x):\n"
+                "    return x  # kwoklint: disable=store-boundary\n"
+            ),
+        },
+    )
+    assert run_rules(root, ["store-boundary"]) == []
+
+
+# ------------------------------------------------------- changed-only + sarif
+
+
+def test_collect_changed_files_outside_git_returns_none(tmp_path):
+    from kwok_tpu.analysis.driver import collect_changed_files
+
+    root = write_repo(
+        tmp_path, {"kwok_tpu/utils/a.py": "X = 1\n"}
+    )
+    assert collect_changed_files(root) is None
+
+
+def test_collect_changed_files_scopes_to_git_diff(tmp_path):
+    from kwok_tpu.analysis.driver import collect_changed_files
+
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/committed.py": "X = 1\n",
+            "kwok_tpu/utils/other.py": "Y = 1\n",
+        },
+    )
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", root, *args], check=True, capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    # modify one tracked file, add one untracked
+    (tmp_path / "kwok_tpu" / "utils" / "committed.py").write_text("X = 2\n")
+    (tmp_path / "kwok_tpu" / "utils" / "fresh.py").write_text("Z = 1\n")
+    files = collect_changed_files(root)
+    assert files is not None
+    assert sorted(sf.path for sf in files) == [
+        "kwok_tpu/utils/committed.py",
+        "kwok_tpu/utils/fresh.py",
+    ]
+
+
+def test_collect_changed_files_root_below_git_toplevel(tmp_path):
+    """Tracked diffs must resolve when the analysis root is a
+    SUBDIRECTORY of the git toplevel (vendored checkout): git diff
+    emits toplevel-relative paths unless --relative is passed."""
+    from kwok_tpu.analysis.driver import collect_changed_files
+
+    root = write_repo(
+        tmp_path / "vendor" / "kwok-tpu",
+        {"kwok_tpu/utils/committed.py": "X = 1\n"},
+    )
+
+    def git(*args):
+        subprocess.run(
+            ["git", "-C", str(tmp_path), *args], check=True,
+            capture_output=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"},
+        )
+
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (tmp_path / "vendor" / "kwok-tpu" / "kwok_tpu" / "utils"
+     / "committed.py").write_text("X = 2\n")
+    files = collect_changed_files(root)
+    assert files is not None
+    assert [sf.path for sf in files] == ["kwok_tpu/utils/committed.py"]
+
+
+def test_cli_sarif_output(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {"kwok_tpu/workloads/w.py": "def f(store):\n    return store._types\n"},
+    )
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--root", root,
+         "--rules", "store-boundary", "--format", "sarif"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["ruleId"] == "store-boundary"
+    assert results[0]["level"] == "error"
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "kwok_tpu/workloads/w.py"
+    assert loc["region"]["startLine"] == 2
+    assert doc["runs"][0]["tool"]["driver"]["name"] == "kwoklint"
+
+
+def test_cli_changed_only_refuses_update_baseline(tmp_path):
+    """A baseline rewritten from a changed-file subset would drop every
+    entry for unchanged files — the flag pair is always an error."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis",
+         "--changed-only", "--update-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "full walk" in proc.stderr
+
+
+def test_cli_json_exports_callgraph_build_seconds():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--format", "json",
+         "--rules", "lock-order"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert isinstance(data["callgraph_build_seconds"], float)
+    assert data["callgraph_build_seconds"] > 0
+
+
 # ---------------------------------------------------------- swallowed-errors
 
 

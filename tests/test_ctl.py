@@ -184,9 +184,14 @@ def test_kwok_daemon_accepts_config_docs(home, tmp_path):
             proc.wait(timeout=10)
 
 
-def test_cluster_lifecycle_end_to_end(home, capsys):
+def test_cluster_lifecycle_end_to_end(home, capsys, monkeypatch):
     """create → scale → kubectl → snapshot → stop → start (state
-    persists) → hack → delete.  Real subprocess components."""
+    persists) → hack → delete.  Real subprocess components.
+
+    Runs with the deadlock sentinel armed (utils/locks.py): every
+    daemon inherits KWOK_LOCK_SENTINEL=1, so a lock-order inversion
+    anywhere in the control plane fails this tier-1 e2e loudly."""
+    monkeypatch.setenv("KWOK_LOCK_SENTINEL", "1")
     name = "e2e"
     logf = os.path.join(str(home), "container.log")
     with open(logf, "w", encoding="utf-8") as f:

@@ -1,0 +1,479 @@
+"""lock-order analyzer + interprocedural lock-discipline closure.
+
+Synthetic positive/negative fixtures in a throwaway repo layout (the
+test_analysis.py pattern): the ABBA two-lock inversion and a
+three-lock cycle must fire, the aligned orders and re-entrant RLock
+recursion must not, and the upgraded blocking-under-lock closure must
+reach a genuinely cross-module chain.
+"""
+
+import textwrap
+
+from kwok_tpu.analysis.driver import Config, run
+
+from tests.test_analysis import run_rules, write_repo
+
+
+# ------------------------------------------------------------- lock-order
+
+
+def test_abba_two_lock_cycle_fires(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._mut = threading.Lock()
+                    self._other = threading.Lock()
+
+                def ab(self):
+                    with self._mut:
+                        with self._other:
+                            return 1
+
+                def ba(self):
+                    with self._other:
+                        with self._mut:
+                            return 2
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "deadlock candidate" in fs[0].message
+    assert "A._mut" in fs[0].message and "A._other" in fs[0].message
+
+
+def test_multi_item_with_abba_fires(tmp_path):
+    """``with a, b:`` acquires left-to-right on ONE line — the same
+    ABBA written as same-line multi-item withs must still fire."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._mut = threading.Lock()
+                    self._other = threading.Lock()
+
+                def ab(self):
+                    with self._mut, self._other:
+                        return 1
+
+                def ba(self):
+                    with self._other, self._mut:
+                        return 2
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "A._mut" in fs[0].message and "A._other" in fs[0].message
+
+
+def test_aligned_two_lock_order_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._mut = threading.Lock()
+                    self._other = threading.Lock()
+
+                def ab(self):
+                    with self._mut:
+                        with self._other:
+                            return 1
+
+                def ab2(self):
+                    with self._mut:
+                        with self._other:
+                            return 2
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-order"]) == []
+
+
+def test_three_lock_cycle_across_modules_fires(tmp_path):
+    """A -> B -> C -> A through cross-module call chains: each hold
+    site calls into the next module, where the next lock is taken."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+            from kwok_tpu.cluster.b import B
+
+            class A:
+                def __init__(self, b: B):
+                    self._mut = threading.Lock()
+                    self._b = b
+
+                def step(self):
+                    with self._mut:
+                        self._b.step()
+            """,
+            "kwok_tpu/cluster/b.py": """
+            import threading
+            from kwok_tpu.cluster.c import C
+
+            class B:
+                def __init__(self, c: C):
+                    self._mut = threading.Lock()
+                    self._c = c
+
+                def step(self):
+                    with self._mut:
+                        self._c.step()
+            """,
+            "kwok_tpu/cluster/c.py": """
+            import threading
+
+            class C:
+                def __init__(self, a):
+                    self._mut = threading.Lock()
+                    self._a = a
+
+                def step(self):
+                    with self._mut:
+                        self.kick()
+
+                def kick(self):
+                    from kwok_tpu.cluster.a import A
+                    return None
+            """,
+            # the back edge C -> A lives in a fourth module, so the
+            # cycle is invisible to any single-file view
+            "kwok_tpu/cluster/d.py": """
+            from kwok_tpu.cluster.a import A
+            from kwok_tpu.cluster.c import C
+
+            class D:
+                def __init__(self, a: A, c: C):
+                    self._a = a
+                    self._c = c
+
+                def cross(self):
+                    with self._c._mut:
+                        self._a.step()
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    msg = fs[0].message
+    assert "a.A._mut" in msg and "b.B._mut" in msg and "c.C._mut" in msg
+
+
+def test_chain_without_back_edge_clean(tmp_path):
+    """The same A -> B -> C chain with no closing edge is a plain
+    hierarchy — no finding."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+            from kwok_tpu.cluster.b import B
+
+            class A:
+                def __init__(self, b: B):
+                    self._mut = threading.Lock()
+                    self._b = b
+
+                def step(self):
+                    with self._mut:
+                        self._b.step()
+            """,
+            "kwok_tpu/cluster/b.py": """
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._mut = threading.Lock()
+
+                def step(self):
+                    with self._mut:
+                        return 1
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-order"]) == []
+
+
+def test_rlock_reentry_is_not_a_self_cycle(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._mut = threading.RLock()
+
+                def outer(self):
+                    with self._mut:
+                        return self.inner()
+
+                def inner(self):
+                    with self._mut:
+                        return 1
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-order"]) == []
+
+
+def test_plain_lock_self_cycle_fires(tmp_path):
+    """A non-reentrant Lock re-acquired through a call chain is a
+    single-thread self-deadlock."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._mut = threading.Lock()
+
+                def outer(self):
+                    with self._mut:
+                        return self.inner()
+
+                def inner(self):
+                    with self._mut:
+                        return 1
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1 and "Pump._mut" in fs[0].message
+
+
+def test_raw_acquire_hold_feeds_the_graph(tmp_path):
+    """The _LaneGrant pattern: a raw .acquire() holds to end of
+    function, so a later call under the hold contributes edges."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+            from kwok_tpu.cluster.b import B
+
+            class Grant:
+                def __init__(self, b: B):
+                    self._mut = threading.Lock()
+                    self._b = b
+
+                def enter(self):
+                    self._mut.acquire()  # kwoklint: disable=lock-discipline
+                    return self._b.step()
+            """,
+            "kwok_tpu/cluster/b.py": """
+            import threading
+            from kwok_tpu.cluster import a
+
+            class B:
+                def __init__(self):
+                    self._mut = threading.Lock()
+
+                def step(self):
+                    with self._mut:
+                        return 1
+
+                def back(self, g: "a.Grant"):
+                    with self._mut:
+                        g.enter()
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "Grant._mut" in fs[0].message and "B._mut" in fs[0].message
+
+
+def test_lock_order_suppression_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self._mut = threading.Lock()
+                    self._other = threading.Lock()
+
+                def ab(self):
+                    # invariant: ab/ba never run concurrently (single
+                    # owner thread)
+                    with self._mut:  # kwoklint: disable=lock-order
+                        with self._other:
+                            return 1
+
+                def ba(self):
+                    with self._other:
+                        with self._mut:
+                            return 2
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    # the anchor lands on the smallest witness site; when that site
+    # carries the suppression the cycle is accepted
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_sentinel_factory_sites_are_lock_classes(tmp_path):
+    """Adopted sites create locks via kwok_tpu.utils.locks factories;
+    the analyzer must treat them exactly like threading constructors."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/a.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class A:
+                def __init__(self):
+                    self._mut = make_lock("cluster.a.A._mut")
+                    self._other = make_lock("cluster.a.A._other")
+
+                def ab(self):
+                    with self._mut:
+                        with self._other:
+                            return 1
+
+                def ba(self):
+                    with self._other:
+                        with self._mut:
+                            return 2
+            """,
+            "kwok_tpu/utils/locks.py": """
+            def make_lock(name):
+                import threading
+                return threading.Lock()
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-order"])
+    assert len(fs) == 1 and "A._mut" in fs[0].message
+
+
+# ---------------------------------- interprocedural blocking-under-lock
+
+
+def test_cross_module_blocking_chain_fires(tmp_path):
+    """with-lock body -> helper in another module -> socket sendall
+    two hops away: invisible to the same-module fixpoint, caught by
+    the call-graph closure."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/top.py": """
+            from kwok_tpu.cluster.mid import Transport
+
+            class Session:
+                def __init__(self, transport: Transport):
+                    self._mut = __import__("threading").Lock()
+                    self._transport = transport
+
+                def push(self, frame):
+                    with self._mut:
+                        return self._transport.deliver(frame)
+            """,
+            "kwok_tpu/cluster/mid.py": """
+            from kwok_tpu.cluster.wire import send_bytes
+
+            class Transport:
+                def deliver(self, frame):
+                    return send_bytes(self.sock, frame)
+            """,
+            "kwok_tpu/cluster/wire.py": """
+            def send_bytes(sock, frame):
+                sock.sendall(frame)
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "reaches blocking I/O" in fs[0].message
+    assert "wire.send_bytes" in fs[0].message
+
+
+def test_cross_module_nonblocking_chain_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/top.py": """
+            from kwok_tpu.cluster.mid import Transport
+
+            class Session:
+                def __init__(self, transport: Transport):
+                    self._mut = __import__("threading").Lock()
+                    self._transport = transport
+
+                def push(self, frame):
+                    with self._mut:
+                        return self._transport.stage(frame)
+            """,
+            "kwok_tpu/cluster/mid.py": """
+            class Transport:
+                def stage(self, frame):
+                    self.pending.append(frame)
+                    return len(self.pending)
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-discipline"]) == []
+
+
+def test_cross_module_chain_suppression_works(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/top.py": """
+            from kwok_tpu.cluster.wire import send_bytes
+
+            class Session:
+                def push(self, frame):
+                    with self._mut:
+                        # the frame MUST go out under the hold (ordering)
+                        return send_bytes(self.sock, frame)  # kwoklint: disable=lock-discipline
+            """,
+            "kwok_tpu/cluster/wire.py": """
+            def send_bytes(sock, frame):
+                sock.sendall(frame)
+            """,
+        },
+    )
+    assert run_rules(root, ["lock-discipline"]) == []
+
+
+def test_lexical_and_interproc_do_not_double_report(tmp_path):
+    """A same-module transitive helper is caught once (the lexical
+    pass wins the line), not twice."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/l.py": """
+            class S:
+                def _send_raw(self, frame):
+                    self.sock.sendall(frame)
+                def send(self, frame):
+                    with self._wlock:
+                        return self._send_raw(frame)
+            """,
+        },
+    )
+    fs = run_rules(root, ["lock-discipline"])
+    assert len(fs) == 1, [f.render() for f in fs]

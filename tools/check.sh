@@ -33,8 +33,12 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== failover smoke (leader kill/release -> bounded takeover, fenced writes) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --failover-smoke \
         --lease-seconds "${FAILOVER_LEASE_SECONDS:-2.5}"
-    echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks) =="
-    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
+    echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks; lock sentinel armed) =="
+    # KWOK_LOCK_SENTINEL=1 arms the runtime deadlock sentinel
+    # (kwok_tpu/utils/locks.py): every seed doubles as a lock-order
+    # inversion detector, and trace digests are sentinel-neutral by
+    # construction (tests/test_locks.py pins that)
+    KWOK_LOCK_SENTINEL=1 JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
