@@ -62,6 +62,12 @@ TARGET_TPS = 100_000.0
 #: seconds of seeded best-effort flood for the overload/shedding
 #: measurement (0 disables)
 OVERLOAD_S = float(os.environ.get("BENCH_OVERLOAD_S", 1.5))
+#: scheduling-scenario bench (kwok_tpu.sched): node fleet size; 0
+#: disables the section.  Scenario mixes scale off it.
+SCHED_NODES = int(os.environ.get("BENCH_SCHED_NODES", 32))
+#: gangs of SCHED_GANG_SIZE in the training mix
+SCHED_GANGS = int(os.environ.get("BENCH_SCHED_GANGS", 6))
+SCHED_GANG_SIZE = int(os.environ.get("BENCH_SCHED_GANG_SIZE", 8))
 
 
 def run_overload_bench() -> dict:
@@ -81,6 +87,202 @@ def run_overload_bench() -> dict:
         "canary_writes": rep["canary_writes"],
         "canary_worst_latency_s": rep["canary_worst_latency_s"],
     }
+
+
+def _pct(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def run_sched_bench() -> dict:
+    """Scheduling-scenario suite (ROADMAP item 4): seeded workload
+    mixes against a live in-process scheduler + gang engine —
+
+    - **burst**: a serverless-style wave of small singleton pods
+      (KUBEDIRECT's traffic shape), measuring per-pod time-to-schedule
+      (create -> bind observed on the watch stream);
+    - **gangs**: long-running training PodGroups placed all-or-nothing
+      through the atomic txn lane, measuring gang time-to-schedule
+      (last member created -> whole gang bound) and topology locality
+      (fraction of each gang on its modal slice — 1.0 = co-located);
+    - **churn**: HPA-style scale-down mid-wave (delete half, add more),
+      measuring bind latency under membership churn.
+
+    Asserted: every surviving pod binds (a stuck scheduler fails the
+    section loudly) and gang locality stays >= 0.9 — binpack must
+    actually co-locate on an uncontended fleet.
+    """
+    import random as _random
+
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.controllers.scheduler import Scheduler
+    from kwok_tpu.sched.topology import TopologyModel
+
+    rng = _random.Random(42)
+    topo = TopologyModel(slice_hosts=8)
+    store = ResourceStore()
+    sched = Scheduler(store, gang_policy="binpack", topology=topo).start()
+
+    def node(i):
+        return {
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {"name": f"node-{i}", "labels": topo.labels_for(i)},
+            "status": {
+                "allocatable": {"cpu": "16", "memory": "64Gi", "pods": "110"},
+                "conditions": [{"type": "Ready", "status": "True"}],
+            },
+        }
+
+    def pod(name, cpu="100m", gang=None):
+        meta = {"name": name, "namespace": "default"}
+        if gang:
+            meta["annotations"] = {"kwok.io/pod-group": gang}
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": meta,
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "image": "fake",
+                        "resources": {"requests": {"cpu": cpu}},
+                    }
+                ]
+            },
+            "status": {},
+        }
+
+    out: dict = {"nodes": SCHED_NODES, "scenarios": {}}
+    try:
+        for i in range(SCHED_NODES):
+            store.create(node(i))
+        watcher = store.watch("Pod")
+        created: dict = {}
+        bound: dict = {}
+        pod_node: dict = {}
+
+        def drain():
+            for ev in watcher.drain():
+                meta = ev.object.get("metadata") or {}
+                name = meta.get("name")
+                nd = (ev.object.get("spec") or {}).get("nodeName")
+                if nd and name in created and name not in bound:
+                    bound[name] = time.time()
+                    pod_node[name] = nd
+
+        def wait_bound(names, budget=60.0):
+            deadline = time.time() + budget
+            while time.time() < deadline:
+                drain()
+                if all(n in bound for n in names):
+                    return True
+                time.sleep(0.005)
+            drain()
+            return all(n in bound for n in names)
+
+        def tts(names):
+            lat = sorted(
+                bound[n] - created[n] for n in names if n in bound
+            )
+            return {
+                "tts_p50_s": round(_pct(lat, 0.50), 4),
+                "tts_p99_s": round(_pct(lat, 0.99), 4),
+            }
+
+        # ---- burst: serverless singleton wave -----------------------
+        burst = [f"burst-{i}" for i in range(4 * SCHED_NODES)]
+        for n in burst:
+            created[n] = time.time()
+            store.create(pod(n))
+        ok_burst = wait_bound(burst)
+        out["scenarios"]["burst"] = {
+            "pods": len(burst),
+            "bound": sum(1 for n in burst if n in bound),
+            **tts(burst),
+        }
+
+        # ---- gangs: training PodGroups, all-or-nothing --------------
+        gang_stats = []
+        gang_names = []
+        for g in range(SCHED_GANGS):
+            gname = f"train-{g}"
+            store.create(
+                {
+                    "apiVersion": "scheduling.kwok.io/v1alpha1",
+                    "kind": "PodGroup",
+                    "metadata": {"name": gname, "namespace": "default"},
+                    "spec": {"minMember": SCHED_GANG_SIZE, "priority": 10},
+                }
+            )
+            members = [f"{gname}-{i}" for i in range(SCHED_GANG_SIZE)]
+            for m in members:
+                created[m] = time.time()
+                store.create(pod(m, cpu="1", gang=gname))
+            t_full = time.time()
+            okg = wait_bound(members)
+            gang_names.extend(members)
+            if okg:
+                slices = [
+                    topo.coords({"metadata": {"name": pod_node[m]}})[0]
+                    for m in members
+                ]
+                gang_stats.append(
+                    {
+                        "tts_s": max(bound[m] for m in members) - t_full,
+                        "locality": topo.locality(slices),
+                    }
+                )
+        lat = sorted(g["tts_s"] for g in gang_stats)
+        locality = (
+            sum(g["locality"] for g in gang_stats) / len(gang_stats)
+            if gang_stats
+            else 0.0
+        )
+        out["scenarios"]["gangs"] = {
+            "gangs": SCHED_GANGS,
+            "gang_size": SCHED_GANG_SIZE,
+            "placed": len(gang_stats),
+            "tts_p50_s": round(_pct(lat, 0.50), 4),
+            "tts_p99_s": round(_pct(lat, 0.99), 4),
+            "locality": round(locality, 3),
+        }
+
+        # ---- churn: HPA-style scale-down mid-wave -------------------
+        wave1 = [f"churn-a-{i}" for i in range(2 * SCHED_NODES)]
+        for n in wave1:
+            created[n] = time.time()
+            store.create(pod(n))
+        victims = set(rng.sample(wave1, len(wave1) // 2))
+        for n in victims:
+            store.delete("Pod", n, namespace="default")
+        wave2 = [f"churn-b-{i}" for i in range(SCHED_NODES)]
+        for n in wave2:
+            created[n] = time.time()
+            store.create(pod(n))
+        churn = [n for n in wave1 if n not in victims] + wave2
+        ok_churn = wait_bound(churn)
+        out["scenarios"]["churn"] = {
+            "pods": len(churn),
+            "deleted": len(victims),
+            "bound": sum(1 for n in churn if n in bound),
+            **tts(churn),
+        }
+
+        ok = ok_burst and ok_churn and len(gang_stats) == SCHED_GANGS
+        if not ok:
+            out["error"] = "unbound pods or unplaced gangs at deadline"
+        elif locality < 0.9:
+            out["error"] = f"gang locality {locality:.3f} < 0.9"
+        out["gangs_scheduled"] = (
+            sched.gang.gangs_scheduled if sched.gang else 0
+        )
+    finally:
+        sched.stop()
+    return out
 
 
 def _clear_backends() -> None:
@@ -364,6 +566,18 @@ def main() -> int:
 
                 traceback.print_exc()
                 out["e2e"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if SCHED_NODES > 0:
+            # scheduling-scenario suite (kwok_tpu.sched): seeded burst /
+            # training-gang / churn mixes with time-to-schedule and
+            # topology-locality metrics
+            try:
+                out["sched"] = run_sched_bench()
+            except Exception as e:  # noqa: BLE001 — must not kill the headline
+                import traceback
+
+                traceback.print_exc()
+                out["sched"] = {"error": f"{type(e).__name__}: {e}"}
 
         if OVERLOAD_S > 0:
             # degradation trajectory: a short seeded best-effort flood

@@ -8,10 +8,14 @@ and the package layout carry the kwok_tpu mapping) is, bottom to top::
     engine, ops, parallel  (2)  FSM compiler + device kernels + mesh
     native                 (3)  optional C/C++ accelerators
     cluster                (4)  store/apiserver/client/informer
+    sched                  (5)  gang engine + policy seam (imports only
+                                cluster/utils/parallel downward; its
+                                own layer so the scheduler controller
+                                can build on it but never vice versa)
     controllers, workloads,
-    metrics, snapshot, cni (5)  reconcilers over the cluster bus
-    server, tools          (6)  kubelet-surface HTTP + dev tooling
-    ctl, cmd, chaos        (7)  cluster lifecycle CLI + entrypoints +
+    metrics, snapshot, cni (6)  reconcilers over the cluster bus
+    server, tools          (7)  kubelet-surface HTTP + dev tooling
+    ctl, cmd, chaos        (8)  cluster lifecycle CLI + entrypoints +
                                 fault injection (drives ctl components)
 
 Two rules:
@@ -46,6 +50,7 @@ LAYERS: List[Tuple[str, ...]] = [
     ("engine", "ops", "parallel"),
     ("native",),
     ("cluster",),
+    ("sched",),
     ("controllers", "workloads", "metrics", "snapshot", "cni"),
     ("server", "tools"),
     ("ctl", "cmd", "chaos", "dst"),

@@ -8,7 +8,8 @@ Clock (``Clock.wait_signal`` — exactly what ``cluster/client.py``'s
 retry backoff and ``controllers/device_player.py``'s tick pacing do)
 or an Event wait the component's stop path can interrupt.
 
-Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/controllers/``,
+Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/sched/``,
+``kwok_tpu/controllers/``,
 ``kwok_tpu/workloads/`` — the layers the simulation hosts
 (kwok_tpu/dst/harness.py:1; the clockable-pause seam this rule
 protects is kwok_tpu/utils/clock.py:42 ``Clock.wait_signal``).  A
@@ -30,6 +31,7 @@ RULE = "untestable-sleep"
 #: layers the DST harness hosts on a virtual clock
 SCOPE = (
     "kwok_tpu/cluster/",
+    "kwok_tpu/sched/",
     "kwok_tpu/controllers/",
     "kwok_tpu/workloads/",
 )

@@ -8,8 +8,8 @@ expired lease live again, which is exactly the split-brain the
 election exists to prevent).  This rule mechanizes that invariant for
 the layers that carry lease/deadline arithmetic:
 
-Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/controllers/``,
-``kwok_tpu/ctl/``.
+Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/sched/``,
+``kwok_tpu/controllers/``, ``kwok_tpu/ctl/``.
 
 A finding fires when a ``time.time()`` call participates in *deadline
 or expiry arithmetic*:
@@ -39,7 +39,12 @@ from kwok_tpu.analysis import Finding, SourceFile, dotted_name
 RULE = "wallclock-deadline"
 
 #: layers whose deadline math must be monotonic
-SCOPE = ("kwok_tpu/cluster/", "kwok_tpu/controllers/", "kwok_tpu/ctl/")
+SCOPE = (
+    "kwok_tpu/cluster/",
+    "kwok_tpu/sched/",
+    "kwok_tpu/controllers/",
+    "kwok_tpu/ctl/",
+)
 
 #: assignment targets that make a bare ``time.time()`` a deadline
 _DEADLINE_NAME = re.compile(

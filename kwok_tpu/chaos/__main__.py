@@ -53,7 +53,9 @@ Three modes over one seeded profile
   invariant checks over every run's trace.  Any violating seed replays
   exactly (same seed ⇒ byte-identical trace digest).  Exits nonzero on
   any violation.  ``--dst-bug ungated-writer`` injects the test-only
-  regression the acceptance gate uses to prove violations are caught.
+  regression the acceptance gate uses to prove violations are caught;
+  ``--dst-bug partial-gang`` un-atomics the gang scheduler's bind lane
+  so the gang-atomicity invariant can prove it catches partial gangs.
 """
 
 from __future__ import annotations
@@ -1147,8 +1149,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--dst-bug",
         default=None,
-        choices=[None, "ungated-writer"],
-        help="inject a test-only regression (must be caught)",
+        choices=[None, "ungated-writer", "partial-gang"],
+        help="inject a test-only regression (must be caught): "
+        "ungated-writer reconciles without the lease, partial-gang "
+        "binds PodGroups per-pod instead of atomically",
     )
     p.add_argument(
         "--dst-verbose",

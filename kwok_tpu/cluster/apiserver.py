@@ -499,6 +499,14 @@ class _Handler(BaseHTTPRequestHandler):
                     (body or {}).get("ops") or [], as_user=self._user()
                 )
                 self._send_json(200, {"results": results})
+            elif head == "txn":
+                # all-or-nothing sibling of /bulk (gang scheduling's
+                # commit lane); TransactionAborted → 409 via the shared
+                # error mapping, with the failing op index in the body
+                results = self.store.transact(
+                    (body or {}).get("ops") or [], as_user=self._user()
+                )
+                self._send_json(200, {"results": results})
             elif head == "r" and len(rest) == 1:
                 out = self.store.create(
                     body, namespace=self._ns(q), as_user=self._user()

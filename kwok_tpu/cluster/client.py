@@ -788,6 +788,20 @@ class ClusterClient:
         )
         return data.get("results", [])
 
+    def transact(self, ops, as_user: Optional[str] = None) -> list:
+        """All-or-nothing sibling of :meth:`bulk` (``POST /txn``): the
+        gang-scheduling commit lane (ResourceStore.transact).  The
+        whole batch applies atomically or a 409 Conflict surfaces —
+        with the failing op named in the message — and nothing was
+        mutated."""
+        data = self._request(
+            "POST",
+            "/txn",
+            body={"ops": list(ops)},
+            headers=self._user_hdr(as_user),
+        )
+        return data.get("results", [])
+
     # --------------------------------------------------------------- watch
 
     def watch(

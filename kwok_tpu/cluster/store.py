@@ -110,6 +110,19 @@ class AlreadyExists(Conflict):
     client-go retry.RetryOnConflict keys on the reason string)."""
 
 
+class TransactionAborted(Conflict):
+    """:meth:`ResourceStore.transact` validation failed: NOTHING was
+    applied.  ``index`` names the offending op and ``reason`` carries
+    the k8s-style reason string the failing op would have produced
+    alone (NotFound / AlreadyExists / Conflict / Invalid) — the gang
+    scheduler keys its retry-vs-give-up decision on it."""
+
+    def __init__(self, index: int, reason: str, message: str):
+        super().__init__(message)
+        self.index = index
+        self.reason = reason
+
+
 class ApplyConflict(Conflict):
     """Server-side apply hit fields owned by other managers.
 
@@ -144,6 +157,10 @@ BUILTIN_TYPES = [
     ResourceType("v1", "ConfigMap", "configmaps"),
     ResourceType("v1", "Service", "services"),
     ResourceType("coordination.k8s.io/v1", "Lease", "leases"),
+    # gang scheduling (kwok_tpu.sched): a PodGroup names an
+    # all-or-nothing admission unit; pods join it via the
+    # kwok.io/pod-group annotation (sched/group.py)
+    ResourceType("scheduling.kwok.io/v1alpha1", "PodGroup", "podgroups"),
     # workload kinds (kwok_tpu.workloads controllers; the reference gets
     # these from the real apiserver's builtin registry, so they must be
     # first-class here too — apps/v1 + batch/v1 + autoscaling/v2 routes
@@ -1826,6 +1843,298 @@ class ResourceStore:
                     {"status": "error", "reason": "Invalid", "error": str(exc)}
                 )
 
+    # --------------------------------------------------------------- transact
+
+    #: verbs :meth:`transact` accepts (bulk's vocabulary minus apply —
+    #: server-side apply's conflict surface cannot be pre-validated
+    #: without running the merge, so it stays on the per-op lane)
+    _TXN_VERBS = ("create", "patch", "delete")
+
+    def transact(
+        self,
+        ops: List[dict],
+        as_user: Optional[str] = None,
+        copy_results: bool = True,
+    ) -> List[Optional[dict]]:
+        """All-or-nothing sibling of :meth:`bulk` — the gang-scheduling
+        commit lane (``kwok_tpu/sched/engine.py`` binds a whole
+        PodGroup through here so no partial gang is ever observable).
+
+        Every op is validated under ONE mutex hold before anything
+        commits: the first op that cannot apply aborts the whole batch
+        with :class:`TransactionAborted` — nothing mutated, nothing
+        logged, no events emitted.  On success all ops commit under the
+        same hold and land in the WAL as a single ``txn`` record (one
+        CRC-framed line), so crash replay is also all-or-nothing: a
+        torn or corrupted txn drops WHOLE, never as a prefix
+        (``kwok_tpu/cluster/wal.py:32`` record shapes).  A crash
+        *between* the in-memory commit and the txn append loses the
+        whole batch together — the caller never got the ack, exactly
+        like :meth:`bulk`'s deferred-append window.
+
+        Op shape matches :meth:`bulk` (``verb``/``kind``/``name``/
+        ``namespace``/``data``/``patch_type``/``subresource``/
+        ``expect``/``as_user``); ``expect`` CAS preconditions are part
+        of validation.  ``create`` ops must carry a concrete name
+        (``generateName`` alone would make validation a guess).
+        Returns one result per op: the committed object, or None for a
+        completed delete.
+        """
+        with self._mut:
+            self._check_writable()
+            # ---------------- phase 1: validate (mutates nothing) ----
+            # overlay: (canonical kind, key) -> planned object (None =
+            # deleted by an earlier op in this txn), so intra-batch
+            # sequences validate against the state they will see;
+            # keyed on st.rtype.kind, NOT the caller's spelling — ops
+            # mixing aliases ("Pod"/"pods") must hit one overlay slot
+            # or phase 2 would fail mid-commit on state phase 1 never saw
+            overlay: Dict[Tuple[str, Tuple[str, str]], Optional[dict]] = {}
+
+            def abort(i: int, reason: str, msg: str) -> None:
+                raise TransactionAborted(i, reason, f"txn op {i}: {msg}")
+
+            # phase 2 must commit exactly what phase 1 validated, so
+            # any op normalization below replaces entries in a local
+            # copy of the list (never the caller's ops)
+            ops = list(ops)
+            for i, op in enumerate(ops):
+                if not isinstance(op, dict):
+                    abort(i, "Invalid", "op is not an object")
+                verb = op.get("verb")
+                if verb not in self._TXN_VERBS:
+                    abort(i, "Invalid", f"unknown txn verb {verb!r}")
+                data = op.get("data")
+                kind = op.get("kind") or (
+                    (data or {}).get("kind") if isinstance(data, dict) else ""
+                )
+                try:
+                    st = self._state(kind or "")
+                except NotFound as exc:
+                    abort(i, "NotFound", str(exc))
+                self._check_writable(
+                    kind,
+                    (
+                        ((data or {}).get("metadata") or {}).get("namespace")
+                        if isinstance(data, dict)
+                        else None
+                    )
+                    or op.get("namespace"),
+                )
+                if verb == "create":
+                    if not isinstance(data, dict):
+                        abort(i, "Invalid", "create needs a data object")
+                    # phase 2's create() resolves the type from data
+                    # alone: normalize the op-level kind into it, and
+                    # refuse a data kind that resolves to a DIFFERENT
+                    # type than the op kind phase 1 validated against —
+                    # either divergence would raise mid-commit and
+                    # strand a partially-applied txn
+                    dkind = data.get("kind")
+                    if dkind:
+                        try:
+                            if self._state(dkind) is not st:
+                                abort(
+                                    i,
+                                    "Invalid",
+                                    f"op kind {kind!r} does not match "
+                                    f"data kind {dkind!r}",
+                                )
+                        except NotFound as exc:
+                            abort(i, "NotFound", str(exc))
+                    else:
+                        data = dict(data)
+                        data["kind"] = st.rtype.kind
+                        op = dict(op)
+                        op["data"] = data
+                        ops[i] = op
+                    meta = data.get("metadata") or {}
+                    name = meta.get("name") or ""
+                    if not name:
+                        abort(
+                            i,
+                            "Invalid",
+                            "create in a txn requires metadata.name "
+                            "(generateName resolves at commit time)",
+                        )
+                    ns = (
+                        (meta.get("namespace") or op.get("namespace") or "default")
+                        if st.rtype.namespaced
+                        else ""
+                    )
+                    key = (ns, name)
+                    okey = (st.rtype.kind, key)
+                    exists = (
+                        overlay[okey] is not None
+                        if okey in overlay
+                        else key in st.objects
+                    )
+                    if exists:
+                        abort(i, "AlreadyExists", f"{kind} {key} already exists")
+                    overlay[okey] = data
+                else:
+                    name = op.get("name") or ""
+                    ns = (
+                        (op.get("namespace") or "default")
+                        if st.rtype.namespaced
+                        else ""
+                    )
+                    key = (ns, name)
+                    okey = (st.rtype.kind, key)
+                    cur = (
+                        overlay[okey]
+                        if okey in overlay
+                        else st.objects.get(key)
+                    )
+                    if cur is None:
+                        abort(i, "NotFound", f"{kind} {ns}/{name} not found")
+                    if verb == "patch":
+                        for path, want in (op.get("expect") or {}).items():
+                            have = _dotted_get(cur, path)
+                            if have != want:
+                                abort(
+                                    i,
+                                    "Conflict",
+                                    f"{kind} {ns}/{name}: expected "
+                                    f"{path}={want!r}, found {have!r}",
+                                )
+                        try:
+                            planned = apply_patch(
+                                cur,
+                                op.get("data"),
+                                op.get("patch_type", "merge"),
+                                kind=st.rtype.kind,
+                            )
+                        except (ValueError, TypeError, KeyError) as exc:
+                            abort(i, "Invalid", f"patch does not apply: {exc}")
+                        # mirror patch()'s commit shape exactly (see
+                        # patch() above): a subresource patch may only
+                        # change that one subtree, and a root patch
+                        # cannot move identity metadata — an overlay
+                        # that drifts from what phase 2 produces lets
+                        # a later op validate a state that never
+                        # commits
+                        sub = op.get("subresource") or ""
+                        cmeta = cur.get("metadata") or {}
+                        if sub:
+                            scoped = dict(cur)
+                            scoped["metadata"] = dict(cmeta)
+                            scoped[sub] = planned.get(sub)
+                            planned = scoped
+                        else:
+                            planned["metadata"] = dict(
+                                planned.get("metadata") or {}
+                            )
+                            planned["metadata"]["uid"] = cmeta.get("uid")
+                            planned["metadata"]["creationTimestamp"] = (
+                                cmeta.get("creationTimestamp")
+                            )
+                            planned["metadata"]["name"] = cmeta.get("name")
+                            if st.rtype.namespaced:
+                                planned["metadata"]["namespace"] = (
+                                    cmeta.get("namespace")
+                                )
+                            if cmeta.get("deletionTimestamp") is not None:
+                                planned["metadata"]["deletionTimestamp"] = (
+                                    cmeta["deletionTimestamp"]
+                                )
+                        overlay[okey] = planned
+                    else:  # delete — mirror delete()'s graceful
+                        # semantics: a finalizer-bearing object
+                        # survives with a deletionTimestamp, so later
+                        # ops in this txn must see it as still present
+                        # (modeling it as gone would let a create of
+                        # the same name pass validation and then raise
+                        # AlreadyExists mid-commit, breaking the
+                        # nothing-mutated abort contract)
+                        if (cur.get("metadata") or {}).get("finalizers"):
+                            planned = dict(cur)
+                            pmeta = dict(planned.get("metadata") or {})
+                            if pmeta.get("deletionTimestamp") is None:
+                                pmeta["deletionTimestamp"] = "(pending)"
+                            planned["metadata"] = pmeta
+                            overlay[okey] = planned
+                        else:
+                            overlay[okey] = None
+
+            # ---------------- phase 2: commit (validated, same hold) --
+            dict_ops = [op for op in ops if isinstance(op, dict)]
+            kinds = sorted(
+                {
+                    str(op.get("kind") or (op.get("data") or {}).get("kind") or "")
+                    for op in dict_ops
+                }
+            )
+            self._audit.append(
+                ("txn", f"{'+'.join(kinds)}:{len(ops)}", as_user)
+            )
+            defer = self._wal is not None
+            prev_buf = getattr(self._wal_local, "buf", None)
+            if defer:
+                self._wal_local.buf = []
+            results: List[Optional[dict]] = []
+            try:
+                for op in ops:
+                    verb = op["verb"]
+                    user = op.get("as_user") or as_user
+                    if verb == "create":
+                        out = self.create(
+                            op["data"],
+                            namespace=op.get("namespace"),
+                            as_user=user,
+                            copy_result=copy_results,
+                        )
+                    elif verb == "patch":
+                        out = self.patch(
+                            op["kind"],
+                            op["name"],
+                            op.get("data"),
+                            patch_type=op.get("patch_type", "merge"),
+                            namespace=op.get("namespace"),
+                            subresource=op.get("subresource", ""),
+                            as_user=user,
+                            expect=op.get("expect"),
+                            copy_result=copy_results,
+                        )
+                    else:
+                        out = self.delete(
+                            op["kind"],
+                            op["name"],
+                            namespace=op.get("namespace"),
+                            as_user=user,
+                            copy_result=copy_results,
+                        )
+                    results.append(out)
+            except BaseException:
+                # validation guarantees this is unreachable for
+                # precondition failures; what remains is a crash hook
+                # (chaos/DST) or a genuine bug.  Drop the buffered
+                # prefix so the WAL never learns a partial txn — the
+                # simulated process death that follows discards the
+                # partially-committed memory state with it.
+                if defer:
+                    self._wal_local.buf = prev_buf
+                raise
+            if defer:
+                buf = self._wal_local.buf
+                self._wal_local.buf = prev_buf
+                if buf:
+                    txn = {
+                        "t": "txn",
+                        "rv": max(int(r.get("rv", 0) or 0) for r in buf),
+                        "recs": buf,
+                    }
+                    try:
+                        # _wal_put: lands directly, or joins an outer
+                        # bulk deferral as one (still atomic) record
+                        self._wal_put(txn)
+                    except WalExhausted as exc:
+                        # committed in memory but not durable: refuse
+                        # the ack; a crash before space returns rolls
+                        # the whole txn back together (see bulk())
+                        raise StorageDegraded(exc.reason, str(exc)) from exc
+            return results
+
     # -------------------------------------------------------------- persistence
 
     def dump_state(self, copy: bool = True) -> dict:
@@ -2091,6 +2400,30 @@ class ResourceStore:
                             observed.add(int(item[3]))
                         except (LookupError, TypeError, ValueError):
                             pass
+                elif t == "txn":
+                    # one frame, many commits (transact()): the frame's
+                    # CRC makes the batch all-or-nothing on disk; replay
+                    # applies its inner events in rv order.  Inner rvs
+                    # can never interleave with other records' — the
+                    # txn holds the store mutex end to end
+                    inner = [
+                        sub
+                        for sub in rec.get("recs") or []
+                        if sub.get("t") == "ev"
+                    ]
+                    applied = False
+                    for sub in sorted(
+                        inner, key=lambda r: int(r.get("rv", 0) or 0)
+                    ):
+                        srv = int(sub.get("rv", 0) or 0)
+                        observed.add(srv)
+                        if srv <= floor:
+                            continue
+                        self._replay_event(sub)
+                        applied = True
+                    if applied:
+                        n += 1
+                    continue
                 if rv <= floor:
                     continue  # the snapshot already covers this record
                 if t == "ev":

@@ -64,6 +64,9 @@ Record shapes (all carry ``rv``)::
     {"t": "status", "rv": N, "k": kind, "i": [[ns, name, status, rv], ...]}
     {"t": "type", "rv": N, "api_version": ..., "kind": ..., "plural": ..., "namespaced": ...}
     {"t": "reset", "rv": N}          # restore_state wiped the keyspace
+    {"t": "txn", "rv": maxN, "recs": [ev, ...]}  # transact(): one frame,
+                                     # so the batch is durable (and
+                                     # replays) all-or-nothing
 
 Legacy (PR 3) bare-JSON lines are still readable for upgrade, counted
 as ``legacy`` frames by the scanner and flagged by fsck.
@@ -667,14 +670,25 @@ class WriteAheadLog:
     # ------------------------------------------------------------ writing
 
     def _note_rv(self, record: Dict[str, Any]) -> None:
+        rvs = []
+        if record.get("t") == "txn":
+            # a txn frame spans its inner events' whole rv range — the
+            # segment floor must reflect the smallest, or compaction
+            # bookkeeping would overstate what this file retains
+            for sub in record.get("recs") or []:
+                try:
+                    rvs.append(int(sub.get("rv", 0)))
+                except (TypeError, ValueError):
+                    pass
         try:
-            rv = int(record.get("rv", 0))
+            rvs.append(int(record.get("rv", 0)))
         except (TypeError, ValueError):
-            rv = 0
-        if self._active_min_rv is None or rv < self._active_min_rv:
-            self._active_min_rv = rv
-        if self._active_max_rv is None or rv > self._active_max_rv:
-            self._active_max_rv = rv
+            rvs.append(0)
+        lo, hi = min(rvs), max(rvs)
+        if self._active_min_rv is None or lo < self._active_min_rv:
+            self._active_min_rv = lo
+        if self._active_max_rv is None or hi > self._active_max_rv:
+            self._active_max_rv = hi
         self._active_records += 1
 
     def append(self, record: Dict[str, Any]) -> None:
@@ -1322,6 +1336,17 @@ def fsck(
             observed.add(rv)
             max_rv = max(max_rv, rv)
             min_rv = rv if min_rv is None else min(min_rv, rv)
+        elif rec.get("t") == "txn":
+            for sub in rec.get("recs") or []:
+                if sub.get("t") != "ev":
+                    continue
+                try:
+                    irv = int(sub.get("rv", 0) or 0)
+                except (TypeError, ValueError):
+                    continue
+                observed.add(irv)
+                max_rv = max(max_rv, irv)
+                min_rv = irv if min_rv is None else min(min_rv, irv)
     snap_rv: Optional[int] = None
     snap_error: Optional[str] = None
     if snapshot:

@@ -82,6 +82,7 @@ def cmd_create_cluster(args) -> int:
         max_inflight=args.max_inflight,
         controller_replicas=args.controller_replicas,
         leader_elect=args.leader_elect,
+        gang_policy=args.gang_policy,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -1414,6 +1415,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="replicas per controller-tier component (scheduler, kcm, "
         "kwok-controller); replicas campaign on one Lease per "
         "component and only the holder reconciles",
+    )
+    from kwok_tpu.sched.policy import POLICIES
+
+    c.add_argument(
+        "--gang-policy",
+        default="binpack",
+        choices=sorted(POLICIES) + ["none"],
+        help="scheduler gang-placement scoring policy (binpack | "
+        "spread | none; kwok_tpu.sched.policy — PodGroups bind "
+        "all-or-nothing through it).  Validated here so a typo fails "
+        "the create command, not the scheduler daemon at bring-up",
     )
     c.add_argument(
         "--leader-elect",

@@ -27,21 +27,58 @@ from kwok_tpu.controllers.scheduler import Scheduler
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from kwok_tpu.sched.policy import POLICIES
+
     p = argparse.ArgumentParser(prog="kwok-tpu-scheduler", description=__doc__)
     p.add_argument("--server", required=True, help="apiserver base URL")
     p.add_argument("--ca-cert", default="")
     p.add_argument("--client-cert", default="")
     p.add_argument("--client-key", default="")
+    p.add_argument(
+        "--gang-policy",
+        default="binpack",
+        choices=sorted(POLICIES) + ["none"],
+        help="scoring policy for gang (PodGroup) placement; 'none' "
+        "disables the gang engine and gang pods bind individually "
+        "(kwok_tpu.sched.policy — external policies registered via "
+        "register_policy are selectable here too)",
+    )
+    p.add_argument(
+        "--gang-slice-hosts",
+        type=int,
+        default=8,
+        help="simulated TPU topology: hosts per slice (the device-mesh "
+        "shape, kwok_tpu.sched.topology; rack/slice labels on nodes "
+        "override the derived coordinates)",
+    )
     add_leader_elect_flags(p, lease_name="kwok-scheduler")
     p.add_argument("-v", "--verbosity", action="count", default=0)
     return p
 
 
-def build_scheduler(store, active=None, recorder=None) -> Scheduler:
+def build_scheduler(
+    store,
+    active=None,
+    recorder=None,
+    clock=None,
+    gang_policy: str = "binpack",
+    slice_hosts: int = 8,
+) -> Scheduler:
     """In-process hosting seam: the (unstarted) scheduler instance the
     daemon runs, over any store duck-type — the composition the DST
-    harness (kwok_tpu.dst) drives synchronously on a virtual clock."""
-    return Scheduler(store, active=active, recorder=recorder)
+    harness (kwok_tpu.dst) drives synchronously on a virtual clock.
+    ``gang_policy`` wires the gang engine (kwok_tpu.sched); "none"
+    turns it off."""
+    from kwok_tpu.sched.topology import TopologyModel
+
+    return Scheduler(
+        store,
+        active=active,
+        recorder=recorder,
+        clock=clock,
+        gang_policy=gang_policy,
+        topology=TopologyModel(slice_hosts=max(1, slice_hosts)),
+    )
 
 
 def main(argv=None) -> int:
@@ -69,7 +106,14 @@ def main(argv=None) -> int:
         with run_mut:
             if running:
                 return
-            running.append(build_scheduler(client, active=active).start())
+            running.append(
+                build_scheduler(
+                    client,
+                    active=active,
+                    gang_policy=args.gang_policy,
+                    slice_hosts=args.gang_slice_hosts,
+                ).start()
+            )
         print("scheduler binding", flush=True)
 
     def stop_controllers() -> None:

@@ -15,6 +15,13 @@ Like every controller in this package it is store-duck-typed: give it a
 topology, ``python -m kwok_tpu.cmd.scheduler``).  Binds go through the
 merge-patch path the facade's ``pods/{name}/binding`` subresource uses
 (cluster/k8s_api.py), so both entrances converge on the same write.
+
+Feasibility (readiness, ``spec.nodeSelector``, ``NoSchedule`` taints
+vs tolerations, capacity) is shared with the gang engine via
+``kwok_tpu/sched/predicates.py:1``; pods carrying the
+``kwok.io/pod-group`` annotation are delegated wholesale to the gang
+engine (``kwok_tpu/sched/engine.py:1``), which binds each PodGroup
+all-or-nothing through the store's atomic transaction lane.
 """
 
 from __future__ import annotations
@@ -24,56 +31,22 @@ from typing import Dict, Optional, Tuple
 
 from kwok_tpu.cluster.informer import CacheGetter, Informer, WatchOptions
 from kwok_tpu.cluster.store import DELETED, EventRecorder
-from kwok_tpu.utils.cel import parse_quantity
+from kwok_tpu.sched.engine import GangEngine
+from kwok_tpu.sched.group import gang_key
+from kwok_tpu.sched.predicates import (
+    node_allocatable as _allocatable,
+    node_feasible,
+    pod_requests as _requests,
+)
+from kwok_tpu.sched.topology import TopologyModel
+from kwok_tpu.utils.backoff import WarnGate
+from kwok_tpu.utils.clock import Clock, MonotonicClock
 from kwok_tpu.utils.log import get_logger
 from kwok_tpu.utils.queue import Queue
 
 __all__ = ["Scheduler"]
 
 logger = get_logger("scheduler")
-
-#: default per-node pod cap when the node declares none (k8s default)
-_DEFAULT_PODS = 110.0
-
-
-def _requests(pod: dict) -> Tuple[float, float]:
-    """Total (cpu_cores, memory_bytes) requested by a pod's containers."""
-    cpu = mem = 0.0
-    spec = pod.get("spec") or {}
-    for c in spec.get("containers") or []:
-        reqs = ((c.get("resources") or {}).get("requests")) or {}
-        if "cpu" in reqs:
-            cpu += parse_quantity(str(reqs["cpu"]))
-        if "memory" in reqs:
-            mem += parse_quantity(str(reqs["memory"]))
-    return cpu, mem
-
-
-def _allocatable(node: dict) -> Tuple[float, float, float]:
-    """(cpu, memory, pods) a node offers — allocatable, else capacity."""
-    status = node.get("status") or {}
-    res = status.get("allocatable") or status.get("capacity") or {}
-
-    def q(key: str, default: float) -> float:
-        try:
-            return parse_quantity(str(res[key])) if key in res else default
-        except (ValueError, TypeError):
-            return default
-
-    return q("cpu", float("inf")), q("memory", float("inf")), q("pods", _DEFAULT_PODS)
-
-
-def _ready(node: dict) -> bool:
-    if (node.get("spec") or {}).get("unschedulable"):
-        return False
-    if (node.get("metadata") or {}).get("deletionTimestamp"):
-        return False
-    for c in (node.get("status") or {}).get("conditions") or []:
-        if c.get("type") == "Ready":
-            return c.get("status") == "True"
-    # nodes fresh out of create have no conditions yet; schedule onto
-    # them anyway — their initialize stage is about to run
-    return True
 
 
 class Scheduler:
@@ -85,6 +58,9 @@ class Scheduler:
         recorder: Optional[EventRecorder] = None,
         name: str = "kwok-scheduler",
         active=None,
+        clock: Optional[Clock] = None,
+        gang_policy: Optional[str] = "binpack",
+        topology: Optional[TopologyModel] = None,
     ):
         self.store = store
         self.name = name
@@ -94,6 +70,9 @@ class Scheduler:
         #: = always active (in-process single-instance composition).
         self._active = active
         self.recorder = recorder or EventRecorder(store, source=name)
+        #: monotonic by default (wallclock-deadline discipline); the
+        #: DST injects its virtual clock so warn backoff replays
+        self._clock = clock or MonotonicClock()
         self._done = threading.Event()
         self._events: Queue = Queue()
         self._nodes: CacheGetter = CacheGetter()
@@ -107,8 +86,28 @@ class Scheduler:
         #: name-sorted node objects; invalidated on node events and
         #: rebuilt lazily at the next bind (not per bind)
         self._sorted_nodes: Optional[list] = None
+        #: per-pod FailedScheduling backoff (utils.backoff.WarnGate).
+        #: _retry_pending re-binds every 2s; without this every pending
+        #: pod re-emits the same warning each pass — an event flood at
+        #: 1M-pod scale
+        self._warn_pods = WarnGate(self.WARN_BASE_S, self.WARN_CAP_S)
         self._threads = []
         self._mut = threading.Lock()
+        #: gang engine (kwok_tpu.sched): pods annotated with
+        #: kwok.io/pod-group bypass _bind and go through all-or-nothing
+        #: admission; None disables (gang pods then bind individually)
+        self.gang: Optional[GangEngine] = None
+        if gang_policy and gang_policy != "none":
+            self.gang = GangEngine(
+                store,
+                recorder=self.recorder,
+                policy=gang_policy,
+                topology=topology,
+                nodes=self._sorted,
+                usage=self._usage_snapshot,
+                track=self._track,
+                clock=self._clock,
+            )
 
     # ----------------------------------------------------------- usage cache
 
@@ -125,6 +124,7 @@ class Scheduler:
     def _untrack(self, pod: dict) -> None:
         uid = (pod.get("metadata") or {}).get("uid") or ""
         with self._mut:
+            self._warn_pods.clear(uid)
             entry = self._pod_usage.pop(uid, None)
             if entry is None:
                 return
@@ -134,6 +134,12 @@ class Scheduler:
                 self._used_agg.pop(node, None)
             else:
                 self._used_agg[node] = (c0 - cpu, m0 - mem, n0 - 1)
+
+    def _usage_snapshot(self) -> Dict[str, Tuple[float, float, int]]:
+        """Per-node (cpu, mem, pods) in use — the gang engine's view of
+        the same cache binds maintain, copied under the lock."""
+        with self._mut:
+            return dict(self._used_agg)
 
     # --------------------------------------------------------------- fitting
 
@@ -158,7 +164,11 @@ class Scheduler:
             used = self._used_agg  # read under the same lock binds write
             for i in range(n):
                 node = nodes[(self._rr + i) % n]
-                if not _ready(node):
+                # readiness + nodeSelector + NoSchedule-taint
+                # feasibility (sched/predicates.py — both were silently
+                # ignored before, landing selector-bearing workloads on
+                # arbitrary nodes)
+                if not node_feasible(pod, node):
                     continue
                 name = node["metadata"]["name"]
                 a_cpu, a_mem, a_pods = _allocatable(node)
@@ -186,6 +196,29 @@ class Scheduler:
         else:
             self._bind_inner(pod, None)
 
+    #: FailedScheduling re-emit cadence: base doubles per miss up to cap
+    WARN_BASE_S = 2.0
+    WARN_CAP_S = 60.0
+
+    def _warn_unschedulable(self, pod: dict) -> None:
+        """Per-pod deduplicated FailedScheduling with exponential
+        backoff — _retry_pending re-binds every 2s, and re-emitting the
+        identical warning each pass is an event flood at scale."""
+        meta = pod.get("metadata") or {}
+        uid = meta.get("uid") or (
+            f"{meta.get('namespace') or 'default'}/{meta.get('name')}"
+        )
+        now = self._clock.now()
+        with self._mut:
+            if not self._warn_pods.ready(uid, now):
+                return
+        self.recorder.event(
+            pod,
+            "Warning",
+            "FailedScheduling",
+            "0/%d nodes are available" % len(self._nodes),
+        )
+
     def _bind_inner(self, pod: dict, span) -> None:
         meta = pod.get("metadata") or {}
         name, ns = meta.get("name") or "", meta.get("namespace") or "default"
@@ -193,12 +226,7 @@ class Scheduler:
         if span is not None:
             span.set("node", target or "")
         if target is None:
-            self.recorder.event(
-                pod,
-                "Warning",
-                "FailedScheduling",
-                "0/%d nodes are available" % len(self._nodes),
-            )
+            self._warn_unschedulable(pod)
             return
         try:
             self.store.patch(
@@ -209,6 +237,8 @@ class Scheduler:
                 namespace=ns,
             )
             self._track(pod, target)
+            with self._mut:
+                self._warn_pods.clear(meta.get("uid") or "")
             self.recorder.event(
                 pod,
                 "Normal",
@@ -244,8 +274,13 @@ class Scheduler:
             # the next bind rebuilds it (retry path covers pods)
             self._sorted_nodes = None
             return
+        gang = self.gang if (
+            self.gang is not None and GangEngine.is_gang_pod(obj)
+        ) else None
         if ev.type == DELETED:
             self._untrack(obj)
+            if gang is not None:
+                gang.observe(DELETED, obj)
             return
         node = (obj.get("spec") or {}).get("nodeName")
         if node:
@@ -253,11 +288,20 @@ class Scheduler:
                 self._untrack(obj)  # terminal pods free their slot
             else:
                 self._track(obj, node)
+            if gang is not None:
+                gang.observe(ev.type, obj)  # membership, like the cache
             return
         if (obj.get("metadata") or {}).get("deletionTimestamp"):
             return
+        if gang is not None:
+            # membership is cache maintenance (standbys stay current);
+            # the bind attempt below is leader-gated like _bind
+            gang.observe(ev.type, obj)
         if self._active is not None and not self._active():
             return  # standby/deposed: track caches, never bind
+        if gang is not None:
+            gang.try_schedule(gang_key(obj))
+            return
         self._bind(obj)
 
     def _retry_pending(self) -> None:
@@ -270,7 +314,14 @@ class Scheduler:
         for pod in pods:
             if (pod.get("metadata") or {}).get("deletionTimestamp"):
                 continue
+            if self.gang is not None and GangEngine.is_gang_pod(pod):
+                # heal membership the watch may have missed, then let
+                # the engine's own retry pass below attempt the gang
+                self.gang.observe("ADDED", pod)
+                continue
             self._bind(pod)
+        if self.gang is not None:
+            self.gang.retry_pending()
 
     def start(self) -> "Scheduler":
         node_informer = Informer(self.store, "Node")

@@ -171,6 +171,7 @@ def build_scheduler_component(
     pki_dir: Optional[str] = None,
     replica: int = 0,
     leader_elect: bool = True,
+    gang_policy: str = "binpack",
 ) -> Component:
     """(reference components/kube_scheduler.go:51 BuildKubeSchedulerComponent)"""
     args = [
@@ -179,6 +180,10 @@ def build_scheduler_component(
         "kwok_tpu.cmd.scheduler",
         "--server",
         server_url,
+        # gang (PodGroup) placement policy pinned in argv so the
+        # component spec is auditable (kwok_tpu.sched; "none" disables)
+        "--gang-policy",
+        gang_policy or "binpack",
     ] + _leader_elect_args("kwok-scheduler", leader_elect)
     if secure and pki_dir:
         args += [
@@ -312,6 +317,7 @@ def build_core_components(
     max_inflight: Optional[int] = None,
     controller_replicas: int = 1,
     leader_elect: bool = True,
+    gang_policy: str = "binpack",
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
@@ -345,6 +351,7 @@ def build_core_components(
                 pki_dir=pki_dir,
                 replica=i,
                 leader_elect=leader_elect,
+                gang_policy=gang_policy,
             )
         )
     for i in range(replicas):

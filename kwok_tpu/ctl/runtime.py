@@ -114,6 +114,7 @@ class BinaryRuntime:
         max_inflight: Optional[int] = None,
         controller_replicas: int = 1,
         leader_elect: bool = True,
+        gang_policy: str = "binpack",
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -181,6 +182,7 @@ class BinaryRuntime:
             max_inflight=max_inflight,
             controller_replicas=controller_replicas,
             leader_elect=leader_elect,
+            gang_policy=gang_policy,
         )
         tracing_port = 0
         if enable_tracing:
@@ -216,6 +218,8 @@ class BinaryRuntime:
             conf["controllerReplicas"] = int(controller_replicas)
         if not leader_elect:
             conf["leaderElect"] = False
+        if gang_policy and gang_policy != "binpack":
+            conf["gangPolicy"] = gang_policy
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:

@@ -91,6 +91,7 @@ def scale(
     start_index: int = 0,
     parallelism: int = 16,
     progress: Optional[Callable[[int, int], None]] = None,
+    topology: Optional[Any] = None,
 ) -> int:
     """Create ``replicas`` rendered objects; returns the created count.
 
@@ -99,12 +100,23 @@ def scale(
     - ``Namespace``  the target namespace
     - ``Index``      i
     - ``AddCIDR cidr i``  i-th address of a CIDR (scale.go AddCIDR)
+
+    Scaled Nodes get ``topology.kwok.io/slice``/``rack`` labels from
+    ``topology`` (a ``kwok_tpu.sched.topology.TopologyModel``; defaults
+    to the stock 8-hosts-per-slice shape) so the gang scheduler scores
+    real coordinates instead of the name-derived fallback — template
+    labels win when present.
     """
     tpl_src = template or DEFAULT_TEMPLATES.get(kind.lower())
     if tpl_src is None:
         raise ValueError(
             f"no default template for kind {kind!r}; pass template="
         )
+    topo = topology
+    if topo is None and kind.lower() in ("node", "nodes"):
+        from kwok_tpu.sched.topology import TopologyModel
+
+        topo = TopologyModel()
     prefix = name_prefix or kind.lower()
     renderer = Renderer()
     ctx: Dict[str, Any] = dict(params or {})
@@ -124,7 +136,12 @@ def scale(
             "Index": lambda _i=i: _i,
             "AddCIDR": add_cidr,
         }
-        return yaml.safe_load(renderer.render(tpl_src, ctx, extra_funcs=funcs))
+        obj = yaml.safe_load(renderer.render(tpl_src, ctx, extra_funcs=funcs))
+        if topo is not None and (obj.get("kind") or "").lower() == "node":
+            labels = obj.setdefault("metadata", {}).setdefault("labels", {})
+            for k, v in topo.labels_for(i).items():
+                labels.setdefault(k, v)
+        return obj
 
     def submit(i: int) -> None:
         nonlocal created

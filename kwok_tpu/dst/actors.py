@@ -403,8 +403,18 @@ class SchedulerActor(_GatedControllerActor):
             store, source=r.seat, clock=sim.clock, suffix=sim.next_suffix
         )
         self.sched = build_scheduler(
-            store, active=r.is_leader, recorder=recorder
+            store,
+            active=r.is_leader,
+            recorder=recorder,
+            clock=sim.clock,
+            slice_hosts=sim.opts.gang_slice_hosts,
         )
+        if self.sched.gang is not None and sim.opts.bug == "partial-gang":
+            # test-only injected regression: binds go as individual
+            # patches instead of one atomic txn, re-opening the
+            # partial-gang crash window the gang-atomicity invariant
+            # exists to catch
+            self.sched.gang.atomic = False
         cid = f"controller:{r.name}"
         self._node_pump = WatchPump(sim, "Node", cid)
         self._pod_pump = WatchPump(sim, "Pod", cid)

@@ -440,6 +440,31 @@ class ActorStore:
 
         return self._mutate("bulk", self._sim.store.bulk, details, ops, **kw)
 
+    def transact(self, ops, **kw):
+        """The atomic gang-bind lane (ResourceStore.transact): traced
+        as one ``txn`` action plus the per-object details — the
+        single-reconciler invariant gates it like any other write, and
+        the gang-atomicity probes read the resulting store states."""
+
+        def details(results):
+            out = [("txn", f"{len(ops)} ok")]
+            for op, res in zip(ops, results):
+                verb = op.get("verb") if isinstance(op, dict) else None
+                if verb == "create":
+                    out.extend(self._obj_detail("create", res))
+                elif verb == "delete":
+                    ns = op.get("namespace") or ""
+                    out.append(
+                        ("delete", f"{op.get('kind')} {ns}/{op.get('name')}")
+                    )
+                elif verb == "patch":
+                    out.extend(self._obj_detail("patch", res))
+            return out
+
+        return self._mutate(
+            "txn", self._sim.store.transact, details, ops, **kw
+        )
+
     # ----------------------------------------------------------- fallback
 
     def __getattr__(self, name):

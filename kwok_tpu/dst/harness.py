@@ -81,6 +81,12 @@ class SimOptions:
     deployment_replicas: int = 6
     scale_to: int = 9
     scale_back: int = 4
+    #: gang scheduling scenario: a PodGroup of this many members is
+    #: created mid-run (0 disables); members must bind all-or-nothing
+    #: through every crash/failover window (gang-atomicity invariant)
+    gang_size: int = 3
+    #: simulated topology shape for the scenario nodes (hosts/slice)
+    gang_slice_hosts: int = 2
 
 
 @dataclass
@@ -98,6 +104,11 @@ class RunRecord:
     #: accounted durable-in-log ∪ visibly-rejected, and writes re-armed
     #: at window end (exhaustion-honesty invariant)
     exhaustion_checks: List[dict] = field(default_factory=list)
+    #: gang probes: per crash/disk recovery (and at end of run, live +
+    #: replayed), how many of each gang's present members were bound —
+    #: a bound strict subset surviving a recovery is the atomicity
+    #: violation the gang-atomicity invariant flags
+    gang_checks: List[dict] = field(default_factory=list)
     replay_matches: Optional[bool] = None
     replay_detail: str = ""
     converged: bool = False
@@ -127,6 +138,7 @@ class Simulation:
         self.crash_checks: List[dict] = []
         self.disk_checks: List[dict] = []
         self.exhaustion_checks: List[dict] = []
+        self.gang_checks: List[dict] = []
         #: live pressure shim (chaos/fs_pressure.py) while a window is
         #: open — reinstalled onto recovered WALs so a crash inside a
         #: window does not silently lift the pressure
@@ -287,6 +299,45 @@ class Simulation:
                 "records": rep.applied,
             }
         )
+        self._gang_probe(self.store, "crash")
+
+    def _gang_probe(self, store, at: str) -> None:
+        """Gang-atomicity evidence: for every gang present in a
+        (recovered) store state, how many of its live members are
+        bound.  A bound strict subset is exactly what the atomic txn
+        lane makes impossible — the gang-atomicity invariant flags it
+        (kwok_tpu/dst/invariants.py)."""
+        from kwok_tpu.sched.group import POD_GROUP_ANNOTATION
+
+        try:
+            pods, _ = store.list("Pod")
+        except Exception:  # noqa: BLE001 — probe only; no Pods yet
+            return
+        gangs: Dict[str, List[dict]] = {}
+        for p in pods:
+            meta = p.get("metadata") or {}
+            g = (meta.get("annotations") or {}).get(POD_GROUP_ANNOTATION)
+            if not g:
+                continue
+            if (p.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            gangs.setdefault(
+                f"{meta.get('namespace') or 'default'}/{g}", []
+            ).append(p)
+        for key in sorted(gangs):
+            members = gangs[key]
+            bound = sum(
+                1 for p in members if (p.get("spec") or {}).get("nodeName")
+            )
+            self.gang_checks.append(
+                {
+                    "at": at,
+                    "gang": key,
+                    "present": len(members),
+                    "bound": bound,
+                    "t": round(self.clock.now() - EPOCH, 3),
+                }
+            )
 
     def _disk_fault(self, mode: str) -> None:
         """Seeded storage corruption against the live WAL, then an
@@ -334,6 +385,7 @@ class Simulation:
             f"rv={rep.recovered_rv} reported={len(reported)} "
             f"silent={len(silent)}",
         )
+        self._gang_probe(self.store, "disk")
         # prune to the post-rollback world: lost rvs were accounted
         # above, and their numbers will be re-issued by new commits
         self.acked_rvs = {
@@ -354,6 +406,25 @@ class Simulation:
         steps.append((t0 + 2.0, "deployment", ("web", o.deployment_replicas)))
         steps.append((t0 + o.duration * 0.4, "scale", ("web", o.scale_to)))
         steps.append((t0 + o.duration * 0.7, "scale", ("web", o.scale_back)))
+        if o.gang_size > 0:
+            # the gang lands mid-faults: PodGroup first, then members
+            # staggered so the engine provably waits for minMember
+            tg = t0 + o.duration * 0.5
+            steps.append((tg, "podgroup", ("train", o.gang_size)))
+            for i in range(o.gang_size):
+                steps.append((tg + 0.3 * (i + 1), "gang-pod", ("train", i)))
+            # operator re-submit after the fault window: a disk fault
+            # can honestly roll back (and report) the creates above —
+            # including the NODES — and a real operator re-applies;
+            # creates tolerate AlreadyExists so this is a no-op on
+            # clean runs
+            for i in range(o.nodes):
+                steps.append((t0 + o.duration - 0.5, "node", f"node-{i}"))
+            steps.append((t0 + o.duration, "podgroup", ("train", o.gang_size)))
+            for i in range(o.gang_size):
+                steps.append(
+                    (t0 + o.duration + 0.1 * (i + 1), "gang-pod", ("train", i))
+                )
         return steps
 
     def _apply_scenario(self, kind: str, arg):
@@ -361,14 +432,50 @@ class Simulation:
         degraded read-only gate (the run loop reschedules the step to
         just past the pressure window), else None."""
         if kind == "node":
+            from kwok_tpu.sched.topology import TopologyModel
+
+            topo = TopologyModel(slice_hosts=self.opts.gang_slice_hosts)
+            idx = int(arg.rsplit("-", 1)[-1])
             obj = {
                 "apiVersion": "v1",
                 "kind": "Node",
-                "metadata": {"name": arg},
+                "metadata": {"name": arg, "labels": topo.labels_for(idx)},
                 "spec": {},
                 "status": {
                     "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"},
                     "capacity": {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                },
+            }
+            return self._must(lambda: self._op_store.create(dict(obj)))
+        elif kind == "podgroup":
+            name, size = arg
+            obj = {
+                "apiVersion": "scheduling.kwok.io/v1alpha1",
+                "kind": "PodGroup",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"minMember": size, "priority": 10},
+            }
+            return self._must(lambda: self._op_store.create(dict(obj)))
+        elif kind == "gang-pod":
+            gname, i = arg
+            obj = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{gname}-{i}",
+                    "namespace": "default",
+                    "annotations": {"kwok.io/pod-group": gname},
+                },
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "train",
+                            "image": "fake",
+                            "resources": {
+                                "requests": {"cpu": "1", "memory": "128Mi"}
+                            },
+                        }
+                    ]
                 },
             }
             return self._must(lambda: self._op_store.create(dict(obj)))
@@ -533,6 +640,14 @@ class Simulation:
                         observed.add(int(item[3]))
                     except (LookupError, TypeError, ValueError):
                         continue
+            elif rt == "txn":
+                for sub in rec.get("recs") or []:
+                    if sub.get("t") != "ev":
+                        continue
+                    try:
+                        observed.add(int(sub.get("rv", 0) or 0))
+                    except (TypeError, ValueError):
+                        continue
         silent = sorted(rv for rv in acked_during if rv not in observed)
         self.exhaustion_checks.append(
             {
@@ -671,6 +786,7 @@ class Simulation:
         rec.crash_checks = self.crash_checks
         rec.disk_checks = self.disk_checks
         rec.exhaustion_checks = self.exhaustion_checks
+        self._gang_probe(self.store, "final")
         rec.audit_overflow = self.store.audit_overflow
         rec.steps = self.steps
         rec.virtual_end = self.clock.now() - EPOCH
@@ -684,6 +800,8 @@ class Simulation:
         self.wal.close()
         replayed = ResourceStore()
         replayed.recover_wal(self.wal_path)
+        self._gang_probe(replayed, "replay")
+        self.record.gang_checks = self.gang_checks
         live, fresh = self.store.dump_state(), replayed.dump_state()
         rec.replay_matches = live == fresh
         if not rec.replay_matches:
@@ -725,6 +843,7 @@ def run_seed(
         "crashes": len(rec.crash_checks),
         "disk_faults": len(rec.disk_checks),
         "pressure_windows": len(rec.exhaustion_checks),
+        "gang_probes": len(rec.gang_checks),
         "counts": rec.final_counts,
         "violations": violations,
     }

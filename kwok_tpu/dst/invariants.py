@@ -27,7 +27,10 @@ specifies for this repo:
 - exhaustion honesty (every write acked inside a storage-pressure
   window is durable in the log or was visibly rejected, and writes
   re-arm when the window closes — the degraded read-only contract of
-  ``kwok_tpu/chaos/fs_pressure.py:1``).
+  ``kwok_tpu/chaos/fs_pressure.py:1``),
+- gang atomicity (no recovered, final, or WAL-replayed state shows a
+  bound strict subset of a PodGroup — the all-or-nothing admission
+  contract of ``kwok_tpu/sched/engine.py:1``).
 
 Pluggable: ``INVARIANTS`` maps name → checker; ``run_checks`` runs a
 selection and returns ``{name: [violations]}``.
@@ -41,7 +44,7 @@ from typing import Callable, Dict, List
 __all__ = ["INVARIANTS", "run_checks"]
 
 #: trace actions that are leader-gated controller writes
-_WRITE_ACTIONS = {"create", "update", "patch", "delete", "apply", "bulk"}
+_WRITE_ACTIONS = {"create", "update", "patch", "delete", "apply", "bulk", "txn"}
 
 _ELECTED_RE = re.compile(r"^(?P<lease>\S+) transitions=(?P<tr>-?\d+)$")
 
@@ -228,6 +231,26 @@ def check_exhaustion_honesty(record) -> List[str]:
     return out
 
 
+def check_gang_atomicity(record) -> List[str]:
+    """No store state surviving a crash/failover window — recovered,
+    final, or WAL-replayed — may show a bound STRICT SUBSET of a gang:
+    the all-or-nothing contract of the atomic bind lane
+    (``kwok_tpu/sched/engine.py:1`` commits every gang through
+    ``ResourceStore.transact``, one CRC-framed WAL record).  Probes
+    are taken by the harness at every recovery and at end of run
+    (``RunRecord.gang_checks``)."""
+    out: List[str] = []
+    for i, probe in enumerate(record.gang_checks):
+        bound, present = probe["bound"], probe["present"]
+        if 0 < bound < present:
+            out.append(
+                f"probe #{i} ({probe['at']}, t={probe['t']}): gang "
+                f"{probe['gang']} has {bound}/{present} members bound "
+                "— a strict subset survived"
+            )
+    return out
+
+
 def check_trace_complete(record) -> List[str]:
     if record.audit_overflow:
         return [
@@ -246,6 +269,7 @@ INVARIANTS: Dict[str, Callable] = {
     "trace-complete": check_trace_complete,
     "recovery-honesty": check_recovery_honesty,
     "exhaustion-honesty": check_exhaustion_honesty,
+    "gang-atomicity": check_gang_atomicity,
 }
 
 

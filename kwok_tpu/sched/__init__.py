@@ -1,0 +1,54 @@
+"""Gang scheduling: topology-aware all-or-nothing placement.
+
+Real TPU fleets schedule multi-host slices, not single pods: a training
+job is a *gang* that must land together or not at all (ROADMAP item 4;
+the RL-scheduler paper in PAPERS.md motivates the pluggable, batched
+policy seam).  This package is the engine behind the scheduler seat:
+
+- :mod:`kwok_tpu.sched.group` — the PodGroup vocabulary (minMember /
+  priority) and the ``kwok.io/pod-group`` annotation that gangs pods;
+- :mod:`kwok_tpu.sched.predicates` — feasibility (nodeSelector, taints
+  vs tolerations, capacity fit), shared with the single-pod scheduler;
+- :mod:`kwok_tpu.sched.topology` — the simulated TPU topology model:
+  rack/slice labels derived from the device-mesh shape
+  (``kwok_tpu/parallel/mesh.py:34``);
+- :mod:`kwok_tpu.sched.policy` — the pluggable ``Policy`` protocol:
+  ``score()`` over columnar pod x node candidate batches (numpy
+  arrays), so built-in bin-packing/spread are vectorized and an
+  external (e.g. RL) policy plugs into the same seam;
+- :mod:`kwok_tpu.sched.engine` — the gang engine: all-or-nothing
+  admission through the store's atomic transaction lane
+  (``kwok_tpu/cluster/store.py:1``), priority preemption with graceful
+  victim selection.
+
+The package sits between ``cluster`` and ``controllers`` in the layer
+map: it imports only cluster/utils/parallel downward, and
+``kwok_tpu/controllers/scheduler.py:1`` delegates gang-tagged pods
+into it.
+"""
+
+from kwok_tpu.sched.engine import GangEngine
+from kwok_tpu.sched.group import POD_GROUP_ANNOTATION, GroupSpec, gang_key
+from kwok_tpu.sched.policy import (
+    POLICIES,
+    CandidateBatch,
+    Policy,
+    get_policy,
+    register_policy,
+)
+from kwok_tpu.sched.topology import RACK_LABEL, SLICE_LABEL, TopologyModel
+
+__all__ = [
+    "GangEngine",
+    "POD_GROUP_ANNOTATION",
+    "GroupSpec",
+    "gang_key",
+    "POLICIES",
+    "CandidateBatch",
+    "Policy",
+    "get_policy",
+    "register_policy",
+    "RACK_LABEL",
+    "SLICE_LABEL",
+    "TopologyModel",
+]

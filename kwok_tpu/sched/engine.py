@@ -1,0 +1,623 @@
+"""Gang engine: all-or-nothing admission with topology-aware scoring.
+
+The co-scheduling half of the scheduler seat.  The single-pod binder
+(``kwok_tpu/controllers/scheduler.py:1``) delegates every pod carrying
+the ``kwok.io/pod-group`` annotation here; the engine holds members
+until the group's ``minMember`` exist, plans a placement for the whole
+gang against a usage-adjusted node snapshot, scores the feasible
+pod x node candidates through the pluggable vectorized policy seam
+(``kwok_tpu/sched/policy.py:1``), and commits every bind in ONE atomic
+store transaction (``kwok_tpu/cluster/store.py:1`` ``transact``) with
+a ``spec.nodeName == None`` CAS precondition per pod — so a concurrent
+binder, a crash, or a leader failover can never leave a strict subset
+of a gang bound (the DST ``gang-atomicity`` invariant,
+``kwok_tpu/dst/invariants.py:1``).
+
+When a gang does not fit and its group carries ``priority > 0``, the
+engine preempts gracefully: victims are chosen lowest-priority-first,
+then fewest-gangs-disrupted (evicting a second member of an
+already-disrupted gang is free — it was coming down anyway), evicted
+through the ordinary delete path (finalizer-bearing pods get a
+deletionTimestamp and drain through their stages), and the gang binds
+on a later pass once the capacity is actually free — the two-phase
+shape real kube-scheduler preemption has.
+
+Determinism contract: every iteration is over sorted keys, scoring is
+pure numpy, and time only enters through the injected clock — the
+engine steps identically under the DST virtual clock
+(``kwok_tpu/dst/harness.py:1``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from kwok_tpu.cluster.store import Conflict, NotFound, StorageDegraded
+from kwok_tpu.sched.group import (
+    GroupSpec,
+    gang_key,
+    parse_group,
+    pod_priority,
+)
+from kwok_tpu.sched.policy import CandidateBatch, Policy, get_policy
+from kwok_tpu.sched.predicates import (
+    node_allocatable,
+    node_feasible,
+    pod_requests,
+)
+from kwok_tpu.sched.topology import TopologyModel
+from kwok_tpu.utils.backoff import WarnGate
+from kwok_tpu.utils.clock import Clock, MonotonicClock
+from kwok_tpu.utils.log import get_logger
+
+__all__ = ["GangEngine"]
+
+logger = get_logger("sched")
+
+PodKey = Tuple[str, str]  # (namespace, name)
+GangKey = Tuple[str, str]  # (namespace, group)
+
+
+def _pod_key(pod: dict) -> PodKey:
+    meta = pod.get("metadata") or {}
+    return (meta.get("namespace") or "default", meta.get("name") or "")
+
+
+class GangEngine:
+    """Holds pending gangs and binds each one atomically or not at all.
+
+    Single-threaded by contract: driven from the scheduler's event
+    loop (``handle_event``/retry cadence), which is one thread in the
+    daemon and one actor in the DST — no internal locking, matching
+    how the scheduler's own caches are owned.
+    """
+
+    #: FailedScheduling/Waiting warn cadence: first warning immediately,
+    #: then exponential backoff per gang up to the cap — the event-flood
+    #: fix scaled to gangs (one gang = one event stream, not one per pod)
+    WARN_BASE_S = 2.0
+    WARN_CAP_S = 60.0
+
+    #: ceiling on victims evicted per preemption pass (a gang that
+    #: needs more than this is asked to wait for the next pass — keeps
+    #: one pass's blast radius bounded and observable)
+    MAX_VICTIMS = 64
+
+    def __init__(
+        self,
+        store,
+        *,
+        recorder=None,
+        policy: str = "binpack",
+        topology: Optional[TopologyModel] = None,
+        nodes: Optional[Callable[[], List[dict]]] = None,
+        usage: Optional[Callable[[], Dict[str, Tuple[float, float, int]]]] = None,
+        track: Optional[Callable[[dict, str], None]] = None,
+        clock: Optional[Clock] = None,
+        atomic: bool = True,
+    ):
+        self.store = store
+        self.recorder = recorder
+        self.policy: Policy = get_policy(policy)
+        self.topology = topology or TopologyModel()
+        self._nodes_fn = nodes or (lambda: [])
+        self._usage_fn = usage or (lambda: {})
+        self._track = track or (lambda pod, node: None)
+        self._clock = clock or MonotonicClock()
+        #: False is a TEST-ONLY regression mode (DST --dst-bug
+        #: partial-gang): binds go as individual patches, re-opening
+        #: the partial-gang crash window the txn lane closes
+        self.atomic = atomic
+        #: gangs waiting for members or capacity
+        self._pending: Dict[GangKey, Dict[PodKey, dict]] = {}
+        #: bound members per gang (maintained from watch echoes too,
+        #: so a takeover leader reconstructs gang state from the cache)
+        self._bound: Dict[GangKey, Dict[PodKey, str]] = {}
+        #: per-gang warn cadence (shared event-flood guard with the
+        #: scheduler's per-pod stream)
+        self._warn = WarnGate(self.WARN_BASE_S, self.WARN_CAP_S)
+        #: per-policy-name cache for group policy overrides
+        self._policies: Dict[str, Policy] = {self.policy.name: self.policy}
+        # counters (surfaced by tests/bench)
+        self.gangs_scheduled = 0
+        self.preemptions = 0
+
+    # ------------------------------------------------------------ membership
+
+    @staticmethod
+    def is_gang_pod(pod: dict) -> bool:
+        return gang_key(pod) is not None
+
+    def observe(self, ev_type: str, pod: dict) -> None:
+        """Maintain gang membership from a pod watch event (called for
+        every gang pod regardless of leadership, like the scheduler's
+        usage cache — a standby that takes over starts current)."""
+        key = gang_key(pod)
+        if key is None:
+            return
+        pk = _pod_key(pod)
+        if ev_type == "DELETED":
+            self._pending.get(key, {}).pop(pk, None)
+            self._bound.get(key, {}).pop(pk, None)
+            if not self._pending.get(key) and not self._bound.get(key):
+                self._pending.pop(key, None)
+                self._bound.pop(key, None)
+                self._warn.clear(key)
+            return
+        meta = pod.get("metadata") or {}
+        node = (pod.get("spec") or {}).get("nodeName")
+        phase = (pod.get("status") or {}).get("phase")
+        if node:
+            self._pending.get(key, {}).pop(pk, None)
+            if phase in ("Succeeded", "Failed"):
+                self._bound.get(key, {}).pop(pk, None)
+            else:
+                self._bound.setdefault(key, {})[pk] = node
+            return
+        if meta.get("deletionTimestamp"):
+            self._pending.get(key, {}).pop(pk, None)
+            return
+        self._pending.setdefault(key, {})[pk] = pod
+
+    def offer(self, pod: dict) -> bool:
+        """A pending gang pod from the event stream: register it and
+        attempt the gang.  Returns True when the gang bound."""
+        key = gang_key(pod)
+        if key is None:
+            return False
+        self.observe("ADDED", pod)
+        return self.try_schedule(key)
+
+    def retry_pending(self) -> int:
+        """Re-attempt every waiting gang (the scheduler retry cadence);
+        returns how many gangs bound this pass."""
+        n = 0
+        for key in sorted(self._pending):
+            if self._pending.get(key) and self.try_schedule(key):
+                n += 1
+        return n
+
+    def pending_gangs(self) -> List[GangKey]:
+        return sorted(k for k, v in self._pending.items() if v)
+
+    # ------------------------------------------------------------- planning
+
+    def _policy_for(self, spec: GroupSpec) -> Policy:
+        name = spec.policy or self.policy.name
+        pol = self._policies.get(name)
+        if pol is None:
+            try:
+                pol = get_policy(name)
+            except ValueError:
+                logger.warn(
+                    "unknown policy on PodGroup; using engine default",
+                    group=f"{spec.namespace}/{spec.name}",
+                    policy=name,
+                )
+                pol = self.policy
+            self._policies[name] = pol
+        return pol
+
+    def _snapshot(
+        self, nodes: List[dict], usage: Dict[str, Tuple[float, float, int]]
+    ):
+        """Usage-adjusted free capacity + topology columns per node."""
+        free_cpu, free_mem, free_pods = [], [], []
+        cap_cpu, cap_mem, cap_pods = [], [], []
+        slice_ids, rack_ids = [], []
+        for node in nodes:
+            name = node["metadata"]["name"]
+            a_cpu, a_mem, a_pods = node_allocatable(node)
+            u_cpu, u_mem, u_n = usage.get(name, (0.0, 0.0, 0))
+            cap_cpu.append(a_cpu)
+            cap_mem.append(a_mem)
+            cap_pods.append(a_pods)
+            free_cpu.append(a_cpu - u_cpu)
+            free_mem.append(a_mem - u_mem)
+            free_pods.append(a_pods - u_n)
+            sl, rk = self.topology.coords(node)
+            slice_ids.append(sl)
+            rack_ids.append(rk)
+        return {
+            "free_cpu": np.asarray(free_cpu, dtype=np.float64),
+            "free_mem": np.asarray(free_mem, dtype=np.float64),
+            "free_pods": np.asarray(free_pods, dtype=np.float64),
+            "cap_cpu": np.asarray(cap_cpu, dtype=np.float64),
+            "cap_mem": np.asarray(cap_mem, dtype=np.float64),
+            "cap_pods": np.asarray(cap_pods, dtype=np.float64),
+            "slice_id": np.asarray(slice_ids, dtype=np.int64),
+            "rack_id": np.asarray(rack_ids, dtype=np.int64),
+        }
+
+    def _build_batch(
+        self, pods: List[dict], nodes: List[dict], snap
+    ) -> Optional[CandidateBatch]:
+        """Columnar feasible pod x node candidates (None when some pod
+        has no feasible node at all — the gang cannot place)."""
+        n_nodes = len(nodes)
+        reqs = [pod_requests(p) for p in pods]
+        gang_cpu = float(sum(r[0] for r in reqs))
+        gang_n = len(pods)
+        # per-slice aggregate free capacity -> the co-location signal
+        slice_ids = snap["slice_id"]
+        nslice = int(slice_ids.max()) + 1 if n_nodes else 0
+        slice_free_cpu = np.bincount(
+            slice_ids,
+            weights=np.maximum(snap["free_cpu"], 0.0),
+            minlength=nslice,
+        )
+        slice_free_pods = np.bincount(
+            slice_ids,
+            weights=np.maximum(snap["free_pods"], 0.0),
+            minlength=nslice,
+        )
+        slice_fits = (
+            (slice_free_pods >= gang_n) & (slice_free_cpu >= gang_cpu)
+        ).astype(np.float64)
+
+        pod_rows: List[int] = []
+        node_rows: List[int] = []
+        for pi, pod in enumerate(pods):
+            cpu, mem = reqs[pi]
+            any_node = False
+            for ni, node in enumerate(nodes):
+                if not node_feasible(pod, node):
+                    continue
+                if (
+                    snap["free_cpu"][ni] < cpu
+                    or snap["free_mem"][ni] < mem
+                    or snap["free_pods"][ni] < 1
+                ):
+                    continue
+                pod_rows.append(pi)
+                node_rows.append(ni)
+                any_node = True
+            if not any_node:
+                return None
+        pod_idx = np.asarray(pod_rows, dtype=np.int64)
+        node_idx = np.asarray(node_rows, dtype=np.int64)
+        req_cpu = np.asarray([r[0] for r in reqs], dtype=np.float64)
+        req_mem = np.asarray([r[1] for r in reqs], dtype=np.float64)
+        return CandidateBatch(
+            pod_idx=pod_idx,
+            node_idx=node_idx,
+            cpu_req=req_cpu[pod_idx],
+            mem_req=req_mem[pod_idx],
+            free_cpu=snap["free_cpu"][node_idx],
+            free_mem=snap["free_mem"][node_idx],
+            free_pods=snap["free_pods"][node_idx],
+            cap_cpu=snap["cap_cpu"][node_idx],
+            cap_mem=snap["cap_mem"][node_idx],
+            cap_pods=snap["cap_pods"][node_idx],
+            slice_id=slice_ids[node_idx],
+            rack_id=snap["rack_id"][node_idx],
+            gang_fit_slice=slice_fits[slice_ids[node_idx]]
+            if nslice
+            else np.zeros(len(node_rows)),
+        )
+
+    def _plan(
+        self,
+        pods: List[dict],
+        nodes: List[dict],
+        snap,
+        policy: Policy,
+    ) -> Optional[List[Tuple[dict, str]]]:
+        """Assign every pod a node or return None.  Greedy over the
+        scored batch: pods in descending cpu-request order (biggest
+        first packs tightest), each taking its best-scoring node with
+        capacity remaining; ties break on node name."""
+        batch = self._build_batch(pods, nodes, snap)
+        if batch is None or len(batch) == 0:
+            return None
+        free_cpu = snap["free_cpu"].copy()
+        free_mem = snap["free_mem"].copy()
+        free_pods = snap["free_pods"].copy()
+        reqs = [pod_requests(p) for p in pods]
+        order = sorted(
+            range(len(pods)),
+            key=lambda i: (-reqs[i][0], _pod_key(pods[i])),
+        )
+        names = [n["metadata"]["name"] for n in nodes]
+        assignment: List[Optional[str]] = [None] * len(pods)
+        for pi in order:
+            rows = np.nonzero(batch.pod_idx == pi)[0]
+            cpu, mem = reqs[pi]
+            # score THIS pod's candidates against the live free state —
+            # earlier members of the gang already claimed capacity, and
+            # policies must see it (spread fans out, binpack stacks
+            # then spills); one vectorized call per pod, columnar
+            nidx = batch.node_idx[rows]
+            sub = CandidateBatch(
+                pod_idx=batch.pod_idx[rows],
+                node_idx=nidx,
+                cpu_req=batch.cpu_req[rows],
+                mem_req=batch.mem_req[rows],
+                free_cpu=free_cpu[nidx],
+                free_mem=free_mem[nidx],
+                free_pods=free_pods[nidx],
+                cap_cpu=batch.cap_cpu[rows],
+                cap_mem=batch.cap_mem[rows],
+                cap_pods=batch.cap_pods[rows],
+                slice_id=batch.slice_id[rows],
+                rack_id=batch.rack_id[rows],
+                gang_fit_slice=batch.gang_fit_slice[rows],
+            )
+            scores = np.asarray(policy.score(sub), dtype=np.float64)
+            if scores.shape != sub.pod_idx.shape:
+                raise ValueError(
+                    f"policy {policy.name!r} returned shape {scores.shape}, "
+                    f"want {sub.pod_idx.shape}"
+                )
+            # best-score-first, node-name tiebreak
+            ranked = sorted(
+                range(len(rows)),
+                key=lambda j: (-scores[j], names[int(nidx[j])]),
+            )
+            for j in ranked:
+                ni = int(nidx[j])
+                if (
+                    free_cpu[ni] >= cpu
+                    and free_mem[ni] >= mem
+                    and free_pods[ni] >= 1
+                ):
+                    assignment[pi] = names[ni]
+                    free_cpu[ni] -= cpu
+                    free_mem[ni] -= mem
+                    free_pods[ni] -= 1
+                    break
+            if assignment[pi] is None:
+                return None
+        return [(pods[i], assignment[i]) for i in range(len(pods))]
+
+    # ------------------------------------------------------------ scheduling
+
+    def try_schedule(self, key: GangKey) -> bool:
+        members = self._pending.get(key)
+        if not members:
+            return False
+        pods = [members[k] for k in sorted(members)]
+        ns, name = key
+        try:
+            pg = self.store.get("PodGroup", name, namespace=ns)
+        except NotFound:
+            self._warn_gang(
+                key,
+                pods[0],
+                "FailedScheduling",
+                f"gang {ns}/{name}: PodGroup not found",
+            )
+            return False
+        except Exception as exc:  # noqa: BLE001 — apiserver outage; retried
+            logger.debug("podgroup fetch failed", gang=f"{ns}/{name}", err=str(exc))
+            return False
+        spec = parse_group(pg)
+        bound = self._bound.get(key) or {}
+        if len(members) + len(bound) < spec.min_member:
+            self._warn_gang(
+                key,
+                pods[0],
+                "WaitingForGang",
+                f"gang {ns}/{name}: {len(members) + len(bound)}/"
+                f"{spec.min_member} members",
+            )
+            return False
+        nodes = self._nodes_fn()
+        snap = self._snapshot(nodes, self._usage_fn())
+        plan = self._plan(pods, nodes, snap, self._policy_for(spec))
+        if plan is None:
+            preempting = spec.priority > 0 and self._preempt(
+                key, spec, pods, nodes, snap
+            )
+            self._warn_gang(
+                key,
+                pods[0],
+                "FailedScheduling",
+                f"gang {ns}/{name}: cannot place {len(pods)} pods on "
+                f"{len(nodes)} nodes"
+                + (" (preempting victims)" if preempting else ""),
+            )
+            return False
+        if not self._commit(key, plan):
+            return False
+        self.gangs_scheduled += 1
+        for pod, node in plan:
+            self._track(pod, node)
+            self.observe("MODIFIED", _with_node(pod, node))
+            self._event(
+                pod,
+                "Normal",
+                "Scheduled",
+                f"Successfully assigned "
+                f"{_pod_key(pod)[0]}/{_pod_key(pod)[1]} to {node} "
+                f"(gang {name})",
+            )
+        self._warn.clear(key)
+        return True
+
+    def _commit(self, key: GangKey, plan: List[Tuple[dict, str]]) -> bool:
+        """The all-or-nothing bind: one store transaction, every pod
+        CAS-guarded on still being unbound."""
+        ops = [
+            {
+                "verb": "patch",
+                "kind": "Pod",
+                "name": _pod_key(pod)[1],
+                "namespace": _pod_key(pod)[0],
+                "data": {"spec": {"nodeName": node}},
+                "patch_type": "merge",
+                "expect": {"spec.nodeName": None},
+            }
+            for pod, node in plan
+        ]
+        try:
+            if self.atomic:
+                self.store.transact(ops)
+            else:
+                # test-only regression mode: per-pod binds re-open the
+                # partial-gang window the txn lane exists to close
+                for op in ops:
+                    self.store.patch(
+                        op["kind"],
+                        op["name"],
+                        op["data"],
+                        patch_type="merge",
+                        namespace=op["namespace"],
+                        expect=op["expect"],
+                    )
+        except (Conflict, StorageDegraded, NotFound) as exc:
+            # stale view (a member changed under us) or storage
+            # refusing writes: nothing bound — watch echoes refresh
+            # membership and the retry cadence re-plans
+            logger.debug(
+                "gang bind refused", gang=f"{key[0]}/{key[1]}", err=str(exc)
+            )
+            return False
+        except Exception as exc:  # noqa: BLE001 — transport outage; retried
+            logger.info(
+                "gang bind failed", gang=f"{key[0]}/{key[1]}", err=str(exc)
+            )
+            return False
+        return True
+
+    # ------------------------------------------------------------ preemption
+
+    def _preempt(
+        self,
+        key: GangKey,
+        spec: GroupSpec,
+        pods: List[dict],
+        nodes: List[dict],
+        snap,
+    ) -> bool:
+        """Graceful victim selection: simulate evictions cheapest-first
+        — (priority asc, gangs-disrupted, name) — until the gang plans,
+        then evict that victim set through the ordinary delete path.
+        Binds happen on a later pass once capacity really frees."""
+        try:
+            all_pods, _ = self.store.list("Pod")
+        except Exception:  # noqa: BLE001 — apiserver outage; retried
+            return False
+        node_names = {n["metadata"]["name"] for n in nodes}
+        prio: Dict[GangKey, int] = {}
+
+        def _victim_priority(p: dict) -> int:
+            """Preemption weight of a candidate victim: its gang's
+            declared PodGroup priority when it has one (spec.priority
+            is only the gangless fallback — gang members normally
+            carry none, and valuing them at 0 would let any gang evict
+            them); an unreadable PodGroup makes the gang
+            non-preemptible this pass — when in doubt, don't evict."""
+            gk = gang_key(p)
+            if gk is None:
+                return pod_priority(p)
+            if gk not in prio:
+                try:
+                    prio[gk] = parse_group(
+                        self.store.get("PodGroup", gk[1], namespace=gk[0])
+                    ).priority
+                except NotFound:
+                    prio[gk] = pod_priority(p)
+                except Exception:  # noqa: BLE001 — outage; retried
+                    prio[gk] = spec.priority
+            return prio[gk]
+
+        victims: List[dict] = []
+        for p in all_pods:
+            meta = p.get("metadata") or {}
+            node = (p.get("spec") or {}).get("nodeName")
+            if not node or node not in node_names:
+                continue
+            if meta.get("deletionTimestamp"):
+                continue
+            if (p.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            if gang_key(p) == key:
+                continue
+            if _victim_priority(p) >= spec.priority:
+                continue
+            victims.append(p)
+        if not victims:
+            return False
+        disrupted: Set[GangKey] = set()
+        chosen: List[dict] = []
+        snap_sim = {k: (v.copy() if hasattr(v, "copy") else v) for k, v in snap.items()}
+        name_to_idx = {
+            n["metadata"]["name"]: i for i, n in enumerate(nodes)
+        }
+        policy = self._policy_for(spec)
+        while len(chosen) < self.MAX_VICTIMS:
+            victims.sort(
+                key=lambda p: (
+                    _victim_priority(p),
+                    0
+                    if gang_key(p) is None or gang_key(p) in disrupted
+                    else 1,
+                    _pod_key(p),
+                )
+            )
+            if not victims:
+                return False
+            v = victims.pop(0)
+            chosen.append(v)
+            gk = gang_key(v)
+            if gk is not None:
+                disrupted.add(gk)
+            ni = name_to_idx[(v.get("spec") or {}).get("nodeName")]
+            cpu, mem = pod_requests(v)
+            snap_sim["free_cpu"][ni] += cpu
+            snap_sim["free_mem"][ni] += mem
+            snap_sim["free_pods"][ni] += 1
+            if self._plan(pods, nodes, snap_sim, policy) is not None:
+                break
+        else:
+            return False  # hit MAX_VICTIMS before the gang fit
+        for v in chosen:
+            vk = _pod_key(v)
+            try:
+                self._event(
+                    v,
+                    "Normal",
+                    "Preempted",
+                    f"Preempted by gang {key[0]}/{key[1]} "
+                    f"(priority {spec.priority})",
+                )
+                self.store.delete("Pod", vk[1], namespace=vk[0])
+            except NotFound:
+                continue
+            except Exception as exc:  # noqa: BLE001 — outage; retried
+                logger.info(
+                    "preemption eviction failed",
+                    pod=f"{vk[0]}/{vk[1]}",
+                    err=str(exc),
+                )
+                return True  # partial evictions still free capacity
+        self.preemptions += len(chosen)
+        return True
+
+    # --------------------------------------------------------------- events
+
+    def _event(self, pod: dict, etype: str, reason: str, msg: str) -> None:
+        if self.recorder is not None:
+            self.recorder.event(pod, etype, reason, msg)
+
+    def _warn_gang(
+        self, key: GangKey, pod: dict, reason: str, msg: str
+    ) -> None:
+        """Deduplicated, per-gang backed-off warning events — one gang
+        emits one event stream with exponential spacing, not one event
+        per pod per retry tick."""
+        if not self._warn.ready(key, self._clock.now()):
+            return
+        self._event(pod, "Warning", reason, msg)
+
+
+def _with_node(pod: dict, node: str) -> dict:
+    """A shallow overlay of the pod with its new binding, for the
+    membership cache (the authoritative copy arrives via watch)."""
+    out = dict(pod)
+    out["spec"] = dict(pod.get("spec") or {})
+    out["spec"]["nodeName"] = node
+    return out

@@ -1,0 +1,102 @@
+"""PodGroup vocabulary: the all-or-nothing admission unit.
+
+A ``PodGroup`` (``scheduling.kwok.io/v1alpha1``, registered with the
+builtin kinds in ``kwok_tpu/cluster/store.py:139``) names a gang:
+
+.. code-block:: yaml
+
+    apiVersion: scheduling.kwok.io/v1alpha1
+    kind: PodGroup
+    metadata: {name: train-42, namespace: default}
+    spec:
+      minMember: 8     # the gang binds only when this many pods exist
+      priority: 100    # preemption weight; 0 never preempts
+
+Pods join it via the ``kwok.io/pod-group`` annotation — the
+coscheduling-plugin convention, annotation-based so workload templates
+(Deployment/Job pod templates) gang their replicas without a new pod
+field.  The engine (``kwok_tpu/sched/engine.py:1``) holds every member
+until ``minMember`` are pending+bound, then binds the whole gang
+through one atomic store transaction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+__all__ = [
+    "POD_GROUP_ANNOTATION",
+    "GroupSpec",
+    "gang_name",
+    "gang_key",
+    "parse_group",
+    "pod_priority",
+]
+
+#: pods opt into a gang with this annotation (value = PodGroup name in
+#: the pod's namespace)
+POD_GROUP_ANNOTATION = "kwok.io/pod-group"
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """Parsed PodGroup spec with defaults applied."""
+
+    name: str
+    namespace: str
+    min_member: int = 1
+    priority: int = 0
+    #: optional per-group policy override (a POLICIES key); None rides
+    #: the engine default
+    policy: Optional[str] = None
+
+
+def gang_name(pod: dict) -> Optional[str]:
+    """The pod's PodGroup name, or None for a non-gang pod."""
+    ann = (pod.get("metadata") or {}).get("annotations") or {}
+    return ann.get(POD_GROUP_ANNOTATION) or None
+
+
+def gang_key(pod: dict) -> Optional[Tuple[str, str]]:
+    """(namespace, group) identity of the pod's gang, or None."""
+    name = gang_name(pod)
+    if name is None:
+        return None
+    ns = (pod.get("metadata") or {}).get("namespace") or "default"
+    return (ns, name)
+
+
+def parse_group(obj: dict) -> GroupSpec:
+    """PodGroup object -> :class:`GroupSpec` (tolerant of missing or
+    malformed fields — a PodGroup with garbage minMember behaves as a
+    1-member gang rather than wedging the engine)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+
+    def _int(v, default=0) -> int:
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    policy = spec.get("policy")
+    return GroupSpec(
+        name=meta.get("name") or "",
+        namespace=meta.get("namespace") or "default",
+        min_member=max(1, _int(spec.get("minMember"), 1)),
+        priority=_int(spec.get("priority"), 0),
+        policy=str(policy) if policy else None,
+    )
+
+
+def pod_priority(pod: dict, group: Optional[GroupSpec] = None) -> int:
+    """Preemption weight of a pod: its gang's priority when it has
+    one, else ``spec.priority`` (the PriorityClass-resolved field),
+    else 0."""
+    if group is not None:
+        return group.priority
+    try:
+        return int((pod.get("spec") or {}).get("priority") or 0)
+    except (TypeError, ValueError):
+        return 0

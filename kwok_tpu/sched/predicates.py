@@ -1,0 +1,138 @@
+"""Scheduling feasibility predicates and resource accounting.
+
+The kube-scheduler's filter phase, scoped to what simulated clusters
+exercise: node readiness, ``spec.nodeSelector``, ``NoSchedule`` taints
+vs pod tolerations, and requests-vs-allocatable capacity fit.  Shared
+by the single-pod binder (``kwok_tpu/controllers/scheduler.py:1``,
+which historically ignored selectors and taints — any selector-bearing
+workload landed on arbitrary nodes) and the gang engine
+(``kwok_tpu/sched/engine.py:1``), so both placement paths agree on
+what "fits" means.
+
+Quantity parsing rides :func:`kwok_tpu.utils.cel.parse_quantity`, the
+same grammar the usage evaluator uses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from kwok_tpu.utils.cel import parse_quantity
+
+__all__ = [
+    "DEFAULT_PODS",
+    "pod_requests",
+    "node_allocatable",
+    "node_ready",
+    "node_selector_matches",
+    "tolerates_taints",
+    "node_feasible",
+]
+
+#: default per-node pod cap when the node declares none (k8s default)
+DEFAULT_PODS = 110.0
+
+#: taint keys every simulated pod implicitly tolerates.  Stock KWOK
+#: taints fake nodes with ``kwok.x-k8s.io/node: fake:NoSchedule`` to
+#: repel REAL workloads in mixed clusters (its pod scale template
+#: carries the matching toleration, ctl/scale.py) — in this rebuild
+#: every pod is a simulated kwok workload, so enforcing that one taint
+#: would strand every untolerated pod while protecting nothing.  Any
+#: OTHER NoSchedule taint (user cordon policies, dedicated pools) is
+#: enforced for real.
+IMPLICIT_TOLERATION_KEYS = frozenset({"kwok.x-k8s.io/node"})
+
+
+def pod_requests(pod: dict) -> Tuple[float, float]:
+    """Total (cpu_cores, memory_bytes) requested by a pod's containers."""
+    cpu = mem = 0.0
+    spec = pod.get("spec") or {}
+    for c in spec.get("containers") or []:
+        reqs = ((c.get("resources") or {}).get("requests")) or {}
+        if "cpu" in reqs:
+            cpu += parse_quantity(str(reqs["cpu"]))
+        if "memory" in reqs:
+            mem += parse_quantity(str(reqs["memory"]))
+    return cpu, mem
+
+
+def node_allocatable(node: dict) -> Tuple[float, float, float]:
+    """(cpu, memory, pods) a node offers — allocatable, else capacity."""
+    status = node.get("status") or {}
+    res = status.get("allocatable") or status.get("capacity") or {}
+
+    def q(key: str, default: float) -> float:
+        try:
+            return parse_quantity(str(res[key])) if key in res else default
+        except (ValueError, TypeError):
+            return default
+
+    return q("cpu", float("inf")), q("memory", float("inf")), q("pods", DEFAULT_PODS)
+
+
+def node_ready(node: dict) -> bool:
+    if (node.get("spec") or {}).get("unschedulable"):
+        return False
+    if (node.get("metadata") or {}).get("deletionTimestamp"):
+        return False
+    for c in (node.get("status") or {}).get("conditions") or []:
+        if c.get("type") == "Ready":
+            return c.get("status") == "True"
+    # nodes fresh out of create have no conditions yet; schedule onto
+    # them anyway — their initialize stage is about to run
+    return True
+
+
+def node_selector_matches(pod: dict, node: dict) -> bool:
+    """``spec.nodeSelector`` is a hard requirement: every key/value
+    must be present on the node's labels (kube-scheduler's
+    NodeAffinity filter, the matchLabels form)."""
+    sel: Dict[str, str] = (pod.get("spec") or {}).get("nodeSelector") or {}
+    if not sel:
+        return True
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in sel.items())
+
+
+def _toleration_matches(tol: dict, taint: dict) -> bool:
+    op = tol.get("operator") or "Equal"
+    key = tol.get("key") or ""
+    if key and key != taint.get("key"):
+        return False
+    if not key and op != "Exists":
+        return False  # empty key only tolerates-all with Exists
+    if op == "Equal" and (tol.get("value") or "") != (taint.get("value") or ""):
+        return False
+    effect = tol.get("effect") or ""
+    if effect and effect != taint.get("effect"):
+        return False
+    return True
+
+
+def tolerates_taints(pod: dict, node: dict) -> bool:
+    """``NoSchedule`` taints exclude pods without a matching
+    toleration (kube-scheduler's TaintToleration filter; NoExecute is
+    an eviction concern, PreferNoSchedule a scoring one — both out of
+    scope for placement feasibility)."""
+    taints = (node.get("spec") or {}).get("taints") or []
+    if not taints:
+        return True
+    tols = (pod.get("spec") or {}).get("tolerations") or []
+    for taint in taints:
+        if taint.get("effect") != "NoSchedule":
+            continue
+        if taint.get("key") in IMPLICIT_TOLERATION_KEYS:
+            continue  # the fake-node taint; see IMPLICIT_TOLERATION_KEYS
+        if not any(_toleration_matches(t, taint) for t in tols):
+            return False
+    return True
+
+
+def node_feasible(pod: dict, node: dict) -> bool:
+    """Readiness + selector + taints — everything except capacity,
+    which depends on live usage the caller owns."""
+    return (
+        node_ready(node)
+        and node_selector_matches(pod, node)
+        and tolerates_taints(pod, node)
+    )

@@ -1,0 +1,110 @@
+"""Simulated TPU fleet topology: slice and rack coordinates for nodes.
+
+A TPU fleet is not flat: hosts belong to *slices* (one multi-chip ICI
+domain — the device mesh a training job spans) and slices to *racks*
+(a shared failure/bandwidth domain).  Co-locating a gang on one slice
+is the difference between ICI and DCN bandwidth, so placement scoring
+must see the shape.  The model here mirrors the row-sharding mesh the
+device kernel runs on: a slice's host count derives from the mesh
+shape (``kwok_tpu/parallel/mesh.py:34`` ``make_mesh`` — one simulated
+node stands in for one host of the slice).
+
+Nodes carry the coordinates as labels::
+
+    topology.kwok.io/slice: "slice-3"
+    topology.kwok.io/rack:  "rack-1"
+
+``TopologyModel.labels_for(i)`` generates them at node-create time
+(bench/DST/kwokctl scale paths); ``coords()`` reads them back, falling
+back to deriving from a trailing integer in the node name so
+unlabeled fleets still get a consistent (if synthetic) shape.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = ["SLICE_LABEL", "RACK_LABEL", "TopologyModel"]
+
+SLICE_LABEL = "topology.kwok.io/slice"
+RACK_LABEL = "topology.kwok.io/rack"
+
+_TRAILING_INT = re.compile(r"(\d+)$")
+
+
+@dataclass(frozen=True)
+class TopologyModel:
+    """Deterministic node-index -> (slice, rack) mapping.
+
+    ``slice_hosts`` hosts form one slice; ``slices_per_rack``
+    consecutive slices share a rack.  Both default to the shapes the
+    repo's 8-chip dry-run mesh exercises.
+    """
+
+    slice_hosts: int = 8
+    slices_per_rack: int = 2
+
+    @classmethod
+    def from_mesh(cls, mesh, slices_per_rack: int = 2) -> "TopologyModel":
+        """Derive the slice size from a live device mesh: one
+        simulated node per chip-host of the row-sharding mesh
+        (``kwok_tpu.parallel.mesh.make_mesh``)."""
+        return cls(
+            slice_hosts=max(1, int(mesh.devices.size)),
+            slices_per_rack=max(1, slices_per_rack),
+        )
+
+    # ------------------------------------------------------------ forward
+
+    def slice_of(self, index: int) -> int:
+        return index // self.slice_hosts
+
+    def rack_of(self, index: int) -> int:
+        return self.slice_of(index) // self.slices_per_rack
+
+    def labels_for(self, index: int) -> Dict[str, str]:
+        """Topology labels for the ``index``-th node of the fleet."""
+        return {
+            SLICE_LABEL: f"slice-{self.slice_of(index)}",
+            RACK_LABEL: f"rack-{self.rack_of(index)}",
+        }
+
+    # ------------------------------------------------------------ reverse
+
+    def coords(self, node: dict) -> Tuple[int, int]:
+        """(slice_id, rack_id) of a node — labels when present, else
+        derived from the trailing integer of the node name (so a fleet
+        created before labeling still scores consistently)."""
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        sl = _parse_id(labels.get(SLICE_LABEL))
+        rk = _parse_id(labels.get(RACK_LABEL))
+        if sl is not None:
+            return sl, rk if rk is not None else sl // self.slices_per_rack
+        name = (node.get("metadata") or {}).get("name") or ""
+        m = _TRAILING_INT.search(name)
+        idx = int(m.group(1)) if m else 0
+        return self.slice_of(idx), self.rack_of(idx)
+
+    # ------------------------------------------------------------ quality
+
+    @staticmethod
+    def locality(slice_ids) -> float:
+        """Placement-quality score of a gang: the fraction of members
+        on the modal slice (1.0 = whole gang co-located on one slice,
+        the ICI-bandwidth ideal; ->0 as it scatters)."""
+        ids = list(slice_ids)
+        if not ids:
+            return 1.0
+        counts: Dict[int, int] = {}
+        for s in ids:
+            counts[s] = counts.get(s, 0) + 1
+        return max(counts.values()) / len(ids)
+
+
+def _parse_id(value: Optional[str]) -> Optional[int]:
+    if not value:
+        return None
+    m = _TRAILING_INT.search(value)
+    return int(m.group(1)) if m else None

@@ -141,6 +141,11 @@ class PitrArchive:
                     covered = any(
                         int(it[3]) <= to_rv for it in rec.get("i") or []
                     )
+                elif t == "txn":
+                    covered = any(
+                        int(sub.get("rv", 0) or 0) <= to_rv
+                        for sub in rec.get("recs") or []
+                    )
                 elif t in ("ev", "reset"):
                     covered = int(rec.get("rv", 0) or 0) <= to_rv
                 if covered and (last_keep_seq is None or seq > last_keep_seq):
@@ -148,6 +153,27 @@ class PitrArchive:
         out: List[dict] = []
         for i, rec in enumerate(records):
             t = rec.get("t")
+            if t == "txn":
+                # a txn is atomic for crash replay, but a point-in-time
+                # rebuild targets one exact rv: trim per inner event
+                # like a status batch (the byte-identity contract is
+                # with the live state at that rv, which the store held
+                # — under its mutex — mid-commit)
+                keep = [
+                    sub
+                    for sub in rec.get("recs") or []
+                    if sub.get("t") == "ev"
+                    and int(sub.get("rv", 0) or 0) <= to_rv
+                ]
+                if not keep:
+                    continue
+                trimmed = dict(rec)
+                trimmed["recs"] = keep
+                trimmed["rv"] = max(
+                    int(sub.get("rv", 0) or 0) for sub in keep
+                )
+                out.append(trimmed)
+                continue
             if t == "status":
                 items = [
                     it
@@ -211,6 +237,10 @@ class PitrArchive:
                 elif rec.get("t") == "status":
                     for it in rec.get("i") or []:
                         covered.add(int(it[3]))
+                elif rec.get("t") == "txn":
+                    for sub in rec.get("recs") or []:
+                        if sub.get("t") == "ev":
+                            covered.add(int(sub.get("rv", 0) or 0))
             holes = [
                 rv for rv in range(1, int(to_rv) + 1) if rv not in covered
             ]

@@ -99,6 +99,28 @@ def test_scale_default_templates():
     assert pod["spec"]["tolerations"][0]["key"] == "kwok.x-k8s.io/node"
 
 
+def test_scale_node_carries_topology_labels():
+    """Scaled nodes get slice/rack coordinates (the gang scheduler's
+    co-location signal) without relying on the name-derived fallback;
+    template-provided labels win."""
+    store = ResourceStore()
+    scale(store, "node", 10)
+    node = store.get("Node", "node-9")  # default shape: 8 hosts/slice
+    assert node["metadata"]["labels"]["topology.kwok.io/slice"] == "slice-1"
+    assert node["metadata"]["labels"]["topology.kwok.io/rack"] == "rack-0"
+    tpl = (
+        "apiVersion: v1\n"
+        "kind: Node\n"
+        "metadata:\n"
+        "  name: {{ Name }}\n"
+        "  labels: {topology.kwok.io/slice: slice-7}\n"
+        "spec: {}\n"
+    )
+    scale(store, "Node", 1, template=tpl, name_prefix="pinned")
+    pinned = store.get("Node", "pinned-0")
+    assert pinned["metadata"]["labels"]["topology.kwok.io/slice"] == "slice-7"
+
+
 def test_scale_custom_template_with_index_and_cidr():
     store = ResourceStore()
     tpl = (

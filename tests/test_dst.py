@@ -175,7 +175,10 @@ def test_clean_tree_seeds_converge_without_violations():
         assert r["converged"], (seed, r)
         assert r["violations"] == {}, (seed, r)
         assert r["counts"]["Deployment"] == 1
-        assert r["counts"]["Pod"] == 4  # scaled back down at the end
+        # web scaled back to 4 + the 3-member training gang
+        assert r["counts"]["Pod"] == 7
+        # the gang engine ran and was probed (end-of-run at minimum)
+        assert r["gang_probes"] >= 2
 
 
 def test_injected_regression_is_caught_and_replays_identically():
@@ -219,3 +222,45 @@ def test_audit_overflow_surfaces_in_metrics():
     store = ResourceStore()
     text = expose_metrics(None, store=store)
     assert "kwok_apiserver_audit_overflow_total 0" in text
+
+
+# ------------------------------------------------------------ gang atomicity
+
+
+def test_gang_atomicity_checker_flags_bound_strict_subset():
+    clean = _record(
+        Trace(),
+        gang_checks=[
+            {"at": "crash", "gang": "default/train", "present": 3, "bound": 3, "t": 1.0},
+            {"at": "final", "gang": "default/train", "present": 3, "bound": 0, "t": 2.0},
+        ],
+    )
+    assert INVARIANTS["gang-atomicity"](clean) == []
+    partial = _record(
+        Trace(),
+        gang_checks=[
+            {"at": "disk", "gang": "default/train", "present": 3, "bound": 2, "t": 1.5},
+        ],
+    )
+    found = INVARIANTS["gang-atomicity"](partial)
+    assert found and "2/3" in found[0]
+
+
+def test_partial_gang_regression_is_caught_and_replays_identically():
+    """Acceptance gate for the gang engine: un-atomic the bind lane
+    (--dst-bug partial-gang: per-pod patches instead of one txn) and
+    the seed search must find a crash window that strands a bound
+    strict subset — and the violating seed must replay exactly."""
+    opts = SimOptions(bug="partial-gang")
+    caught = None
+    for seed in range(10):
+        r = run_seed(seed, opts)
+        if r["violations"]:
+            caught = (seed, r)
+            break
+    assert caught is not None, "seed search never caught partial-gang"
+    seed, first = caught
+    assert "gang-atomicity" in first["violations"]
+    replay = run_seed(seed, opts)
+    assert replay["trace_digest"] == first["trace_digest"]
+    assert replay["violations"] == first["violations"]

@@ -1,0 +1,665 @@
+"""Gang scheduling subsystem (kwok_tpu/sched/): topology model,
+vectorized policy seam, all-or-nothing admission through the atomic
+store transaction lane, priority preemption — and the crash/failover
+acceptance: a gang is never observably partial."""
+
+import os
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from kwok_tpu.cluster.store import (
+    ResourceStore,
+    TransactionAborted,
+)
+from kwok_tpu.cluster.wal import WriteAheadLog
+from kwok_tpu.controllers.scheduler import Scheduler
+from kwok_tpu.sched import (
+    CandidateBatch,
+    GangEngine,
+    TopologyModel,
+    get_policy,
+    register_policy,
+)
+from kwok_tpu.sched.policy import POLICIES
+from kwok_tpu.sched.predicates import (
+    node_selector_matches,
+    tolerates_taints,
+)
+
+
+def make_node(name, cpu="8", pods="110", labels=None, taints=None):
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels or {}},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": "16Gi", "pods": pods},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+    if taints:
+        node["spec"] = {"taints": taints}
+    return node
+
+
+def make_gpod(name, gang, cpu="1", priority=None):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "annotations": {"kwok.io/pod-group": gang} if gang else {},
+        },
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "image": "i",
+                    "resources": {"requests": {"cpu": cpu}},
+                }
+            ]
+        },
+        "status": {},
+    }
+    if priority is not None:
+        pod["spec"]["priority"] = priority
+    return pod
+
+
+def make_group(name, min_member, priority=0, policy=None):
+    spec = {"minMember": min_member, "priority": priority}
+    if policy:
+        spec["policy"] = policy
+    return {
+        "apiVersion": "scheduling.kwok.io/v1alpha1",
+        "kind": "PodGroup",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def make_engine(store, topo=None, policy="binpack", **kw):
+    def nodes():
+        items, _ = store.list("Node")
+        return sorted(items, key=lambda n: n["metadata"]["name"])
+
+    return GangEngine(
+        store, nodes=nodes, topology=topo or TopologyModel(), policy=policy, **kw
+    )
+
+
+def bound_map(store):
+    pods, _ = store.list("Pod")
+    return {
+        p["metadata"]["name"]: (p.get("spec") or {}).get("nodeName")
+        for p in pods
+    }
+
+
+# ----------------------------------------------------------------- topology
+
+
+def test_topology_labels_and_coords_roundtrip():
+    topo = TopologyModel(slice_hosts=4, slices_per_rack=2)
+    labels = topo.labels_for(10)  # node 10 -> slice 2, rack 1
+    assert labels == {
+        "topology.kwok.io/slice": "slice-2",
+        "topology.kwok.io/rack": "rack-1",
+    }
+    node = {"metadata": {"name": "node-10", "labels": labels}}
+    assert topo.coords(node) == (2, 1)
+    # unlabeled fleets derive the same shape from the name's index
+    bare = {"metadata": {"name": "node-10", "labels": {}}}
+    assert topo.coords(bare) == (2, 1)
+
+
+def test_topology_locality_score():
+    assert TopologyModel.locality([0, 0, 0, 0]) == 1.0
+    assert TopologyModel.locality([0, 0, 1, 1]) == 0.5
+    assert TopologyModel.locality([]) == 1.0
+
+
+# ------------------------------------------------------------------ policies
+
+
+def _batch(rows):
+    """rows: (pod, node, cpu_req, free_cpu, cap_cpu, slice, rack, fit)"""
+    cols = list(zip(*rows))
+    return CandidateBatch(
+        pod_idx=np.asarray(cols[0]),
+        node_idx=np.asarray(cols[1]),
+        cpu_req=np.asarray(cols[2], dtype=float),
+        mem_req=np.zeros(len(rows)),
+        free_cpu=np.asarray(cols[3], dtype=float),
+        free_mem=np.full(len(rows), 1e12),
+        free_pods=np.full(len(rows), 100.0),
+        cap_cpu=np.asarray(cols[4], dtype=float),
+        cap_mem=np.full(len(rows), 1e12),
+        cap_pods=np.full(len(rows), 110.0),
+        slice_id=np.asarray(cols[5]),
+        rack_id=np.asarray(cols[6]),
+        gang_fit_slice=np.asarray(cols[7], dtype=float),
+    )
+
+
+def test_binpack_prefers_fuller_node_and_fitting_slice():
+    pol = get_policy("binpack")
+    # same slice fit: fuller node (less free) wins
+    b = _batch(
+        [(0, 0, 1.0, 8.0, 8.0, 0, 0, 1.0), (0, 1, 1.0, 2.0, 8.0, 0, 0, 1.0)]
+    )
+    s = pol.score(b)
+    assert s[1] > s[0]
+    # slice fit dominates packing
+    b = _batch(
+        [(0, 0, 1.0, 2.0, 8.0, 0, 0, 0.0), (0, 1, 1.0, 8.0, 8.0, 1, 0, 1.0)]
+    )
+    s = pol.score(b)
+    assert s[1] > s[0]
+
+
+def test_spread_prefers_emptier_node():
+    pol = get_policy("spread")
+    b = _batch(
+        [(0, 0, 1.0, 8.0, 8.0, 0, 0, 0.0), (0, 1, 1.0, 2.0, 8.0, 0, 1, 0.0)]
+    )
+    s = pol.score(b)
+    assert s[0] > s[1]
+
+
+def test_external_policy_registers_into_the_seam():
+    class Constant:
+        name = "constant"
+
+        def score(self, batch):
+            return np.zeros(len(batch))
+
+    register_policy("constant", Constant)
+    try:
+        assert isinstance(get_policy("constant"), Constant)
+        with pytest.raises(ValueError):
+            get_policy("no-such-policy")
+    finally:
+        POLICIES.pop("constant", None)
+
+
+# ----------------------------------------------------------- gang admission
+
+
+def test_gang_waits_for_min_member_then_binds_atomically():
+    store = ResourceStore()
+    topo = TopologyModel(slice_hosts=2)
+    for i in range(4):
+        store.create(make_node(f"node-{i}", labels=topo.labels_for(i)))
+    store.create(make_group("train", 3))
+    eng = make_engine(store, topo)
+    for i in range(2):
+        store.create(make_gpod(f"g{i}", "train"))
+        eng.offer(store.get("Pod", f"g{i}"))
+    assert all(n is None for n in bound_map(store).values())
+    store.create(make_gpod("g2", "train"))
+    assert eng.offer(store.get("Pod", "g2")) is True
+    binds = bound_map(store)
+    assert all(binds.values()), binds
+    # one atomic txn carried the whole gang
+    txns = [a for a in store.audit_log() if a[0] == "txn"]
+    assert len(txns) == 1 and txns[0][1] == "Pod:3"
+    # binpack co-located the gang on one slice
+    slices = {
+        topo.coords({"metadata": {"name": n, "labels": {}}})[0]
+        for n in binds.values()
+    }
+    assert len(slices) == 1
+
+
+def test_missing_podgroup_holds_the_gang_and_warns_once():
+    store = ResourceStore()
+    store.create(make_node("node-0"))
+    events = []
+
+    class Rec:
+        def event(self, obj, etype, reason, msg):
+            events.append((reason, msg))
+
+    eng = make_engine(store, recorder=Rec())
+    store.create(make_gpod("g0", "ghost"))
+    pod = store.get("Pod", "g0")
+    assert eng.offer(pod) is False
+    assert bound_map(store)["g0"] is None
+    assert events and events[0][0] == "FailedScheduling"
+    n = len(events)
+    # immediate retry is deduplicated by the per-gang backoff
+    eng.retry_pending()
+    assert len(events) == n
+
+
+def test_spread_policy_fans_gang_across_nodes():
+    store = ResourceStore()
+    topo = TopologyModel(slice_hosts=4)
+    for i in range(4):
+        store.create(make_node(f"node-{i}", labels=topo.labels_for(i)))
+    store.create(make_group("svc", 4, policy="spread"))
+    eng = make_engine(store, topo)
+    for i in range(4):
+        store.create(make_gpod(f"s{i}", "svc", cpu="100m"))
+        eng.offer(store.get("Pod", f"s{i}"))
+    binds = bound_map(store)
+    assert all(binds.values())
+    assert len(set(binds.values())) == 4  # one per node
+
+
+# --------------------------------------------------------------- atomicity
+
+
+def test_transact_partial_gang_is_impossible_on_conflict():
+    store = ResourceStore()
+    store.create(make_node("node-0"))
+    store.create(make_group("train", 2))
+    eng = make_engine(store)
+    store.create(make_gpod("g0", "train"))
+    store.create(make_gpod("g1", "train"))
+    # sabotage: g1 is bound out from under the engine
+    store.patch(
+        "Pod", "g1", {"spec": {"nodeName": "elsewhere"}}, namespace="default"
+    )
+    eng.offer(store.get("Pod", "g0"))
+    eng._pending[("default", "train")][("default", "g1")] = store.get(
+        "Pod", "g1"
+    ) | {"spec": {"containers": [], "nodeName": None}}
+    # force a plan over a stale member: the CAS expect must abort ALL
+    ops = [
+        {
+            "verb": "patch",
+            "kind": "Pod",
+            "name": n,
+            "namespace": "default",
+            "data": {"spec": {"nodeName": "node-0"}},
+            "expect": {"spec.nodeName": None},
+        }
+        for n in ("g0", "g1")
+    ]
+    with pytest.raises(TransactionAborted):
+        store.transact(ops)
+    assert bound_map(store)["g0"] is None  # nothing partial
+
+
+def test_crash_inside_gang_txn_recovers_full_or_nothing():
+    """The kill-the-leader-mid-gang acceptance, store-side: a crash at
+    EVERY commit phase inside the gang's transaction must recover to
+    zero binds (the txn never hit the WAL) — never a strict subset."""
+
+    class Died(BaseException):
+        pass
+
+    for phase in ("before-commit", "after-commit"):
+        for skip in (0, 1, 2):
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, "wal.jsonl")
+                store = ResourceStore()
+                store.attach_wal(WriteAheadLog(path, fsync="off"))
+                topo = TopologyModel(slice_hosts=2)
+                for i in range(2):
+                    store.create(
+                        make_node(f"node-{i}", labels=topo.labels_for(i))
+                    )
+                store.create(make_group("train", 3))
+                eng = make_engine(store, topo)
+                for i in range(3):
+                    store.create(make_gpod(f"g{i}", "train"))
+                seen = {"n": 0}
+
+                def hook(p, phase=phase, skip=skip, seen=seen):
+                    if p != phase:
+                        return
+                    seen["n"] += 1
+                    if seen["n"] > skip:
+                        raise Died(p)
+
+                store.set_crash_hook(hook)
+                with pytest.raises(Died):
+                    for i in range(3):
+                        eng.offer(store.get("Pod", f"g{i}"))
+                recovered = ResourceStore()
+                recovered.recover_wal(path)
+                n_bound = sum(
+                    1 for v in bound_map(recovered).values() if v
+                )
+                assert n_bound == 0, (phase, skip, bound_map(recovered))
+
+
+def test_leader_failover_mid_gang_full_bind_or_full_rollback():
+    """Two elected schedulers over one store: the leader dies mid-gang
+    (between planning and commit, and again right after commit); at no
+    observable point is a strict subset of the gang bound, and the
+    standby completes the gang."""
+    store = ResourceStore()
+    topo = TopologyModel(slice_hosts=2)
+    for i in range(2):
+        store.create(make_node(f"node-{i}", labels=topo.labels_for(i)))
+    store.create(make_group("train", 3))
+    for i in range(3):
+        store.create(make_gpod(f"g{i}", "train"))
+
+    class Died(BaseException):
+        pass
+
+    # leader A dies inside its first commit attempt (store-side crash
+    # hook = the process was killed mid-transaction)
+    eng_a = make_engine(store)
+    for i in range(3):
+        eng_a.observe("ADDED", store.get("Pod", f"g{i}"))
+    state = {"n": 0}
+
+    def die_once(phase):
+        if phase == "before-commit" and state["n"] == 0:
+            state["n"] = 1
+            raise Died(phase)
+
+    store.set_crash_hook(die_once)
+    with pytest.raises(Died):
+        eng_a.try_schedule(("default", "train"))
+    store.set_crash_hook(None)
+    assert sum(1 for v in bound_map(store).values() if v) == 0  # full rollback
+
+    # standby B takes over with a fresh engine built from the store
+    eng_b = make_engine(store)
+    for i in range(3):
+        eng_b.observe("ADDED", store.get("Pod", f"g{i}"))
+    assert eng_b.try_schedule(("default", "train")) is True
+    assert all(bound_map(store).values())  # full bind
+
+    # a straggling retry from the deposed leader cannot double-bind:
+    # every op's CAS expect fails, the txn aborts whole
+    assert eng_a.try_schedule(("default", "train")) is False
+    assert all(bound_map(store).values())
+
+
+# -------------------------------------------------------------- preemption
+
+
+def test_preemption_evicts_lowest_priority_fewest_gangs():
+    store = ResourceStore()
+    store.create(make_node("node-0", cpu="2"))
+    store.create(make_node("node-1", cpu="2"))
+    events = []
+
+    class Rec:
+        def event(self, obj, etype, reason, msg):
+            events.append((reason, (obj.get("metadata") or {}).get("name")))
+
+    # fill the cluster: two low-prio and two mid-prio singletons
+    fillers = [("low-a", 1), ("low-b", 1), ("mid-a", 5), ("mid-b", 5)]
+    usage = {}
+    for i, (name, prio) in enumerate(fillers):
+        pod = make_gpod(name, None, cpu="1", priority=prio)
+        node = f"node-{i % 2}"
+        pod["spec"]["nodeName"] = node
+        store.create(pod)
+        c, m, n = usage.get(node, (0.0, 0.0, 0))
+        usage[node] = (c + 1.0, m, n + 1)
+    store.create(make_group("train", 2, priority=10))
+    eng = make_engine(store, recorder=Rec(), usage=lambda: dict(usage))
+    for i in range(2):
+        store.create(make_gpod(f"g{i}", "train"))
+        eng.observe("ADDED", store.get("Pod", f"g{i}"))
+    # no room: the engine must preempt the two LOWEST-priority victims
+    assert eng.try_schedule(("default", "train")) is False
+    preempted = sorted(n for r, n in events if r == "Preempted")
+    assert preempted == ["low-a", "low-b"]
+    live = {p["metadata"]["name"] for p in store.list("Pod")[0]}
+    assert "low-a" not in live and "low-b" not in live
+    assert "mid-a" in live and "mid-b" in live
+    # capacity freed: the retry pass binds the whole gang
+    usage = {"node-0": (1.0, 0.0, 1), "node-1": (1.0, 0.0, 1)}
+    assert eng.retry_pending() == 1
+    binds = bound_map(store)
+    assert binds["g0"] and binds["g1"]
+
+
+def test_zero_priority_gang_never_preempts():
+    store = ResourceStore()
+    store.create(make_node("node-0", cpu="1"))
+    filler = make_gpod("filler", None, cpu="1", priority=0)
+    filler["spec"]["nodeName"] = "node-0"
+    store.create(filler)
+    store.create(make_group("train", 1, priority=0))
+    eng = make_engine(store, usage=lambda: {"node-0": (1.0, 0.0, 1)})
+    store.create(make_gpod("g0", "train"))
+    assert eng.offer(store.get("Pod", "g0")) is False
+    assert "filler" in {p["metadata"]["name"] for p in store.list("Pod")[0]}
+
+
+def test_preemption_values_victims_by_their_podgroup_priority():
+    """Bound gang members normally carry no spec.priority — their
+    preemption weight is the PodGroup's declared priority.  Valuing
+    them at 0 would let ANY gang evict a higher-priority gang."""
+    store = ResourceStore()
+    store.create(make_node("node-0", cpu="1"))
+    store.create(make_group("high", 1, priority=100))
+    member = make_gpod("high-0", "high", cpu="1")  # no spec.priority
+    member["spec"]["nodeName"] = "node-0"
+    store.create(member)
+    usage = {"node-0": (1.0, 0.0, 1)}
+    store.create(make_group("low", 1, priority=1))
+    eng = make_engine(store, usage=lambda: dict(usage))
+    store.create(make_gpod("l0", "low"))
+    assert eng.offer(store.get("Pod", "l0")) is False
+    assert "high-0" in {p["metadata"]["name"] for p in store.list("Pod")[0]}
+    # a genuinely higher-priority gang still preempts the same victim
+    store.create(make_group("over", 1, priority=200))
+    store.create(make_gpod("o0", "over"))
+    assert eng.offer(store.get("Pod", "o0")) is False  # evicts; binds next pass
+    assert "high-0" not in {p["metadata"]["name"] for p in store.list("Pod")[0]}
+
+
+def test_transact_alias_and_graceful_delete_validate_coherently():
+    """Phase-1 overlay is keyed on the canonical kind and mirrors
+    graceful-delete semantics — either divergence would pass
+    validation and then fail mid-commit, leaving a partially applied
+    txn in memory with no WAL record."""
+    store = ResourceStore()
+    store.create(make_gpod("x", None))
+    # alias-mixed ops must share one overlay slot: the delete is
+    # visible to the later patch spelled with the plural alias
+    with pytest.raises(TransactionAborted) as ei:
+        store.transact(
+            [
+                {"verb": "delete", "kind": "Pod", "name": "x", "namespace": "default"},
+                {
+                    "verb": "patch",
+                    "kind": "pods",
+                    "name": "x",
+                    "namespace": "default",
+                    "data": {"spec": {"nodeName": "n"}},
+                },
+            ]
+        )
+    assert ei.value.index == 1 and ei.value.reason == "NotFound"
+    assert store.get("Pod", "x")  # nothing mutated
+    # a finalizer-bearing delete leaves the object present: a same-name
+    # create later in the txn aborts up front, not mid-commit
+    store.patch("Pod", "x", {"metadata": {"finalizers": ["keep"]}})
+    with pytest.raises(TransactionAborted) as ei:
+        store.transact(
+            [
+                {"verb": "delete", "kind": "Pod", "name": "x", "namespace": "default"},
+                {"verb": "create", "kind": "Pod", "data": make_gpod("x", None)},
+            ]
+        )
+    assert ei.value.index == 1 and ei.value.reason == "AlreadyExists"
+    assert not store.get("Pod", "x")["metadata"].get("deletionTimestamp")
+
+
+def test_transact_phase1_mirrors_phase2_commit_shape():
+    """Phase 2 commits through create()/patch(), so phase 1 must plan
+    with exactly their semantics: create resolves the kind from data
+    alone, and a subresource patch only changes that one subtree."""
+    store = ResourceStore()
+    # data without an embedded kind: normalized from the op kind (the
+    # raw data would make phase 2's create() raise mid-commit)
+    out = store.transact(
+        [
+            {
+                "verb": "create",
+                "kind": "Pod",
+                "data": {
+                    "apiVersion": "v1",
+                    "metadata": {"name": "k", "namespace": "default"},
+                    "spec": {},
+                },
+            }
+        ]
+    )
+    assert out[0]["kind"] == "Pod" and store.get("Pod", "k")
+    # op/data kind mismatch aborts up front, not mid-commit
+    with pytest.raises(TransactionAborted) as ei:
+        store.transact(
+            [
+                {
+                    "verb": "create",
+                    "kind": "Pod",
+                    "data": {
+                        "apiVersion": "v1",
+                        "kind": "Node",
+                        "metadata": {"name": "m"},
+                    },
+                }
+            ]
+        )
+    assert ei.value.index == 0 and ei.value.reason == "Invalid"
+    # the spec half of a status-subresource patch is discarded by
+    # patch(); the overlay must discard it too, or a later expect
+    # would validate a state that never commits
+    with pytest.raises(TransactionAborted) as ei:
+        store.transact(
+            [
+                {
+                    "verb": "patch",
+                    "kind": "Pod",
+                    "name": "k",
+                    "namespace": "default",
+                    "subresource": "status",
+                    "data": {
+                        "spec": {"nodeName": "n1"},
+                        "status": {"phase": "Running"},
+                    },
+                },
+                {
+                    "verb": "patch",
+                    "kind": "Pod",
+                    "name": "k",
+                    "namespace": "default",
+                    "data": {"metadata": {"labels": {"x": "y"}}},
+                    "expect": {"spec.nodeName": "n1"},
+                },
+            ]
+        )
+    assert ei.value.index == 1 and ei.value.reason == "Conflict"
+    cur = store.get("Pod", "k")
+    assert (cur.get("status") or {}).get("phase") is None  # nothing mutated
+
+
+# ------------------------------------------------- scheduler integration
+
+
+def wait_until(cond, budget=10.0):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+def test_scheduler_delegates_gang_pods_end_to_end():
+    store = ResourceStore()
+    topo = TopologyModel(slice_hosts=2)
+    sched = Scheduler(store, gang_policy="binpack", topology=topo).start()
+    try:
+        for i in range(4):
+            store.create(make_node(f"node-{i}", labels=topo.labels_for(i)))
+        store.create(make_group("train", 3, priority=10))
+        for i in range(3):
+            store.create(make_gpod(f"g{i}", "train"))
+        # a plain pod binds alongside, untouched by the gang engine
+        store.create(make_gpod("solo", None, cpu="100m"))
+        assert wait_until(lambda: all(bound_map(store).values()))
+        slices = {
+            topo.coords({"metadata": {"name": n, "labels": {}}})[0]
+            for name, n in bound_map(store).items()
+            if name.startswith("g")
+        }
+        assert len(slices) == 1  # gang co-located
+        events, _ = store.list("Event")
+        assert any(
+            e.get("reason") == "Scheduled" and "gang" in (e.get("message") or "")
+            for e in events
+        )
+    finally:
+        sched.stop()
+
+
+def test_scheduler_gang_policy_none_disables_engine():
+    store = ResourceStore()
+    sched = Scheduler(store, gang_policy="none")
+    assert sched.gang is None
+    sched.start()
+    try:
+        store.create(make_node("node-0"))
+        store.create(make_gpod("g0", "orphan-gang"))
+        # no engine: the gang pod binds individually like any other
+        assert wait_until(lambda: bound_map(store)["g0"] == "node-0")
+    finally:
+        sched.stop()
+
+
+# ------------------------------------------------------ predicates (unit)
+
+
+def test_node_selector_and_toleration_matching():
+    pod = {"spec": {"nodeSelector": {"disk": "ssd"}}}
+    assert node_selector_matches(
+        pod, {"metadata": {"labels": {"disk": "ssd", "x": "y"}}}
+    )
+    assert not node_selector_matches(
+        pod, {"metadata": {"labels": {"disk": "hdd"}}}
+    )
+    taint = [{"key": "tpu", "value": "only", "effect": "NoSchedule"}]
+    node = {"spec": {"taints": taint}, "metadata": {}}
+    assert not tolerates_taints({"spec": {}}, node)
+    assert tolerates_taints(
+        {"spec": {"tolerations": [{"key": "tpu", "operator": "Exists"}]}},
+        node,
+    )
+    assert tolerates_taints(
+        {
+            "spec": {
+                "tolerations": [
+                    {"key": "tpu", "value": "only", "effect": "NoSchedule"}
+                ]
+            }
+        },
+        node,
+    )
+    # PreferNoSchedule does not filter
+    node2 = {
+        "spec": {"taints": [{"key": "a", "effect": "PreferNoSchedule"}]},
+        "metadata": {},
+    }
+    assert tolerates_taints({"spec": {}}, node2)
+    # the stock fake-node taint is implicitly tolerated — every pod in
+    # a fully-simulated cluster is a kwok workload (kwokctl scale node
+    # templates carry it; enforcing it would strand every deployment)
+    fake = {
+        "spec": {
+            "taints": [
+                {"key": "kwok.x-k8s.io/node", "value": "fake", "effect": "NoSchedule"}
+            ]
+        },
+        "metadata": {},
+    }
+    assert tolerates_taints({"spec": {}}, fake)

@@ -158,3 +158,98 @@ def test_scheduler_over_http_client():
             assert wait_until(lambda: bound_nodes(store)["remote"] == "node-0")
         finally:
             sched.stop()
+
+
+# ------------------------------------------------ selector/taint satellites
+
+
+def test_node_selector_is_honored(sched_store):
+    store = sched_store
+    plain = make_node("plain")
+    store.create(plain)
+    ssd = make_node("ssd-node")
+    ssd["metadata"]["labels"] = {"disk": "ssd"}
+    store.create(ssd)
+    pod = make_pod("picky")
+    pod["spec"]["nodeSelector"] = {"disk": "ssd"}
+    store.create(pod)
+    assert wait_until(lambda: bound_nodes(store)["picky"] == "ssd-node")
+
+
+def test_node_selector_with_no_matching_node_stays_pending(sched_store):
+    store = sched_store
+    store.create(make_node("plain"))
+    pod = make_pod("stuck")
+    pod["spec"]["nodeSelector"] = {"disk": "ssd"}
+    store.create(pod)
+    time.sleep(0.6)
+    assert bound_nodes(store)["stuck"] is None
+    events, _ = store.list("Event")
+    assert any(e.get("reason") == "FailedScheduling" for e in events)
+
+
+def test_noschedule_taint_requires_toleration(sched_store):
+    store = sched_store
+    tainted = make_node("tainted")
+    tainted["spec"] = {
+        "taints": [{"key": "tpu", "value": "only", "effect": "NoSchedule"}]
+    }
+    store.create(tainted)
+    store.create(make_pod("ordinary"))
+    assert wait_until(lambda: "ordinary" in bound_nodes(store))
+    time.sleep(0.5)
+    assert bound_nodes(store)["ordinary"] is None  # nowhere to go
+    tolerant = make_pod("tolerant")
+    tolerant["spec"]["tolerations"] = [{"key": "tpu", "operator": "Exists"}]
+    store.create(tolerant)
+    assert wait_until(lambda: bound_nodes(store)["tolerant"] == "tainted")
+
+
+# -------------------------------------------- FailedScheduling event flood
+
+
+def test_failed_scheduling_events_are_deduped_with_backoff():
+    """_retry_pending re-binds every 2s; the warning must NOT re-emit
+    every pass (per-pod exponential backoff, satellite of the gang
+    PR — an event flood at 1M-pod scale)."""
+    from kwok_tpu.controllers.scheduler import Scheduler
+    from kwok_tpu.utils.clock import FakeClock
+
+    store = ResourceStore()
+    clock = FakeClock(100.0)
+    events = []
+
+    class Rec:
+        def event(self, obj, etype, reason, msg):
+            events.append(reason)
+
+    sched = Scheduler(store, recorder=Rec(), clock=clock, gang_policy="none")
+    pod = make_pod("pending")
+    store.create(pod)
+    stored = store.get("Pod", "pending")
+    # drive the retry path directly (no threads): first pass warns
+    sched._bind(stored)
+    assert events.count("FailedScheduling") == 1
+    # immediate retries inside the backoff window stay silent
+    for _ in range(5):
+        sched._bind(stored)
+    assert events.count("FailedScheduling") == 1
+    # past the first interval (2s) exactly one more fires
+    clock.advance(2.1)
+    sched._bind(stored)
+    sched._bind(stored)
+    assert events.count("FailedScheduling") == 2
+    # the interval doubles: +2s is now inside the window, +4s is not
+    clock.advance(2.1)
+    sched._bind(stored)
+    assert events.count("FailedScheduling") == 2
+    clock.advance(2.0)
+    sched._bind(stored)
+    assert events.count("FailedScheduling") == 3
+    # a successful bind clears the backoff state
+    store.create(make_node("node-0"))
+    sched._sorted_nodes = None
+    sched._nodes._apply("ADDED", store.get("Node", "node-0"))
+    sched._bind(store.get("Pod", "pending"))
+    assert store.get("Pod", "pending")["spec"].get("nodeName") == "node-0"
+    assert not sched._warn_pods
