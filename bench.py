@@ -68,6 +68,14 @@ SCHED_NODES = int(os.environ.get("BENCH_SCHED_NODES", 32))
 #: gangs of SCHED_GANG_SIZE in the training mix
 SCHED_GANGS = int(os.environ.get("BENCH_SCHED_GANGS", 6))
 SCHED_GANG_SIZE = int(os.environ.get("BENCH_SCHED_GANG_SIZE", 8))
+#: sharded-vs-single store A/B (kwok_tpu.cluster.sharding): target
+#: population for the direct-dispatch leg (0 disables the section)
+STORE_PODS = int(os.environ.get("BENCH_STORE_PODS", min(N_PODS, 1_000_000)))
+STORE_SHARDS = int(os.environ.get("BENCH_STORE_SHARDS", 4))
+STORE_WRITERS = int(os.environ.get("BENCH_STORE_WRITERS", 4))
+#: wall budget for the routed-HTTP baseline leg (it is the slow one —
+#: the whole point of the A/B)
+STORE_HTTP_BUDGET_S = float(os.environ.get("BENCH_STORE_HTTP_BUDGET_S", 45))
 
 
 def run_overload_bench() -> dict:
@@ -86,6 +94,173 @@ def run_overload_bench() -> dict:
         "queued_peak": be["queued_peak"],
         "canary_writes": rep["canary_writes"],
         "canary_worst_latency_s": rep["canary_worst_latency_s"],
+    }
+
+
+def run_store_bench() -> dict:
+    """Sharded-vs-single bulk-lane write throughput (ROADMAP item 2,
+    KUBEDIRECT shape): how fast can writers push pods through the
+    store's bulk lane at the 1M-pod scale point?
+
+    Legs (same workload: STORE_WRITERS threads, shard-affine 10k-op
+    create batches, one namespace per writer chosen to spread across
+    the shards):
+
+    - ``routed_http``: the single-store baseline — the production
+      write path, ``ClusterClient.bulk`` through the apiserver
+      facade.  Time-boxed (STORE_HTTP_BUDGET_S): it is the slow leg.
+    - ``direct_sharded``: STORE_SHARDS shards, colocated KUBEDIRECT
+      direct dispatch — the router hands each shard-affine batch to
+      the owning shard's bulk lane in-process (the scheduler/workload
+      daemon posture after PR 11).  Runs to the full STORE_PODS.
+    - no-regression check: the same in-process workload against a
+      plain ResourceStore vs the 1-shard router composition — the
+      default configuration must not pay for the feature.
+
+    Asserted: direct-dispatch throughput >= 2x the routed baseline,
+    and the 1-shard composition within 20% of the plain store (noise
+    floor on a loaded 1-core host)."""
+    import gc
+    import threading
+
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.client import ClusterClient
+    from kwok_tpu.cluster.sharding import (
+        build_sharded_store,
+        namespaces_covering_shards,
+    )
+    from kwok_tpu.cluster.store import ResourceStore
+
+    batch = 10_000
+    # one namespace per writer, spread across the shard count
+    namespaces = namespaces_covering_shards(STORE_SHARDS, "bench-ns")
+
+    def ops_for(writer, start, n):
+        ns = namespaces[writer % len(namespaces)]
+        return [
+            {
+                "verb": "create",
+                "data": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"w{writer}-{start + j}",
+                        "namespace": ns,
+                    },
+                    "spec": {"nodeName": f"node-{writer}"},
+                    "status": {},
+                },
+            }
+            for j in range(n)
+        ]
+
+    def drive(bulk_fn, target, budget_s=None):
+        """Run the writers; returns (pods_created, seconds)."""
+        per = target // STORE_WRITERS
+        deadline = (time.time() + budget_s) if budget_s else None
+        created = [0] * STORE_WRITERS
+
+        def writer(wi):
+            done = 0
+            while done < per:
+                if deadline and time.time() >= deadline:
+                    break
+                n = min(batch, per - done)
+                bulk_fn(ops_for(wi, done, n))
+                done += n
+                created[wi] = done
+
+        threads = [
+            threading.Thread(target=writer, args=(i,))
+            for i in range(STORE_WRITERS)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(created), time.time() - t0
+
+    # ---- leg 1: routed HTTP baseline (single store) ------------------
+    single = ResourceStore()
+    with APIServer(single) as srv:
+        local = threading.local()
+
+        def http_bulk(ops):
+            if not hasattr(local, "client"):
+                local.client = ClusterClient(srv.url)
+            local.client.bulk(ops)
+
+        pods, secs = drive(
+            http_bulk, STORE_PODS, budget_s=STORE_HTTP_BUDGET_S
+        )
+    routed = {
+        "tps": round(pods / secs) if secs else 0,
+        "pods": pods,
+        "seconds": round(secs, 1),
+    }
+    # a leg's dead store must not tax the next leg's gen2 collections
+    del single
+    gc.collect()
+
+    # ---- leg 2: sharded store, colocated direct dispatch -------------
+    sharded = build_sharded_store(STORE_SHARDS)
+    pods, secs = drive(
+        lambda ops: sharded.bulk(ops, copy_results=False), STORE_PODS
+    )
+    direct = {
+        "tps": round(pods / secs) if secs else 0,
+        "pods": pods,
+        "seconds": round(secs, 1),
+    }
+    speedup = direct["tps"] / max(1, routed["tps"])
+    assert speedup >= 2.0, (
+        f"sharded direct dispatch {direct['tps']} pods/s is only "
+        f"{speedup:.2f}x the routed single-store baseline "
+        f"{routed['tps']} pods/s (want >= 2x)"
+    )
+
+    del sharded
+    gc.collect()
+
+    # ---- leg 3: 1-shard no-regression --------------------------------
+    # best-of-2, alternating, fresh store per run: co-load and gen2
+    # pressure on the shared 1-core host skew single runs by 20%+
+    small = max(20_000, STORE_PODS // 8)
+    plain_tps = one_tps = 0.0
+    for _ in range(2):
+        plain = ResourceStore()
+        p_pods, p_secs = drive(
+            lambda ops: plain.bulk(ops, copy_results=False), small
+        )
+        plain_tps = max(plain_tps, p_pods / p_secs if p_secs else 0.0)
+        del plain
+        gc.collect()
+        one = build_sharded_store(1)
+        o_pods, o_secs = drive(
+            lambda ops: one.bulk(ops, copy_results=False), small
+        )
+        one_tps = max(one_tps, o_pods / o_secs if o_secs else 0.0)
+        del one
+        gc.collect()
+    ratio = one_tps / max(1.0, plain_tps)
+    assert ratio >= 0.8, (
+        f"1-shard composition regressed the plain store: "
+        f"{one_tps:.0f} vs {plain_tps:.0f} pods/s ({ratio:.2f}x)"
+    )
+
+    return {
+        "shards": STORE_SHARDS,
+        "writers": STORE_WRITERS,
+        "target_pods": STORE_PODS,
+        "routed_http": routed,
+        "direct_sharded": direct,
+        "speedup": round(speedup, 2),
+        "one_shard": {
+            "plain_tps": round(plain_tps),
+            "sharded1_tps": round(one_tps),
+            "ratio": round(ratio, 2),
+        },
     }
 
 
@@ -578,6 +753,18 @@ def main() -> int:
 
                 traceback.print_exc()
                 out["sched"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if STORE_PODS > 0:
+            # sharded-vs-single bulk-lane write throughput A/B
+            # (kwok_tpu.cluster.sharding; asserts the >=2x direct
+            # dispatch win and the 1-shard no-regression floor)
+            try:
+                out["store"] = run_store_bench()
+            except (Exception, AssertionError) as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                out["store"] = {"error": f"{type(e).__name__}: {e}"}
 
         if OVERLOAD_S > 0:
             # degradation trajectory: a short seeded best-effort flood

@@ -58,7 +58,7 @@ PER_FILE_RULES = frozenset(
 )
 
 #: bump when any rule's semantics change — invalidates the on-disk cache
-CACHE_VERSION = 9
+CACHE_VERSION = 10
 
 
 def repo_root(start: Optional[str] = None) -> str:

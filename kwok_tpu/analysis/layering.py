@@ -8,14 +8,19 @@ and the package layout carry the kwok_tpu mapping) is, bottom to top::
     engine, ops, parallel  (2)  FSM compiler + device kernels + mesh
     native                 (3)  optional C/C++ accelerators
     cluster                (4)  store/apiserver/client/informer
-    sched                  (5)  gang engine + policy seam (imports only
+    cluster.sharding       (5)  shard router/fan-in/dispatch over N
+                                stores (its own sub-layer: the core
+                                store/WAL must never import the router
+                                that composes them — wal.py matches
+                                the shard layout structurally instead)
+    sched                  (6)  gang engine + policy seam (imports only
                                 cluster/utils/parallel downward; its
                                 own layer so the scheduler controller
                                 can build on it but never vice versa)
     controllers, workloads,
-    metrics, snapshot, cni (6)  reconcilers over the cluster bus
-    server, tools          (7)  kubelet-surface HTTP + dev tooling
-    ctl, cmd, chaos        (8)  cluster lifecycle CLI + entrypoints +
+    metrics, snapshot, cni (7)  reconcilers over the cluster bus
+    server, tools          (8)  kubelet-surface HTTP + dev tooling
+    ctl, cmd, chaos        (9)  cluster lifecycle CLI + entrypoints +
                                 fault injection (drives ctl components)
 
 Two rules:
@@ -50,6 +55,7 @@ LAYERS: List[Tuple[str, ...]] = [
     ("engine", "ops", "parallel"),
     ("native",),
     ("cluster",),
+    ("cluster.sharding",),
     ("sched",),
     ("controllers", "workloads", "metrics", "snapshot", "cni"),
     ("server", "tools"),
@@ -62,11 +68,15 @@ LAYER_OF: Dict[str, int] = {
 
 
 def _subpackage(module: str) -> Optional[str]:
-    """``kwok_tpu.cluster.store`` -> ``cluster``; None for externals."""
+    """``kwok_tpu.cluster.store`` -> ``cluster``; None for externals.
+    ``cluster.sharding`` is its own sub-layer (the router composes N
+    stores, so the core store/WAL modules must sit below it)."""
     parts = module.split(".")
-    if len(parts) >= 2 and parts[0] == "kwok_tpu":
-        return parts[1]
-    return None
+    if len(parts) < 2 or parts[0] != "kwok_tpu":
+        return None
+    if len(parts) >= 3 and parts[1] == "cluster" and parts[2] == "sharding":
+        return "cluster.sharding"
+    return parts[1]
 
 
 def _module_name(path: str) -> Optional[str]:

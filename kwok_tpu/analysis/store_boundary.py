@@ -15,6 +15,13 @@ identifier whose terminal name looks store-like — ``store``,
 ``_store``, ``client``, ``_client``, or any ``*store``/``*client``
 suffix.  Optional-capability *probes* stay legal: ``hasattr(store,
 "status_lane")``-style feature tests never name a private attribute.
+
+Shard internals are stricter: any ``X._shards`` / ``X._shard_*``
+access (the :class:`~kwok_tpu.cluster.sharding.router.ShardedStore`
+private family) is flagged REGARDLESS of the receiver's name.  Shard placement is an implementation detail of
+cluster/ — code above it that reaches for a shard list stops working
+over the REST client AND breaks the single-store composition, so the
+lexical net is cast receiver-wide.
 """
 
 from __future__ import annotations
@@ -47,6 +54,23 @@ def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
             if not attr.startswith("_") or attr.startswith("__"):
                 continue
             recv = terminal_name(node.value)
+            if attr in ("_shard", "_shards") or attr.startswith("_shard_"):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=(
+                            f"shard-internal access '{recv}.{attr}' "
+                            "outside kwok_tpu/cluster/ — shard placement "
+                            "is a cluster/ implementation detail; use "
+                            "the duck-typed store surface (shard_lane/"
+                            "shard_for/shard_topology are the public "
+                            "seams)"
+                        ),
+                    )
+                )
+                continue
             if not _storeish(recv):
                 continue
             findings.append(

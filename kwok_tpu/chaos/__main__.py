@@ -484,6 +484,87 @@ def run_corruption_smoke(seed: int = 42, pods: int = 24) -> dict:
             "recovery_s": round(dt, 3),
         }
 
+    # ---- sharded scene: one shard's damage, union-accounted ---------
+    # (kwok_tpu/cluster/sharding): mid-log corruption on ONE shard's
+    # WAL must fail the sharded fsck, recovery must detect it and
+    # account every acked rv over the UNION of the shards (honest,
+    # bounded to the damaged shard's slice), and the intact shard's
+    # objects must all survive.
+    from kwok_tpu.cluster.sharding import namespaces_covering_shards
+    from kwok_tpu.cluster.wal import fsck_sharded
+    from kwok_tpu.snapshot.sharded import open_sharded_store
+
+    with tempfile.TemporaryDirectory() as tmp:
+        opened = open_sharded_store(
+            tmp, 2, namespace_finalizers=False, wal_fsync="off", pitr=False
+        )
+        sstore = opened["store"]
+        ns_by_shard = namespaces_covering_shards(2)
+        sacked: set = set()
+
+        def strack(fn, *a, **kw):
+            rv0 = sstore.resource_version
+            out = fn(*a, **kw)
+            sacked.update(range(rv0 + 1, sstore.resource_version + 1))
+            return out
+
+        for j in range(10):
+            for s, ns in enumerate(ns_by_shard):
+                p = pod(f"sh-{j}")
+                p["metadata"]["namespace"] = ns
+                strack(sstore.create, p)
+        shard0_names = {
+            (o.get("metadata") or {}).get("name")
+            for o in sstore.list("Pod", namespace=ns_by_shard[0])[0]
+        }
+        for w in opened["wals"]:
+            w.close()
+
+        clean = fsck_sharded(tmp)
+        if not clean["ok"] or clean["shards"] != 2:
+            fail(f"sharded fsck flagged a pristine workdir: {clean}")
+
+        from kwok_tpu.cluster.sharding.layout import shard_wal_path
+
+        disk_faults.bit_flip_line(
+            shard_wal_path(tmp, 1), rng, exclude_last=True
+        )
+        bad = fsck_sharded(tmp)
+        if bad["ok"]:
+            fail("sharded fsck passed a workdir with one damaged shard")
+
+        t0 = time.monotonic()
+        reopened = open_sharded_store(
+            tmp, 2, namespace_finalizers=False, wal_fsync="off", pitr=False
+        )
+        dt = time.monotonic() - t0
+        rep = reopened["report"]
+        if not rep.corruptions and not rep.torn_tail:
+            fail("one-shard bit-flip was silently absorbed by recovery")
+        reported, silent = rep.account(sacked)
+        if silent:
+            fail(f"sharded: acked rvs {silent[:10]} lost WITHOUT report")
+        survivors = {
+            (o.get("metadata") or {}).get("name")
+            for o in reopened["store"].list(
+                "Pod", namespace=ns_by_shard[0]
+            )[0]
+        }
+        if survivors != shard0_names:
+            fail(
+                "damage on shard 1 cost shard 0 objects: "
+                f"{sorted(shard0_names - survivors)[:5]}"
+            )
+        for w in reopened["wals"]:
+            w.close()
+        results["sharded-isolation"] = {
+            "detected": True,
+            "acked_lost_reported": len(reported),
+            "silent_lost": 0,
+            "intact_shard_preserved": True,
+            "recovery_s": round(dt, 3),
+        }
+
     return {
         "seed": seed,
         "acked_writes": len(acked),
@@ -751,6 +832,94 @@ def run_exhaustion_smoke(seed: int = 42, pods: int = 16) -> dict:
             )
         if fresh.dump_state() != live:
             fail("post-crash recovery diverged from live state")
+
+    # ---- sharded scene: one shard's full disk degrades ONLY it ------
+    # (kwok_tpu/cluster/sharding): writes routed to the pressured
+    # shard 503 with reason StorageDegraded, the other shard stays
+    # writable, /readyz names the degraded shard set, and clearing
+    # the pressure re-arms just that shard.
+    from kwok_tpu.cluster.sharding import namespaces_covering_shards
+    from kwok_tpu.snapshot.sharded import open_sharded_store
+
+    with tempfile.TemporaryDirectory() as tmp:
+        opened = open_sharded_store(
+            tmp, 2, namespace_finalizers=False, wal_fsync="off", pitr=False
+        )
+        sstore = opened["store"]
+        wals = opened["wals"]
+        # one namespace per shard
+        ns_by_shard = namespaces_covering_shards(2)
+
+        def ns_pod(ns, n):
+            p = pod(n)
+            p["metadata"]["namespace"] = ns
+            return p
+
+        with APIServer(sstore) as srv:
+            sraw = ClusterClient(
+                srv.url,
+                retry=RetryPolicy(
+                    max_attempts=1,
+                    budget_s=5.0,
+                    backoff=Backoff(duration=0.0, cap=0.0),
+                    retry_statuses=(),
+                ),
+                client_id="exhaustion-sharded",
+            )
+            for s, ns in enumerate(ns_by_shard):
+                sraw.create(ns_pod(ns, "warm"))
+            shim = FsPressure("disk-full")
+            wals[1].set_pressure(shim)
+            # the first write into the window rides shard 1's reserve
+            # (acked + durable), then the shard degrades
+            sraw.create(ns_pod(ns_by_shard[1], "inflight"))
+            deg = sstore.storage_degraded()
+            if deg is None or deg.get("shards") != [1]:
+                fail(f"sharded: degraded shard set wrong: {deg}")
+            try:
+                sraw.create(ns_pod(ns_by_shard[1], "rej"))
+                fail("sharded: degraded shard acked a write")
+            except APIError as exc:
+                if exc.code != 503 or exc.reason != "StorageDegraded":
+                    fail(
+                        f"sharded: rejection was {exc.code}/{exc.reason}, "
+                        "want 503/StorageDegraded"
+                    )
+            # the OTHER shard keeps accepting writes mid-window
+            sraw.create(ns_pod(ns_by_shard[0], "cross"))
+            # /readyz names the degraded shard set
+            import http.client as hc
+
+            host, port = srv.address
+            c = hc.HTTPConnection(host, port, timeout=5)
+            c.request("GET", "/readyz")
+            resp = c.getresponse()
+            body = json.loads(resp.read() or b"{}")
+            c.close()
+            if resp.status != 503 or (
+                (body.get("storage") or {}).get("shards") != [1]
+            ):
+                fail(
+                    f"sharded: /readyz did not report the degraded "
+                    f"shard set ({resp.status}, {body})"
+                )
+            # reads stay live across ALL shards
+            items, _ = sraw.list("Pod")
+            if len(items) < 3:
+                fail("sharded: reads went dark while one shard degraded")
+            wals[1].set_pressure(None)
+            if not sstore.probe_writable():
+                fail("sharded: shard never re-armed after the window")
+            sraw.create(ns_pod(ns_by_shard[1], "post"))
+            if sstore.storage_degraded() is not None:
+                fail("sharded: still degraded after re-arm")
+        for w in wals:
+            w.close()
+        results["sharded-isolation"] = {
+            "degraded_shard": 1,
+            "other_shard_writable": True,
+            "readyz_shards": [1],
+        }
 
     return {
         "seed": seed,
@@ -1149,10 +1318,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--dst-bug",
         default=None,
-        choices=[None, "ungated-writer", "partial-gang"],
+        choices=[None, "ungated-writer", "partial-gang", "cross-shard-txn"],
         help="inject a test-only regression (must be caught): "
         "ungated-writer reconciles without the lease, partial-gang "
-        "binds PodGroups per-pod instead of atomically",
+        "binds PodGroups per-pod instead of atomically, "
+        "cross-shard-txn makes the shard router place txn ops "
+        "per-object and split atomic batches into per-shard sub-txns",
+    )
+    p.add_argument(
+        "--dst-shards",
+        type=int,
+        default=2,
+        help="store shards the DST composes (kwok_tpu.cluster.sharding; "
+        "1 = the single-store composition)",
     )
     p.add_argument(
         "--dst-verbose",
@@ -1174,7 +1352,11 @@ def run_dst(args) -> int:
     any invariant violation (the check.sh gate contract)."""
     from kwok_tpu.dst import SimOptions, run_seed
 
-    opts = SimOptions(duration=args.dst_duration, bug=args.dst_bug)
+    opts = SimOptions(
+        duration=args.dst_duration,
+        bug=args.dst_bug,
+        store_shards=args.dst_shards,
+    )
     violating = {}
     runs = []
     for i in range(args.seeds):

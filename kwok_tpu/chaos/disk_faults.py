@@ -206,7 +206,19 @@ class DiskFaultDriver:
                 continue
             self._stop.wait(min(max(pending[0].at - now, 0.0), 0.25))
 
-    def _target_path(self, target: str) -> str:
+    def _target_path(self, target: str, shard: int = 0) -> str:
+        # shard 0 lives at the workdir root (the single-store layout);
+        # a profile aiming a corruption fault at shard N>0 must hit
+        # THAT shard's files, not silently bit-flip shard 0's
+        if shard:
+            from kwok_tpu.cluster.sharding.layout import (
+                shard_state_path,
+                shard_wal_path,
+            )
+
+            if target == "snapshot":
+                return shard_state_path(self.runtime.workdir, shard)
+            return shard_wal_path(self.runtime.workdir, shard)
         from kwok_tpu.ctl.components import state_path, wal_path
 
         if target == "snapshot":
@@ -228,7 +240,7 @@ class DiskFaultDriver:
                 }
             )
             return
-        path = self._target_path(spec.target)
+        path = self._target_path(spec.target, getattr(spec, "shard", 0))
         info: Dict[str, int] = {"offset": -1}
         try:
             if spec.kind == "fsync-crash":

@@ -29,12 +29,15 @@ function of the plan, byte-identical per seed.
 from __future__ import annotations
 
 import errno
+import logging
 import os
 import threading
 import time
 from typing import Dict, List, Optional
 
 __all__ = ["EXHAUSTION_KINDS", "FsPressure", "PressureDriver"]
+
+log = logging.getLogger(__name__)
 
 #: fault kinds the pressure shim models (the ``disk:`` profile section
 #: accepts these alongside the corruption kinds of disk_faults.py)
@@ -105,9 +108,13 @@ class PressureDriver:
     exhaustion kinds; after each window it force-probes the re-arm path
     so the cluster leaves degraded mode without waiting for traffic."""
 
-    def __init__(self, plan, wal, store=None):
+    def __init__(self, plan, wal, store=None, wals=None):
         self.plan = plan
         self.wal = wal
+        #: per-shard WAL handles of a sharded store (index = shard);
+        #: a spec's ``shard:`` picks its target, out-of-range entries
+        #: fall back to the primary ``wal`` (shard 0's handle)
+        self.wals = list(wals) if wals else [wal]
         #: when given, re-arm probes route through
         #: ``store.probe_writable()`` — the store mutex serializes them
         #: against request-thread appends (a bare ``wal.try_rearm()``
@@ -135,12 +142,30 @@ class PressureDriver:
             return bool(self.store.probe_writable())
         return bool(self.wal.try_rearm())
 
+    def _wal_for(self, spec) -> tuple:
+        """(wal, shard index actually pressured): an out-of-range
+        ``shard:`` (a stale profile after a shard-count change) falls
+        back to the primary WAL — the event log must record THAT
+        index, not the spec's, or a per-shard isolation readout
+        concludes the wrong shard was degraded."""
+        shard = int(getattr(spec, "shard", 0))
+        if 0 <= shard < len(self.wals):
+            return self.wals[shard], shard
+        log.warning(
+            "pressure window spec shard=%d out of range (%d shards); "
+            "falling back to shard 0",
+            shard,
+            len(self.wals),
+        )
+        return self.wal, 0
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
         # never leave a dangling shim behind a cancelled schedule
-        self.wal.set_pressure(None)
+        for w in self.wals:
+            w.set_pressure(None)
         self._rearm()
 
     def run(self) -> None:
@@ -151,16 +176,18 @@ class PressureDriver:
             if spec.at > now and self._stop.wait(spec.at - now):
                 return
             shim = FsPressure(spec.kind)
-            self.wal.set_pressure(shim)
+            wal, shard = self._wal_for(spec)
+            wal.set_pressure(shim)
             self.events.append(
                 {
                     "t": round(time.monotonic() - t0, 3),
                     "kind": spec.kind,
+                    "shard": shard,
                     "event": "window-open",
                 }
             )
             self._stop.wait(max(spec.duration, 0.0))
-            self.wal.set_pressure(None)
+            wal.set_pressure(None)
             rearmed = self._rearm()
             self.events.append(
                 {

@@ -205,6 +205,9 @@ class DiskFaultSpec:
     target: str = "wal"  # wal | snapshot
     #: window length for exhaustion kinds (ignored by point faults)
     duration: float = 0.0
+    #: which store shard's files the fault hits (sharded clusters,
+    #: kwok_tpu/cluster/sharding — 0 is also the single-store layout)
+    shard: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "DiskFaultSpec":
@@ -230,17 +233,23 @@ class DiskFaultSpec:
             raise ValueError(
                 f"exhaustion fault {kind!r} needs a positive duration"
             )
+        shard = int(d.get("shard", 0))
+        if shard < 0:
+            raise ValueError(f"disk fault shard {shard} must be >= 0")
         return cls(
             at=float(d.get("at", 0.0)),
             kind=kind,
             target=target,
             duration=duration,
+            shard=shard,
         )
 
     def to_dict(self) -> dict:
         out = {"at": self.at, "kind": self.kind, "target": self.target}
         if self.kind in EXHAUSTION_KINDS:
             out["duration"] = self.duration
+        if self.shard:
+            out["shard"] = self.shard
         return out
 
 

@@ -416,6 +416,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif head == "shards":
+                # shard route table (kwok_tpu/cluster/sharding): the
+                # per-shard direct-dispatch clients derive their own
+                # copy of the placement from this.  A single store has
+                # no topology — 404 tells the probe to stay routed.
+                topo = getattr(self.store, "shard_topology", None)
+                if topo is None:
+                    self._send_json(
+                        404, {"error": "store is not sharded", "reason": "NotFound"}
+                    )
+                else:
+                    self._send_json(200, topo())
             elif head == "state":
                 # raw store dump — the etcd-snapshot analog (reference
                 # kwokctl snapshot save, etcd/save.go)
@@ -507,6 +519,29 @@ class _Handler(BaseHTTPRequestHandler):
                     (body or {}).get("ops") or [], as_user=self._user()
                 )
                 self._send_json(200, {"results": results})
+            elif head == "shards" and len(rest) == 2 and rest[1] in ("bulk", "txn"):
+                # per-shard direct-dispatch lanes (KUBEDIRECT shape,
+                # kwok_tpu/cluster/sharding/dispatch.py): the caller
+                # routed with its own route table; the shard
+                # re-validates ownership.  Sitting inside _dispatch
+                # keeps APF admission and the leader fence at this
+                # boundary, exactly like the routed lanes.
+                fn = getattr(
+                    self.store,
+                    "shard_bulk" if rest[1] == "bulk" else "shard_transact",
+                    None,
+                )
+                if fn is None:
+                    self._send_json(
+                        404, {"error": "store is not sharded", "reason": "NotFound"}
+                    )
+                else:
+                    results = fn(
+                        int(rest[0]),
+                        (body or {}).get("ops") or [],
+                        as_user=self._user(),
+                    )
+                    self._send_json(200, {"results": results})
             elif head == "r" and len(rest) == 1:
                 out = self.store.create(
                     body, namespace=self._ns(q), as_user=self._user()

@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from kwok_tpu.cluster.store import (
     Conflict,
+    CrossShardTransaction,
     Expired,
     NotFound,
     ResourceType,
@@ -176,6 +177,11 @@ def _raise_for(code: int, payload: Any) -> None:
     if code == 404:
         raise NotFound(msg)
     if code == 409:
+        if reason == "CrossShard":
+            # the sharded router's typed refusal of a multi-shard
+            # atomic batch — surfaced as the same exception type the
+            # in-process store raises (index unknown over the wire)
+            raise CrossShardTransaction(-1, msg)
         raise Conflict(msg)
     if code == 410:
         raise Expired(msg)

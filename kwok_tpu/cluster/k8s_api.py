@@ -44,6 +44,7 @@ from typing import List, Optional, Tuple
 from kwok_tpu.cluster.store import (
     AlreadyExists,
     Conflict,
+    CrossShardTransaction,
     Expired,
     NotFound,
     ResourceStore,
@@ -141,6 +142,11 @@ def error_code_reason(exc: Exception) -> Tuple[int, str]:
         return 404, "NotFound"
     if isinstance(exc, AlreadyExists):
         return 409, "AlreadyExists"
+    if isinstance(exc, CrossShardTransaction):
+        # sharded router refused a multi-shard atomic batch: typed so
+        # callers can tell a design violation (fix the batch) from an
+        # ordinary retryable Conflict
+        return 409, "CrossShard"
     if isinstance(exc, Conflict):
         # update/patch rv or CAS precondition: client-go
         # retry.RetryOnConflict keys on this exact reason string

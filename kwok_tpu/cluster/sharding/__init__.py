@@ -1,0 +1,38 @@
+"""Horizontally sharded ResourceStore (see
+``kwok_tpu/cluster/sharding/router.py:1`` for the design): hash
+router, shared rv sequence, per-shard WAL/PITR, ordered watch fan-in,
+direct dispatch."""
+
+from kwok_tpu.cluster.sharding.fanin import MergedWatcher
+from kwok_tpu.cluster.sharding.layout import (
+    discover_shards,
+    shard_dir,
+    shard_dirs,
+    shard_pitr_dir,
+    shard_state_path,
+    shard_wal_path,
+)
+from kwok_tpu.cluster.sharding.router import (
+    RvSource,
+    ShardedStore,
+    build_sharded_store,
+    namespaces_covering_shards,
+    shard_key,
+    shard_of,
+)
+
+__all__ = [
+    "MergedWatcher",
+    "RvSource",
+    "ShardedStore",
+    "build_sharded_store",
+    "discover_shards",
+    "namespaces_covering_shards",
+    "shard_dir",
+    "shard_dirs",
+    "shard_key",
+    "shard_of",
+    "shard_pitr_dir",
+    "shard_state_path",
+    "shard_wal_path",
+]

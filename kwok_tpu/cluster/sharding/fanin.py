@@ -1,0 +1,125 @@
+"""Ordered watch fan-in across store shards.
+
+A sharded router answers an all-namespaces watch of a namespaced kind
+with one per-shard :class:`~kwok_tpu.cluster.store.Watcher` per shard
+and merges them behind this single consumer surface
+(``kwok_tpu/cluster/store.py:342`` Watcher is the merged twin's
+contract: ``next``/``drain``/``stop``/``stopped``/``evicted``).
+
+Ordering contract — the one Kubernetes itself gives: **per-object**
+resourceVersion ordering.  Every object lives on exactly one shard and
+each shard delivers its own events in commit order, so an object's
+events arrive strictly rv-increasing through the merge; no *global*
+total order across objects on different shards is promised (two
+objects' events may interleave in either order), exactly like events
+from distinct apiserver watch caches.
+
+Resume: ``since_rv`` is handed to every shard, which replays its own
+history above it — resourceVersions are drawn from one cluster-wide
+sequence (``kwok_tpu/cluster/sharding/router.py`` RvSource), so the
+same number means the same instant on every shard.  Eviction: any
+shard's high-water eviction evicts the WHOLE merged watch (the
+consumer resumes at its last delivered rv, per shard, through the
+ordinary reflector path); ``Expired`` from any shard during creation
+aborts the merge and the consumer re-lists.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from kwok_tpu.cluster.store import Watcher
+
+__all__ = ["MergedWatcher"]
+
+
+class MergedWatcher:
+    """N per-shard watchers behind one Watcher-shaped consumer surface.
+
+    The per-shard watchers' wakeup events are replaced with ONE shared
+    event right after construction, so a push on any shard wakes the
+    single consumer; events queued before the swap are covered because
+    every ``next``/``drain`` drains the shard deques before waiting.
+    Only the consumer thread pops the (thread-safe) per-shard deques —
+    the merge holds no buffer of its own and adds no lock."""
+
+    def __init__(self, parts: List[Watcher]):
+        self._parts = list(parts)
+        self._signal = threading.Event()
+        self._stopped = threading.Event()
+        #: True once any shard's backpressure evicted its watcher (the
+        #: merged stream is then gone as a whole — same consumer
+        #: contract as a single store.Watcher eviction)
+        self.evicted = False
+        for w in self._parts:
+            w._signal = self._signal
+
+    def part_for(self, index: int) -> Watcher:
+        """The shard-local watcher behind shard ``index`` (the router
+        translates ``exclude=`` arguments through this)."""
+        return self._parts[index]
+
+    # ------------------------------------------------------------ consume
+
+    def _pop(self):
+        for w in self._parts:
+            try:
+                return w._events.popleft()
+            # IndexError IS the empty-queue signal on a lock-free
+            # deque pop — same idiom as Watcher.next
+            except IndexError:
+                pass
+        return None
+
+    def _gone(self) -> bool:
+        """True when the merged stream ended: stopped by the consumer,
+        or any shard evicted it (which stops the rest)."""
+        if self._stopped.is_set():
+            return True
+        for w in self._parts:
+            if w.evicted:
+                self.evicted = True
+                self.stop()
+                return True
+        return False
+
+    def next(self, timeout: Optional[float] = 0.5):
+        while True:
+            ev = self._pop()
+            if ev is not None:
+                return ev
+            if self._gone():
+                return None
+            self._signal.clear()
+            ev = self._pop()
+            if ev is not None:
+                return ev
+            if not self._signal.wait(timeout):
+                return None
+
+    def drain(self):
+        """Pop every currently-queued event without blocking (shard
+        order, per-shard commit order — per-object ordering holds)."""
+        evs = []
+        for w in self._parts:
+            evs.extend(w.drain())
+        return evs
+
+    def __iter__(self):
+        while not self._stopped.is_set():
+            ev = self.next(timeout=0.5)
+            if ev is not None:
+                yield ev
+
+    # ------------------------------------------------------------- control
+
+    def stop(self) -> None:
+        self._stopped.set()
+        for w in self._parts:
+            w.stop()
+        self._signal.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped.is_set() or any(w.evicted for w in self._parts)

@@ -1,0 +1,65 @@
+"""On-disk layout of a horizontally sharded store workdir.
+
+Shard 0 lives at the workdir root using exactly the single-store file
+conventions (``kwok_tpu/ctl/components.py:61`` wal_path/state_path/
+pitr_dir) — a 1-shard cluster is therefore byte-compatible with every
+pre-sharding workdir, WAL and PITR archive.  Shards 1..N-1 live under
+``shards/NN/`` with the same per-shard file set:
+
+    <workdir>/wal.jsonl            shard 0 live WAL (+ .seg-* files)
+    <workdir>/state.json           shard 0 snapshot
+    <workdir>/pitr/                shard 0 PITR archive
+    <workdir>/shards/01/wal.jsonl  shard 1 ...
+    <workdir>/shards/01/state.json
+    <workdir>/shards/01/pitr/
+
+``python -m kwok_tpu.cluster.wal --fsck <workdir>`` matches the same
+convention structurally (``kwok_tpu/cluster/wal.py:1`` fsck_sharded —
+wal sits below this module in the layer map, so the convention is
+duplicated there rather than imported upward).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+
+def shard_dir(workdir: str, index: int) -> str:
+    """Directory holding shard ``index``'s WAL/snapshot/PITR files."""
+    if index == 0:
+        return workdir
+    return os.path.join(workdir, "shards", f"{index:02d}")
+
+
+def shard_dirs(workdir: str, n_shards: int) -> List[str]:
+    return [shard_dir(workdir, i) for i in range(max(1, n_shards))]
+
+
+def shard_wal_path(workdir: str, index: int) -> str:
+    return os.path.join(shard_dir(workdir, index), "wal.jsonl")
+
+
+def shard_state_path(workdir: str, index: int) -> str:
+    return os.path.join(shard_dir(workdir, index), "state.json")
+
+
+def shard_pitr_dir(workdir: str, index: int) -> str:
+    return os.path.join(shard_dir(workdir, index), "pitr")
+
+
+def discover_shards(workdir: str) -> int:
+    """How many shards a workdir holds (1 + the ``shards/NN`` dirs)."""
+    root = os.path.join(workdir, "shards")
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 1
+    n = 1
+    for name in names:
+        if os.path.isdir(os.path.join(root, name)):
+            try:
+                n = max(n, int(name) + 1)
+            except ValueError:
+                continue
+    return n

@@ -1,0 +1,129 @@
+"""Tolerant recovery of a sharded store (in-memory composition).
+
+Rebuilds a :class:`~kwok_tpu.cluster.sharding.router.ShardedStore`
+from per-shard WALs, with one sharding twist — **rv continuity is a
+property of the union**.  Each shard's WAL holds a deliberately sparse
+slice of the cluster-wide rv sequence, so per-shard recovery runs with
+``rv_continuity=False`` and the union gap check happens here (the
+offline twin is ``kwok_tpu/cluster/wal.py`` ``fsck_sharded``; the
+on-disk snapshot+WAL+PITR boot composition is
+``kwok_tpu/snapshot/sharded.py:1`` — snapshot sits above cluster in
+the layer map).  The aggregate
+:class:`~kwok_tpu.cluster.store.RecoveryReport` keeps the honesty
+contract: every cluster rv is applied on some shard, snapshot-covered,
+or listed missing — never silently skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from kwok_tpu.cluster.sharding.router import (
+    RvSource,
+    ShardedStore,
+)
+from kwok_tpu.cluster.store import RecoveryReport, ResourceStore
+from kwok_tpu.cluster.wal import segment_files
+
+__all__ = [
+    "aggregate_reports",
+    "recover_sharded",
+]
+
+
+def aggregate_reports(
+    reports: List[Optional[RecoveryReport]],
+) -> RecoveryReport:
+    """Fold per-shard recovery reports into one cluster-wide report:
+    observed rvs union, missing = holes in the union above the highest
+    shard snapshot floor (rvs at or below a shard's own floor are
+    covered by its snapshot — same floor rule as ``fsck_sharded``).
+    The aggregate's ``floor`` is that same highest floor: ``account``
+    treats ``rv <= floor`` as covered, and with one captured save
+    horizon the floors agree, so max is exact.  When a skipped save
+    tick skews them, a snapshot-covered acked rv in (min, max] is
+    compacted out of its shard's live log — a min floor would classify
+    it silently lost (a false honesty violation); real loss on the
+    stale-floor shard surfaces through that shard's own corruption
+    and seq-continuity findings instead.  ``account`` on the result
+    classifies acked rvs exactly like the single-store report does."""
+    live = [r for r in reports if r is not None]
+    if not live:
+        return RecoveryReport(
+            applied=0,
+            floor=0,
+            recovered_rv=0,
+            missing_rvs=[],
+            corruptions=[],
+            torn_tail=0,
+            tail_after_rv=None,
+            observed_rvs=set(),
+        )
+    observed: set = set()
+    for r in live:
+        observed |= r.observed_rvs
+    floor = max(r.floor for r in live)
+    recovered = max(r.recovered_rv for r in live)
+    missing = sorted(
+        rv
+        for rv in range(floor + 1, recovered + 1)
+        if rv not in observed
+    )
+    tails = [r.tail_after_rv for r in live if r.tail_after_rv is not None]
+    corruptions: List[dict] = []
+    for r in live:
+        corruptions.extend(r.corruptions)
+    return RecoveryReport(
+        applied=sum(r.applied for r in live),
+        floor=floor,
+        recovered_rv=recovered,
+        missing_rvs=missing,
+        corruptions=corruptions,
+        torn_tail=sum(r.torn_tail for r in live),
+        # conservative: damage on any shard's tail exposes acked rvs
+        # beyond it (they may have lived there) — same judgement a
+        # single damaged tail gets
+        tail_after_rv=min(tails) if tails else None,
+        observed_rvs=observed,
+    )
+
+
+def recover_sharded(
+    wal_paths: List[str],
+    clock=None,
+    namespace_finalizers: bool = False,
+    watch_high_water: Optional[int] = None,
+) -> Dict[str, Any]:
+    """In-memory sharded recovery from explicit per-shard WAL paths
+    (the DST harness's crash/disk-fault path): fresh shards on one
+    shared rv sequence, each tolerantly replaying its own log, the
+    union gap check on top.  Returns ``{"store", "reports",
+    "report"}`` (``report`` is the aggregate)."""
+    n = len(wal_paths)
+    source = RvSource()
+    shards: List[ResourceStore] = []
+    reports: List[Optional[RecoveryReport]] = []
+    for i, path in enumerate(wal_paths):
+        s = ResourceStore(
+            clock=clock,
+            namespace_finalizers=namespace_finalizers,
+            watch_high_water=watch_high_water,
+            rv_source=source,
+            uid_start=i,
+            uid_step=n,
+        )
+        if path and segment_files(path):
+            reports.append(s.recover_wal(path, rv_continuity=False))
+        else:
+            reports.append(None)
+        shards.append(s)
+    agg = aggregate_reports(reports)
+    # the union gap count is the cluster's loss surface; shard 0
+    # carries it so /metrics and /stats reflect it exactly once
+    shards[0].wal_missing_rvs += len(agg.missing_rvs)
+    source.advance_to(agg.recovered_rv)
+    return {
+        "store": ShardedStore(shards, source),
+        "reports": reports,
+        "report": agg,
+    }

@@ -123,6 +123,21 @@ class TransactionAborted(Conflict):
         self.reason = reason
 
 
+class CrossShardTransaction(TransactionAborted):
+    """:meth:`ResourceStore.transact` stays single-shard-atomic by
+    contract: a sharded router
+    (``kwok_tpu/cluster/sharding/router.py``) refuses a txn whose ops
+    hash to more than one shard with this typed error instead of
+    attempting a 2PC.  Namespace-hash placement keeps legitimate gangs
+    shard-affine, so hitting this means the caller mixed namespaces
+    (or namespaced and cluster-scoped kinds) in one atomic batch —
+    rendered as 409 reason ``CrossShard`` on the wire, never a silent
+    partial apply."""
+
+    def __init__(self, index: int, message: str):
+        super().__init__(index, "CrossShard", message)
+
+
 class ApplyConflict(Conflict):
     """Server-side apply hit fields owned by other managers.
 
@@ -525,6 +540,9 @@ class _LaneGrant:
                 # a WAL cannot observe statuses spliced in place — with
                 # durability on, status batches take the logging lanes
                 or store._wal is not None
+                # shared rv source (sharded store): the lane allocates
+                # rvs locally, which a cluster-wide sequence must see
+                or store._rv_source is not None
                 or any(p.startswith("status.") for p in st.indexes)
                 or any(
                     w is not self.exclude
@@ -602,6 +620,9 @@ class ResourceStore:
         clock: Optional[Clock] = None,
         namespace_finalizers: bool = False,
         watch_high_water: Optional[int] = None,
+        rv_source=None,
+        uid_start: int = 0,
+        uid_step: int = 1,
     ):
         #: inject NS_FINALIZER on Namespace create (the real apiserver
         #: injects spec.finalizers the same way) — opt-in by cluster
@@ -618,7 +639,21 @@ class ResourceStore:
         # lock class is also the WAL's ordering identity
         self._mut = make_rlock("cluster.store.ResourceStore._mut")
         self._rv = 0
-        self._uid = 0
+        #: external resourceVersion allocator (the sharded-store seam,
+        #: kwok_tpu/cluster/sharding/router.py): when set, every rv is
+        #: drawn from the shared cluster-wide sequence so rvs stay
+        #: globally unique and monotonic across shards.  ``self._rv``
+        #: remains this store's high-water mark (the last rv it
+        #: allocated or replayed); the fastdrain batch allocators and
+        #: the zero-copy status lane assume local allocation and are
+        #: disabled while a source is attached.
+        self._rv_source = rv_source
+        #: uid striding (sharded stores): shard ``i`` of ``N`` draws
+        #: uids ``i + k*N`` so uids never collide across shards without
+        #: any shared state (replay only ever observes this shard's own
+        #: uids, so the residue class survives recovery too)
+        self._uid = int(uid_start)
+        self._uid_step = max(1, int(uid_step))
         #: durability hooks (kwok_tpu.cluster.wal): None keeps every
         #: mutation path WAL-free (the in-process/bench posture); the
         #: apiserver daemon attaches a log via attach_wal
@@ -758,7 +793,7 @@ class ResourceStore:
             self._wal_event(etype, obj, rv)
         except WalExhausted as exc:
             undo()
-            self._rv -= 1
+            self._unbump(rv)
             raise StorageDegraded(exc.reason, str(exc)) from exc
 
     def storage_degraded(self) -> Optional[dict]:
@@ -867,7 +902,7 @@ class ResourceStore:
         return t.isoformat(timespec="seconds").replace("+00:00", "Z")
 
     def _next_uid(self) -> str:
-        self._uid += 1
+        self._uid += self._uid_step
         return f"00000000-0000-0000-0000-{self._uid:012d}"
 
     def _key(self, st: _TypeState, obj: dict) -> Tuple[str, str]:
@@ -899,9 +934,29 @@ class ResourceStore:
         self._audit.append(("watch-evicted", "", None))
 
     def _bump(self, obj: dict) -> int:
-        self._rv += 1
+        src = self._rv_source
+        if src is None:
+            self._rv += 1
+        else:
+            self._rv = src.alloc()
         obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
         return self._rv
+
+    def _unbump(self, rv: int) -> None:
+        """Roll back the rv of a commit whose WAL record could not be
+        made durable (the ``_wal_event_or_rollback`` undo path).  With
+        a shared rv source the number can only be reclaimed while it is
+        still the sequence tip; otherwise another shard already
+        allocated past it and the hole is recorded as a best-effort
+        ``void`` marker so offline fsck and recovery account it as
+        covered, never as a silently lost record."""
+        src = self._rv_source
+        if src is None:
+            self._rv -= 1
+            return
+        self._rv = rv - 1
+        if not src.unalloc(rv) and self._wal is not None:
+            self._wal.note_void(rv)
 
     # --------------------------------------------------------------------- CRUD
 
@@ -1498,7 +1553,15 @@ class ResourceStore:
                 status_interest=status_interest,
                 high_water=self.watch_high_water,
             )
-            if since_rv is not None and since_rv > self._rv:
+            # with a shared rv source (sharded store) the cluster-wide
+            # sequence may be ahead of this shard's own high-water mark
+            # — a resume from another shard's rv is legitimate, so the
+            # future-rv check compares against the shared horizon
+            src = self._rv_source
+            horizon = (
+                self._rv if src is None else max(self._rv, src.current())
+            )
+            if since_rv is not None and since_rv > horizon:
                 # a resume from the future means the store lost state
                 # this consumer already observed (crash between a bulk
                 # batch's event emission and its WAL append is the one
@@ -1506,7 +1569,7 @@ class ResourceStore:
                 # the divergence instead of silently diverging forever
                 raise Expired(
                     f"resourceVersion {since_rv} is ahead of the store "
-                    f"({self._rv}); state rolled back across a restart"
+                    f"({horizon}); state rolled back across a restart"
                 )
             if since_rv is not None and since_rv < self._rv:
                 if since_rv < self._history_floor:
@@ -1580,6 +1643,10 @@ class ResourceStore:
                 _FAST is not None
                 and not status_indexed
                 and self._wal is None  # in-place splices bypass the log
+                # the C committers allocate rvs locally from a start
+                # value; a shared rv source (sharded store) must see
+                # every allocation, so both fast lanes stand down
+                and self._rv_source is None
                 and exclude is not None
                 and all(
                     w is exclude or w.stopped or not w.status_interest
@@ -1604,7 +1671,11 @@ class ResourceStore:
                         ("patch-status-batch", f"{kind}:{len(items)}", None)
                     )
                 return out
-            if _FAST is not None and not status_indexed:
+            if (
+                _FAST is not None
+                and not status_indexed
+                and self._rv_source is None
+            ):
                 out, evs, self._rv = _FAST.status_commit(
                     st.objects, items, self._rv, namespaced, WatchEvent
                 )
@@ -1623,6 +1694,7 @@ class ResourceStore:
             evs: List[WatchEvent] = []
             history = st.history
             objects = st.objects
+            src = self._rv_source
             for ns, name, status in items:
                 key = ((ns or "default") if namespaced else "", name)
                 cur = objects.get(key)
@@ -1632,7 +1704,10 @@ class ResourceStore:
                 new = dict(cur)
                 new["status"] = status
                 nm = dict(cur["metadata"])
-                self._rv += 1
+                if src is None:
+                    self._rv += 1
+                else:
+                    self._rv = src.alloc()
                 rv = self._rv
                 nm["resourceVersion"] = str(rv)
                 new["metadata"] = nm
@@ -2302,7 +2377,9 @@ class ResourceStore:
         report = self._apply_wal_scan(s)
         return report.applied
 
-    def recover_wal(self, path: str, files=None) -> "RecoveryReport":
+    def recover_wal(
+        self, path: str, files=None, rv_continuity: bool = True
+    ) -> "RecoveryReport":
         """Tolerant boot recovery: apply every verifiable WAL record
         (including those after a corrupt region) and report exactly
         what is missing — the recovered state plus the reported-lost
@@ -2313,14 +2390,21 @@ class ResourceStore:
 
         ``files`` overrides the scanned file set (ordered oldest
         first) — the PITR boot fallback replays archived segments
-        ahead of the live log this way."""
+        ahead of the live log this way.
+
+        ``rv_continuity=False`` skips the per-log missing-rv
+        computation: one shard of a sharded store holds a deliberately
+        sparse slice of the cluster-wide rv sequence, and continuity
+        only holds over the union of the shards
+        (``kwok_tpu/cluster/sharding/recovery.py`` computes it
+        there)."""
         from kwok_tpu.cluster import wal as _wal
 
         if files is not None:
             s = _wal.scan_files(list(files))
         else:
             s = _wal.scan(path)
-        report = self._apply_wal_scan(s)
+        report = self._apply_wal_scan(s, rv_continuity=rv_continuity)
         with self._mut:
             self.wal_recoveries += 1
             self.wal_corruptions += len(report.corruptions)
@@ -2337,7 +2421,7 @@ class ResourceStore:
 
         return self._apply_wal_scan(WalScan(records=list(records))).applied
 
-    def _apply_wal_scan(self, s) -> "RecoveryReport":
+    def _apply_wal_scan(self, s, rv_continuity: bool = True) -> "RecoveryReport":
         """Apply a tolerant scan's records and compute the recovery
         report (missing resourceVersions, tail exposure)."""
         n = 0
@@ -2392,6 +2476,15 @@ class ResourceStore:
                     n += 1
                     continue
                 rv = int(rec.get("rv", 0) or 0)
+                # this walk mirrors wal.record_rvs (kept inline: replay
+                # interleaves application with the rv accounting) — a
+                # new record type must be threaded through both
+                if t == "void":
+                    # an allocated-then-rolled-back rv (sharded undo
+                    # path, ResourceStore._unbump): the number was
+                    # never a commit — covered, not lost
+                    observed.add(rv)
+                    continue
                 if t == "ev":
                     observed.add(rv)
                 elif t == "status":
@@ -2440,11 +2533,15 @@ class ResourceStore:
             # a hole is a lost (or never-durable) record — report it,
             # never guess
             base = max(boot_floor, reset_rv)
-            missing = [
-                rv
-                for rv in range(base + 1, recovered_rv + 1)
-                if rv not in observed
-            ]
+            missing = (
+                [
+                    rv
+                    for rv in range(base + 1, recovered_rv + 1)
+                    if rv not in observed
+                ]
+                if rv_continuity
+                else []
+            )
             tail_after_rv = (
                 recovered_rv
                 if (s.torn_tail or s.corruptions)

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
         "restore --to-rv` and boot fallback past a corrupt state file",
     )
     p.add_argument(
+        "--store-shards",
+        type=int,
+        default=1,
+        help="horizontally shard the store by namespace/kind hash "
+        "across N independent shards, each with its own mutex family, "
+        "WAL and PITR archive (kwok_tpu.cluster.sharding; 1 = the "
+        "single-store layout, byte-compatible with existing workdirs)",
+    )
+    p.add_argument(
         "--pitr-keep",
         type=int,
         default=5,
@@ -110,6 +119,11 @@ def main(argv=None) -> int:
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)
+    n_shards = max(1, int(args.store_shards))
+    if n_shards > 1:
+        store, wals, pitrs = _boot_sharded(args, n_shards)
+        wal = wals[0] if wals else None
+        return _serve(args, store, wal, wals, pitrs, sharded=True)
     # namespace finalizers ON: cluster compositions always include the
     # controller-manager seat that finalizes them (ctl/runtime.py)
     store = ResourceStore(namespace_finalizers=True)
@@ -132,21 +146,8 @@ def main(argv=None) -> int:
             args.wal_file or None,
             pitr_root=args.pitr_dir or None,
         )
-        if boot["state_loaded"]:
-            where = (
-                f"archived snapshot rv={boot['fallback_rv']} "
-                f"(state file corrupt: {boot['snapshot_error']})"
-                if boot["fell_back"]
-                else args.state_file
-            )
-            print(f"restored state from {where}", flush=True)
+        _print_boot(args, store, boot)
         rec = boot["recovery"]
-        if rec is not None and rec.applied:
-            print(
-                f"replayed {rec.applied} WAL records from {args.wal_file} "
-                f"(rv {store.resource_version})",
-                flush=True,
-            )
         if rec is not None and not rec.clean:
             import json as _json
 
@@ -172,7 +173,105 @@ def main(argv=None) -> int:
             archive_dir=args.pitr_dir or None,
         )
         store.attach_wal(wal)
+    return _serve(
+        args,
+        store,
+        wal,
+        [wal] if wal is not None else [],
+        [pitr],
+        sharded=False,
+    )
 
+
+def _print_boot(args, store, boot, which: str = "", state_file: str = "") -> None:
+    """Boot-report lines shared by the single and sharded paths."""
+    state_file = state_file or args.state_file
+    if boot["state_loaded"]:
+        where = (
+            f"archived snapshot rv={boot['fallback_rv']} "
+            f"(state file corrupt: {boot['snapshot_error']})"
+            if boot["fell_back"]
+            else state_file
+        )
+        print(f"restored state{which} from {where}", flush=True)
+    rec = boot.get("recovery")
+    if rec is not None and rec.applied:
+        print(
+            f"replayed {rec.applied} WAL records{which} "
+            f"(rv {store.resource_version})",
+            flush=True,
+        )
+
+
+def _boot_sharded(args, n_shards: int):
+    """Build the N-shard store: per-shard snapshot-then-WAL recovery
+    with the union rv-continuity check (kwok_tpu.cluster.sharding).
+    The workdir is the state/WAL file's directory — shard 0 keeps the
+    single-store file names at the root (byte-compatible), shards
+    1..N-1 live under ``shards/NN/``."""
+    if not (args.state_file or args.wal_file):
+        from kwok_tpu.cluster.sharding.router import build_sharded_store
+
+        return build_sharded_store(
+            n_shards, namespace_finalizers=True
+        ), [], []
+    from kwok_tpu.cluster.sharding.layout import (
+        shard_state_path,
+        shard_wal_path,
+    )
+    from kwok_tpu.snapshot.sharded import open_sharded_store
+
+    workdir = os.path.dirname(
+        os.path.abspath(args.state_file or args.wal_file)
+    )
+    # the sharded layout owns the file names inside the workdir; a
+    # mismatched --state-file/--wal-file spelling would silently boot
+    # an empty shard 0 next to the real files
+    expect = {
+        args.state_file: shard_state_path(workdir, 0),
+        args.wal_file: shard_wal_path(workdir, 0),
+    }
+    for given, canonical in expect.items():
+        if given and os.path.abspath(given) != canonical:
+            raise SystemExit(
+                f"--store-shards needs the sharded workdir layout: "
+                f"{given!r} should be {canonical!r}"
+            )
+    opened = open_sharded_store(
+        workdir,
+        n_shards,
+        namespace_finalizers=True,
+        wal_fsync=args.wal_fsync,
+        wal_segment_bytes=args.wal_segment_bytes,
+        pitr=bool(args.pitr_dir),
+    )
+    store = opened["store"]
+    for i, boot in enumerate(opened["boots"]):
+        _print_boot(
+            args,
+            store,
+            boot,
+            which=f" [shard {i}]",
+            state_file=shard_state_path(workdir, i),
+        )
+    rep = opened["report"]
+    if rep is not None and not rep.clean:
+        import json as _json
+
+        print(
+            "sharded WAL recovery was lossy (detected, bounded): "
+            + _json.dumps(rep.summary()),
+            flush=True,
+        )
+    print(
+        f"store sharded {n_shards} ways under {workdir} "
+        f"(rv {store.resource_version})",
+        flush=True,
+    )
+    return store, opened["wals"], opened["pitrs"]
+
+
+def _serve(args, store, wal, wals, pitrs, sharded: bool) -> int:
     injector = None
     plan = None
     if args.chaos_profile:
@@ -242,8 +341,12 @@ def main(argv=None) -> int:
         if PressureDriver.specs(plan):
             # exhaustion windows (disk-full/fsync-error/quota) run
             # inside this process against the live WAL handles — the
-            # external DiskFaultDriver only applies corruption kinds
-            pressure = PressureDriver(plan, wal, store=store).start()
+            # external DiskFaultDriver only applies corruption kinds.
+            # On a sharded store each spec's `shard:` picks its target
+            # handle, so a window degrades ONE shard's writes
+            pressure = PressureDriver(
+                plan, wal, store=store, wals=wals
+            ).start()
             print(
                 "chaos: filesystem pressure armed "
                 f"({len(PressureDriver.specs(plan))} windows)",
@@ -258,7 +361,9 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
 
-    def save_once() -> bool:
+    pitr = pitrs[0] if pitrs else None
+
+    def save_single() -> bool:
         # online consistent cut: refs captured under one brief mutex
         # hold (copy-on-write store), serialized outside the lock —
         # live writers are never stalled for the disk write
@@ -282,6 +387,54 @@ def main(argv=None) -> int:
             print(f"snapshot save skipped: {exc}", flush=True)
             return False
         return True
+
+    def save_shards() -> bool:
+        from kwok_tpu.cluster.sharding.layout import shard_state_path
+        from kwok_tpu.cluster.wal import write_state_file
+
+        workdir = os.path.dirname(os.path.abspath(args.state_file))
+        # One captured horizon per shard stamps its snapshot: an rv a
+        # shard owns that is <= g was fully committed before the
+        # capture (allocation happens inside the shard's commit hold,
+        # which the dump also takes), so a dump whose own cut has NOT
+        # advanced past g covers exactly this shard's slice of (0, g].
+        # A dump that HAS advanced (a write landed in the capture->dump
+        # window) would archive future state under an rv-g label —
+        # restore --to-rv g would then resurrect objects that did not
+        # exist at g — so that shard skips this tick and retries at
+        # the next one, exactly like the full-disk skip below.
+        # Records landing after a capture stay in their shard's WAL
+        # (compaction stops at g).
+        ok = True
+        for i in range(store.shard_count):
+            shard = store.shard_lane(i)
+            g = store.resource_version
+            state = shard.dump_state(copy=not args.wal_file)
+            if int(state.get("resourceVersion") or 0) > g:
+                print(
+                    f"snapshot save deferred [shard {i}]: write raced "
+                    "the horizon capture",
+                    flush=True,
+                )
+                ok = False
+                continue
+            state["resourceVersion"] = g
+            arch = pitrs[i] if i < len(pitrs) else None
+            try:
+                write_state_file(shard_state_path(workdir, i), state)
+                if arch is not None:
+                    arch.add_snapshot(state)
+                shard.compact_wal(g)
+                if arch is not None:
+                    arch.prune(keep_snapshots=args.pitr_keep)
+            except OSError as exc:
+                # one shard's full disk must not stop the healthy
+                # shards' snapshots — skip ITS tick only
+                print(f"snapshot save skipped [shard {i}]: {exc}", flush=True)
+                ok = False
+        return ok
+
+    save_once = save_shards if sharded else save_single
 
     def rearm_loop() -> None:
         # background re-arm probe: degraded mode also clears when NO

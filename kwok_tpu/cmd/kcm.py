@@ -161,6 +161,14 @@ def main(argv=None) -> int:
     if not client.wait_ready(timeout=60):
         print("apiserver not ready", file=sys.stderr)
         return 1
+    # KUBEDIRECT direct dispatch: the workload controllers' bulk lane
+    # posts straight to the owning shard on a sharded apiserver (the
+    # probe hands the client back untouched on a single store)
+    from kwok_tpu.cluster.sharding.dispatch import direct_dispatch
+
+    client = direct_dispatch(client)
+    if type(client) is not ClusterClient:
+        print("direct dispatch: sharded apiserver detected", flush=True)
     groups = {g.strip() for g in args.controllers.split(",") if g.strip()}
     unknown = groups - {"gc", "workloads"}
     if unknown:

@@ -71,6 +71,10 @@ def cmd_create_cluster(args) -> int:
     if rt.exists() and not dry_run.enabled:
         print(f"cluster {rt.name!r} already exists", file=sys.stderr)
         return 1
+    if args.store_shards < 1:
+        raise SystemExit(
+            f"--store-shards must be >= 1 (got {args.store_shards})"
+        )
     rt.install(
         secure=args.secure,
         backend=args.backend,
@@ -83,6 +87,7 @@ def cmd_create_cluster(args) -> int:
         controller_replicas=args.controller_replicas,
         leader_elect=args.leader_elect,
         gang_policy=args.gang_policy,
+        store_shards=args.store_shards,
     )
     rt.up(wait=args.wait)
     if not dry_run.enabled:
@@ -156,9 +161,17 @@ def cmd_get_components(args) -> int:
         status = "Running" if alive else "Stopped"
         if name == "apiserver" and alive and wal and wal.get("degraded"):
             # alive but read-only: the disk is full / fsync poisoned.
-            # Shown as its own state so nobody "fixes" it with restarts
+            # Shown as its own state so nobody "fixes" it with restarts.
+            # On a sharded store only the NAMED shards' writes are
+            # 503ing — the rest of the cluster stays writable
             deg = wal["degraded"]
             status = f"DEGRADED({deg.get('reason', 'storage')})"
+            if wal.get("degraded_shards"):
+                shards = ",".join(str(s) for s in wal["degraded_shards"])
+                status = (
+                    f"DEGRADED({deg.get('reason', 'storage')} "
+                    f"shards={shards})"
+                )
         line = f"{name}\t{status}"
         if name in election:
             lease, transitions, age = election[name]
@@ -175,6 +188,22 @@ def cmd_get_components(args) -> int:
                 line += f"\tfsynced={fs_age:.1f}s ago"
             if wal.get("corruptions"):
                 line += f"\tcorruptions={wal['corruptions']}"
+            per_shard = wal.get("shards") or []
+            if len(per_shard) > 1:
+                # per-shard WAL column (sharded store): one cell per
+                # shard so a single full disk is attributable at a
+                # glance — `!` marks a degraded (read-only) shard
+                cells = []
+                for i, h in enumerate(per_shard):
+                    if not h:
+                        cells.append(f"{i}:-")
+                        continue
+                    mark = "!" if h.get("degraded") else ""
+                    cells.append(
+                        f"{i}:{h.get('segments')}seg/"
+                        f"{int(h.get('bytes') or 0) // 1024}KB{mark}"
+                    )
+                line += "\tshards=" + ",".join(cells)
         print(line)
     return 0
 
@@ -403,14 +432,28 @@ def cmd_snapshot_save(args) -> int:
     write_state_file(args.path, state)
     print(f"saved {len(state.get('objects', []))} objects (raw) to {args.path}")
     if getattr(args, "pitr", False):
+        from kwok_tpu.cluster.sharding.layout import discover_shards
         from kwok_tpu.ctl.components import pitr_dir
         from kwok_tpu.snapshot.pitr import PitrArchive
 
-        archived = PitrArchive(pitr_dir(rt.workdir)).add_snapshot(state)
-        print(
-            f"archived as {archived} "
-            f"(rv {state.get('resourceVersion')})"
-        )
+        if discover_shards(rt.workdir) > 1:
+            # sharded workdir: each shard's archive gets exactly its
+            # own placement slice (a merged snapshot dropped whole
+            # into shard 0's archive would mis-place every other
+            # shard's objects on restore)
+            from kwok_tpu.snapshot.sharded import archive_sharded_snapshot
+
+            names = archive_sharded_snapshot(rt.workdir, state)
+            print(
+                f"archived as {names[0]} across {len(names)} shards "
+                f"(rv {state.get('resourceVersion')})"
+            )
+        else:
+            archived = PitrArchive(pitr_dir(rt.workdir)).add_snapshot(state)
+            print(
+                f"archived as {archived} "
+                f"(rv {state.get('resourceVersion')})"
+            )
     return 0
 
 
@@ -425,13 +468,21 @@ def cmd_snapshot_restore(args) -> int:
 
     rt = _require_cluster(args)
     if getattr(args, "to_rv", 0):
+        from kwok_tpu.cluster.sharding.layout import discover_shards
         from kwok_tpu.ctl.components import pitr_dir, wal_path
         from kwok_tpu.snapshot.pitr import PitrArchive
 
-        archive = PitrArchive(pitr_dir(rt.workdir))
-        state, info = archive.build_state(
-            args.to_rv, live_wal=wal_path(rt.workdir)
-        )
+        if discover_shards(rt.workdir) > 1:
+            # sharded workdir: per-shard rebuilds with the retention
+            # check over the union of the shards' retained rvs
+            from kwok_tpu.snapshot.sharded import build_sharded_state
+
+            state, info = build_sharded_state(rt.workdir, args.to_rv)
+        else:
+            archive = PitrArchive(pitr_dir(rt.workdir))
+            state, info = archive.build_state(
+                args.to_rv, live_wal=wal_path(rt.workdir)
+            )
         n = rt.client().restore_state(state)
         print(
             f"restored {n} objects at rv {info['built_rv']} "
@@ -1407,6 +1458,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="apiserver global inflight budget split across priority "
         "levels (default 64; 0 disables flow control)",
+    )
+    c.add_argument(
+        "--store-shards",
+        type=int,
+        default=1,
+        metavar="N",
+        help="horizontally shard the apiserver's store by "
+        "namespace/kind hash across N independent shards, each with "
+        "its own mutex family, WAL and PITR archive "
+        "(kwok_tpu.cluster.sharding).  1 (the default) keeps the "
+        "single-store layout, byte-compatible with existing workdirs",
     )
     c.add_argument(
         "--controller-replicas",

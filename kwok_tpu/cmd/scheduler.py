@@ -95,6 +95,14 @@ def main(argv=None) -> int:
     if not client.wait_ready(timeout=60):
         print("apiserver not ready", file=sys.stderr)
         return 1
+    # KUBEDIRECT direct dispatch: against a sharded apiserver the gang
+    # engine's txn lane posts straight to the owning shard (no-op
+    # wrapper-free on a single store)
+    from kwok_tpu.cluster.sharding.dispatch import direct_dispatch
+
+    client = direct_dispatch(client)
+    if type(client) is not ClusterClient:
+        print("direct dispatch: sharded apiserver detected", flush=True)
 
     identity = os.environ.get("KWOK_COMPONENT_NAME") or (
         f"kwok-scheduler-{os.getpid()}"

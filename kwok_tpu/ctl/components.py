@@ -83,6 +83,7 @@ def build_apiserver_component(
     chaos_profile: Optional[str] = None,
     flow_config: Optional[str] = None,
     max_inflight: Optional[int] = None,
+    store_shards: int = 1,
 ) -> Component:
     """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
     args = [
@@ -113,6 +114,14 @@ def build_apiserver_component(
         "--max-inflight",
         str(DEFAULT_MAX_INFLIGHT if max_inflight is None else max_inflight),
     ]
+    if int(store_shards) > 1:
+        # horizontally sharded store (kwok_tpu.cluster.sharding): N
+        # independent mutex/WAL/PITR families under one router.  Shard
+        # 0 keeps the single-store file names above — the workdir
+        # stays byte-compatible — and shards 1..N-1 live under
+        # shards/NN/.  Pinned in argv so the shard count is auditable
+        # and survives restarts (the layout must match what's on disk)
+        args += ["--store-shards", str(int(store_shards))]
     if flow_config:
         args += ["--flow-config", flow_config]
     if chaos_profile:
@@ -318,6 +327,7 @@ def build_core_components(
     controller_replicas: int = 1,
     leader_elect: bool = True,
     gang_policy: str = "binpack",
+    store_shards: int = 1,
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
@@ -341,6 +351,7 @@ def build_core_components(
             chaos_profile=chaos_profile,
             flow_config=flow_config,
             max_inflight=max_inflight,
+            store_shards=store_shards,
         )
     ]
     for i in range(replicas):

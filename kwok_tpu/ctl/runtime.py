@@ -115,6 +115,7 @@ class BinaryRuntime:
         controller_replicas: int = 1,
         leader_elect: bool = True,
         gang_policy: str = "binpack",
+        store_shards: int = 1,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -183,6 +184,7 @@ class BinaryRuntime:
             controller_replicas=controller_replicas,
             leader_elect=leader_elect,
             gang_policy=gang_policy,
+            store_shards=store_shards,
         )
         tracing_port = 0
         if enable_tracing:
@@ -220,6 +222,8 @@ class BinaryRuntime:
             conf["leaderElect"] = False
         if gang_policy and gang_policy != "binpack":
             conf["gangPolicy"] = gang_policy
+        if int(store_shards) > 1:
+            conf["storeShards"] = int(store_shards)
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:

@@ -641,15 +641,19 @@ class LifecycleActor(_GatedControllerActor):
 
 
 class ObserverActor(Actor):
-    """Passive watch consumer recording per-stream resourceVersion
-    sequences for the rv-monotonicity invariant; reconnects across
-    crashes like any reflector (a rollback shows up as Expired and a
-    fresh stream, never as a silent rv regression)."""
+    """Passive watch consumer recording per-stream
+    ``(object key, resourceVersion)`` sequences for the
+    rv-monotonicity invariant; reconnects across crashes like any
+    reflector (a rollback shows up as Expired and a fresh stream,
+    never as a silent rv regression).  The key is recorded because a
+    sharded store's merged watch promises PER-OBJECT rv ordering, not
+    a global total order (kwok_tpu/cluster/sharding/fanin.py) — the
+    checker asserts the contract that matches the store shape."""
 
     def __init__(self, sim, kind: str = "Pod"):
         super().__init__(sim, "observer", None, period=0.5)
         self.kind = kind
-        self.streams: List[List[int]] = []
+        self.streams: List[List[tuple]] = []
         self._w = None
         self._gen: Optional[int] = None
         self._rv: Optional[int] = None
@@ -671,6 +675,8 @@ class ObserverActor(Actor):
             self.streams.append([])
         for ev in self._w.drain():
             rv = getattr(ev, "rv", 0) or 0
-            self.streams[-1].append(rv)
+            meta = (getattr(ev, "object", None) or {}).get("metadata") or {}
+            key = f"{meta.get('namespace') or ''}/{meta.get('name') or ''}"
+            self.streams[-1].append((key, rv))
             if self._rv is None or rv > self._rv:
                 self._rv = rv

@@ -75,8 +75,17 @@ class SimOptions:
     lease_duration: float = 6.0
     faults: bool = True
     #: test-only injected regression: "ungated-writer" makes one kcm
-    #: standby reconcile without holding the lease
+    #: standby reconcile without holding the lease; "partial-gang"
+    #: un-atomics the gang bind; "cross-shard-txn" makes the shard
+    #: router place txn ops per-object and split atomic batches into
+    #: per-shard sub-txns (needs store_shards > 1)
     bug: Optional[str] = None
+    #: store shards (kwok_tpu/cluster/sharding): the default DST run
+    #: exercises the sharded composition — per-shard WALs on one
+    #: shared rv sequence, recovery through the union continuity
+    #: check, the merged watch fan-in under the observer.  1 restores
+    #: the single-store composition
+    store_shards: int = 2
     nodes: int = 4
     deployment_replicas: int = 6
     scale_to: int = 9
@@ -116,6 +125,10 @@ class RunRecord:
     audit_overflow: int = 0
     #: write-trace actor name -> its replica name (leader-gated actors)
     gated_writers: Dict[str, str] = field(default_factory=dict)
+    #: store shards this run composed (the watch-rv checker asserts
+    #: per-object ordering for >1, the single store's global order
+    #: for 1)
+    store_shards: int = 1
     final_counts: Dict[str, int] = field(default_factory=dict)
     steps: int = 0
     virtual_end: float = 0.0
@@ -153,16 +166,55 @@ class Simulation:
 
         sprig.set_default_rng(random.Random(opts.seed ^ 0x517A1))
 
-        self.wal_path = os.path.join(wal_dir, "dst-wal.jsonl")
-        self.wal = WriteAheadLog(self.wal_path, fsync="off")
-        self.store = ResourceStore(clock=self.clock)
-        self.store.attach_wal(self.wal)
+        self.n_shards = max(1, int(opts.store_shards))
+        if self.n_shards == 1:
+            self.wal_paths = [os.path.join(wal_dir, "dst-wal.jsonl")]
+            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off")]
+            self.store = ResourceStore(clock=self.clock)
+            self.store.attach_wal(self.wals[0])
+        else:
+            # sharded composition: per-shard WALs on one shared rv
+            # sequence (kwok_tpu/cluster/sharding) — the default DST
+            # shape, so every seed doubles as a split-brain search
+            # over the router/fan-in/recovery stack
+            from kwok_tpu.cluster.sharding.router import (
+                RvSource,
+                ShardedStore,
+            )
+
+            source = RvSource()
+            shards = [
+                ResourceStore(
+                    clock=self.clock,
+                    rv_source=source,
+                    uid_start=i,
+                    uid_step=self.n_shards,
+                )
+                for i in range(self.n_shards)
+            ]
+            self.wal_paths = [
+                os.path.join(wal_dir, f"dst-wal-{i}.jsonl")
+                for i in range(self.n_shards)
+            ]
+            self.wals = [
+                WriteAheadLog(p, fsync="off") for p in self.wal_paths
+            ]
+            for s, w in zip(shards, self.wals):
+                s.attach_wal(w)
+            self.store = ShardedStore(shards, source)
+            if opts.bug == "cross-shard-txn":
+                self.store.unsafe_split_cross_shard_txns = True
+        #: shard index an open pressure window targets (0 on a single
+        #: store); a crash inside the window reinstalls the shim there
+        self._pressure_shard = 0
         self.store.set_crash_hook(self._crash_dispatch)
 
         # ----- replicas + actors ------------------------------------
         self.seats: Dict[str, List[Replica]] = {}
         self.actors: List = []
-        self.record = RunRecord(seed=opts.seed, trace=self.trace)
+        self.record = RunRecord(
+            seed=opts.seed, trace=self.trace, store_shards=self.n_shards
+        )
         for seat, lease in SEATS:
             reps = [
                 Replica(self, seat, lease, i, opts.lease_duration)
@@ -266,15 +318,34 @@ class Simulation:
         disk fault must be detected and reported, never crash the
         recovery), and swap it in.  Returns the RecoveryReport."""
         t = self.clock.now()
-        self.wal.close()
-        recovered = ResourceStore(clock=self.clock)
-        rep = recovered.recover_wal(self.wal_path)
-        self.wal = WriteAheadLog(self.wal_path, fsync="off")
+        for w in self.wals:
+            w.close()
+        if self.n_shards == 1:
+            recovered = ResourceStore(clock=self.clock)
+            rep = recovered.recover_wal(self.wal_paths[0])
+            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off")]
+            recovered.attach_wal(self.wals[0])
+        else:
+            # per-shard tolerant replay + the union rv-continuity
+            # check (kwok_tpu/cluster/sharding/recovery.py)
+            from kwok_tpu.cluster.sharding.recovery import recover_sharded
+
+            out = recover_sharded(self.wal_paths, clock=self.clock)
+            recovered = out["store"]
+            rep = out["report"]
+            self.wals = [
+                WriteAheadLog(p, fsync="off") for p in self.wal_paths
+            ]
+            for i, w in enumerate(self.wals):
+                recovered.shard_lane(i).attach_wal(w)
+            if self.opts.bug == "cross-shard-txn":
+                recovered.unsafe_split_cross_shard_txns = True
         if self._active_pressure is not None:
             # a crash inside a pressure window: the disk is still full
             # when the process comes back
-            self.wal.set_pressure(self._active_pressure)
-        recovered.attach_wal(self.wal)
+            self.wals[self._pressure_shard].set_pressure(
+                self._active_pressure
+            )
         recovered.set_crash_hook(self._crash_dispatch)
         self.store = recovered
         self.store_generation += 1
@@ -350,17 +421,27 @@ class Simulation:
         from kwok_tpu.chaos import disk_faults
 
         t = self.clock.now()
+        # seeded target shard (always 0 on a single store): damage
+        # lands on ONE shard's log, recovery must bound the loss to
+        # that shard's slice of the rv sequence
+        shard = (
+            self.faults.rng.randrange(self.n_shards)
+            if self.n_shards > 1
+            else 0
+        )
+        path = self.wal_paths[shard]
         if mode == "bit-flip":
             info = disk_faults.bit_flip_line(
-                self.wal_path, self.faults.rng, exclude_last=True
+                path, self.faults.rng, exclude_last=True
             )
         else:
-            info = disk_faults.truncate_mid_record(
-                self.wal_path, self.faults.rng
-            )
+            info = disk_faults.truncate_mid_record(path, self.faults.rng)
         noop = info.get("offset", -1) < 0
         self.trace.add(
-            t, "faults", "disk-corrupt", f"{mode} offset={info.get('offset', -1)}"
+            t,
+            "faults",
+            "disk-corrupt",
+            f"{mode} shard={shard} offset={info.get('offset', -1)}",
         )
         rep = self._recover()
         missing = set(rep.missing_rvs)
@@ -601,13 +682,26 @@ class Simulation:
         t = self.clock.now()
         shim = FsPressure(mode)
         self._active_pressure = shim
-        self.wal.set_pressure(shim)
+        # seeded target shard: exhaustion degrades ONE shard's writes
+        # (the per-shard StorageDegraded story); other shards stay
+        # writable through the window
+        self._pressure_shard = (
+            self.faults.rng.randrange(self.n_shards)
+            if self.n_shards > 1
+            else 0
+        )
+        self.wals[self._pressure_shard].set_pressure(shim)
         self._pressure_probe = {
             "mode": mode,
             "start_acked": set(self.acked_rvs),
             "rejections": 0,
         }
-        self.trace.add(t, "faults", "pressure-start", mode)
+        self.trace.add(
+            t,
+            "faults",
+            "pressure-start",
+            f"{mode} shard={self._pressure_shard}",
+        )
 
     def _pressure_end(self, mode: str) -> None:
         """Close the window, force the re-arm probe, and record the
@@ -617,37 +711,22 @@ class Simulation:
         from kwok_tpu.cluster import wal as walmod
 
         t = self.clock.now()
-        self.wal.set_pressure(None)
+        self.wals[self._pressure_shard].set_pressure(None)
         self._active_pressure = None
-        rearmed = self.wal.try_rearm()
+        rearmed = self.wals[self._pressure_shard].try_rearm()
         probe = self._pressure_probe or {
             "mode": mode, "start_acked": set(), "rejections": 0,
         }
         self._pressure_probe = None
         acked_during = self.acked_rvs - probe["start_acked"]
-        s = walmod.scan(self.wal_path)
+        # acked rvs may live on ANY shard's log (only one shard was
+        # under pressure) — the durability check scans the union.
+        # Deliberately NOT include_void: an acked rv that was voided
+        # is a lost write, not a covered one
         observed: set = set()
-        for rec in s.records:
-            rt = rec.get("t")
-            if rt == "ev":
-                try:
-                    observed.add(int(rec.get("rv", 0) or 0))
-                except (TypeError, ValueError):
-                    continue
-            elif rt == "status":
-                for item in rec.get("i") or []:
-                    try:
-                        observed.add(int(item[3]))
-                    except (LookupError, TypeError, ValueError):
-                        continue
-            elif rt == "txn":
-                for sub in rec.get("recs") or []:
-                    if sub.get("t") != "ev":
-                        continue
-                    try:
-                        observed.add(int(sub.get("rv", 0) or 0))
-                    except (TypeError, ValueError):
-                        continue
+        for path in self.wal_paths:
+            for rec in walmod.scan(path).records:
+                observed.update(walmod.record_rvs(rec))
         silent = sorted(rv for rv in acked_during if rv not in observed)
         self.exhaustion_checks.append(
             {
@@ -792,14 +871,20 @@ class Simulation:
         rec.virtual_end = self.clock.now() - EPOCH
         for kind in ("Node", "Pod", "Deployment", "ReplicaSet"):
             rec.final_counts[kind] = self.store.count(kind)
-        # durability epilogue: the WAL alone must reproduce the live
+        # durability epilogue: the WAL(s) alone must reproduce the live
         # state (the chaos --smoke recovery assertion, end-of-run form).
         # Tolerant recovery: an injected disk fault earlier in the run
         # left detected (and already-probed) damage mid-log — the final
         # replay must deterministically apply the same verifiable set.
-        self.wal.close()
-        replayed = ResourceStore()
-        replayed.recover_wal(self.wal_path)
+        for w in self.wals:
+            w.close()
+        if self.n_shards == 1:
+            replayed = ResourceStore()
+            replayed.recover_wal(self.wal_paths[0])
+        else:
+            from kwok_tpu.cluster.sharding.recovery import recover_sharded
+
+            replayed = recover_sharded(self.wal_paths)["store"]
         self._gang_probe(replayed, "replay")
         self.record.gang_checks = self.gang_checks
         live, fresh = self.store.dump_state(), replayed.dump_state()

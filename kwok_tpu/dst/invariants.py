@@ -162,17 +162,37 @@ def check_no_duplicate_reconcile(record) -> List[str]:
 
 
 def check_watch_rv_monotonic(record) -> List[str]:
+    """Watch-stream rv ordering, matched to the store shape: a single
+    store delivers a strict global order per stream; a sharded store's
+    merged watch promises PER-OBJECT rv ordering only (two objects on
+    different shards may interleave either way —
+    kwok_tpu/cluster/sharding/fanin.py).  Entries are ``(key, rv)``
+    tuples from the observer; bare ints (synthetic traces) check as
+    key-less, i.e. globally."""
     out: List[str] = []
+    sharded = getattr(record, "store_shards", 1) > 1
     for i, stream in enumerate(record.streams):
-        prev = None
-        for rv in stream:
-            if prev is not None and rv <= prev:
-                out.append(
-                    f"stream #{i}: rv {rv} after {prev} (not strictly "
-                    "increasing)"
-                )
-                break
-            prev = rv
+        prev_global = None
+        prev_by_key: Dict[str, int] = {}
+        for item in stream:
+            key, rv = item if isinstance(item, tuple) else (None, item)
+            if key is not None:
+                last = prev_by_key.get(key)
+                if last is not None and rv <= last:
+                    out.append(
+                        f"stream #{i}: {key} rv {rv} after {last} "
+                        "(per-object order violated)"
+                    )
+                    break
+                prev_by_key[key] = rv
+            if not sharded or key is None:
+                if prev_global is not None and rv <= prev_global:
+                    out.append(
+                        f"stream #{i}: rv {rv} after {prev_global} "
+                        "(not strictly increasing)"
+                    )
+                    break
+                prev_global = rv
     return out
 
 

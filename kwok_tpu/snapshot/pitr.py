@@ -34,6 +34,7 @@ from kwok_tpu.cluster.wal import (
     SnapshotCorruption,
     _note_os_error,
     read_state_file,
+    record_rvs,
     scan_files,
     segment_files,
     write_state_file,
@@ -204,15 +205,36 @@ class PitrArchive:
             out.append(rec)
         return out
 
+    @staticmethod
+    def _covered_rvs(records) -> set:
+        """Every rv a record list commits (event, status-batch item,
+        txn sub-event, voided allocation)."""
+        return {
+            rv
+            for rec in records
+            for rv in record_rvs(rec, include_void=True)
+        }
+
     def build_state(
-        self, to_rv: int, live_wal: Optional[str] = None
+        self,
+        to_rv: int,
+        live_wal: Optional[str] = None,
+        rv_continuity: bool = True,
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Reconstruct the cluster state as of resourceVersion
         ``to_rv``: newest verifiable snapshot at or below it, plus the
         archived + live WAL records up to it.  Returns ``(state,
         info)`` where ``state`` is ``dump_state``-shaped (byte-identical
         to the live state at that rv) and ``info`` reports the base
-        snapshot, applied record count, and any integrity findings."""
+        snapshot, applied record count, and any integrity findings.
+
+        ``rv_continuity=False`` is the per-shard posture (one shard of
+        a sharded store archives a deliberately sparse slice of the
+        cluster rv sequence): the empty-base retention check is
+        skipped here and ``info["_observed"]`` exposes the rvs this
+        archive covers, so the sharded composition
+        (``kwok_tpu/snapshot/sharded.py`` build_sharded_state) can run
+        the retention/continuity check over the union instead."""
         base = self.newest_verifiable(max_rv=to_rv)
         files = self.segments()
         if live_wal:
@@ -230,27 +252,20 @@ class PitrArchive:
             # but only if every committed rv up to the target is
             # provably present; otherwise the target predates retention
             base_rv = 0
-            covered = set()
-            for rec in s.records:
-                if rec.get("t") == "ev":
-                    covered.add(int(rec.get("rv", 0) or 0))
-                elif rec.get("t") == "status":
-                    for it in rec.get("i") or []:
-                        covered.add(int(it[3]))
-                elif rec.get("t") == "txn":
-                    for sub in rec.get("recs") or []:
-                        if sub.get("t") == "ev":
-                            covered.add(int(sub.get("rv", 0) or 0))
-            holes = [
-                rv for rv in range(1, int(to_rv) + 1) if rv not in covered
-            ]
-            if holes:
-                raise SnapshotCorruption(
-                    f"rv {to_rv} is below the archive's retention floor "
-                    f"(no snapshot at or below it, and rvs "
-                    f"{holes[:10]}{'...' if len(holes) > 10 else ''} are "
-                    "not in the retained log)"
-                )
+            if rv_continuity:
+                covered = self._covered_rvs(s.records)
+                holes = [
+                    rv
+                    for rv in range(1, int(to_rv) + 1)
+                    if rv not in covered
+                ]
+                if holes:
+                    raise SnapshotCorruption(
+                        f"rv {to_rv} is below the archive's retention floor "
+                        f"(no snapshot at or below it, and rvs "
+                        f"{holes[:10]}{'...' if len(holes) > 10 else ''} are "
+                        "not in the retained log)"
+                    )
         applied = store.replay_records(
             self._filter_records(s.records, int(to_rv), seqs=s.seqs)
         )
@@ -264,6 +279,19 @@ class PitrArchive:
             "corruptions": s.corruptions,
             "torn_tail": s.torn_tail,
         }
+        if not rv_continuity:
+            info["_observed"] = {
+                rv
+                for rv in self._covered_rvs(s.records)
+                if rv <= int(to_rv)
+            }
+            # earliest retained frame: a shard rebuilding without a
+            # base snapshot is only complete when its log reaches back
+            # to genesis (seq 1) — the sharded composition refuses
+            # otherwise instead of silently merging a tail-only slice
+            info["_first_seq"] = next(
+                (q for q in s.seqs if q is not None), None
+            )
         return built, info
 
     # ------------------------------------------------------------- hygiene
@@ -314,6 +342,7 @@ def boot_recover(
     state_file: Optional[str],
     wal_file: Optional[str],
     pitr_root: Optional[str] = None,
+    rv_continuity: bool = True,
 ) -> Dict[str, Any]:
     """The apiserver's boot path: snapshot, then WAL, with integrity.
 
@@ -378,5 +407,10 @@ def boot_recover(
         store.restore_state(state)
         report["state_loaded"] = True
     if wal_file and (files or segment_files(wal_file)):
-        report["recovery"] = store.recover_wal(wal_file, files=files)
+        # rv_continuity=False: one shard of a sharded store replays a
+        # sparse slice of the cluster rv sequence — the union check
+        # lives in kwok_tpu/cluster/sharding/recovery.py
+        report["recovery"] = store.recover_wal(
+            wal_file, files=files, rv_continuity=rv_continuity
+        )
     return report

@@ -1,0 +1,246 @@
+"""On-disk boot of a horizontally sharded store.
+
+The snapshot-layer composition over
+``kwok_tpu/cluster/sharding/recovery.py:1`` (which owns the in-memory
+recovery shape): per shard, snapshot-then-WAL recovery with PITR
+fallback (``kwok_tpu/snapshot/pitr.py:312`` boot_recover), then a live
+WAL attached — shard 0 at the workdir root (byte-compatible with every
+pre-sharding workdir), shards 1..N-1 under ``shards/NN/`` per the
+layout of ``kwok_tpu/cluster/sharding/layout.py:1``.  Lives here, not
+in cluster/sharding, because booting needs ``boot_recover`` and
+``PitrArchive`` and snapshot sits above cluster in the layer map.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from kwok_tpu.cluster.sharding.layout import (
+    discover_shards,
+    shard_dir,
+    shard_pitr_dir,
+    shard_state_path,
+    shard_wal_path,
+)
+from kwok_tpu.cluster.sharding.recovery import aggregate_reports
+from kwok_tpu.cluster.sharding.router import (
+    RvSource,
+    ShardedStore,
+    split_state,
+)
+from kwok_tpu.cluster.store import RecoveryReport, ResourceStore
+from kwok_tpu.cluster.wal import SnapshotCorruption, WriteAheadLog
+from kwok_tpu.snapshot.pitr import PitrArchive, boot_recover
+
+__all__ = [
+    "archive_sharded_snapshot",
+    "build_sharded_state",
+    "open_sharded_store",
+]
+
+
+def open_sharded_store(
+    workdir: str,
+    n: int,
+    clock=None,
+    namespace_finalizers: bool = True,
+    watch_high_water: Optional[int] = None,
+    wal_fsync: str = "interval",
+    wal_segment_bytes: int = 0,
+    pitr: bool = True,
+) -> Dict[str, Any]:
+    """The apiserver daemon's sharded boot: per shard, snapshot-then-
+    WAL recovery with PITR fallback (``boot_recover``), then a live
+    WAL attached.
+
+    Returns ``{"store", "wals", "boots", "reports", "report",
+    "pitrs"}``; the caller owns the save loop (per-shard snapshots +
+    compaction + pruning, ``kwok_tpu/cmd/apiserver.py``)."""
+    n = max(1, int(n))
+    # the shard count is fixed at creation — placement is a pure hash
+    # of (namespace, kind, N), so booting an existing workdir under a
+    # different N silently mis-routes: a too-small N strands whole
+    # shards' objects from every routed read, a too-large N leaves the
+    # restored objects on shard 0 while lookups (and same-name
+    # creates) go to the hash's shard.  Refuse loudly instead.
+    existing = discover_shards(workdir)
+    if existing > 1 and n != existing:
+        raise ValueError(
+            f"workdir {workdir} holds {existing} shards; booting it "
+            f"with --store-shards {n} would mis-route every object "
+            "(resharding in place is not supported — restore a "
+            "snapshot into a freshly created cluster instead)"
+        )
+    if (
+        existing == 1
+        and n > 1
+        and (
+            os.path.exists(shard_state_path(workdir, 0))
+            or os.path.exists(shard_wal_path(workdir, 0))
+        )
+    ):
+        raise ValueError(
+            f"workdir {workdir} holds an existing single-store layout; "
+            f"booting it with --store-shards {n} would strand its "
+            "objects on shard 0 (resharding in place is not supported "
+            "— restore a snapshot into a freshly created cluster "
+            "instead)"
+        )
+    source = RvSource()
+    shards: List[ResourceStore] = []
+    wals: List[WriteAheadLog] = []
+    boots: List[Dict[str, Any]] = []
+    reports: List[Optional[RecoveryReport]] = []
+    pitrs: List[Optional[PitrArchive]] = []
+    for i in range(n):
+        os.makedirs(shard_dir(workdir, i), exist_ok=True)
+        s = ResourceStore(
+            clock=clock,
+            namespace_finalizers=namespace_finalizers,
+            watch_high_water=watch_high_water,
+            rv_source=source if n > 1 else None,
+            uid_start=i if n > 1 else 0,
+            uid_step=n if n > 1 else 1,
+        )
+        pitr_root = shard_pitr_dir(workdir, i) if pitr else None
+        boot = boot_recover(
+            s,
+            shard_state_path(workdir, i),
+            shard_wal_path(workdir, i),
+            pitr_root=pitr_root,
+            rv_continuity=(n == 1),
+        )
+        wal = WriteAheadLog(
+            shard_wal_path(workdir, i),
+            fsync=wal_fsync,
+            **(
+                {"segment_bytes": wal_segment_bytes}
+                if wal_segment_bytes
+                else {}
+            ),
+            archive_dir=pitr_root,
+        )
+        s.attach_wal(wal)
+        shards.append(s)
+        wals.append(wal)
+        boots.append(boot)
+        reports.append(boot.get("recovery"))
+        pitrs.append(PitrArchive(pitr_root) if pitr_root else None)
+    agg = aggregate_reports(reports)
+    if n > 1:
+        shards[0].wal_missing_rvs += len(agg.missing_rvs)
+        # seed from the shards' own post-boot rvs, not just the WAL
+        # reports: a snapshot-only boot (state.json present, no WAL
+        # segments) yields no recovery report, and recovered_rv=0
+        # would leave the shared sequence at 0 while every shard sits
+        # at the restored rv — the next write would then re-issue rvs
+        # the restored objects already hold
+        source.advance_to(
+            max(agg.recovered_rv, *(s.resource_version for s in shards))
+        )
+    return {
+        "store": ShardedStore(shards, source),
+        "wals": wals,
+        "boots": boots,
+        "reports": reports,
+        "report": agg,
+        "pitrs": pitrs,
+    }
+
+
+def archive_sharded_snapshot(workdir: str, state: Dict[str, Any]) -> List[str]:
+    """Register one merged ``dump_state``-shaped snapshot in every
+    shard's PITR archive (``kwokctl snapshot save --pitr`` on a
+    sharded workdir): the state is split by the SAME placement hash
+    live traffic uses, so each shard's archive holds exactly the slice
+    its own WAL logs — a merged snapshot dropped whole into shard 0's
+    archive would mis-place every other shard's objects on restore.
+    Returns the per-shard archive file names."""
+    n = discover_shards(workdir)
+    slices = split_state(state, n)
+    names: List[str] = []
+    for i, piece in enumerate(slices):
+        names.append(
+            PitrArchive(shard_pitr_dir(workdir, i)).add_snapshot(piece)
+        )
+    return names
+
+
+def build_sharded_state(
+    workdir: str, to_rv: int
+) -> tuple:
+    """Point-in-time rebuild over a sharded workdir (``kwokctl
+    snapshot restore --to-rv`` twin of ``PitrArchive.build_state``):
+    each shard rebuilds its own slice from its archive + live WAL with
+    the per-shard continuity check off, plus two completeness gates:
+    per shard, a rebuild with NO base snapshot must hold its log back
+    to genesis (first retained frame at seq 1) — a shard whose base
+    was pruned or corrupted out from under the rebuild (e.g. the live
+    save loop's prune racing a restore) otherwise silently merges a
+    tail-only slice; across shards, every rv in ``(floor, to_rv]``
+    must be covered by some shard's retained records, where ``floor``
+    is the highest per-shard snapshot base (rvs at or below a shard's
+    own base are covered by its snapshot, and a lower-floor shard —
+    one whose save tick was skipped on a full disk — keeps everything
+    above its own base in its retained log, which the seq-1 gate and
+    its own corruption scan vouch for).  Returns ``(state, info)``
+    with the merged ``dump_state``-shaped state at ``to_rv``."""
+    n = discover_shards(workdir)
+    states: List[Dict[str, Any]] = []
+    infos: List[Dict[str, Any]] = []
+    union: set = set()
+    for i in range(n):
+        archive = PitrArchive(shard_pitr_dir(workdir, i))
+        st, info = archive.build_state(
+            int(to_rv),
+            live_wal=shard_wal_path(workdir, i),
+            rv_continuity=False,
+        )
+        union |= info.pop("_observed")
+        first_seq = info.pop("_first_seq")
+        if (
+            info["base_rv"] == 0
+            and first_seq is not None
+            and first_seq != 1
+        ):
+            raise SnapshotCorruption(
+                f"shard {i}: no base snapshot at or below rv {to_rv} "
+                f"and the retained log starts at seq {first_seq}, not "
+                "genesis — its early history was pruned or lost, so a "
+                "rebuild would silently drop part of this shard's slice"
+            )
+        states.append(st)
+        infos.append(info)
+    floor = max(info["base_rv"] for info in infos)
+    holes = [
+        rv for rv in range(floor + 1, int(to_rv) + 1) if rv not in union
+    ]
+    if holes:
+        raise SnapshotCorruption(
+            f"rv {to_rv} is below the sharded archive's retention floor "
+            f"(rvs {holes[:10]}{'...' if len(holes) > 10 else ''} are not "
+            "in any shard's retained log)"
+        )
+    objects: List[dict] = []
+    for st in states:
+        objects.extend(st.get("objects", []))
+    merged = {
+        "resourceVersion": int(to_rv),
+        "uidCounter": max(int(st.get("uidCounter", 0)) for st in states),
+        "types": next(
+            (st["types"] for st in states if st.get("types")), []
+        ),
+        "objects": objects,
+    }
+    info = {
+        "shards": n,
+        "base_rv": floor,
+        "to_rv": int(to_rv),
+        "built_rv": int(to_rv),
+        "applied_records": sum(i["applied_records"] for i in infos),
+        "corruptions": [c for i in infos for c in i["corruptions"]],
+        "torn_tail": sum(i["torn_tail"] for i in infos),
+        "per_shard": infos,
+    }
+    return merged, info

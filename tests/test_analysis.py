@@ -239,6 +239,46 @@ def test_store_boundary_client_receiver_also_guarded(tmp_path):
     assert len(fs) == 1 and "client._conn" in fs[0].message
 
 
+def test_store_boundary_shard_internals_any_receiver(tmp_path):
+    """_shards/_shard_* are flagged even on a non-storeish receiver
+    (shard placement is a cluster/ implementation detail), while an
+    unrelated _shard-prefixed attribute like _sharded_ticks is not."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/c.py": """
+            def f(router, sim):
+                sim._sharded_ticks += 1     # unrelated: fine
+                router.shard_lane(0)        # public seam: fine
+                return router._shards[0]    # internal: flagged
+            """,
+            # inside cluster/: owns the internals
+            "kwok_tpu/cluster/x.py": "def g(r):\n    return r._shards\n",
+        },
+    )
+    fs = run_rules(root, ["store-boundary"])
+    assert len(fs) == 1 and "router._shards" in fs[0].message
+
+
+def test_layering_cluster_sharding_is_own_sublayer(tmp_path):
+    """cluster core modules must not import the sharding router
+    (upward); the router importing core cluster is fine."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/store.py": (
+                "import kwok_tpu.cluster.sharding.router\n"
+            ),
+            "kwok_tpu/cluster/sharding/router.py": (
+                "import kwok_tpu.cluster.wal\n"
+            ),
+            "kwok_tpu/cluster/wal.py": "",
+        },
+    )
+    fs = run_rules(root, ["layering"])
+    assert len(fs) == 1 and "cluster/store.py" in fs[0].path
+
+
 # ---------------------------------------------------------- lock-discipline
 
 

@@ -98,6 +98,18 @@ def test_create_cluster_ha_golden(home):
     check_golden("create_cluster_ha.txt", got)
 
 
+def test_create_cluster_sharded_golden(home):
+    """--store-shards N: only the apiserver argv grows the shard
+    count — scheduler/kcm discover the shard set at runtime via
+    ``GET /shards`` and need no flag."""
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "cluster",
+         "--store-shards", "2"],
+    )
+    check_golden("create_cluster_sharded.txt", got)
+
+
 def test_create_cluster_no_leader_elect_golden(home):
     got = run_dry(
         home,

@@ -145,6 +145,26 @@ def test_watch_rv_monotonic_checker():
     )
 
 
+def test_watch_rv_monotonic_checker_sharded_is_per_object():
+    """A sharded run's merged watch promises per-object ordering only:
+    cross-object interleaving is legal, a per-object regression is
+    not; the same interleaving on a 1-shard record still violates the
+    single store's global order."""
+    interleaved = [[("a/x", 5), ("b/y", 3), ("a/x", 7), ("b/y", 6)]]
+    ok = _record(Trace(), streams=interleaved, store_shards=4)
+    assert not run_checks(ok, ["watch-rv-monotonic"])
+    single = _record(Trace(), streams=interleaved, store_shards=1)
+    out = run_checks(single, ["watch-rv-monotonic"])
+    assert "not strictly increasing" in out["watch-rv-monotonic"][0]
+    bad = _record(
+        Trace(),
+        streams=[[("a/x", 5), ("b/y", 3), ("a/x", 5)]],
+        store_shards=4,
+    )
+    out = run_checks(bad, ["watch-rv-monotonic"])
+    assert "per-object order violated" in out["watch-rv-monotonic"][0]
+
+
 def test_lost_write_and_trace_complete_checkers():
     rec = _record(
         Trace(),
@@ -250,8 +270,13 @@ def test_partial_gang_regression_is_caught_and_replays_identically():
     """Acceptance gate for the gang engine: un-atomic the bind lane
     (--dst-bug partial-gang: per-pod patches instead of one txn) and
     the seed search must find a crash window that strands a bound
-    strict subset — and the violating seed must replay exactly."""
-    opts = SimOptions(bug="partial-gang")
+    strict subset — and the violating seed must replay exactly.
+    Pinned to the single-store composition: the bug lives in the
+    engine's bind lane, and the 1-shard fault schedule is the one
+    whose seeds land a crash inside the per-pod bind window (the
+    sharded router has its own injected regression,
+    --dst-bug cross-shard-txn)."""
+    opts = SimOptions(bug="partial-gang", store_shards=1)
     caught = None
     for seed in range(10):
         r = run_seed(seed, opts)
@@ -261,6 +286,30 @@ def test_partial_gang_regression_is_caught_and_replays_identically():
     assert caught is not None, "seed search never caught partial-gang"
     seed, first = caught
     assert "gang-atomicity" in first["violations"]
+    replay = run_seed(seed, opts)
+    assert replay["trace_digest"] == first["trace_digest"]
+    assert replay["violations"] == first["violations"]
+
+
+def test_cross_shard_txn_regression_is_caught_and_replays_identically():
+    """Acceptance gate for the sharded router: --dst-bug
+    cross-shard-txn stripes txn ops across shards and commits
+    per-shard sub-txns in sequence — the committed prefix strands a
+    bound strict subset, which the gang-atomicity invariant must flag
+    on the default (sharded) composition, reproducibly."""
+    opts = SimOptions(bug="cross-shard-txn")
+    caught = None
+    for seed in range(3):
+        r = run_seed(seed, opts)
+        if r["violations"]:
+            caught = (seed, r)
+            break
+    assert caught is not None, "seed search never caught cross-shard-txn"
+    seed, first = caught
+    assert "gang-atomicity" in first["violations"]
+    assert any(
+        "strict subset" in v for v in first["violations"]["gang-atomicity"]
+    )
     replay = run_seed(seed, opts)
     assert replay["trace_digest"] == first["trace_digest"]
     assert replay["violations"] == first["violations"]
