@@ -76,6 +76,12 @@ STORE_WRITERS = int(os.environ.get("BENCH_STORE_WRITERS", 4))
 #: wall budget for the routed-HTTP baseline leg (it is the slow one —
 #: the whole point of the A/B)
 STORE_HTTP_BUDGET_S = float(os.environ.get("BENCH_STORE_HTTP_BUDGET_S", 45))
+#: SLO-telemetry overhead guard: pods pushed through the bulk lane
+#: with instrumentation armed vs disarmed (0 disables the section;
+#: scales down with BENCH_PODS so check.sh's smoke stays fast)
+OBS_PODS = int(
+    os.environ.get("BENCH_OBS_PODS", min(40_000, max(5_000, N_PODS)))
+)
 
 
 def run_overload_bench() -> dict:
@@ -261,6 +267,98 @@ def run_store_bench() -> dict:
             "sharded1_tps": round(one_tps),
             "ratio": round(ratio, 2),
         },
+    }
+
+
+def run_obs_bench() -> dict:
+    """SLO-telemetry overhead guard (the observability tentpole's
+    don't-regress contract): the same WAL-backed, watched bulk-lane
+    create wave with instrumentation ARMED vs DISARMED, asserted
+    within 5%.
+
+    The workload deliberately maximizes the instrumented surface: a
+    WAL is attached (per-batch append observation) and a live watcher
+    subscribes (per-event commit-time notes feeding the delivery-lag
+    series) — the costliest observation paths the armed cluster pays.
+    Best-of-3 alternating runs with fresh stores: single runs on the
+    shared 1-core host skew past the 5% band on noise alone."""
+    import gc
+    import tempfile
+    import threading
+
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.cluster.wal import WriteAheadLog
+    from kwok_tpu.utils import telemetry
+
+    batch = 5_000
+
+    def ops_for(start, n):
+        return [
+            {
+                "verb": "create",
+                "data": {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": f"obs-{start + j}", "namespace": "default"},
+                    "spec": {"nodeName": "node-0"},
+                    "status": {},
+                },
+            }
+            for j in range(n)
+        ]
+
+    def one_run(tmpdir, tag) -> float:
+        store = ResourceStore()
+        wal = WriteAheadLog(os.path.join(tmpdir, f"wal-{tag}.jsonl"))
+        store.attach_wal(wal)
+        watcher = store.watch("Pod")
+        stop = threading.Event()
+
+        def drain():
+            while not stop.is_set():
+                if not watcher.drain():
+                    watcher.next(timeout=0.05)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        t0 = time.time()
+        done = 0
+        while done < OBS_PODS:
+            n = min(batch, OBS_PODS - done)
+            store.bulk(ops_for(done, n), copy_results=False)
+            done += n
+        secs = time.time() - t0
+        stop.set()
+        watcher.stop()
+        t.join(timeout=2)
+        del store, wal
+        gc.collect()
+        return done / secs if secs else 0.0
+
+    armed_tps = disarmed_tps = 0.0
+    with tempfile.TemporaryDirectory() as tmpdir:
+        for i in range(3):
+            prev = telemetry.set_enabled(False)
+            try:
+                disarmed_tps = max(disarmed_tps, one_run(tmpdir, f"off-{i}"))
+            finally:
+                telemetry.set_enabled(prev)
+            telemetry.set_enabled(True)
+            try:
+                armed_tps = max(armed_tps, one_run(tmpdir, f"on-{i}"))
+            finally:
+                telemetry.set_enabled(prev)
+    overhead = 1.0 - armed_tps / max(1.0, disarmed_tps)
+    assert armed_tps >= 0.95 * disarmed_tps, (
+        f"telemetry overhead {overhead * 100:.1f}% exceeds the 5% "
+        f"budget ({armed_tps:.0f} armed vs {disarmed_tps:.0f} "
+        "disarmed pods/s)"
+    )
+    return {
+        "pods": OBS_PODS,
+        "armed_tps": round(armed_tps),
+        "disarmed_tps": round(disarmed_tps),
+        "overhead_pct": round(overhead * 100, 2),
     }
 
 
@@ -765,6 +863,18 @@ def main() -> int:
 
                 traceback.print_exc()
                 out["store"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if OBS_PODS > 0:
+            # SLO-telemetry overhead A/B: the instrumented bulk lane
+            # must stay within 5% of the disarmed one (the observed-
+            # histogram layer's don't-regress guard)
+            try:
+                out["obs"] = run_obs_bench()
+            except (Exception, AssertionError) as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                out["obs"] = {"error": f"{type(e).__name__}: {e}"}
 
         if OVERLOAD_S > 0:
             # degradation trajectory: a short seeded best-effort flood

@@ -115,6 +115,7 @@ def all_rules() -> "dict[str, object]":
         layering,
         lock_discipline,
         lock_order,
+        metric_cardinality,
         parity_citations,
         store_boundary,
         swallowed_errors,
@@ -129,6 +130,7 @@ def all_rules() -> "dict[str, object]":
         "store-boundary": store_boundary.analyze,
         "lock-discipline": lock_discipline.analyze,
         "lock-order": lock_order.analyze,
+        "metric-cardinality": metric_cardinality.analyze,
         "tracer-safety": tracer_safety.analyze,
         "parity-citations": parity_citations.analyze,
         "swallowed-errors": swallowed_errors.analyze,

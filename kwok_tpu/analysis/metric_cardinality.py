@@ -1,0 +1,254 @@
+"""Metric-cardinality analyzer: bounded label sets only.
+
+Prometheus label values multiply series: a per-object value (a pod
+name, a uid, a namespace) used as a metric label value turns one
+histogram into millions of them — the classic cardinality explosion
+that kills a scrape pipeline at exactly the scale this repo simulates
+(1M pods).  The SLO telemetry layer (``kwok_tpu/utils/telemetry.py:1``)
+therefore labels only with bounded vocabularies (verbs, kinds, APF
+levels, shard indexes, stage names), and this rule mechanizes the
+convention for the layers that observe on hot paths.
+
+Scope: ``kwok_tpu/cluster/``, ``kwok_tpu/controllers/``,
+``kwok_tpu/sched/``.  A finding fires when an expression *tainted by
+per-object identity* — a ``.get("name"|"uid"|"namespace")`` reach, a
+``["name"]``-style subscript, or an f-string interpolating either
+(tracked through simple same-scope assignments) — is used in a metric
+label position:
+
+- a ``const_labels=`` / ``labels=`` keyword value (collector
+  constructors and helpers),
+- a label-value argument of a telemetry ``observe(value, *labels)``
+  call (everything after the first argument),
+- a registry ``register`` / ``get_or_register`` key (keys embed label
+  values by convention — ``kwok_tpu/metrics/collectors.py:180``).
+
+Per-object detail belongs in the flight recorder's bounded debug ring
+or in trace span attributes, never in label space.  Deliberately
+bounded exceptions (e.g. the election-lease gauges: one Lease per
+control-plane seat) carry ``# kwoklint: disable=metric-cardinality``
+with the reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from kwok_tpu.analysis import Finding, SourceFile
+
+RULE = "metric-cardinality"
+
+SCOPE = (
+    "kwok_tpu/cluster/",
+    "kwok_tpu/controllers/",
+    "kwok_tpu/sched/",
+)
+
+#: metadata keys whose values are per-object identity
+_IDENTITY_KEYS = {"name", "uid", "namespace", "generateName"}
+
+#: call attributes whose non-first arguments are label values
+_OBSERVE_ATTRS = {"observe"}
+
+#: call attributes whose FIRST argument is a collector key (label
+#: values embedded by convention)
+_REGISTER_ATTRS = {"register", "get_or_register"}
+
+#: keyword names that carry label mappings
+_LABEL_KWARGS = {"const_labels", "labels", "labelvalues"}
+
+_MSG = (
+    "per-object identity ({what}) used as a metric label value — label "
+    "sets must be bounded (verbs/kinds/levels/shards/stages); put "
+    "per-object detail in the flight recorder or trace attributes "
+    "instead"
+)
+
+
+def _const_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Scope:
+    """One function (or module) body's forward taint pass."""
+
+    def __init__(self):
+        self.tainted: Set[str] = set()
+
+    def expr_taint(self, node: ast.AST) -> Optional[str]:
+        """A human-readable taint witness for this expression, or
+        None when it is not object-identity derived."""
+        if isinstance(node, ast.Name):
+            return f"variable '{node.id}'" if node.id in self.tainted else None
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "get"
+                and node.args
+            ):
+                key = _const_key(node.args[0])
+                if key in _IDENTITY_KEYS:
+                    return f'.get("{key}") reach'
+            if isinstance(fn, ast.Attribute) and fn.attr == "format":
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    w = self.expr_taint(a)
+                    if w:
+                        return w
+                # str.format on a tainted receiver template is inert;
+                # the VALUES carry the identity
+                return None
+            for a in node.args:
+                w = self.expr_taint(a)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.Subscript):
+            key = _const_key(node.slice)
+            if key in _IDENTITY_KEYS:
+                return f'["{key}"] subscript'
+            return self.expr_taint(node.value)
+        if isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    w = self.expr_taint(part.value)
+                    if w:
+                        return f"f-string over {w}"
+            return None
+        if isinstance(node, ast.BinOp):
+            return self.expr_taint(node.left) or self.expr_taint(node.right)
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                w = self.expr_taint(v)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.IfExp):
+            return self.expr_taint(node.body) or self.expr_taint(node.orelse)
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                if v is None:
+                    continue
+                w = self.expr_taint(v)
+                if w:
+                    return w
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for v in node.elts:
+                w = self.expr_taint(v)
+                if w:
+                    return w
+            return None
+        if isinstance(node, ast.Attribute):
+            return self.expr_taint(node.value)
+        if isinstance(node, ast.Starred):
+            return self.expr_taint(node.value)
+        return None
+
+
+def _check_call(scope: _Scope, node: ast.Call, sf, findings: List[Finding]) -> None:
+    fn = node.func
+    attr = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", "")
+
+    # label-mapping keywords on any call (collector ctors, helpers);
+    # anchored to the keyword's own line so a trailing suppression on
+    # that line covers it even in a multi-line call
+    for kw in node.keywords:
+        if kw.arg in _LABEL_KWARGS:
+            w = scope.expr_taint(kw.value)
+            if w:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=kw.value.lineno,
+                        message=_MSG.format(what=w),
+                    )
+                )
+
+    # telemetry observe(value, *labelvalues): labels are args[1:]
+    if attr in _OBSERVE_ATTRS and len(node.args) > 1:
+        for a in node.args[1:]:
+            w = scope.expr_taint(a)
+            if w:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.path,
+                        line=node.lineno,
+                        message=_MSG.format(what=w),
+                    )
+                )
+
+    # registry keys embed label values by convention
+    if attr in _REGISTER_ATTRS and node.args:
+        w = scope.expr_taint(node.args[0])
+        if w:
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.path,
+                    line=node.lineno,
+                    message=_MSG.format(what=w),
+                )
+            )
+
+
+def _walk_scope(body: List[ast.stmt], sf, findings: List[Finding]) -> None:
+    """Forward pass over one scope's statements: grow the taint set
+    from assignments, check every call, recurse into nested scopes with
+    a fresh taint set (conservative: outer taints rarely matter and a
+    fresh set keeps the pass linear)."""
+    scope = _Scope()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _walk_scope(node.body, sf, findings)
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Assign):
+            w = scope.expr_taint(node.value)
+            if w:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        scope.tainted.add(tgt.id)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and scope.expr_taint(node.value):
+                scope.tainted.add(node.target.id)
+        elif isinstance(node, ast.AnnAssign):
+            if (
+                node.value is not None
+                and isinstance(node.target, ast.Name)
+                and scope.expr_taint(node.value)
+            ):
+                scope.tainted.add(node.target.id)
+        if isinstance(node, ast.Call):
+            _check_call(scope, node, sf, findings)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in body:
+        visit(stmt)
+
+
+def analyze(files: List[SourceFile], config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.path.startswith(SCOPE):
+            continue
+        _walk_scope(sf.tree.body, sf, findings)
+    # one report per (path, line): a tainted dict used twice on one
+    # call line must not double-report
+    seen = set()
+    out: List[Finding] = []
+    for f in findings:
+        key = (f.path, f.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    return out

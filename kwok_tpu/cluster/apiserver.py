@@ -52,6 +52,7 @@ from typing import Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlsplit
 
 from kwok_tpu.cluster.flowcontrol import FlowRejected, expose_metrics
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.cluster.k8s_api import (
     PATCH_CONTENT_TYPES,
     K8sFacade,
@@ -62,6 +63,7 @@ from kwok_tpu.cluster.k8s_api import (
 from kwok_tpu.cluster.store import (
     ResourceStore,
     ResourceType,
+    observe_watch_delivery,
 )
 
 __all__ = ["APIServer", "PATCH_CONTENT_TYPES"]
@@ -82,6 +84,59 @@ _FLOW_EXEMPT = {"healthz", "readyz", "livez", "metrics"}
 #: every watch at --min-request-timeout-ish horizons and clients resume
 #: transparently; this bounds how long a dead peer can pin a thread
 DEFAULT_WATCH_TIMEOUT = 3600.0
+
+#: observed request-duration histogram (SLO telemetry; the
+#: apiserver_request_duration_seconds analog).  Labels are all drawn
+#: from bounded sets: HTTP verb, route-derived resource plural (the
+#: registered-type registry), APF priority level, and the direct-
+#: dispatch shard index ("-" off the /shards lanes).
+_H_REQ = _telemetry.histogram(
+    "kwok_apiserver_request_duration_seconds",
+    help="observed request duration (admission wait included; watches excluded)",
+    labelnames=("verb", "kind", "level", "shard"),
+    # the legitimate label product (verbs x registered kinds x levels
+    # x shards) is wide; the cap stays a leak backstop, not a quota
+    max_children=512,
+)
+
+#: non-resource route heads that may appear as a ``kind`` label; any
+#: other unrecognized path collapses to one junk bucket so a client
+#: spraying 404 paths cannot mint label values
+_ROUTE_HEADS = frozenset(
+    {
+        "r",
+        "api",
+        "apis",
+        "bulk",
+        "txn",
+        "shards",
+        "state",
+        "stats",
+        "debug",
+        "dashboard",
+        "version",
+        "openapi",
+    }
+)
+
+def _route_kind(head: str, rest: list) -> str:
+    """Bounded ``kind`` label for a request path: the resource plural
+    for resource routes (legacy ``/r/{plural}`` and both k8s dialect
+    shapes), else the route head.  Object names/namespaces NEVER reach
+    the label (kwoklint ``metric-cardinality``) — only fixed path
+    positions that hold resource words do."""
+    if head == "r":
+        return rest[0] if rest else "r"
+    if head in ("api", "apis"):
+        # /api/v1/... vs /apis/{group}/{version}/...
+        parts = rest[1:] if head == "api" else rest[2:]
+        if not parts:
+            return head
+        if parts[0] == "namespaces":
+            # /namespaces/{ns}/{resource}[/...]; bare /namespaces[/{n}]
+            return parts[2] if len(parts) >= 3 else "namespaces"
+        return parts[0]
+    return head
 
 
 def _traced(fn):
@@ -310,32 +365,91 @@ class _Handler(BaseHTTPRequestHandler):
             return
         flow = getattr(self.server, "flow", None)
         self._flow_level = None
-        if flow is None:
-            inner()
-            return
-        head, _rest, q = self._route()
-        if head in _FLOW_EXEMPT:
-            inner()
-            return
-        cid = self.headers.get("X-Kwok-Client") or ""
-        self._flow_level = flow.classify(cid)
+        head, rest, q = self._route()
+        # watches are long-running (minutes of held connection): their
+        # duration is a stream lifetime, not a latency — they stay out
+        # of the request histogram, same as real APF's WATCH exemption.
+        # Exempt heads (healthz/metrics) stay unobserved too so the
+        # scrape loop does not dominate the distribution.
+        observe = (
+            q.get("watch") not in ("1", "true")
+            and head not in _FLOW_EXEMPT
+        )
+        t_req0 = time.monotonic()
         try:
-            ticket = flow.admit(
-                cid,
-                self.command,
-                self.path,
-                # same truthiness as both dialects' watch routing —
-                # "watch=false" is an ordinary (seat-holding) list
-                long_running=q.get("watch") in ("1", "true"),
-                level=self._flow_level,
-            )
-        except FlowRejected as rej:
-            self._send_shed(rej)
-            return
-        try:
-            inner()
+            if flow is None or head in _FLOW_EXEMPT:
+                inner()
+                return
+            cid = self.headers.get("X-Kwok-Client") or ""
+            self._flow_level = flow.classify(cid)
+            try:
+                ticket = flow.admit(
+                    cid,
+                    self.command,
+                    self.path,
+                    # same truthiness as both dialects' watch routing —
+                    # "watch=false" is an ordinary (seat-holding) list
+                    long_running=q.get("watch") in ("1", "true"),
+                    level=self._flow_level,
+                )
+            except FlowRejected as rej:
+                # sheds are counted by the rejected counter; observing
+                # their queue wait as a "request duration" would read
+                # as served-request latency (real APF excludes them)
+                observe = False
+                self._send_shed(rej)
+                return
+            try:
+                inner()
+            finally:
+                flow.release(ticket)
         finally:
-            flow.release(ticket)
+            if observe and _telemetry.enabled():
+                self._observe_request(head, rest, t_req0)
+
+    def _observe_request(self, head: str, rest: list, t0: float) -> None:
+        """Observed request duration (bounded labels) plus the flight
+        recorder's threshold-gated slow-request sample — the sample
+        keeps the raw path and the request's trace id as the exemplar
+        linking the latency outlier to its distributed trace."""
+        dur = time.monotonic() - t0
+        shard = "-"
+        if head == "shards" and rest and str(rest[0]).isdigit():
+            # same bounded-label discipline as the kind below: the
+            # digit string is client-supplied, so only indexes the
+            # store actually has become label values ("007" and
+            # out-of-range spray collapse instead of minting children)
+            idx = int(rest[0])
+            n = int(getattr(self.store, "shard_count", 0) or 0)
+            shard = str(idx) if 0 <= idx < n else "(invalid)"
+        level = self._flow_level or "-"
+        kind = _route_kind(head, rest)
+        # the kind label must come from the BOUNDED registered-type
+        # registry (or the fixed route-head set) — path segments are
+        # client-supplied, and 404-spraying junk paths must collapse
+        # into one bucket instead of minting label values until the
+        # family's child cap folds every legit series into "(other)"
+        if head not in _ROUTE_HEADS:
+            kind = "(unknown)"
+        elif kind not in _ROUTE_HEADS:
+            try:
+                self.store.resource_type(kind)
+            except Exception:  # noqa: BLE001 — NotFound on junk plurals
+                kind = "(unknown)"
+        _H_REQ.observe(dur, self.command, kind, level, shard)
+        rec = _telemetry.flight_recorder()
+        tid = ""
+        if dur >= rec.slow_threshold_s:
+            # the exemplar is only worth computing for a sample the
+            # ring will actually keep
+            from kwok_tpu.utils.trace import from_traceparent, peek_global
+
+            tid = from_traceparent(self.headers.get("traceparent"))[0] or ""
+            if not tid:
+                tracer = peek_global()
+                cur = tracer.current() if tracer is not None else None
+                tid = cur.trace_id if cur is not None else ""
+        rec.note_request(self.command, self.path, level, dur, trace_id=tid)
 
     def _send_shed(self, rej: FlowRejected) -> None:
         """429 with Retry-After — the graceful-shedding contract: the
@@ -446,7 +560,19 @@ class _Handler(BaseHTTPRequestHandler):
                     # bytes, last-fsync age, recovery/corruption
                     # counters (kwokctl get components reads these)
                     body["wal"] = wal
+                lat = _telemetry.registry().summary()
+                if lat:
+                    # compact per-family p50/p99 of the observed SLO
+                    # histograms (kwokctl get components renders the
+                    # request-duration row as its latency column)
+                    body["latency"] = lat
                 self._send_json(200, body)
+            elif head == "debug" and rest == ["flightrecorder"]:
+                # the flight recorder: last-N tick stage breakdowns +
+                # slow-request samples (trace-id exemplars), bounded
+                # ring — the after-the-fact answer to "what was slow
+                # two minutes ago" without a profiler attached
+                self._send_json(200, _telemetry.flight_recorder().dump())
             elif head == "r" and len(rest) == 1:
                 # canonical watch values only — must stay in lockstep
                 # with _dispatch's long-running classification, or a
@@ -701,6 +827,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # drain the burst (e.g. a bulk tick's worth of MODIFIED
                 # events) into one buffered write + single flush
                 buf = [self._encode_line({"type": ev.type, "object": ev.object, "rv": ev.rv})]
+                last_rv = ev.rv
                 while len(buf) < 512:
                     ev = w.next(timeout=0)
                     if ev is None:
@@ -710,8 +837,12 @@ class _Handler(BaseHTTPRequestHandler):
                             {"type": ev.type, "object": ev.object, "rv": ev.rv}
                         )
                     )
+                    last_rv = ev.rv
                 self.wfile.write(b"".join(buf))
                 self.wfile.flush()
+                # observed rv-commit -> delivery lag, one sample per
+                # flushed burst (shared with the k8s dialect)
+                observe_watch_delivery(self.store, last_rv)
         except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
             pass
         finally:

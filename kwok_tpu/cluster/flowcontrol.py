@@ -35,11 +35,23 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils.locks import make_lock
+
+#: observed seat-wait latency per priority level (SLO telemetry): how
+#: long admission held a request before granting its seat — ~0 for an
+#: uncontended level, up to queue_wait_s under load.  Only admitted
+#: requests observe; sheds are counted by the rejected counter.
+_H_QWAIT = _telemetry.histogram(
+    "kwok_apiserver_flow_queue_wait_seconds",
+    help="APF admission wait from arrival to seat grant",
+    labelnames=("level",),
+)
 
 __all__ = [
     "PriorityLevel",
@@ -353,6 +365,7 @@ class FlowController:
         lvl = self._levels[level]
         ticket = _Ticket(level)
         waiter: Optional[_Waiter] = None
+        t_admit0 = time.monotonic()
         with self._mut:
             if lvl.inflight < lvl.seats:
                 # queues non-empty implies inflight == seats (release
@@ -397,6 +410,9 @@ class FlowController:
                         f"{lvl.spec.queue_wait_s}s",
                     )
                 lvl.dispatched += 1
+        # observed seat-wait (immediate grants land in the first bucket;
+        # queued grants report their real wait).  Observation-only.
+        _H_QWAIT.observe(time.monotonic() - t_admit0, level)
         if long_running:
             self.release(ticket)
         return ticket
@@ -498,7 +514,40 @@ def expose_metrics(flow: Optional[FlowController], store=None) -> str:
         reg.register("kwok_apiserver_resource_version", rv)
         _expose_wal(reg, store, Gauge)
         _expose_election(reg, store, Gauge)
-    return reg.expose()
+    _expose_tracer(reg, Counter)
+    # observed SLO histograms (utils/telemetry): request duration, APF
+    # queue wait, WAL append/fsync, watch delivery lag, scheduler bind
+    # latency, tick stages — whatever this process observed, appended
+    # so one scrape covers synthetic and observed series alike
+    return reg.expose() + _telemetry.registry().expose()
+
+
+def _expose_tracer(reg, Counter) -> None:
+    """Span-exporter health from the process-global tracer (None when
+    the process never configured one): dropped-vs-exported counters, so
+    a dead collector or a full buffer is visible at /metrics instead of
+    silently eating spans (utils/trace.py logs each outage edge once)."""
+    from kwok_tpu.utils.trace import peek_global
+
+    tracer = peek_global()
+    if tracer is None:
+        return
+    stats = tracer.stats()
+    for mname, key, help_ in (
+        (
+            "kwok_tracer_dropped_spans_total",
+            "dropped",
+            "spans dropped (buffer full or collector unreachable)",
+        ),
+        (
+            "kwok_tracer_exported_spans_total",
+            "exported",
+            "spans delivered to the OTLP collector",
+        ),
+    ):
+        c = Counter(mname, help=help_)
+        c.set(stats[key])
+        reg.register(mname, c)
 
 
 def _expose_wal(reg, store, Gauge) -> None:
@@ -550,6 +599,9 @@ def _expose_election(reg, store, Gauge) -> None:
         leases, _rv = store.list("Lease", namespace="kube-system")
     except Exception:  # noqa: BLE001 — Lease kind may be unregistered
         return
+    # these lease "names" are a BOUNDED set — one election Lease per
+    # control-plane seat (kwok/kcm/scheduler), never per-object — so
+    # the per-lease labels below are deliberate cardinality exceptions
     for lease in leases:
         name = (lease.get("metadata") or {}).get("name") or ""
         spec = lease.get("spec") or {}
@@ -558,19 +610,21 @@ def _expose_election(reg, store, Gauge) -> None:
         g = Gauge(
             "kwok_leader_election_transitions",
             help="lease transitions (leadership takeovers)",
-            const_labels=labels,
+            const_labels=labels,  # kwoklint: disable=metric-cardinality — one election Lease per seat
         )
         try:
             g.set(int(spec.get("leaseTransitions") or 0))
         except (TypeError, ValueError):
             g.set(0)
+        # kwoklint: disable=metric-cardinality — one election Lease per seat
         reg.register(f"kwok_leader_election_transitions{name}", g)
         age = wall_age(spec.get("renewTime"))
         if age is not None:
             a = Gauge(
                 "kwok_leader_election_renew_age_seconds",
                 help="seconds since the holder last renewed",
-                const_labels=labels,
+                const_labels=labels,  # kwoklint: disable=metric-cardinality — one election Lease per seat
             )
             a.set(round(age, 3))
+            # kwoklint: disable=metric-cardinality — one election Lease per seat
             reg.register(f"kwok_leader_election_renew_age_seconds{name}", a)

@@ -50,6 +50,7 @@ from kwok_tpu.cluster.store import (
     ResourceStore,
     ResourceType,
     StorageDegraded,
+    observe_watch_delivery,
     selector_to_string,
 )
 from kwok_tpu.cluster.tables import to_table, wants_table
@@ -995,6 +996,7 @@ class K8sFacade:
                     continue
                 idle = 0.0
                 buf = [self._encode_event(r.rtype, ev, as_table, include_object)]
+                last_rv = ev.rv
                 while len(buf) < 512:
                     ev = w.next(timeout=0)
                     if ev is None:
@@ -1002,8 +1004,12 @@ class K8sFacade:
                     buf.append(
                         self._encode_event(r.rtype, ev, as_table, include_object)
                     )
+                    last_rv = ev.rv
                 handler.wfile.write(b"".join(buf))
                 handler.wfile.flush()
+                # observed rv-commit -> delivery lag, one sample per
+                # flushed burst (shared with the legacy dialect)
+                observe_watch_delivery(self.store, last_rv)
         except (BrokenPipeError, ConnectionError, socket.timeout, OSError):
             pass
         finally:

@@ -207,6 +207,10 @@ def build_sharded_store(
         )
         for i in range(n)
     ]
+    for i, s in enumerate(shards):
+        # bounded shard index on the observed latency series (watch
+        # delivery lag; the on-disk composition also stamps its WALs)
+        s.telemetry_shard = i
     return ShardedStore(shards, source)
 
 
@@ -257,6 +261,20 @@ class ShardedStore:
             raise NotFound(
                 f"no shard {index} (store has {len(self._shards)})"
             )
+
+    def delivery_lag(self, rv: int):
+        """(seconds since rv committed, owning shard) for a recently
+        committed rv, or None — the sharded twin of
+        ``ResourceStore.delivery_lag``.  Every rv lives on exactly one
+        shard (one shared sequence), so the first ring that knows it
+        answers; the probe is O(shards) dict lookups and feeds the
+        ``kwok_watch_delivery_lag_seconds{shard=}`` series for events
+        delivered through the ``MergedWatcher`` fan-in."""
+        for s in self._shards:
+            lag = s.delivery_lag(rv)
+            if lag is not None:
+                return lag
+        return None
 
     def shard_topology(self) -> Dict[str, Any]:
         """The route table the per-shard HTTP dispatch lanes are

@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from kwok_tpu.cluster.wal import StorageDegraded, WalExhausted
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.locks import make_lock, make_rlock
 from kwok_tpu.utils.patch import apply_patch
@@ -48,6 +49,30 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 SYNC = "SYNC"  # informer re-list marker, never emitted by the store
+
+#: observed rv-commit -> watcher-delivery lag (SLO telemetry; shard
+#: labels attribute the sharded MergedWatcher fan-in path).  Both watch
+#: dialects feed this ONE family through observe_watch_delivery below.
+_H_WATCH_DELIVERY = _telemetry.histogram(
+    "kwok_watch_delivery_lag_seconds",
+    help="lag from rv commit to watch-stream delivery",
+    labelnames=("shard",),
+)
+
+
+def observe_watch_delivery(store, rv: int) -> None:
+    """One delivery-lag sample for a flushed watch burst: the store's
+    commit ring resolves the rv's commit instant (and owning shard, on
+    a sharded router); a miss just means the rv aged out of the
+    bounded ring.  Shared by both watch dialects
+    (``cluster/apiserver.py`` and ``cluster/k8s_api.py`` call it after
+    each burst flush) so the series can never diverge between them."""
+    if not _telemetry.enabled():
+        return
+    lag_fn = getattr(store, "delivery_lag", None)
+    hit = lag_fn(rv) if lag_fn is not None else None
+    if hit is not None:
+        _H_WATCH_DELIVERY.observe(hit[0], hit[1])
 
 #: the namespace-lifecycle finalizer (the apiserver's
 #: ``spec.finalizers: [kubernetes]`` analog; consumed by
@@ -682,6 +707,23 @@ class ResourceStore:
         )
         #: slow watchers evicted by backpressure (scraped via /metrics)
         self.watch_evictions = 0
+        #: which shard of a sharded composition this store is (bounded
+        #: histogram label; 0 = single store).  The sharding layer sets
+        #: it right after construction.
+        self.telemetry_shard = 0
+        #: rv -> monotonic commit instant for recently emitted events
+        #: (bounded ring, evicted FIFO): the watch servers look a
+        #: delivered event's rv up here to observe rv-commit ->
+        #: watcher-delivery lag.  Only populated while a watcher exists
+        #: and telemetry is armed, so watcher-less bulk loads pay one
+        #: branch per emit.  Mutated under the store mutex.
+        self._commit_ring: deque = deque()
+        self._commit_times: Dict[int, float] = {}
+        #: per-thread batch marker: inside bulk(), per-event commit
+        #: notes collapse into ONE note of the batch's last rv (same
+        #: cadence as status batches) so the drain-rate event stream
+        #: pays one ring insert per round-trip, not per event
+        self._tel_local = threading.local()
         #: storage-integrity counters (scraped via /metrics): tolerant
         #: recoveries run, mid-log corruptions detected, exact missing
         #: resourceVersions reported, and snapshot-fallback boots
@@ -910,6 +952,30 @@ class ResourceStore:
         ns = meta.get("namespace") or "" if st.rtype.namespaced else ""
         return (ns, meta.get("name") or "")
 
+    #: rv->commit-time ring bound: covers several seconds of peak event
+    #: flow; older deliveries just go unobserved (sampling, not error)
+    COMMIT_RING = 8192
+
+    def _note_commit(self, rv: int) -> None:
+        """Record the commit instant of an emitted rv (caller holds the
+        mutex and has checked a watcher exists).  Observation-only: the
+        watch servers turn this into the delivery-lag histogram."""
+        self._commit_times[rv] = time.monotonic()
+        ring = self._commit_ring
+        ring.append(rv)
+        if len(ring) > self.COMMIT_RING:
+            self._commit_times.pop(ring.popleft(), None)
+
+    def delivery_lag(self, rv: int) -> Optional[Tuple[float, int]]:
+        """(seconds since rv committed, shard index) for a recently
+        emitted rv, or None when it aged out of the ring (or was never
+        noted — no watcher / telemetry disarmed)."""
+        with self._mut:
+            t = self._commit_times.get(rv)
+        if t is None:
+            return None
+        return (time.monotonic() - t, self.telemetry_shard)
+
     def _emit(self, st: _TypeState, etype: str, obj: dict, rv: int) -> None:
         # the event shares the stored instance — the same
         # handed-out-by-reference contract apply_status_batch pins:
@@ -919,6 +985,13 @@ class ResourceStore:
         # drain cost at 1M objects.)
         ev = WatchEvent(type=etype, object=obj, rv=rv)
         st.history.append(ev)
+        if st.watchers and _telemetry.enabled():
+            tl = self._tel_local
+            if getattr(tl, "in_batch", False):
+                # deferred: bulk() notes the batch's last rv once
+                tl.batch_rv = rv
+            else:
+                self._note_commit(rv)
         for w in list(st.watchers):
             w._push(ev)
 
@@ -1686,6 +1759,14 @@ class ResourceStore:
                     )
                     if self._wal is not None:
                         self._wal_status_batch(kind, items, out)
+                    if _telemetry.enabled() and any(
+                        w is not exclude and w.status_interest
+                        for w in st.watchers
+                    ):
+                        # one commit-time note per batch (not per event:
+                        # a tick commits thousands) — delivery lag is
+                        # then measured against the batch's last rv
+                        self._note_commit(evs[-1].rv)
                     for w in list(st.watchers):
                         if w is not exclude and w.status_interest:
                             w._push_batch(evs)
@@ -1724,6 +1805,12 @@ class ResourceStore:
                 )
                 if self._wal is not None:
                     self._wal_status_batch(kind, items, out)
+                if _telemetry.enabled() and any(
+                    w is not exclude and w.status_interest
+                    for w in st.watchers
+                ):
+                    # same per-batch commit note as the fast lane above
+                    self._note_commit(evs[-1].rv)
                 for w in list(st.watchers):
                     if w is not exclude and w.status_interest:
                         w._push_batch(evs)
@@ -1835,9 +1922,19 @@ class ResourceStore:
             with self._mut:
                 self._check_writable()
             self._wal_local.buf = []
+        tl = self._tel_local
+        tl.in_batch = True
+        tl.batch_rv = None
         try:
             self._bulk_ops(ops, results, copy_results)
         finally:
+            tl.in_batch = False
+            if tl.batch_rv is not None:
+                # one delivery-lag commit note per batch (the status-
+                # batch cadence): the last rv stands in for the burst
+                with self._mut:
+                    self._note_commit(tl.batch_rv)
+                tl.batch_rv = None
             if defer_wal:
                 buf = self._wal_local.buf
                 self._wal_local.buf = None

@@ -82,6 +82,23 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from kwok_tpu.utils import telemetry as _telemetry
+
+#: observed storage-latency histograms (SLO telemetry; shard is the
+#: bounded sharded-store index, 0 for the single-store layout).  The
+#: append series covers the whole framed write (encode excluded, policy
+#: fsync included); the fsync series isolates the os.fsync syscall.
+_H_APPEND = _telemetry.histogram(
+    "kwok_wal_append_seconds",
+    help="WAL append latency (framed write + flush + policy fsync)",
+    labelnames=("shard",),
+)
+_H_FSYNC = _telemetry.histogram(
+    "kwok_wal_fsync_seconds",
+    help="WAL fsync syscall latency",
+    labelnames=("shard",),
+)
+
 __all__ = [
     "WalCorruption",
     "SnapshotCorruption",
@@ -555,6 +572,11 @@ class WriteAheadLog:
         self.path = path
         self.fsync = fsync
         self.fsync_interval = fsync_interval
+        #: which store shard this log backs (0 = the single-store /
+        #: shard-0 workdir root layout; kwok_tpu/cluster/sharding sets
+        #: 1..N-1 on the shard logs) — the bounded label the observed
+        #: append/fsync latency histograms carry
+        self.shard = 0
         self.segment_bytes = int(segment_bytes)
         #: sealed segments fully covered by a snapshot move here on
         #: compaction (the PITR archive); None deletes them instead
@@ -751,8 +773,12 @@ class WriteAheadLog:
             lines.append(encode_record(self._seq, r))
             self._seq += 1
             self._note_rv(r)
+        t0 = time.monotonic()
         self._write_frames(lines)
         self._maybe_rotate()
+        # observation-only; a failed write raised above, so this series
+        # is the latency acked writes actually paid
+        _H_APPEND.observe(time.monotonic() - t0, self.shard)
 
     # ------------------------------------------------- exhaustion-safe I/O
 
@@ -786,15 +812,18 @@ class WriteAheadLog:
     def _policy_fsync(self) -> None:
         if self.fsync == "always":
             self._guard_fsync()
+            t0 = time.monotonic()
             os.fsync(self._f.fileno())
             self._last_fsync_at = time.monotonic()
+            _H_FSYNC.observe(self._last_fsync_at - t0, self.shard)
         elif self.fsync == "interval":
             now = time.monotonic()
             if now - self._last_sync >= self.fsync_interval:
                 self._last_sync = now
                 self._guard_fsync()
                 os.fsync(self._f.fileno())
-                self._last_fsync_at = now
+                self._last_fsync_at = time.monotonic()
+                _H_FSYNC.observe(self._last_fsync_at - now, self.shard)
 
     def _flush(self) -> None:
         # flush python buffer -> fd: acked writes survive process death
@@ -808,6 +837,7 @@ class WriteAheadLog:
         the written frames stay process-crash durable, and lost pages
         surface as CRC-detected corruption at recovery."""
         self._f.flush()
+        t0 = time.monotonic()
         try:
             self._guard_fsync()
             os.fsync(self._f.fileno())
@@ -815,6 +845,7 @@ class WriteAheadLog:
             self._on_fsync_failure(exc)
             return
         self._last_fsync_at = time.monotonic()
+        _H_FSYNC.observe(self._last_fsync_at - t0, self.shard)
 
     # ------------------------------------------------- exhaustion handling
 

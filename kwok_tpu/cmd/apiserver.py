@@ -101,6 +101,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="default server-side watch deadline in seconds "
         "(?timeoutSeconds= overrides per request; 0 disables)",
     )
+    p.add_argument(
+        "--slow-request-s",
+        type=float,
+        default=0.0,
+        help="flight-recorder slow-request threshold in seconds: "
+        "requests at/over it are sampled (with their trace ids) into "
+        "the bounded /debug/flightrecorder ring (0 keeps the default, "
+        "0.5s or KWOK_SLOW_REQUEST_S)",
+    )
     p.add_argument("--tls-cert", default="")
     p.add_argument("--tls-key", default="")
     p.add_argument("--client-ca", default="")
@@ -272,6 +281,10 @@ def _boot_sharded(args, n_shards: int):
 
 
 def _serve(args, store, wal, wals, pitrs, sharded: bool) -> int:
+    if args.slow_request_s > 0:
+        from kwok_tpu.utils import telemetry
+
+        telemetry.flight_recorder().slow_threshold_s = args.slow_request_s
     injector = None
     plan = None
     if args.chaos_profile:

@@ -135,6 +135,7 @@ def cmd_get_components(args) -> int:
     rt = _require_cluster(args)
     election = {}  # holder instance -> (lease, transitions, renew age)
     wal = None
+    latency = None
     try:
         client = rt.client(timeout=2.0)
         leases, _rv = client.list("Lease", namespace="kube-system")
@@ -153,7 +154,9 @@ def cmd_get_components(args) -> int:
                 transitions,
                 age,
             )
-        wal = (client.stats() or {}).get("wal")
+        stats = client.stats() or {}
+        wal = stats.get("wal")
+        latency = stats.get("latency")
     except Exception:  # noqa: BLE001 — a down apiserver degrades to
         # the plain liveness listing rather than failing the command
         pass
@@ -188,6 +191,20 @@ def cmd_get_components(args) -> int:
                 line += f"\tfsynced={fs_age:.1f}s ago"
             if wal.get("corruptions"):
                 line += f"\tcorruptions={wal['corruptions']}"
+        if name == "apiserver" and latency:
+            # observed SLO latency summary (utils/telemetry via /stats):
+            # request-duration p50/p99 — the live answer to "is the
+            # control plane slow", next to the storage health it rides
+            req = latency.get("kwok_apiserver_request_duration_seconds")
+            if req:
+                line += (
+                    f"\tlat={req['p50_s'] * 1000:.1f}/"
+                    f"{req['p99_s'] * 1000:.1f}ms(p50/p99)"
+                )
+            wq = latency.get("kwok_apiserver_flow_queue_wait_seconds")
+            if wq and wq.get("p99_s", 0) >= 0.001:
+                line += f"\tqueue-wait-p99={wq['p99_s'] * 1000:.1f}ms"
+        if name == "apiserver" and wal:
             per_shard = wal.get("shards") or []
             if len(per_shard) > 1:
                 # per-shard WAL column (sharded store): one cell per

@@ -33,6 +33,7 @@ from kwok_tpu.engine.render_plan import RenderPlan, compile_plan
 from kwok_tpu.engine.render_plan import build as _plan_build
 from kwok_tpu.engine.simulator import DEFAULT_EPOCH, DeviceSimulator, Transition
 from kwok_tpu.native.fastdrain import load as _load_fastdrain
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.log import get_logger
 from kwok_tpu.utils.patch import apply_merge_patch as _merge_patch
@@ -43,6 +44,17 @@ from kwok_tpu.utils.queue import Queue
 _FAST = _load_fastdrain()
 
 _LOG = get_logger("device-player")
+
+#: observed per-stage tick pipeline timing (SLO telemetry): the
+#: production drain loop's split of each macro-tick into device kernel
+#: / host drain / host patch build / store round-trips — ROADMAP open
+#: item 1's ``host_build`` wall as a live series instead of a bench
+#: artifact.  Labels are bounded: resource kind x four stage names.
+_H_TICK = _telemetry.histogram(
+    "kwok_tick_stage_seconds",
+    help="per-macro-tick stage time (device_tick/host_drain/host_build/store_bulk)",
+    labelnames=("kind", "stage"),
+)
 
 #: live players for the interpreter-exit safety net: a daemon tick
 #: thread killed mid-XLA-dispatch at teardown aborts the whole process
@@ -490,13 +502,46 @@ class DeviceStagePlayer:
         # a pending pipelined batch must drain FIRST or transitions
         # apply out of order when callers mix the two step flavors
         self.flush_pipeline()
+        base = (self.t_device, self.t_store, self.t_host, self.t_build)
         dt = dt_ms if dt_ms is not None else self.tick_ms
         t0 = time.perf_counter()
         stages_np, t0_ms = self.sim.tick_many(dt, n_ticks)
         self.t_device += time.perf_counter() - t0
         fired_total = self._drain_stages(stages_np, t0_ms, dt)
         self._run_post_tick()
+        self._observe_tick(base, fired_total)
         return fired_total
+
+    def _observe_tick(
+        self, base: Tuple[float, float, float, float], fired: int
+    ) -> None:
+        """Observed per-stage deltas for one macro-tick, and (for
+        firing ticks) a flight-recorder breakdown entry.  Observation-
+        only: nothing here feeds back into pacing or drain routing."""
+        if not _telemetry.enabled():
+            return
+        d_dev = self.t_device - base[0]
+        d_store = self.t_store - base[1]
+        d_host = self.t_host - base[2]
+        d_build = self.t_build - base[3]
+        # host_drain excludes the patch-build subset, matching the
+        # bench's breakdown_s split (host_drain_s = t_host - build)
+        d_drain = max(d_host - d_build, 0.0)
+        _H_TICK.observe(d_dev, self.kind, "device_tick")
+        _H_TICK.observe(d_drain, self.kind, "host_drain")
+        _H_TICK.observe(d_build, self.kind, "host_build")
+        _H_TICK.observe(d_store, self.kind, "store_bulk")
+        if fired:
+            _telemetry.flight_recorder().record_tick(
+                self.kind,
+                fired,
+                {
+                    "device_tick_s": d_dev,
+                    "host_drain_s": d_drain,
+                    "host_build_s": d_build,
+                    "store_bulk_s": d_store,
+                },
+            )
 
     def _run_post_tick(self) -> None:
         if self.post_tick is None:
@@ -570,6 +615,7 @@ class DeviceStagePlayer:
             return self.step_batch(dt, n_ticks)
         import jax
 
+        base = (self.t_device, self.t_store, self.t_host, self.t_build)
         prev = self._inflight
         t0 = time.perf_counter()
         stages_dev, t0_ms = self.sim.tick_many_async(dt, n_ticks)
@@ -591,6 +637,7 @@ class DeviceStagePlayer:
             self.t_device += time.perf_counter() - t1
             fired = self._drain_stages(stages_np, p_t0, p_dt)
         self._run_post_tick()
+        self._observe_tick(base, fired)
         return fired
 
     def flush_pipeline(self) -> int:

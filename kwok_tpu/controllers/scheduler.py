@@ -39,6 +39,7 @@ from kwok_tpu.sched.predicates import (
     pod_requests as _requests,
 )
 from kwok_tpu.sched.topology import TopologyModel
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils.backoff import WarnGate
 from kwok_tpu.utils.clock import Clock, MonotonicClock
 from kwok_tpu.utils.log import get_logger
@@ -47,6 +48,14 @@ from kwok_tpu.utils.queue import Queue
 __all__ = ["Scheduler"]
 
 logger = get_logger("scheduler")
+
+#: observed time-to-bind (SLO telemetry): first-seen-unbound -> bind
+#: patch acknowledged, on the scheduler's injected clock.  No labels —
+#: per-pod identity is exactly what the metric-cardinality rule forbids
+_H_BIND = _telemetry.histogram(
+    "kwok_scheduler_bind_seconds",
+    help="pod time-to-bind (scheduler first sight to acked bind)",
+)
 
 
 class Scheduler:
@@ -91,6 +100,10 @@ class Scheduler:
         #: pod re-emits the same warning each pass — an event flood at
         #: 1M-pod scale
         self._warn_pods = WarnGate(self.WARN_BASE_S, self.WARN_CAP_S)
+        #: uid -> clock instant this scheduler first saw the pod
+        #: unbound (observed time-to-bind anchor; popped on bind,
+        #: cleared on delete so the map stays bounded by pending pods)
+        self._first_seen: Dict[str, float] = {}
         self._threads = []
         self._mut = threading.Lock()
         #: gang engine (kwok_tpu.sched): pods annotated with
@@ -115,6 +128,11 @@ class Scheduler:
         uid = (pod.get("metadata") or {}).get("uid") or ""
         cpu, mem = _requests(pod)
         with self._mut:
+            # bound (by us, the gang engine's txn, or another binder):
+            # drop any pending time-to-bind anchor so _first_seen stays
+            # bounded by pending pods (_untrack mirrors this for
+            # terminal/deleted pods)
+            self._first_seen.pop(uid, None)
             if uid in self._pod_usage:
                 return
             self._pod_usage[uid] = (node, cpu, mem)
@@ -125,6 +143,7 @@ class Scheduler:
         uid = (pod.get("metadata") or {}).get("uid") or ""
         with self._mut:
             self._warn_pods.clear(uid)
+            self._first_seen.pop(uid, None)
             entry = self._pod_usage.pop(uid, None)
             if entry is None:
                 return
@@ -219,6 +238,17 @@ class Scheduler:
             "0/%d nodes are available" % len(self._nodes),
         )
 
+    def _note_pending(self, pod: dict) -> None:
+        """Anchor the pod's time-to-bind at first unbound sight
+        (idempotent; the DST's virtual clock rides the same seam)."""
+        if not _telemetry.enabled():
+            return
+        uid = (pod.get("metadata") or {}).get("uid") or ""
+        if not uid:
+            return
+        with self._mut:
+            self._first_seen.setdefault(uid, self._clock.now())
+
     def _bind_inner(self, pod: dict, span) -> None:
         meta = pod.get("metadata") or {}
         name, ns = meta.get("name") or "", meta.get("namespace") or "default"
@@ -236,9 +266,15 @@ class Scheduler:
                 patch_type="merge",
                 namespace=ns,
             )
-            self._track(pod, target)
+            # pop the anchor BEFORE _track (which also pops, for the
+            # binds that happen outside this method)
             with self._mut:
                 self._warn_pods.clear(meta.get("uid") or "")
+                t_seen = self._first_seen.pop(meta.get("uid") or "", None)
+            if t_seen is not None:
+                # observed time-to-bind; observation-only, clock-seamed
+                _H_BIND.observe(self._clock.now() - t_seen)
+            self._track(pod, target)
             self.recorder.event(
                 pod,
                 "Normal",
@@ -284,6 +320,10 @@ class Scheduler:
             return
         node = (obj.get("spec") or {}).get("nodeName")
         if node:
+            # _track/_untrack both drop the pod's time-to-bind anchor,
+            # so _first_seen stays bounded by pending pods even for
+            # gang members and pods bound by a peer (which never pass
+            # through _bind_inner's pop)
             if (obj.get("status") or {}).get("phase") in ("Succeeded", "Failed"):
                 self._untrack(obj)  # terminal pods free their slot
             else:
@@ -293,6 +333,7 @@ class Scheduler:
             return
         if (obj.get("metadata") or {}).get("deletionTimestamp"):
             return
+        self._note_pending(obj)
         if gang is not None:
             # membership is cache maintenance (standbys stay current);
             # the bind attempt below is leader-gated like _bind
@@ -314,6 +355,7 @@ class Scheduler:
         for pod in pods:
             if (pod.get("metadata") or {}).get("deletionTimestamp"):
                 continue
+            self._note_pending(pod)
             if self.gang is not None and GangEngine.is_gang_pod(pod):
                 # heal membership the watch may have missed, then let
                 # the engine's own retry pass below attempt the gang

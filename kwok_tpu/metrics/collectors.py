@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Gauge", "Counter", "Histogram", "Registry", "escape_label_value"]
@@ -110,6 +111,15 @@ class Histogram(_Collector):
     counts; exposition folds them into the visible cumulative buckets the way
     histogram.go:107-151 does (a stored ``le`` between two visible bounds
     lands in the next visible bucket — this is how ``hidden`` buckets merge).
+
+    Alongside the settable CEL surface there is an *observed* increment
+    path — :meth:`observe` / :meth:`time_observe` — for components that
+    measure real events instead of evaluating expressions (the SLO
+    telemetry layer, ``kwok_tpu/utils/telemetry.py:1``, is its
+    free-standing sibling below the metrics layer).  Both surfaces fold
+    into ONE distribution at exposition time, and the observed path is
+    thread-safe (observations arrive from handler/tick threads while
+    the CEL evaluator sets from its own).
     """
 
     def __init__(
@@ -122,6 +132,12 @@ class Histogram(_Collector):
         super().__init__(name, help, const_labels)
         self.buckets = sorted(float(b) for b in buckets)
         self._stored: Dict[float, int] = {}
+        # observed increments: per visible bucket (+Inf last), guarded —
+        # set() keeps its single-writer CEL contract, observe() does not
+        self._mut = threading.Lock()
+        self._observed = [0] * (len(self.buckets) + 1)
+        self._observed_sum = 0.0
+        self._observed_count = 0
 
     def type_name(self) -> str:
         return "histogram"
@@ -129,8 +145,27 @@ class Histogram(_Collector):
     def set(self, le: float, count: int) -> None:
         self._stored[float(le)] = int(count)
 
+    def observe(self, value: float) -> None:
+        """Record one observation into the visible buckets (cumulative
+        at exposition, like any real prometheus histogram)."""
+        v = float(value)
+        idx = 0
+        while idx < len(self.buckets) and v > self.buckets[idx]:
+            idx += 1
+        with self._mut:
+            self._observed[idx] += 1
+            self._observed_sum += v
+            self._observed_count += 1
+
+    def time_observe(self):
+        """Context manager observing the wrapped block's duration in
+        seconds (monotonic — the utils.clock discipline)."""
+        return _Timer(self)
+
     def distribution(self) -> Tuple[List[Tuple[float, int]], int, float]:
-        """(visible cumulative buckets incl. +Inf, total count, sum)."""
+        """(visible cumulative buckets incl. +Inf, total count, sum) —
+        the stored (CEL-set) per-``le`` counts folded per
+        histogram.go:107-151, merged with the observed increments."""
         bounds = list(self.buckets) + [_INF]
         cumulative = [0] * len(bounds)
         idx = 0
@@ -143,6 +178,14 @@ class Histogram(_Collector):
             cumulative[idx] += val
             count += val
             total += le * val
+        with self._mut:
+            observed = list(self._observed)
+            obs_sum = self._observed_sum
+            obs_count = self._observed_count
+        for i, n in enumerate(observed):
+            cumulative[i] += n
+        count += obs_count
+        total += obs_sum
         # make buckets cumulative
         run = 0
         out: List[Tuple[float, int]] = []
@@ -162,6 +205,25 @@ class Histogram(_Collector):
         lines.append(f"{self.name}_sum{_fmt_labels(self.const_labels)} {_fmt_value(total)}")
         lines.append(f"{self.name}_count{_fmt_labels(self.const_labels)} {count}")
         return lines
+
+
+class _Timer:
+    """``with h.time_observe():`` — observes the block's monotonic
+    duration on exit (exceptions included: a failing request's latency
+    is still a latency)."""
+
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._hist.observe(time.monotonic() - self._t0)
 
 
 class Registry:

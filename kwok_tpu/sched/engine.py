@@ -48,6 +48,7 @@ from kwok_tpu.sched.predicates import (
     pod_requests,
 )
 from kwok_tpu.sched.topology import TopologyModel
+from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils.backoff import WarnGate
 from kwok_tpu.utils.clock import Clock, MonotonicClock
 from kwok_tpu.utils.log import get_logger
@@ -55,6 +56,15 @@ from kwok_tpu.utils.log import get_logger
 __all__ = ["GangEngine"]
 
 logger = get_logger("sched")
+
+#: observed gang time-to-admit (SLO telemetry): first pending member
+#: seen -> whole gang committed through the atomic txn lane.  Rides
+#: the injected clock, so the DST's virtual time observes identically;
+#: unlabeled — gang names are per-object (metric-cardinality)
+_H_GANG = _telemetry.histogram(
+    "kwok_gang_admit_seconds",
+    help="gang time-to-admit (first pending member to atomic commit)",
+)
 
 PodKey = Tuple[str, str]  # (namespace, name)
 GangKey = Tuple[str, str]  # (namespace, group)
@@ -118,6 +128,10 @@ class GangEngine:
         #: per-gang warn cadence (shared event-flood guard with the
         #: scheduler's per-pod stream)
         self._warn = WarnGate(self.WARN_BASE_S, self.WARN_CAP_S)
+        #: gang -> clock instant its first pending member appeared
+        #: (time-to-admit anchor; popped on commit, dropped with the
+        #: gang so the map stays bounded by pending gangs)
+        self._gang_seen: Dict[GangKey, float] = {}
         #: per-policy-name cache for group policy overrides
         self._policies: Dict[str, Policy] = {self.policy.name: self.policy}
         # counters (surfaced by tests/bench)
@@ -145,6 +159,7 @@ class GangEngine:
                 self._pending.pop(key, None)
                 self._bound.pop(key, None)
                 self._warn.clear(key)
+                self._gang_seen.pop(key, None)
             return
         meta = pod.get("metadata") or {}
         node = (pod.get("spec") or {}).get("nodeName")
@@ -155,11 +170,21 @@ class GangEngine:
                 self._bound.get(key, {}).pop(pk, None)
             else:
                 self._bound.setdefault(key, {})[pk] = node
+            if not self._pending.get(key):
+                # no pending members left: the gang bound (here or on
+                # the admitting leader — standbys see it only through
+                # these echoes).  Drop the time-to-admit anchor, or a
+                # post-failover re-admit of the same gang would observe
+                # clock.now() minus an hours-old first sight.
+                self._gang_seen.pop(key, None)
             return
         if meta.get("deletionTimestamp"):
             self._pending.get(key, {}).pop(pk, None)
             return
         self._pending.setdefault(key, {})[pk] = pod
+        if _telemetry.enabled():
+            # time-to-admit anchors at the gang's FIRST pending member
+            self._gang_seen.setdefault(key, self._clock.now())
 
     def offer(self, pod: dict) -> bool:
         """A pending gang pod from the event stream: register it and
@@ -423,6 +448,10 @@ class GangEngine:
         if not self._commit(key, plan):
             return False
         self.gangs_scheduled += 1
+        t_seen = self._gang_seen.pop(key, None)
+        if t_seen is not None:
+            # observed gang time-to-admit; observation-only
+            _H_GANG.observe(self._clock.now() - t_seen)
         for pod, node in plan:
             self._track(pod, node)
             self.observe("MODIFIED", _with_node(pod, node))

@@ -221,6 +221,10 @@ class Server:
         for p in ("/run/", "/runningpods/", "/logs/"):
             r.add("GET", p, self._disabled)
         r.add("GET", "/debug/threads", self._debug_threads)
+        # flight recorder: last-N device-tick stage breakdowns + slow
+        # samples from this process's SLO telemetry ring
+        # (utils/telemetry — the apiserver serves its own twin route)
+        r.add("GET", "/debug/flightrecorder", self._flight_recorder)
         # Go-pprof-shaped profiling surface (reference
         # pkg/kwok/server/profiling.go:26 InstallProfilingHandler):
         # /debug/pprof/profile?seconds=N is an on-CPU sampling profile
@@ -329,7 +333,25 @@ class Server:
             except Exception:  # noqa: BLE001 — a broken updater must not
                 # take down the scrape endpoint
                 traceback.print_exc()
-        req.reply(200, self._self_registry.expose(), content_type="text/plain; version=0.0.4")
+        # observed SLO histograms (utils/telemetry): in the kwok daemon
+        # this carries the per-stage tick pipeline series the device
+        # players observe (kwok_tick_stage_seconds incl. host_build)
+        from kwok_tpu.utils import telemetry as _telemetry
+
+        req.reply(
+            200,
+            self._self_registry.expose() + _telemetry.registry().expose(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    def _flight_recorder(self, req: "_Request", **params) -> None:
+        from kwok_tpu.utils import telemetry as _telemetry
+
+        req.reply(
+            200,
+            json.dumps(_telemetry.flight_recorder().dump()),
+            content_type="application/json",
+        )
 
     def _debug_threads(self, req: "_Request", **params) -> None:
         buf = io.StringIO()

@@ -121,6 +121,10 @@ def open_sharded_store(
             ),
             archive_dir=pitr_root,
         )
+        # bounded shard index on the observed storage/watch latency
+        # series (utils/telemetry SLO histograms)
+        wal.shard = i
+        s.telemetry_shard = i
         s.attach_wal(wal)
         shards.append(s)
         wals.append(wal)

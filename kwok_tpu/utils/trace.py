@@ -31,7 +31,15 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-__all__ = ["Span", "Tracer", "get_tracer", "set_global", "traceparent", "from_traceparent"]
+__all__ = [
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "peek_global",
+    "set_global",
+    "traceparent",
+    "from_traceparent",
+]
 
 
 class Span:
@@ -107,6 +115,18 @@ class Tracer:
         self._thread: Optional[threading.Thread] = None
         self.dropped = 0
         self.exported = 0
+        #: True while the collector is unreachable — the log-once gate:
+        #: the first failed flush of an outage logs a warning (with the
+        #: running drop count), the first successful one logs recovery;
+        #: everything in between drops silently-but-counted
+        self._outage = False
+        #: separate edge for buffer overpressure (spans produced faster
+        #: than FLUSH_EVERY drains them, collector possibly healthy):
+        #: logged once per overpressure episode, cleared only after a
+        #: full flush cycle with zero drops — never recycled per batch,
+        #: and never conflated with collector reachability
+        self._buf_logged = False
+        self._dropped_since_flush = 0
         if endpoint:
             self._thread = threading.Thread(
                 target=self._flush_loop, daemon=True, name=f"trace-{service}"
@@ -161,11 +181,24 @@ class Tracer:
     def _finish(self, span: Span) -> None:
         if not self.enabled:
             return
+        log_edge = False
         with self._mut:
             if len(self._buf) >= self.MAX_BUFFER:
                 self.dropped += 1
-                return
-            self._buf.append(span)
+                self._dropped_since_flush += 1
+                # edge check-and-set under the mutex: two threads
+                # overflowing concurrently must produce ONE warning,
+                # not a race on the log-once flag
+                if not self._buf_logged:
+                    self._buf_logged = True
+                    log_edge = True
+            else:
+                self._buf.append(span)
+        if log_edge:
+            # a full buffer with a healthy exporter means spans arrive
+            # faster than FLUSH_EVERY drains them — say so once per
+            # overpressure episode instead of silently shedding forever
+            self._log_drop("span buffer full; dropping spans")
 
     # ---------------------------------------------------------------- export
 
@@ -177,6 +210,13 @@ class Tracer:
     def flush(self) -> None:
         with self._mut:
             batch, self._buf = self._buf, []
+            # a full flush cycle with zero drops ends the overpressure
+            # episode: the NEXT buffer-full is a new edge worth a line.
+            # Sustained overpressure (drops every cycle) keeps the edge
+            # set, so the warn stays once-per-episode, never per batch.
+            if self._dropped_since_flush == 0:
+                self._buf_logged = False
+            self._dropped_since_flush = 0
         if not batch or not self.endpoint:
             return
         try:
@@ -187,10 +227,52 @@ class Tracer:
                 headers={"Content-Type": "application/json"},
             )
             urllib.request.urlopen(req, timeout=5).read()
-            self.exported += len(batch)
-        except Exception:  # noqa: BLE001 — a dead collector must not
-            # break the traced component; spans from this batch are lost
-            self.dropped += len(batch)
+            with self._mut:
+                self.exported += len(batch)
+                recovered = self._outage
+                self._outage = False
+            if recovered:
+                self._log_drop(
+                    "collector reachable again; resuming span export",
+                    recovered=True,
+                )
+        except Exception as exc:  # noqa: BLE001 — a dead collector must
+            # not break the traced component; spans from this batch are
+            # lost, counted, and the outage is logged ONCE (edge
+            # check-and-set under the mutex, like _finish's)
+            with self._mut:
+                self.dropped += len(batch)
+                log_edge = not self._outage
+                self._outage = True
+            if log_edge:
+                self._log_drop(f"collector unreachable: {exc}")
+
+    def _log_drop(self, message: str, recovered: bool = False) -> None:
+        """One line per outage edge (never per batch — a dead collector
+        at FLUSH_EVERY cadence would otherwise spam forever)."""
+        from kwok_tpu.utils.log import get_logger
+
+        log = get_logger("tracer")
+        if recovered:
+            log.info(message, service=self.service, dropped_total=self.dropped)
+        else:
+            log.warn(
+                message,
+                service=self.service,
+                endpoint=self.endpoint,
+                dropped_total=self.dropped,
+            )
+
+    def stats(self) -> dict:
+        """Exporter health counters (scraped into /metrics as
+        ``kwok_tracer_dropped_spans_total`` etc.)."""
+        with self._mut:
+            return {
+                "dropped": self.dropped,
+                "exported": self.exported,
+                "buffered": len(self._buf),
+                "outage": self._outage,
+            }
 
     def _otlp(self, batch: List[Span]) -> dict:
         def attr(k, v):
@@ -269,6 +351,15 @@ def set_global(tracer: Optional[Tracer]) -> None:
     global _global
     with _global_mut:
         _global = tracer
+
+
+def peek_global() -> Optional[Tracer]:
+    """The installed global tracer, or None — without creating one
+    (metrics exposition reads drop counters from whatever the process
+    already configured; it must not instantiate a tracer as a side
+    effect of a scrape)."""
+    with _global_mut:
+        return _global
 
 
 def get_tracer(service: str = "kwok") -> Tracer:

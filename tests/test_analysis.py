@@ -1465,3 +1465,97 @@ def test_untestable_sleep_suppression(tmp_path):
         },
     )
     assert run_rules(root, ["untestable-sleep"]) == []
+
+
+# -------------------------------------------------------- metric-cardinality
+
+
+def test_metric_cardinality_flags_tainted_const_labels_and_register(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/m.py": """
+            def expose(reg, Gauge, obj):
+                name = (obj.get("metadata") or {}).get("name") or ""
+                labels = {"pod": name}
+                g = Gauge("m_total", const_labels=labels)
+                reg.register(f"m_total{name}", g)
+            """,
+        },
+    )
+    fs = run_rules(root, ["metric-cardinality"])
+    assert len(fs) == 2, [f.render() for f in fs]
+    assert all(f.rule == "metric-cardinality" for f in fs)
+    assert any("const" not in f.message and "identity" in f.message for f in fs)
+
+
+def test_metric_cardinality_flags_observe_label_args(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/sched/m.py": """
+            def record(hist, pod):
+                uid = (pod.get("metadata") or {}).get("uid")
+                hist.observe(0.1, uid)
+            """,
+        },
+    )
+    fs = run_rules(root, ["metric-cardinality"])
+    assert len(fs) == 1 and "uid" in fs[0].message
+
+
+def test_metric_cardinality_fstring_and_subscript_taint(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/controllers/m.py": """
+            def expose(reg, g, meta):
+                reg.register(f"m{meta['namespace']}", g)
+            """,
+        },
+    )
+    fs = run_rules(root, ["metric-cardinality"])
+    assert len(fs) == 1 and "namespace" in fs[0].message
+
+
+def test_metric_cardinality_bounded_labels_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/m.py": """
+            def observe(hist, verb, level, shard):
+                # bounded vocabularies are exactly what labels are for
+                hist.observe(0.5, verb, level, str(shard))
+
+            def expose(reg, Gauge, row):
+                g = Gauge("m_total", const_labels={"level": "system"})
+                reg.register("m_total" + "system", g)
+
+            def value_position_is_not_a_label(hist, pod):
+                # identity in the VALUE slot (arg 0) is not label space
+                hist.observe(len((pod.get("metadata") or {}).get("name") or ""))
+            """,
+        },
+    )
+    assert run_rules(root, ["metric-cardinality"]) == []
+
+
+def test_metric_cardinality_scope_and_suppression(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            # server/ is outside the rule's scope
+            "kwok_tpu/server/m.py": """
+            def expose(reg, Gauge, obj):
+                name = (obj.get("metadata") or {}).get("name")
+                reg.register(f"m{name}", Gauge("m"))
+            """,
+            "kwok_tpu/cluster/ok.py": """
+            def expose(reg, Gauge, lease):
+                name = (lease.get("metadata") or {}).get("name")
+                # one election Lease per control-plane seat (bounded)
+                reg.register(f"m{name}", Gauge("m"))  # kwoklint: disable=metric-cardinality — bounded lease set
+            """,
+        },
+    )
+    assert run_rules(root, ["metric-cardinality"]) == []

@@ -313,3 +313,22 @@ def test_cross_shard_txn_regression_is_caught_and_replays_identically():
     replay = run_seed(seed, opts)
     assert replay["trace_digest"] == first["trace_digest"]
     assert replay["violations"] == first["violations"]
+
+
+def test_telemetry_armed_vs_disarmed_digests_byte_identical():
+    """SLO telemetry is observation-only: a DST run with every observed
+    histogram armed must produce the SAME trace digest as a disarmed
+    run — instrumentation can never leak into control flow (ISSUE 12
+    acceptance)."""
+    from kwok_tpu.utils import telemetry
+
+    prev = telemetry.set_enabled(True)
+    try:
+        armed = run_seed(3, SimOptions())
+        telemetry.set_enabled(False)
+        disarmed = run_seed(3, SimOptions())
+    finally:
+        telemetry.set_enabled(prev)
+    assert armed["trace_digest"] == disarmed["trace_digest"]
+    assert armed == disarmed
+    assert armed["violations"] == {}

@@ -1,0 +1,425 @@
+"""SLO telemetry substrate (kwok_tpu.utils.telemetry) + the observed
+increment path on the CEL collectors (metrics/collectors.py): bucket
+placement, exposition parity, cardinality backstop, flight-recorder
+ring semantics, and the store's commit-time ring feeding delivery lag."""
+
+import json
+import threading
+
+import pytest
+
+from kwok_tpu.metrics.collectors import Histogram, Registry
+from kwok_tpu.utils import telemetry
+from kwok_tpu.utils.telemetry import (
+    FlightRecorder,
+    HistogramFamily,
+    Telemetry,
+)
+
+
+# ------------------------------------------------------ HistogramFamily
+
+
+def test_family_observe_buckets_and_exposition():
+    fam = HistogramFamily(
+        "t_fam_seconds", help="h", buckets=(0.01, 0.1, 1.0), labelnames=("op",)
+    )
+    fam.observe(0.005, "get")   # <= 0.01
+    fam.observe(0.05, "get")    # <= 0.1
+    fam.observe(0.5, "get")     # <= 1.0
+    fam.observe(5.0, "get")     # +Inf
+    snap = fam.snapshot()[("get",)]
+    assert snap["counts"] == [1, 1, 1, 1]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(5.555)
+    lines = fam.expose_lines()
+    assert "# TYPE t_fam_seconds histogram" in lines
+    # cumulative per le, labels intact
+    assert 't_fam_seconds_bucket{op="get",le="0.01"} 1' in lines
+    assert 't_fam_seconds_bucket{op="get",le="0.1"} 2' in lines
+    assert 't_fam_seconds_bucket{op="get",le="1"} 3' in lines
+    assert 't_fam_seconds_bucket{op="get",le="+Inf"} 4' in lines
+    assert 't_fam_seconds_count{op="get"} 4' in lines
+
+
+def test_family_boundary_value_lands_in_its_bucket():
+    fam = HistogramFamily("t_edge", buckets=(0.1, 1.0))
+    fam.observe(0.1)  # exactly on the bound -> le=0.1 bucket
+    assert fam.snapshot()[()]["counts"] == [1, 0, 0]
+
+
+def test_family_negative_value_clamped_not_corrupting():
+    fam = HistogramFamily("t_neg", buckets=(0.1,))
+    fam.observe(-5.0)
+    snap = fam.snapshot()[()]
+    assert snap["counts"][0] == 1 and snap["sum"] == 0.0
+
+
+def test_family_label_width_normalized():
+    fam = HistogramFamily("t_lab", buckets=(1.0,), labelnames=("a", "b"))
+    fam.observe(0.5, "only-one")          # short -> padded
+    fam.observe(0.5, "x", "y", "extra")   # long -> truncated
+    assert set(fam.snapshot()) == {("only-one", ""), ("x", "y")}
+
+
+def test_family_cardinality_backstop_folds_overflow():
+    fam = HistogramFamily("t_cap", buckets=(1.0,), labelnames=("v",))
+    for i in range(telemetry.MAX_CHILDREN + 10):
+        fam.observe(0.5, f"v{i}")
+    snap = fam.snapshot()
+    assert len(snap) <= telemetry.MAX_CHILDREN + 1
+    assert fam.overflowed == 10
+    other = snap[("(other)",)]
+    assert other["count"] == 10
+
+
+def test_family_quantile_estimate():
+    fam = HistogramFamily("t_q", buckets=(0.01, 0.1, 1.0))
+    for _ in range(99):
+        fam.observe(0.005)
+    fam.observe(0.5)
+    assert fam.quantile(0.5) <= 0.01
+    assert 0.1 <= fam.quantile(1.0) <= 1.0
+    empty = HistogramFamily("t_q2", buckets=(1.0,))
+    assert empty.quantile(0.5) is None
+
+
+def test_set_enabled_disarms_observe():
+    fam = HistogramFamily("t_off", buckets=(1.0,))
+    prev = telemetry.set_enabled(False)
+    try:
+        fam.observe(0.5)
+        assert fam.total_count() == 0
+    finally:
+        telemetry.set_enabled(prev)
+    fam.observe(0.5)
+    assert fam.total_count() == 1
+
+
+def test_family_thread_safety_no_lost_increments():
+    fam = HistogramFamily("t_thr", buckets=(1.0,))
+    n, threads = 5000, 4
+
+    def worker():
+        for _ in range(n):
+            fam.observe(0.5)
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert fam.total_count() == n * threads
+
+
+def test_registry_idempotent_and_summary():
+    reg = Telemetry()
+    a = reg.histogram("t_reg", buckets=(1.0,))
+    b = reg.histogram("t_reg", buckets=(9.0,))  # first geometry wins
+    assert a is b
+    a.observe(0.5)
+    summ = reg.summary()
+    assert summ["t_reg"]["count"] == 1
+    text = reg.expose()
+    assert "# TYPE t_reg histogram" in text
+
+
+# -------------------------------------------------------- FlightRecorder
+
+
+def test_recorder_ring_overwrites_oldest():
+    rec = FlightRecorder(size=3)
+    for i in range(5):
+        rec.record_tick("Pod", i + 1, {"device_tick_s": 0.001})
+    dump = rec.dump()
+    assert len(dump["ticks"]) == 3
+    assert [t["fired"] for t in dump["ticks"]] == [3, 4, 5]
+    assert dump["size"] == 3
+
+
+def test_recorder_slow_threshold_gates_samples():
+    rec = FlightRecorder(size=8)
+    rec.slow_threshold_s = 0.25
+    rec.note_request("GET", "/r/pods", "system", 0.1)
+    rec.note_request("POST", "/r/pods/p1", "system", 0.9, trace_id="abc123")
+    dump = rec.dump()
+    assert dump["slow_seen"] == 2 and dump["slow_recorded"] == 1
+    (sample,) = dump["slow_requests"]
+    assert sample["verb"] == "POST"
+    assert sample["seconds"] == pytest.approx(0.9)
+    # the trace-id exemplar links the outlier to its distributed trace
+    assert sample["trace_id"] == "abc123"
+
+
+def test_recorder_disabled_records_nothing():
+    rec = FlightRecorder(size=4)
+    prev = telemetry.set_enabled(False)
+    try:
+        rec.record_tick("Pod", 1, {})
+        rec.note_request("GET", "/", "", 99.0)
+    finally:
+        telemetry.set_enabled(prev)
+    dump = rec.dump()
+    assert dump["ticks"] == [] and dump["slow_requests"] == []
+
+
+def test_recorder_dump_is_json_serializable():
+    rec = FlightRecorder(size=2)
+    rec.record_tick("Node", 2, {"host_build_s": 0.02})
+    rec.note_request("GET", "/r/nodes", "system", 99.0, trace_id="t")
+    json.dumps(rec.dump())
+
+
+# --------------------------------------------- collectors.Histogram path
+
+
+def test_collector_observe_folds_with_set_and_exposes():
+    h = Histogram("req_seconds", buckets=[0.1, 1.0])
+    h.set(0.05, 7)      # CEL-set hidden le folds into le=0.1
+    h.observe(0.5)      # observed lands in le=1.0
+    h.observe(2.0)      # observed +Inf
+    dist, count, total = h.distribution()
+    assert dist == [(0.1, 7), (1.0, 8), (pytest.approx(float("inf")), 9)]
+    assert count == 9
+    assert total == pytest.approx(7 * 0.05 + 0.5 + 2.0)
+    reg = Registry()
+    reg.register("req_seconds", h)
+    text = reg.expose()
+    assert 'req_seconds_bucket{le="0.1"} 7' in text
+    assert 'req_seconds_bucket{le="1"} 8' in text
+    assert 'req_seconds_bucket{le="+Inf"} 9' in text
+    assert "req_seconds_count 9" in text
+
+
+def test_collector_observe_matches_pure_set_exposition():
+    """Parity: N observed values expose identically to the same
+    distribution expressed through set() on the visible bounds."""
+    a = Histogram("par_a", buckets=[0.1, 1.0])
+    for v in (0.05, 0.05, 0.5):
+        a.observe(v)
+    b = Histogram("par_b", buckets=[0.1, 1.0])
+    b.set(0.1, 2)
+    b.set(1.0, 1)
+    da, ca, _ = a.distribution()
+    db, cb, _ = b.distribution()
+    assert [c for _, c in da] == [c for _, c in db]
+    assert ca == cb
+
+
+def test_collector_time_observe_and_threads():
+    h = Histogram("timed", buckets=[10.0])
+    with h.time_observe():
+        pass
+    assert h.distribution()[1] == 1
+
+    n = 2000
+
+    def worker():
+        for _ in range(n):
+            h.observe(0.5)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.distribution()[1] == 1 + 4 * n
+
+
+# --------------------------------------------------- store commit ring
+
+
+def test_store_delivery_lag_ring():
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    # no watcher -> no commit notes -> no lag
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "a", "namespace": "default"}})
+    assert store.delivery_lag(store.resource_version) is None
+    w = store.watch("Pod")
+    try:
+        store.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "b", "namespace": "default"}})
+        rv = store.resource_version
+        hit = store.delivery_lag(rv)
+        assert hit is not None
+        lag, shard = hit
+        assert 0.0 <= lag < 5.0 and shard == 0
+    finally:
+        w.stop()
+
+
+def test_store_commit_ring_is_bounded():
+    from kwok_tpu.cluster.store import ResourceStore
+
+    store = ResourceStore()
+    w = store.watch("Pod")
+    try:
+        first_rv = None
+        for i in range(store.COMMIT_RING + 50):
+            store.create({"apiVersion": "v1", "kind": "Pod",
+                          "metadata": {"name": f"p{i}", "namespace": "default"}})
+            if first_rv is None:
+                first_rv = store.resource_version
+            w.drain()
+        assert len(store._commit_times) <= store.COMMIT_RING
+        # the oldest rv aged out of the ring
+        assert store.delivery_lag(first_rv) is None
+        assert store.delivery_lag(store.resource_version) is not None
+    finally:
+        w.stop()
+
+
+def test_sharded_delivery_lag_resolves_owning_shard():
+    from kwok_tpu.cluster.sharding import build_sharded_store
+
+    store = build_sharded_store(2)
+    w = store.watch("Pod")
+    try:
+        store.create({"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "x", "namespace": "ns-a"}})
+        rv = store.resource_version
+        hit = store.delivery_lag(rv)
+        assert hit is not None
+        lag, shard = hit
+        assert shard == store.shard_for("Pod", "ns-a")
+    finally:
+        w.stop()
+
+
+# ------------------------------------------------------ review regressions
+
+
+def test_scheduler_first_seen_bounded_by_pending():
+    """A pod that binds OUTSIDE _bind_inner (gang txn, peer binder,
+    standby watching) must still drop its time-to-bind anchor when the
+    bound echo arrives — the map stays bounded by pending pods."""
+    from types import SimpleNamespace
+
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.controllers.scheduler import Scheduler
+
+    store = ResourceStore()
+    sched = Scheduler(store, gang_policy="none")
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default", "uid": "u1"},
+        "spec": {},
+        "status": {},
+    }
+    sched._note_pending(pod)
+    assert "u1" in sched._first_seen
+    bound = dict(pod, spec={"nodeName": "n0"})
+    sched.handle_event(SimpleNamespace(type="MODIFIED", object=bound))
+    assert "u1" not in sched._first_seen
+
+
+def test_apiserver_junk_paths_cannot_mint_kind_labels():
+    """Client-supplied junk paths collapse into one '(unknown)' kind
+    bucket instead of minting label values until the family cap folds
+    legitimate series into '(other)'."""
+    import urllib.error
+    import urllib.request
+
+    from kwok_tpu.cluster.apiserver import APIServer, _H_REQ
+    from kwok_tpu.cluster.store import ResourceStore
+
+    with APIServer(ResourceStore()) as srv:
+        for i in range(5):
+            try:
+                urllib.request.urlopen(
+                    f"{srv.url}/r/junk-kind-{i}", timeout=5
+                ).read()
+            except urllib.error.HTTPError:
+                pass
+            try:
+                urllib.request.urlopen(
+                    f"{srv.url}/no-such-head-{i}/x", timeout=5
+                ).read()
+            except urllib.error.HTTPError:
+                pass
+    kinds = {lv[1] for lv in _H_REQ.snapshot()}
+    assert not any(k.startswith("junk-kind-") for k in kinds), kinds
+    assert not any(k.startswith("no-such-head-") for k in kinds), kinds
+    assert "(unknown)" in kinds
+
+
+def test_apiserver_junk_shard_indexes_cannot_mint_shard_labels():
+    """/shards/{N} digit strings are client-supplied too: indexes the
+    store does not have (any, on an unsharded store) collapse into one
+    '(invalid)' bucket instead of minting children."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from kwok_tpu.cluster.apiserver import APIServer, _H_REQ
+    from kwok_tpu.cluster.store import ResourceStore
+
+    with APIServer(ResourceStore()) as srv:
+        for i in (7, 99, 123456):
+            req = urllib.request.Request(
+                f"{srv.url}/shards/{i}/bulk",
+                data=_json.dumps({"ops": []}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except urllib.error.HTTPError:
+                pass
+    shards = {lv[3] for lv in _H_REQ.snapshot()}
+    assert not any(s in ("7", "99", "123456") for s in shards), shards
+    assert "(invalid)" in shards
+
+
+def test_registry_reset_keeps_family_handles_live():
+    """reset() clears observations IN PLACE — import-time family
+    references (the hot-path module globals) keep feeding series a
+    scrape can still see."""
+    reg = Telemetry()
+    fam = reg.histogram("t_reset", buckets=(1.0,))
+    fam.observe(0.5)
+    reg.reset()
+    assert fam.total_count() == 0
+    fam.observe(0.5)  # the old handle still feeds the exposed series
+    assert reg.histogram("t_reset") is fam
+    assert "t_reset_count 1" in reg.expose()
+
+
+def test_standby_gang_engine_drops_admit_anchor_on_bound_echo():
+    """A non-admitting engine (HA standby) that learns of a gang's
+    bind only through watch echoes must drop its time-to-admit anchor,
+    or a post-failover re-admit would observe an hours-old first
+    sight."""
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.sched.engine import GangEngine
+
+    engine = GangEngine(ResourceStore())
+
+    def member(name, node=None):
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": "default",
+                "annotations": {"kwok.io/pod-group": "g"},
+            },
+            "spec": {},
+            "status": {},
+        }
+        if node:
+            pod["spec"]["nodeName"] = node
+        return pod
+
+    engine.observe("ADDED", member("a"))
+    engine.observe("ADDED", member("b"))
+    key = ("default", "g")
+    assert key in engine._gang_seen
+    # the admitting leader bound them; this engine only sees echoes
+    engine.observe("MODIFIED", member("a", node="n0"))
+    assert key in engine._gang_seen  # one member still pending
+    engine.observe("MODIFIED", member("b", node="n1"))
+    assert key not in engine._gang_seen
