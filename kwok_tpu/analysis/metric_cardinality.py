@@ -43,6 +43,13 @@ SCOPE = (
     "kwok_tpu/cluster/",
     "kwok_tpu/controllers/",
     "kwok_tpu/sched/",
+    # journey/timeline modules (causal lifecycle tracing): these hold
+    # per-object detail BY DESIGN — in bounded rings and span
+    # attributes — so a per-object reach leaking into a metric label
+    # here is exactly the confusion this rule exists to catch
+    "kwok_tpu/utils/telemetry.py",
+    "kwok_tpu/utils/trace.py",
+    "kwok_tpu/cmd/tracing.py",
 )
 
 #: metadata keys whose values are per-object identity

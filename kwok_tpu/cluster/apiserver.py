@@ -382,6 +382,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             cid = self.headers.get("X-Kwok-Client") or ""
             self._flow_level = flow.classify(cid)
+            t_admit = time.monotonic()
             try:
                 ticket = flow.admit(
                     cid,
@@ -392,6 +393,19 @@ class _Handler(BaseHTTPRequestHandler):
                     long_running=q.get("watch") in ("1", "true"),
                     level=self._flow_level,
                 )
+                # stamp the admission wait on the request's live span
+                # (observation-only): the critical-path analyzer reads
+                # it back as the journey's "queue" share
+                from kwok_tpu.utils.trace import peek_global
+
+                tracer = peek_global()
+                if tracer is not None and tracer.enabled:
+                    sp = tracer.current()
+                    if sp is not None:
+                        sp.set(
+                            "apf.wait_s",
+                            round(time.monotonic() - t_admit, 6),
+                        )
             except FlowRejected as rej:
                 # sheds are counted by the rejected counter; observing
                 # their queue wait as a "request duration" would read
@@ -573,6 +587,43 @@ class _Handler(BaseHTTPRequestHandler):
                 # ring — the after-the-fact answer to "what was slow
                 # two minutes ago" without a profiler attached
                 self._send_json(200, _telemetry.flight_recorder().dump())
+            elif head == "debug" and rest == ["journey"]:
+                # per-object journey timeline (bounded uid-keyed ring,
+                # utils/telemetry.JourneyRecorder): every commit/watch
+                # hop this apiserver observed for the named object, with
+                # the committing trace ids — `kwokctl trace` joins this
+                # with the collector's span view
+                jr = _telemetry.journey()
+                if q.get("name") or q.get("uid"):
+                    tl = jr.lookup(
+                        kind=q.get("kind"),
+                        namespace=q.get("ns") or q.get("namespace"),
+                        name=q.get("name"),
+                        uid=q.get("uid"),
+                    )
+                    if tl is None:
+                        self._send_json(
+                            404,
+                            {
+                                "error": "no journey recorded for that "
+                                "object (aged out of the ring, or "
+                                "telemetry disarmed)",
+                                "reason": "NotFound",
+                            },
+                        )
+                    else:
+                        self._send_json(200, tl)
+                else:
+                    self._send_json(
+                        200,
+                        {
+                            "stats": jr.stats(),
+                            "journeys": jr.journeys(
+                                kind=q.get("kind"),
+                                limit=int(q.get("limit") or 20),
+                            ),
+                        },
+                    )
             elif head == "r" and len(rest) == 1:
                 # canonical watch values only — must stay in lockstep
                 # with _dispatch's long-running classification, or a
@@ -784,6 +835,38 @@ class _Handler(BaseHTTPRequestHandler):
             self.server, "watch_timeout", 0
         )
         deadline = time.monotonic() + timeout_s if timeout_s else None
+        # rv→span stitching across the watch boundary: with a tracer
+        # armed, each event envelope carries the committing span's
+        # context resolved from the store's commit ring (side channel —
+        # the OBJECT payload is untouched; with tracing off the bytes
+        # are exactly the pre-existing envelope).  Resolution is ONE
+        # batched ring lookup per flushed burst — the ring lives under
+        # the writers' mutex, so per-event holds would multiply lock
+        # pressure by watcher fan-out.
+        from kwok_tpu.utils.trace import peek_global
+
+        _tr = peek_global()
+        ctx_many = (
+            getattr(self.store, "commit_contexts", None)
+            if _tr is not None and _tr.enabled
+            else None
+        )
+
+        def _encode_burst(burst):
+            ctxs = (
+                ctx_many([e.rv for e in burst])
+                if ctx_many is not None
+                else {}
+            )
+            out = []
+            for e in burst:
+                payload = {"type": e.type, "object": e.object, "rv": e.rv}
+                ctx = ctxs.get(e.rv)
+                if ctx is not None:
+                    payload["ctx"] = list(ctx)
+                out.append(self._encode_line(payload))
+            return out
+
         try:
             idle = 0.0
             last_chaos = time.monotonic()
@@ -826,19 +909,14 @@ class _Handler(BaseHTTPRequestHandler):
                 idle = 0.0
                 # drain the burst (e.g. a bulk tick's worth of MODIFIED
                 # events) into one buffered write + single flush
-                buf = [self._encode_line({"type": ev.type, "object": ev.object, "rv": ev.rv})]
-                last_rv = ev.rv
-                while len(buf) < 512:
+                burst = [ev]
+                while len(burst) < 512:
                     ev = w.next(timeout=0)
                     if ev is None:
                         break
-                    buf.append(
-                        self._encode_line(
-                            {"type": ev.type, "object": ev.object, "rv": ev.rv}
-                        )
-                    )
-                    last_rv = ev.rv
-                self.wfile.write(b"".join(buf))
+                    burst.append(ev)
+                last_rv = burst[-1].rv
+                self.wfile.write(b"".join(_encode_burst(burst)))
                 self.wfile.flush()
                 # observed rv-commit -> delivery lag, one sample per
                 # flushed burst (shared with the k8s dialect)

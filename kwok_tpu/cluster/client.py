@@ -188,6 +188,20 @@ def _raise_for(code: int, payload: Any) -> None:
     raise APIError(code, reason, msg)
 
 
+@dataclass
+class WireEvent:
+    """One decoded watch-stream event — duck-compatible with
+    ``store.WatchEvent`` (``type``/``object``/``rv``) plus the optional
+    ``ctx`` side channel: the committing span's (trace_id, span_id)
+    the apiserver resolved from its commit ring at delivery, so a
+    remote consumer can continue/link the causing write's trace."""
+
+    type: str
+    object: dict
+    rv: int = 0
+    ctx: Optional[Tuple[str, str]] = None
+
+
 class RemoteWatcher:
     """Client end of a watch stream; same surface as store.Watcher
     (next/stop/stopped/iteration).
@@ -239,27 +253,31 @@ class RemoteWatcher:
             except OSError:
                 pass
 
+    @staticmethod
+    def _decode(ev: dict) -> WireEvent:
+        ctx = ev.get("ctx")
+        return WireEvent(
+            type=ev["type"],
+            object=ev["object"],
+            rv=ev.get("rv", 0),
+            ctx=tuple(ctx) if isinstance(ctx, (list, tuple)) and len(ctx) == 2 else None,
+        )
+
     def next(self, timeout: Optional[float] = 0.5):
         ev, ok = self._queue.get_or_wait(timeout=timeout)
         if not ok or ev is None:
             return None
-        from kwok_tpu.cluster.store import WatchEvent
-
-        return WatchEvent(type=ev["type"], object=ev["object"], rv=ev.get("rv", 0))
+        return self._decode(ev)
 
     def drain(self):
         """Pop every currently-buffered event without blocking (same
         surface as store.Watcher.drain — the informer batches on it)."""
-        from kwok_tpu.cluster.store import WatchEvent
-
         out = []
         while True:
             ev, ok = self._queue.get()
             if not ok:
                 return out
-            out.append(
-                WatchEvent(type=ev["type"], object=ev["object"], rv=ev.get("rv", 0))
-            )
+            out.append(self._decode(ev))
 
     def __iter__(self):
         while True:
@@ -407,14 +425,22 @@ class ClusterClient:
             hdrs["X-Kwok-Client"] = self.client_id
         if headers:
             hdrs.update(headers)
+        tracer = None
+        orig_span = None
+        trace_hdr_ours = False
         if method != "GET":
             # propagate the caller's trace across the process boundary
             # (W3C traceparent; the apiserver continues the trace)
             from kwok_tpu.utils.trace import get_tracer, traceparent
 
-            tp = traceparent(get_tracer().current())
-            if tp:
-                hdrs.setdefault("traceparent", tp)
+            tr = get_tracer()
+            if tr.enabled:
+                tracer = tr
+                orig_span = tr.current()
+            tp = traceparent(orig_span)
+            if tp and "traceparent" not in hdrs:
+                hdrs["traceparent"] = tp
+                trace_hdr_ours = True
             if self.fence_provider is not None:
                 fence = self.fence_provider()
                 if fence:
@@ -425,6 +451,10 @@ class ClusterClient:
         start = time.monotonic()
         attempts = 0
         last_status: Optional[int] = None
+        #: anchor for retry-attempt spans when the caller has no live
+        #: span: the first retry becomes the trace root so ALL attempts
+        #: of one logical request still share ONE trace
+        retry_root = None
 
         def _wait_or_raise(message: str, retry_after=None, cause=None):
             # decide between sleeping into the next attempt and raising
@@ -446,6 +476,23 @@ class ClusterClient:
 
         while True:
             attempts += 1
+            aspan = None
+            if tracer is not None and attempts > 1:
+                # traceparent continuity across retries: every retry
+                # attempt is a CHILD span of the originating client
+                # span (or of the first retry, for span-less callers),
+                # so a 429/503-then-success sequence reads as ONE trace
+                # with its attempts visible, never N disconnected ones
+                aspan = tracer.span("client.retry", parent=orig_span or retry_root)
+                if orig_span is None and retry_root is None:
+                    retry_root = aspan
+                aspan.set("attempt", attempts)
+                aspan.set("http.method", method)
+                aspan.set("http.path", path)
+                if trace_hdr_ours:
+                    from kwok_tpu.utils.trace import traceparent
+
+                    hdrs["traceparent"] = traceparent(aspan)
             conn = self._conn()
             try:
                 conn.request(method, path, body=payload, headers=hdrs)
@@ -454,6 +501,8 @@ class ClusterClient:
                 # a retry on a fresh socket is safe for any verb (typical
                 # cause: the server closed an idle keep-alive connection,
                 # or a chaos reset/partition)
+                if aspan is not None:
+                    aspan.error(str(exc)).end()
                 self._drop_conn(conn)
                 self._note_retry("transport")
                 _wait_or_raise(f"{method} {path}: {exc}", cause=exc)
@@ -465,6 +514,8 @@ class ClusterClient:
                 # response lost after the request went out: the server
                 # may have applied the mutation, so only idempotent
                 # reads retry
+                if aspan is not None:
+                    aspan.error(str(exc)).end()
                 self._drop_conn(conn)
                 if method not in ("GET", "HEAD"):
                     raise ApiUnavailable(
@@ -474,6 +525,8 @@ class ClusterClient:
                     ) from exc
                 _wait_or_raise(f"{method} {path}: {exc}", cause=exc)
                 continue
+            if aspan is not None:
+                aspan.set("http.status", resp.status).end()
             if resp.status in policy.retry_statuses:
                 last_status = resp.status
                 retry_after = parse_retry_after(resp.getheader("Retry-After"))
@@ -771,6 +824,24 @@ class ClusterClient:
         health surface (``wal``: segments/bytes/last-fsync age plus
         recovery counters)."""
         return self._request("GET", "/stats")
+
+    def debug_journey(
+        self,
+        kind: Optional[str] = None,
+        namespace: Optional[str] = None,
+        name: Optional[str] = None,
+        uid: Optional[str] = None,
+    ) -> dict:
+        """One object's journey timeline from the apiserver's bounded
+        uid-keyed ring (``GET /debug/journey`` — commit/watch hops with
+        committing trace ids); without a name/uid, the recent-journeys
+        listing plus ring stats.  ``kwokctl trace`` joins this with the
+        collector's span view."""
+        return self._request(
+            "GET",
+            "/debug/journey"
+            + self._q(kind=kind, ns=namespace, name=name, uid=uid),
+        )
 
     def restore_state(self, state: dict) -> int:
         """Load a raw snapshot into a live cluster (etcd-restore

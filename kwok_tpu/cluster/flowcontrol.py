@@ -515,11 +515,40 @@ def expose_metrics(flow: Optional[FlowController], store=None) -> str:
         _expose_wal(reg, store, Gauge)
         _expose_election(reg, store, Gauge)
     _expose_tracer(reg, Counter)
+    _expose_journey(reg, Counter)
     # observed SLO histograms (utils/telemetry): request duration, APF
     # queue wait, WAL append/fsync, watch delivery lag, scheduler bind
     # latency, tick stages — whatever this process observed, appended
     # so one scrape covers synthetic and observed series alike
     return reg.expose() + _telemetry.registry().expose()
+
+
+def _expose_journey(reg, Counter) -> None:
+    """Journey-timeline ring health (utils/telemetry.JourneyRecorder):
+    the tentpole's bounded-with-drop-counters contract — LRU object
+    evictions and per-object hop drops must be visible at /metrics, or
+    a truncated timeline reads as a complete one."""
+    stats = _telemetry.journey().stats()
+    for mname, key, help_ in (
+        (
+            "kwok_journey_objects_evicted_total",
+            "evicted_objects",
+            "journey timelines LRU-evicted by the bounded object ring",
+        ),
+        (
+            "kwok_journey_hops_dropped_total",
+            "dropped_hops",
+            "journey hops dropped by a full per-object ring",
+        ),
+        (
+            "kwok_journey_objects",
+            "objects",
+            "objects currently holding a journey timeline",
+        ),
+    ):
+        c = Counter(mname, help=help_)
+        c.set(stats[key])
+        reg.register(mname, c)
 
 
 def _expose_tracer(reg, Counter) -> None:

@@ -42,6 +42,12 @@ _FAST = _load_fastdrain()
 class InformerEvent:
     type: str  # ADDED | MODIFIED | DELETED | SYNC
     object: dict
+    #: committing span context ``(trace_id, span_id)`` resolved across
+    #: the watch boundary (store commit ring / wire ``ctx`` side
+    #: channel), or None — consumers open their reconcile span as a
+    #: continuation of / link to the write that caused this event.
+    #: Lists and re-syncs carry none (no single causing write).
+    ctx: Optional[Tuple[str, str]] = None
 
 
 @dataclass
@@ -345,6 +351,21 @@ class Informer:
         """Forward one live watch stream until it dies or ``done`` is
         set; returns the highest delivered resourceVersion so the outer
         loop can resume there."""
+        # rv→span resolution for in-process stores: with a tracer
+        # armed, forwarded events carry the committing span's context
+        # looked up from the store's commit ring — ONE batched lookup
+        # per forwarded batch (remote streams already arrive with the
+        # wire `ctx` side channel).  Tracing off — or a batch with no
+        # traced writes, e.g. the bulk drain — keeps the native fast
+        # path untouched.
+        from kwok_tpu.utils.trace import peek_global
+
+        _tr = peek_global()
+        resolve_many = (
+            getattr(self._store, "commit_contexts", None)
+            if _tr is not None and _tr.enabled
+            else None
+        )
         while not done.is_set():
             ev = w.next(timeout=0.2)
             if ev is None:
@@ -363,11 +384,19 @@ class Informer:
                 brv = getattr(bev, "rv", 0) or 0
                 if last_rv is None or brv > last_rv:
                     last_rv = brv
-            if opt.predicate is None and _FAST is not None:
+            ctxs = {}
+            if resolve_many is not None:
+                rvs = [r for r in (getattr(e, "rv", 0) or 0 for e in batch) if r]
+                if rvs:
+                    ctxs = resolve_many(rvs)
+            if opt.predicate is None and _FAST is not None and not ctxs:
                 # native fast path: update the cache mirror
                 # in one pass and forward the store events
                 # as-is (WatchEvent and InformerEvent are
-                # duck-compatible: .type/.object)
+                # duck-compatible: .type/.object; a remote
+                # stream's events already carry .ctx).  A batch
+                # with no traced writes — the bulk drain's shape —
+                # stays on this path even with a tracer armed.
                 if use_cache:
                     with getter._mut:
                         _FAST.cache_apply(getter._items, batch)
@@ -382,16 +411,19 @@ class Informer:
                     meta.get("namespace") or "",
                     meta.get("name") or "",
                 )
+                ctx = getattr(ev, "ctx", None)
+                if ctx is None and ctxs:
+                    ctx = ctxs.get(getattr(ev, "rv", 0) or 0)
                 if opt.predicate is not None and not opt.predicate(obj):
                     # object left the predicate set: surface as
                     # a delete so controllers stop managing it
                     if use_cache:
                         if getter.get(key[1], key[0]):
                             cache_ops.append((DELETED, obj))
-                            out.append(InformerEvent(DELETED, obj))
+                            out.append(InformerEvent(DELETED, obj, ctx))
                     elif key in seen:
                         seen.discard(key)
-                        out.append(InformerEvent(DELETED, obj))
+                        out.append(InformerEvent(DELETED, obj, ctx))
                     continue
                 if use_cache:
                     cache_ops.append((ev.type, obj))
@@ -400,7 +432,7 @@ class Informer:
                         seen.discard(key)
                     else:
                         seen.add(key)
-                out.append(InformerEvent(ev.type, obj))
+                out.append(InformerEvent(ev.type, obj, ctx))
             if cache_ops:
                 getter._apply_batch(cache_ops)
             events.extend(out)

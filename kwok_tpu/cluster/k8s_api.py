@@ -891,6 +891,18 @@ class K8sFacade:
         handler.close_connection = True
         shutdown = getattr(handler.server, "shutting_down", None)
         deadline = time.monotonic() + timeout_s if timeout_s else None
+        # rv→span stitch: with a tracer armed each live event envelope
+        # gains the committing span's context from the commit ring —
+        # resolved as ONE batched ring lookup per flushed burst (same
+        # lock-pressure discipline as the legacy dialect)
+        from kwok_tpu.utils.trace import peek_global
+
+        _tr = peek_global()
+        ctx_many = (
+            getattr(self.store, "commit_contexts", None)
+            if _tr is not None and _tr.enabled
+            else None
+        )
         # kubectl get -w sends the same Table accept chain on the watch
         # request: once the list came back as a Table, event objects
         # must be Table-typed too (single-row tables, like the real
@@ -995,17 +1007,30 @@ class K8sFacade:
                         )
                     continue
                 idle = 0.0
-                buf = [self._encode_event(r.rtype, ev, as_table, include_object)]
-                last_rv = ev.rv
-                while len(buf) < 512:
+                burst = [ev]
+                while len(burst) < 512:
                     ev = w.next(timeout=0)
                     if ev is None:
                         break
-                    buf.append(
-                        self._encode_event(r.rtype, ev, as_table, include_object)
+                    burst.append(ev)
+                last_rv = burst[-1].rv
+                ctxs = (
+                    ctx_many([e.rv for e in burst])
+                    if ctx_many is not None
+                    else {}
+                )
+                handler.wfile.write(
+                    b"".join(
+                        self._encode_event(
+                            r.rtype,
+                            e,
+                            as_table,
+                            include_object,
+                            ctx=ctxs.get(e.rv),
+                        )
+                        for e in burst
                     )
-                    last_rv = ev.rv
-                handler.wfile.write(b"".join(buf))
+                )
                 handler.wfile.flush()
                 # observed rv-commit -> delivery lag, one sample per
                 # flushed burst (shared with the legacy dialect)
@@ -1016,7 +1041,12 @@ class K8sFacade:
             w.stop()
 
     def _encode_event(
-        self, rtype, ev, as_table: bool = False, include_object: str = "Metadata"
+        self,
+        rtype,
+        ev,
+        as_table: bool = False,
+        include_object: str = "Metadata",
+        ctx=None,
     ) -> bytes:
         # watch events share the stored instance (store._emit contract):
         # never _stamp it in place — graft missing kind/apiVersion onto
@@ -1028,7 +1058,17 @@ class K8sFacade:
             obj.setdefault("apiVersion", rtype.api_version)
         if as_table:
             obj = to_table(rtype.kind, [obj], include_object=include_object)
-        return json.dumps({"type": ev.type, "object": obj}).encode() + b"\n"
+        payload = {"type": ev.type, "object": obj}
+        # rv→span stitch, k8s dialect: with a tracer armed (ctx
+        # batch-resolved per burst by _serve_watch) the envelope
+        # carries the committing span context as an EXTRA top-level key
+        # (object payload untouched; client-go/kubectl ignore unknown
+        # watch-event fields, and Table streams stay pristine — kubectl
+        # is the only Table consumer).  Tracing off ⇒ byte-identical
+        # frames to the pre-existing dialect.
+        if ctx is not None and not as_table:
+            payload["ctx"] = list(ctx)
+        return json.dumps(payload).encode() + b"\n"
 
     @staticmethod
     def _write_frame(handler, payload: dict) -> None:

@@ -276,6 +276,41 @@ class ShardedStore:
                 return lag
         return None
 
+    def commit_context(self, rv: int):
+        """Sharded twin of ``ResourceStore.commit_context``: the
+        committing span's (trace_id, span_id) for a recent rv, resolved
+        from whichever shard's ring committed it — so the rv→span
+        stitch survives the ``MergedWatcher`` fan-in unchanged."""
+        for s in self._shards:
+            ctx = s.commit_context(rv)
+            if ctx is not None:
+                return ctx
+        return None
+
+    def commit_meta(self, rv: int):
+        """Sharded twin of ``ResourceStore.commit_meta`` (journey join
+        at watch delivery): first owning ring answers."""
+        for s in self._shards:
+            meta = s.commit_meta(rv)
+            if meta is not None:
+                return meta
+        return None
+
+    def commit_contexts(self, rvs):
+        """Batch twin of :meth:`commit_context`: one lock hold PER
+        SHARD resolves the whole burst (each rv lives on exactly one
+        shard, so later shards only probe the leftovers)."""
+        out = {}
+        pending = list(rvs)
+        for s in self._shards:
+            if not pending:
+                break
+            hit = s.commit_contexts(pending)
+            if hit:
+                out.update(hit)
+                pending = [rv for rv in pending if rv not in hit]
+        return out
+
     def shard_topology(self) -> Dict[str, Any]:
         """The route table the per-shard HTTP dispatch lanes are
         derived from (``GET /shards``); ``algo`` names the placement

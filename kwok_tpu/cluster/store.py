@@ -36,6 +36,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 from kwok_tpu.cluster.wal import StorageDegraded, WalExhausted
 from kwok_tpu.utils import telemetry as _telemetry
+from kwok_tpu.utils import trace as _trace
 from kwok_tpu.utils.clock import Clock, RealClock
 from kwok_tpu.utils.locks import make_lock, make_rlock
 from kwok_tpu.utils.patch import apply_patch
@@ -66,13 +67,34 @@ def observe_watch_delivery(store, rv: int) -> None:
     a sharded router); a miss just means the rv aged out of the
     bounded ring.  Shared by both watch dialects
     (``cluster/apiserver.py`` and ``cluster/k8s_api.py`` call it after
-    each burst flush) so the series can never diverge between them."""
+    each burst flush) so the series can never diverge between them.
+    The same resolution feeds the per-object journey timeline: the
+    ring's identity slot names the object the rv committed, so the
+    delivery lands as one ``watch`` hop (deduped per rv — several
+    streams deliver the same commit)."""
     if not _telemetry.enabled():
         return
     lag_fn = getattr(store, "delivery_lag", None)
     hit = lag_fn(rv) if lag_fn is not None else None
-    if hit is not None:
-        _H_WATCH_DELIVERY.observe(hit[0], hit[1])
+    if hit is None:
+        return
+    _H_WATCH_DELIVERY.observe(hit[0], hit[1])
+    meta_fn = getattr(store, "commit_meta", None)
+    meta = meta_fn(rv) if meta_fn is not None else None
+    if meta is not None:
+        ctx, uid, kind, ns, name = meta
+        _telemetry.journey().record(
+            uid,
+            kind,
+            ns,
+            name,
+            "watch",
+            dedupe_rv=rv,
+            rv=rv,
+            lag_s=round(hit[0], 6),
+            shard=hit[1],
+            trace_id=ctx[0] if ctx else "",
+        )
 
 #: the namespace-lifecycle finalizer (the apiserver's
 #: ``spec.finalizers: [kubernetes]`` analog; consumed by
@@ -719,6 +741,11 @@ class ResourceStore:
         #: branch per emit.  Mutated under the store mutex.
         self._commit_ring: deque = deque()
         self._commit_times: Dict[int, float] = {}
+        #: rv -> (span ctx | None, uid, kind, ns, name) for recently
+        #: emitted single-object commits (same ring bound/eviction as
+        #: _commit_times): the causal identity the watch servers
+        #: resolve at delivery — rv→span stitching + journey join key
+        self._commit_meta: Dict[int, tuple] = {}
         #: per-thread batch marker: inside bulk(), per-event commit
         #: notes collapse into ONE note of the batch's last rv (same
         #: cadence as status batches) so the drain-rate event stream
@@ -956,15 +983,60 @@ class ResourceStore:
     #: flow; older deliveries just go unobserved (sampling, not error)
     COMMIT_RING = 8192
 
-    def _note_commit(self, rv: int) -> None:
+    def _note_commit(
+        self,
+        rv: int,
+        st: Optional["_TypeState"] = None,
+        etype: Optional[str] = None,
+        obj: Optional[dict] = None,
+    ) -> None:
         """Record the commit instant of an emitted rv (caller holds the
         mutex and has checked a watcher exists).  Observation-only: the
-        watch servers turn this into the delivery-lag histogram."""
+        watch servers turn this into the delivery-lag histogram.
+
+        With the committing object in hand (single-object mutation
+        paths and txn ops — the bulk drain's per-batch note passes
+        none, keeping the 1M-pod lane at its measured cost) the ring
+        additionally carries the write's causal identity: the
+        committing thread's live span context (rv→span stitching across
+        the watch boundary — the apiserver handler's request span is
+        open right here, continuing the client's W3C trace) plus the
+        object's uid/kind/ns/name, and the commit lands as one
+        ``commit`` hop on the object's journey timeline."""
         self._commit_times[rv] = time.monotonic()
         ring = self._commit_ring
         ring.append(rv)
         if len(ring) > self.COMMIT_RING:
-            self._commit_times.pop(ring.popleft(), None)
+            old = ring.popleft()
+            self._commit_times.pop(old, None)
+            self._commit_meta.pop(old, None)
+        if obj is None or st is None:
+            return
+        ctx = _trace.current_context()
+        meta = obj.get("metadata") or {}
+        uid = meta.get("uid") or ""
+        kind = st.rtype.kind
+        ns = meta.get("namespace") or ""
+        name = meta.get("name") or ""
+        if ctx is not None:
+            self._commit_meta[rv] = (ctx, uid, kind, ns, name)
+        elif uid:
+            self._commit_meta[rv] = (None, uid, kind, ns, name)
+        if uid:
+            phase = (obj.get("status") or {}).get("phase")
+            _telemetry.journey().record(
+                uid,
+                kind,
+                ns,
+                name,
+                "commit",
+                rv=rv,
+                etype=etype or "",
+                phase=phase or "",
+                shard=self.telemetry_shard,
+                trace_id=ctx[0] if ctx else "",
+                span_id=ctx[1] if ctx else "",
+            )
 
     def delivery_lag(self, rv: int) -> Optional[Tuple[float, int]]:
         """(seconds since rv committed, shard index) for a recently
@@ -975,6 +1047,38 @@ class ResourceStore:
         if t is None:
             return None
         return (time.monotonic() - t, self.telemetry_shard)
+
+    def commit_context(self, rv: int) -> Optional[Tuple[str, str]]:
+        """The committing span's ``(trace_id, span_id)`` for a recently
+        emitted rv, or None (aged out / untraced write / tracer off).
+        The watch servers resolve this at delivery so consumers can
+        open their reconcile span as a continuation of — or link to —
+        the write that caused the event."""
+        with self._mut:
+            meta = self._commit_meta.get(rv)
+        return meta[0] if meta is not None else None
+
+    def commit_contexts(self, rvs) -> Dict[int, Tuple[str, str]]:
+        """Batch form of :meth:`commit_context`: one mutex hold
+        resolves a whole watch burst's rvs (the delivery loops call
+        this once per flushed burst, not once per event — the store
+        lock is the writers' lock, and tracing must not multiply holds
+        by fan-out).  Only rvs with a context appear in the result."""
+        out: Dict[int, Tuple[str, str]] = {}
+        meta = self._commit_meta
+        with self._mut:
+            for rv in rvs:
+                m = meta.get(rv)
+                if m is not None and m[0] is not None:
+                    out[rv] = m[0]
+        return out
+
+    def commit_meta(self, rv: int):
+        """Full causal-identity slot for an rv: ``(ctx, uid, kind,
+        namespace, name)`` or None — the journey timeline's join key at
+        watch delivery."""
+        with self._mut:
+            return self._commit_meta.get(rv)
 
     def _emit(self, st: _TypeState, etype: str, obj: dict, rv: int) -> None:
         # the event shares the stored instance — the same
@@ -991,7 +1095,7 @@ class ResourceStore:
                 # deferred: bulk() notes the batch's last rv once
                 tl.batch_rv = rv
             else:
-                self._note_commit(rv)
+                self._note_commit(rv, st=st, etype=etype, obj=obj)
         for w in list(st.watchers):
             w._push(ev)
 

@@ -149,6 +149,13 @@ def run_elected(
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # install the process tracer at boot (KWOK_TRACE_ENDPOINT /
+    # KWOK_TRACE_SERVICE from the runtime): watch streams opened
+    # before the first traced request must already see it to
+    # resolve rv→span contexts at delivery
+    from kwok_tpu.utils.trace import get_tracer
+
+    get_tracer('kcm')
     from kwok_tpu.utils.log import setup as log_setup
 
     log_setup(args.verbosity)

@@ -342,6 +342,13 @@ def _controller_self_metrics(get_ctr, elector=None):
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # install the process tracer at boot (KWOK_TRACE_ENDPOINT /
+    # KWOK_TRACE_SERVICE from the runtime): watch streams opened
+    # before the first traced request must already see it to
+    # resolve rv→span contexts at delivery
+    from kwok_tpu.utils.trace import get_tracer
+
+    get_tracer('kwok')
     if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
         print(
             "error: --tls-cert-file and --tls-private-key-file must be "

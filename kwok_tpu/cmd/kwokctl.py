@@ -160,6 +160,20 @@ def cmd_get_components(args) -> int:
     except Exception:  # noqa: BLE001 — a down apiserver degrades to
         # the plain liveness listing rather than failing the command
         pass
+    tracing_stats = None
+    try:
+        tport = (rt.load_config().get("ports") or {}).get("tracing")
+        if tport:
+            import urllib.request
+
+            tracing_stats = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{tport}/api/stats", timeout=2
+                ).read()
+            )
+    except Exception:  # noqa: BLE001 — a down collector degrades to
+        # the bare liveness row, same as the apiserver stats above
+        pass
     for name, alive in rt.running_components().items():
         status = "Running" if alive else "Stopped"
         if name == "apiserver" and alive and wal and wal.get("degraded"):
@@ -204,6 +218,18 @@ def cmd_get_components(args) -> int:
             wq = latency.get("kwok_apiserver_flow_queue_wait_seconds")
             if wq and wq.get("p99_s", 0) >= 0.001:
                 line += f"\tqueue-wait-p99={wq['p99_s'] * 1000:.1f}ms"
+        if name == "tracing" and tracing_stats:
+            # collector ingest health (GET /api/stats): spans landed vs
+            # shed, plus MAX_TRACES ring churn — the "is my trace still
+            # there" answer at a glance
+            line += (
+                f"\tingest={tracing_stats.get('received', 0)}spans"
+                f"/{tracing_stats.get('traces', 0)}traces"
+            )
+            if tracing_stats.get("dropped"):
+                line += f"\tdropped={tracing_stats['dropped']}"
+            if tracing_stats.get("evicted_traces"):
+                line += f"\tevicted={tracing_stats['evicted_traces']}"
         if name == "apiserver" and wal:
             per_shard = wal.get("shards") or []
             if len(per_shard) > 1:
@@ -222,6 +248,132 @@ def cmd_get_components(args) -> int:
                     )
                 line += "\tshards=" + ",".join(cells)
         print(line)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Render one object's causal journey waterfall: the apiserver's
+    journey timeline (``/debug/journey`` — commit/watch hops with
+    committing trace ids) joined with the collector's link-stitched
+    span view (``/api/journey``), with per-hop latency attribution —
+    the per-object answer the PR 12 histograms only give in aggregate."""
+    from urllib.parse import quote
+
+    rt = _require_cluster(args)
+    kind = args.kind
+    ns, _, name = args.target.rpartition("/")
+    # no namespace given: don't guess — cluster-scoped kinds (nodes)
+    # record namespace "" and the apiserver lookup treats None as
+    # no-filter; the collector probe tries both spellings below
+    ns = ns or None
+
+    timeline = None
+    try:
+        timeline = rt.client(timeout=5.0).debug_journey(
+            kind=kind, namespace=ns, name=name
+        )
+    except Exception as exc:  # noqa: BLE001 — the collector view below
+        # can still answer when the apiserver ring aged the object out
+        print(f"(journey timeline unavailable: {exc})", file=sys.stderr)
+
+    journey = None
+    tport = (rt.load_config().get("ports") or {}).get("tracing")
+    if tport:
+        import urllib.request
+
+        # span identity attrs are "<ns>/<name>": namespaced kinds
+        # default to "default/", cluster-scoped spans carry "/<name>"
+        candidates = (
+            [f"{ns}/{name}"]
+            if ns
+            else [f"default/{name}", f"/{name}"]
+        )
+        last_exc = None
+        for cand in candidates:
+            try:
+                journey = json.loads(
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{tport}/api/journey"
+                        f"?name={quote(cand, safe='')}",
+                        timeout=5,
+                    ).read()
+                )
+                break
+            except Exception as exc:  # noqa: BLE001 — try next spelling
+                last_exc = exc
+        if journey is None:
+            print(
+                f"(collector journey unavailable: {last_exc})", file=sys.stderr
+            )
+    else:
+        print(
+            "(cluster was created without --trace; only the apiserver "
+            "journey timeline is available)",
+            file=sys.stderr,
+        )
+
+    if timeline is None and journey is None:
+        print(f"no trace data for {kind} {args.target}")
+        return 1
+
+    # one wall-clock axis for both sources: collector span rows carry
+    # t0_ns; timeline hops carry t_wall
+    rows = []  # (t_wall, duration_s|None, source, what, detail)
+    if journey:
+        t0 = journey.get("t0_ns", 0) / 1e9
+        for h in journey.get("hops") or []:
+            rows.append(
+                (
+                    t0 + h["start_s"],
+                    h.get("duration_s"),
+                    h.get("service") or "span",
+                    h.get("name") or "",
+                    f"stage={h.get('stage')} trace={h.get('trace_id', '')[:8]}",
+                )
+            )
+    if timeline:
+        for h in timeline.get("hops") or []:
+            what = h.get("hop") or ""
+            detail = []
+            if h.get("etype"):
+                detail.append(str(h["etype"]))
+            if h.get("rv"):
+                detail.append(f"rv={h['rv']}")
+            if h.get("phase"):
+                detail.append(f"phase={h['phase']}")
+            if h.get("lag_s") is not None:
+                detail.append(f"lag={1000 * float(h['lag_s']):.1f}ms")
+            if h.get("trace_id"):
+                detail.append(f"trace={str(h['trace_id'])[:8]}")
+            rows.append(
+                (float(h.get("t_wall") or 0), None, "store", what, " ".join(detail))
+            )
+    rows.sort(key=lambda r: r[0])
+    if not rows:
+        print(f"no trace data for {kind} {args.target}")
+        return 1
+    t_first = rows[0][0]
+    print(f"journey: {kind} {args.target}")
+    if journey:
+        print(
+            f"traces: {', '.join(t[:16] for t in journey.get('traces') or [])}"
+            f"  total={journey.get('total_s', 0):.3f}s"
+        )
+    print(f"{'OFFSET':>10}  {'DURATION':>9}  {'SOURCE':<10}  WHAT")
+    for t, dur, source, what, detail in rows:
+        off = f"+{t - t_first:.3f}s"
+        d = f"{dur:.4f}s" if dur is not None else "-"
+        print(f"{off:>10}  {d:>9}  {source:<10}  {what}  {detail}")
+    if journey:
+        bd = journey.get("breakdown_s") or {}
+        total = journey.get("total_s") or 0.0
+        parts = [
+            f"{stage}={bd[stage]:.3f}s"
+            + (f" ({100 * bd[stage] / total:.0f}%)" if total else "")
+            for stage in ("client", "queue", "commit", "watch", "sched", "stage", "other")
+            if bd.get(stage)
+        ]
+        print("attribution: " + (" | ".join(parts) if parts else "(none)"))
     return 0
 
 
@@ -1451,9 +1603,13 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--controller-arg", action="append", default=[])
     c.add_argument(
         "--enable-tracing",
+        "--trace",
+        dest="enable_tracing",
         action="store_true",
         help="run the trace collector component and point every "
-        "component's tracer at it (the jaeger seat)",
+        "component's tracer at it (the jaeger seat); --trace is the "
+        "short form.  With it armed, `kwokctl trace <kind> <ns>/<name>` "
+        "renders the object's causal journey waterfall",
     )
     c.add_argument(
         "--chaos-profile",
@@ -1568,6 +1724,15 @@ def build_parser() -> argparse.ArgumentParser:
     el = pes.add_parser("logs")
     el.add_argument("dest", help="destination directory")
     el.set_defaults(fn=cmd_export_logs)
+
+    pw = sub.add_parser(
+        "trace",
+        help="render one object's causal journey waterfall "
+        "(apiserver journey timeline + collector span view)",
+    )
+    pw.add_argument("kind", help="resource kind, e.g. pod")
+    pw.add_argument("target", help="[namespace/]name")
+    pw.set_defaults(fn=cmd_trace)
 
     px = sub.add_parser("scale", help="create N rendered objects")
     px.add_argument("kind", help="node | pod | any registered kind with --template")

@@ -11,6 +11,16 @@ serves a Jaeger-flavored query surface:
 - ``GET  /api/services``              known service names
 - ``GET  /api/traces?service=&limit=`` recent traces (span lists)
 - ``GET  /api/traces/{trace_id}``     one trace
+- ``GET  /api/stats``                 ingest health: spans received /
+  malformed-dropped / trace evictions from the bounded ``MAX_TRACES``
+  ring (``kwokctl get components`` renders these on the tracing seat)
+- ``GET  /api/journey?name=ns/name``  one object's causally-stitched
+  span set joined across traces by OTLP links (the rv→span stitch:
+  client create → apiserver commit → scheduler bind / gang txn → stage
+  plays), with per-hop latency attribution (utils/trace.build_journey)
+- ``GET  /api/critical-path?limit=N`` aggregate N recent journeys into
+  a time-to-running budget (queue/commit/watch/sched/stage shares —
+  ``python -m kwok_tpu.utils.trace --critical-path`` renders it)
 - ``GET  /``                          minimal HTML trace browser
 - ``GET  /healthz``
 """
@@ -39,6 +49,10 @@ class TraceStore:
         self._order: deque = deque()
         self.services: Dict[str, int] = {}
         self.received = 0
+        #: non-dict "spans" skipped at ingest (malformed input)
+        self.dropped = 0
+        #: whole traces evicted by the bounded MAX_TRACES ring
+        self.evicted = 0
 
     def ingest(self, payload: dict) -> int:
         n = 0
@@ -51,6 +65,7 @@ class TraceStore:
                 for ss in rs.get("scopeSpans") or []:
                     for span in ss.get("spans") or []:
                         if not isinstance(span, dict):
+                            self.dropped += 1
                             continue
                         span = dict(span)
                         span["service"] = str(service)
@@ -71,12 +86,19 @@ class TraceStore:
                             and "key" in a
                             and isinstance(a.get("value"), dict)
                         ]
+                        links = span.get("links")
+                        span["links"] = [
+                            ln
+                            for ln in (links if isinstance(links, list) else [])
+                            if isinstance(ln, dict)
+                        ]
                         tid = str(span.get("traceId") or "")
                         span["traceId"] = tid
                         if tid not in self._traces:
                             if len(self._traces) >= MAX_TRACES:
                                 old = self._order.popleft()
                                 self._traces.pop(old, None)
+                                self.evicted += 1
                             self._traces[tid] = []
                             self._order.append(tid)
                         self._traces[tid].append(span)
@@ -101,6 +123,119 @@ class TraceStore:
         with self._mut:
             spans = self._traces.get(trace_id)
             return None if spans is None else {"traceID": trace_id, "spans": list(spans)}
+
+    def stats(self) -> dict:
+        """Ingest-health counters for /api/stats and the kwokctl
+        components view."""
+        with self._mut:
+            return {
+                "received": self.received,
+                "dropped": self.dropped,
+                "evicted_traces": self.evicted,
+                "traces": len(self._traces),
+                "max_traces": MAX_TRACES,
+                "services": dict(self.services),
+            }
+
+    # ------------------------------------------------------- journey join
+
+    _IDENTITY_ATTRS = ("pod", "object", "gang")
+
+    @classmethod
+    def _span_object(cls, span: dict) -> str:
+        """The object identity a span claims ("ns/name"), or "" —
+        scheduler spans carry ``pod``, play/gc/workloads spans carry
+        ``object`` (optionally "Kind:ns/name"-prefixed)."""
+        from kwok_tpu.utils.trace import span_attr
+
+        for key in cls._IDENTITY_ATTRS:
+            v = span_attr(span, key)
+            if v is not None:
+                return str(v).split(":")[-1]
+        return ""
+
+    def journey_spans(self, name: str = "", trace_id: str = "") -> List[dict]:
+        """Every span causally joined to one object: seed with the
+        traces whose spans name the object (or the given trace id),
+        then close over the OTLP link graph in both directions — a link
+        FROM a seed trace pulls its target in, and a span elsewhere
+        linking INTO a seed trace joins too (the watch-boundary stitch
+        records links on the consumer side)."""
+        with self._mut:
+            traces = {tid: list(spans) for tid, spans in self._traces.items()}
+        seeds = set()
+        if trace_id and trace_id in traces:
+            seeds.add(trace_id)
+        if name:
+            for tid, spans in traces.items():
+                if any(self._span_object(s) == name for s in spans):
+                    seeds.add(tid)
+        if not seeds:
+            return []
+        # link closure (the graph is tiny per object; traces are
+        # bounded by MAX_TRACES so the fixpoint terminates fast)
+        changed = True
+        while changed:
+            changed = False
+            for tid, spans in traces.items():
+                linked = {
+                    str(ln.get("traceId") or "")
+                    for s in spans
+                    for ln in s.get("links") or []
+                }
+                if tid in seeds:
+                    fresh = (linked & set(traces)) - seeds
+                    if fresh:
+                        seeds |= fresh
+                        changed = True
+                elif linked & seeds:
+                    seeds.add(tid)
+                    changed = True
+        return [s for tid in seeds for s in traces[tid]]
+
+    def recent_journeys(self, limit: int = 50) -> List[dict]:
+        """Journeys (``build_journey`` outputs) of the most recent
+        link-joined trace clusters that actually crossed the watch
+        boundary (>= 2 stage categories) — the critical-path input."""
+        from kwok_tpu.utils.trace import build_journey, classify_span
+
+        with self._mut:
+            traces = {tid: list(spans) for tid, spans in self._traces.items()}
+            order = list(self._order)
+        # union-find over the link graph
+        parent: Dict[str, str] = {tid: tid for tid in traces}
+
+        def find(x: str) -> str:
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        def union(a: str, b: str) -> None:
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[ra] = rb
+
+        for tid, spans in traces.items():
+            for s in spans:
+                for ln in s.get("links") or []:
+                    target = str(ln.get("traceId") or "")
+                    if target in parent:
+                        union(tid, target)
+        clusters: "OrderedDict[str, List[dict]]" = OrderedDict()
+        for tid in order:
+            if tid not in traces:
+                continue
+            clusters.setdefault(find(tid), []).extend(traces[tid])
+        out: List[dict] = []
+        for spans in reversed(clusters.values()):  # newest-first
+            stages = {classify_span(str(s.get("name") or "")) for s in spans}
+            if len(stages - {"other"}) < 2:
+                continue  # a lone request, not a cross-component journey
+            out.append(build_journey(spans))
+            if len(out) >= limit:
+                break
+        return out
 
 
 def _render_trace_html(trace: dict) -> str:
@@ -190,6 +325,35 @@ def serve(store: TraceStore, host: str, port: int) -> ThreadingHTTPServer:
                 self._json(200, {"status": "ok", "received": store.received})
             elif u.path == "/api/services":
                 self._json(200, {"data": sorted(store.services)})
+            elif u.path == "/api/stats":
+                self._json(200, store.stats())
+            elif u.path == "/api/journey":
+                from kwok_tpu.utils.trace import build_journey
+
+                name = q.get("name", "")
+                ns = q.get("ns") or q.get("namespace") or ""
+                if ns and name and "/" not in name:
+                    name = f"{ns}/{name}"
+                spans = store.journey_spans(
+                    name=name, trace_id=q.get("traceId", "")
+                )
+                if not spans:
+                    self._json(
+                        404,
+                        {"error": f"no journey for {name or q.get('traceId')!r}"},
+                    )
+                else:
+                    j = build_journey(spans)
+                    j["object"] = name
+                    j["traces"] = sorted({s["traceId"] for s in spans})
+                    self._json(200, j)
+            elif u.path == "/api/critical-path":
+                from kwok_tpu.utils.trace import critical_path
+
+                journeys = store.recent_journeys(
+                    limit=int(q.get("limit") or 50)
+                )
+                self._json(200, critical_path(journeys))
             elif parts[:2] == ["api", "traces"] and len(parts) == 3:
                 tr = store.get(parts[2])
                 if tr is None:

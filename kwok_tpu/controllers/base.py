@@ -133,21 +133,27 @@ class StagePlayer:
             return
         if self.read_only is not None and self.read_only(obj):
             return
-        self.preprocess_q.add(obj)
+        # the causing write's span context travels with the object
+        # through preprocess -> delay queue -> play (watch-boundary
+        # stitch; None with tracing off)
+        self.preprocess_q.add((obj, getattr(ev, "ctx", None)))
 
     def _preprocess_worker(self) -> None:
         while not self._done.is_set():
-            obj, ok = self.preprocess_q.get_or_wait(timeout=0.2)
+            item, ok = self.preprocess_q.get_or_wait(timeout=0.2)
             if not ok:
                 continue
+            # bare objects still arrive from ctx-less re-feeds
+            # (node_controller.manage_node) — tolerate both shapes
+            obj, ctx = item if isinstance(item, tuple) else (item, None)
             try:
-                self.preprocess(obj)
+                self.preprocess(obj, ctx=ctx)
             except Exception:  # noqa: BLE001 — a bad object must not kill the loop
                 import traceback
 
                 traceback.print_exc()
 
-    def preprocess(self, obj: dict) -> None:
+    def preprocess(self, obj: dict, ctx=None) -> None:
         """Match + delay + enqueue (reference pod_controller.go:196-254)."""
         key = self._key(obj)
         meta = obj.get("metadata") or {}
@@ -168,7 +174,7 @@ class StagePlayer:
             return
         now = datetime.datetime.fromtimestamp(self.clock.now(), datetime.timezone.utc)
         delay, _ = stage.delay(data, now, rng=self.rng)
-        job = StageJob(resource=obj, stage=stage, key=key)
+        job = StageJob(resource=obj, stage=stage, key=key, ctx=ctx)
         self.add_stage_job(job, delay, weight=0)
 
     def add_stage_job(self, job: StageJob, delay: float, weight: int) -> None:
@@ -200,7 +206,7 @@ class StagePlayer:
                 if self.delay_queue_mapping.get(job.key) is job:
                     del self.delay_queue_mapping[job.key]
             try:
-                need_retry = self.play_stage(job.resource, job.stage)
+                need_retry = self.play_stage(job.resource, job.stage, ctx=job.ctx)
             except Exception:  # noqa: BLE001
                 import traceback
 
@@ -217,21 +223,31 @@ class StagePlayer:
         t = datetime.datetime.fromtimestamp(self.clock.now(), datetime.timezone.utc)
         return t.isoformat(timespec="microseconds").replace("+00:00", "Z")
 
-    def play_stage(self, obj: dict, stage: CompiledStage) -> bool:
+    def play_stage(self, obj: dict, stage: CompiledStage, ctx=None) -> bool:
         """Apply one stage's effects; returns need_retry
-        (reference pod_controller.go:290-360 playStage)."""
+        (reference pod_controller.go:290-360 playStage).  ``ctx``
+        (the causing write's span context, stitched across the watch
+        boundary) makes the play span a continuation of — and a link
+        to — that write's trace; immediate-next-stage re-feeds carry
+        the play span's own context so the whole stage chain stays one
+        trace."""
         from kwok_tpu.utils.trace import get_tracer
 
         tracer = get_tracer()
         if tracer.enabled:
             meta = obj.get("metadata") or {}
-            with tracer.span(f"play.{self.kind}") as sp:
+            tid, pid = ctx if ctx else (None, None)
+            with tracer.span(f"play.{self.kind}", trace_id=tid, parent_id=pid) as sp:
+                if ctx:
+                    sp.add_link(*ctx)
                 sp.set("stage", stage.name)
                 sp.set("object", f"{meta.get('namespace', '')}/{meta.get('name', '')}")
-                return self._play_stage_inner(obj, stage)
+                return self._play_stage_inner(
+                    obj, stage, refeed_ctx=(sp.trace_id, sp.span_id)
+                )
         return self._play_stage_inner(obj, stage)
 
-    def _play_stage_inner(self, obj: dict, stage: CompiledStage) -> bool:
+    def _play_stage_inner(self, obj: dict, stage: CompiledStage, refeed_ctx=None) -> bool:
         lc = self.lifecycle
         effects = lc.effects(stage)
         if effects is None:
@@ -290,5 +306,5 @@ class StagePlayer:
         with self._stat_mut:
             self.transitions += 1
         if result is not None and stage.immediate_next_stage:
-            self.preprocess_q.add(result)
+            self.preprocess_q.add((result, refeed_ctx))
         return False

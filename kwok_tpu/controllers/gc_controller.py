@@ -92,6 +92,8 @@ class GCController:
         self._deleting: Set[ChildKey] = set()
         #: failed collections, retried each resync
         self._retry: Set[ChildKey] = set()
+        #: span context of the event being handled (loop-thread-only)
+        self._event_ctx = None
         self.deleted_total = 0
 
     # ------------------------------------------------------------------ wiring
@@ -178,6 +180,7 @@ class GCController:
         """One resync sweep without the thread loop: reap terminating
         namespaces, retry failed collections.  The `_loop` resync body
         and the DST harness share this."""
+        self._event_ctx = None  # sweeps have no single causing write
         for ns in sorted(self._terminating):
             self._reap_namespace(ns)
         with self._mut:
@@ -194,6 +197,12 @@ class GCController:
         ns = meta.get("namespace") or ""
         name = meta.get("name") or ""
         child: ChildKey = (kind, ns, name)
+        # causing write's span context (watch-boundary stitch): held
+        # for the duration of this event's handling so a resulting
+        # delete's span can continue/link the causing trace.  All index
+        # mutation happens on this one loop thread, so a plain
+        # attribute is safe.
+        self._event_ctx = getattr(ev, "ctx", None)
 
         # steady-churn fast path: an ADDED/MODIFIED object with no
         # ownerReferences that we have never indexed, outside any
@@ -310,6 +319,20 @@ class GCController:
             if child in self._deleting:
                 return
             self._deleting.add(child)
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        span = None
+        if tracer.enabled:
+            # the GC cascade continues the causing write's trace (the
+            # owner delete that orphaned this child) when the event ctx
+            # is in hand; resync-sweep deletes open a fresh root
+            ctx = getattr(self, "_event_ctx", None)
+            tid, pid = ctx if ctx else (None, None)
+            span = tracer.span("gc.delete", trace_id=tid, parent_id=pid)
+            if ctx:
+                span.add_link(*ctx)
+            span.set("object", f"{kind}:{ns}/{name}")
         try:
             self.store.delete(kind, name, namespace=ns or None)
             self.deleted_total += 1
@@ -317,9 +340,14 @@ class GCController:
         except NotFound:
             pass
         except Exception:  # noqa: BLE001 — retried on next resync/event
+            if span is not None:
+                span.error("delete failed; queued for retry")
             with self._mut:
                 self._deleting.discard(child)
                 self._retry.add(child)
+        finally:
+            if span is not None:
+                span.end()
 
     # ---------------------------------------------------------------- namespaces
 

@@ -203,13 +203,23 @@ class Scheduler:
 
     # --------------------------------------------------------------- binding
 
-    def _bind(self, pod: dict) -> None:
+    def _bind(self, pod: dict, ctx=None) -> None:
         from kwok_tpu.utils.trace import get_tracer
 
         tracer = get_tracer()
         if tracer.enabled:
             meta = pod.get("metadata") or {}
-            with tracer.span("schedule.bind") as sp:
+            # continue the causing write's trace across the watch
+            # boundary (ctx = the commit's span context resolved at
+            # delivery): the bind span joins the SAME trace id the
+            # client's create started, and also records the link — so
+            # one trace follows the pod from create to Running
+            tid, pid = (ctx or (None, None))[:2] if ctx else (None, None)
+            with tracer.span(
+                "schedule.bind", trace_id=tid, parent_id=pid
+            ) as sp:
+                if ctx:
+                    sp.add_link(*ctx)
                 sp.set("pod", f"{meta.get('namespace', 'default')}/{meta.get('name')}")
                 self._bind_inner(pod, sp)
         else:
@@ -313,6 +323,7 @@ class Scheduler:
         gang = self.gang if (
             self.gang is not None and GangEngine.is_gang_pod(obj)
         ) else None
+        ctx = getattr(ev, "ctx", None)
         if ev.type == DELETED:
             self._untrack(obj)
             if gang is not None:
@@ -337,13 +348,13 @@ class Scheduler:
         if gang is not None:
             # membership is cache maintenance (standbys stay current);
             # the bind attempt below is leader-gated like _bind
-            gang.observe(ev.type, obj)
+            gang.observe(ev.type, obj, ctx=ctx)
         if self._active is not None and not self._active():
             return  # standby/deposed: track caches, never bind
         if gang is not None:
             gang.try_schedule(gang_key(obj))
             return
-        self._bind(obj)
+        self._bind(obj, ctx=ctx)
 
     def _retry_pending(self) -> None:
         if self._active is not None and not self._active():

@@ -81,6 +81,10 @@ class StageJob:
     stage: CompiledStage
     key: str
     retry_count: int = 0
+    #: causing write's span context (watch-boundary stitch) — the play
+    #: span continues/links it so one trace follows the object through
+    #: every stage transition
+    ctx: object = None
 
     # jobs are queue items; identity (not value) equality lets the queue
     # cancel a superseded job by reference

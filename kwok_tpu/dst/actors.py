@@ -452,14 +452,17 @@ class SchedulerActor(_GatedControllerActor):
 
 
 class _StageJob:
-    __slots__ = ("obj", "rv", "stage", "due", "retries")
+    __slots__ = ("obj", "rv", "stage", "due", "retries", "ctx")
 
-    def __init__(self, obj, rv, stage, due, retries=0):
+    def __init__(self, obj, rv, stage, due, retries=0, ctx=None):
         self.obj = obj
         self.rv = rv
         self.stage = stage
         self.due = due
         self.retries = retries
+        #: causing write's span context (watch-boundary stitch); None
+        #: under the DST's usual tracer-off posture
+        self.ctx = ctx
 
 
 class LifecycleActor(_GatedControllerActor):
@@ -527,7 +530,7 @@ class LifecycleActor(_GatedControllerActor):
             .replace("+00:00", "Z")
         )
 
-    def _preprocess(self, obj: dict) -> None:
+    def _preprocess(self, obj: dict, ctx=None) -> None:
         key = self._key(obj)
         meta = obj.get("metadata") or {}
         rv = meta.get("resourceVersion")
@@ -546,7 +549,7 @@ class LifecycleActor(_GatedControllerActor):
             return
         delay, _ = stage.delay(data, self._now_dt(), rng=self.rng)
         self._jobs[key] = _StageJob(
-            obj, rv, stage, self.sim.clock.now() + delay
+            obj, rv, stage, self.sim.clock.now() + delay, ctx=ctx
         )
 
     def _step_leading(self) -> None:
@@ -557,7 +560,7 @@ class LifecycleActor(_GatedControllerActor):
                 if self.on_delete is not None:
                     self.on_delete(ev.object)
                 continue
-            self._preprocess(ev.object)
+            self._preprocess(ev.object, ctx=getattr(ev, "ctx", None))
         # due jobs, in deterministic key order
         due = sorted(
             (key for key, job in self._jobs.items() if job.due <= now)
@@ -567,7 +570,7 @@ class LifecycleActor(_GatedControllerActor):
             if job is None:
                 continue
             try:
-                need_retry = self._play(job.obj, job.stage)
+                need_retry = self._play(job.obj, job.stage, ctx=job.ctx)
             except Exception:  # noqa: BLE001 — partition/shed mid-play
                 need_retry = True
             if need_retry and key not in self._jobs:
@@ -575,9 +578,26 @@ class LifecycleActor(_GatedControllerActor):
                 job.due = now + self.backoff.delay(job.retries, self.rng)
                 self._jobs[key] = job
 
-    def _play(self, obj: dict, stage) -> bool:
+    def _play(self, obj: dict, stage, ctx=None) -> bool:
         """One stage application (StagePlayer._play_stage_inner,
-        controllers/base.py:234, minus the thread plumbing)."""
+        controllers/base.py:234, minus the thread plumbing).  With a
+        tracer armed (the digest-neutrality test's posture) the play
+        opens the same linked reconcile span the production StagePlayer
+        does — spans are side-channel only, so seeds stay byte-identical
+        armed vs disarmed."""
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            tid, pid = ctx if ctx else (None, None)
+            with tracer.span(f"play.{self.kind}", trace_id=tid, parent_id=pid) as sp:
+                if ctx:
+                    sp.add_link(*ctx)
+                sp.set("stage", getattr(stage, "name", ""))
+                return self._play_inner(obj, stage)
+        return self._play_inner(obj, stage)
+
+    def _play_inner(self, obj: dict, stage) -> bool:
         effects = self.lc.effects(stage)
         if effects is None:
             return False

@@ -132,6 +132,11 @@ class GangEngine:
         #: (time-to-admit anchor; popped on commit, dropped with the
         #: gang so the map stays bounded by pending gangs)
         self._gang_seen: Dict[GangKey, float] = {}
+        #: pod -> causing write's span context (rv→span stitch across
+        #: the watch boundary): the gang's atomic commit span links
+        #: every member's causing write and CONTINUES the first one's
+        #: trace.  Bounded by pending members — popped with them.
+        self._member_ctx: Dict[PodKey, tuple] = {}
         #: per-policy-name cache for group policy overrides
         self._policies: Dict[str, Policy] = {self.policy.name: self.policy}
         # counters (surfaced by tests/bench)
@@ -144,10 +149,12 @@ class GangEngine:
     def is_gang_pod(pod: dict) -> bool:
         return gang_key(pod) is not None
 
-    def observe(self, ev_type: str, pod: dict) -> None:
+    def observe(self, ev_type: str, pod: dict, ctx=None) -> None:
         """Maintain gang membership from a pod watch event (called for
         every gang pod regardless of leadership, like the scheduler's
-        usage cache — a standby that takes over starts current)."""
+        usage cache — a standby that takes over starts current).
+        ``ctx`` is the causing write's span context (watch-boundary
+        stitch); remembered per pending member for the commit span."""
         key = gang_key(pod)
         if key is None:
             return
@@ -155,6 +162,7 @@ class GangEngine:
         if ev_type == "DELETED":
             self._pending.get(key, {}).pop(pk, None)
             self._bound.get(key, {}).pop(pk, None)
+            self._member_ctx.pop(pk, None)
             if not self._pending.get(key) and not self._bound.get(key):
                 self._pending.pop(key, None)
                 self._bound.pop(key, None)
@@ -166,6 +174,7 @@ class GangEngine:
         phase = (pod.get("status") or {}).get("phase")
         if node:
             self._pending.get(key, {}).pop(pk, None)
+            self._member_ctx.pop(pk, None)
             if phase in ("Succeeded", "Failed"):
                 self._bound.get(key, {}).pop(pk, None)
             else:
@@ -180,8 +189,11 @@ class GangEngine:
             return
         if meta.get("deletionTimestamp"):
             self._pending.get(key, {}).pop(pk, None)
+            self._member_ctx.pop(pk, None)
             return
         self._pending.setdefault(key, {})[pk] = pod
+        if ctx is not None:
+            self._member_ctx[pk] = ctx
         if _telemetry.enabled():
             # time-to-admit anchors at the gang's FIRST pending member
             self._gang_seen.setdefault(key, self._clock.now())
@@ -445,7 +457,36 @@ class GangEngine:
                 + (" (preempting victims)" if preempting else ""),
             )
             return False
-        if not self._commit(key, plan):
+        from kwok_tpu.utils.trace import get_tracer
+
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the gang's atomic bind continues the FIRST member's
+            # causing trace and links every other member's — the
+            # many-causes-one-commit shape OTLP links exist for
+            ctxs = [
+                c
+                for c in (
+                    self._member_ctx.get(_pod_key(p)) for p, _ in plan
+                )
+                if c
+            ]
+            first = ctxs[0] if ctxs else None
+            with tracer.span(
+                "gang.commit",
+                trace_id=first[0] if first else None,
+                parent_id=first[1] if first else None,
+            ) as sp:
+                sp.set("gang", f"{ns}/{name}")
+                sp.set("members", len(plan))
+                for c in ctxs:
+                    sp.add_link(*c)
+                committed = self._commit(key, plan)
+                if not committed:
+                    sp.set("refused", True)
+        else:
+            committed = self._commit(key, plan)
+        if not committed:
             return False
         self.gangs_scheduled += 1
         t_seen = self._gang_seen.pop(key, None)

@@ -50,7 +50,7 @@ import bisect
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kwok_tpu.utils.locks import make_lock
@@ -59,10 +59,12 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "FlightRecorder",
     "HistogramFamily",
+    "JourneyRecorder",
     "Telemetry",
     "enabled",
     "flight_recorder",
     "histogram",
+    "journey",
     "registry",
     "set_enabled",
 ]
@@ -343,16 +345,28 @@ class FlightRecorder:
 
     def dump(self) -> Dict[str, object]:
         """The ``/debug/flightrecorder`` body: newest-last lists plus
-        the ring geometry so a reader knows the window it is seeing."""
+        the ring geometry so a reader knows the window it is seeing.
+        When the process exports to a trace collector, each slow
+        sample's trace-id exemplar is rendered as a ``trace_url`` deep
+        link into the collector's browser — the one-click hop from "a
+        request was slow" to its distributed trace."""
         with self._mut:
-            return {
+            slow = [dict(s) for s in self._slow]
+            out = {
                 "size": self.size,
                 "slow_threshold_s": self.slow_threshold_s,
                 "slow_seen": self.slow_seen,
                 "slow_recorded": self.slow_recorded,
                 "ticks": list(self._ticks),
-                "slow_requests": list(self._slow),
+                "slow_requests": slow,
             }
+        base = _collector_base()
+        if base:
+            for s in slow:
+                tid = s.get("trace_id")
+                if tid:
+                    s["trace_url"] = f"{base}/trace/{tid}"
+        return out
 
     def reset(self) -> None:
         with self._mut:
@@ -360,6 +374,177 @@ class FlightRecorder:
             self._slow.clear()
             self.slow_seen = 0
             self.slow_recorded = 0
+
+
+def _collector_base() -> str:
+    """Base URL of the trace collector this process exports to, or ""
+    (the flight recorder and journey surfaces render trace ids as deep
+    links when — and only when — a collector is armed)."""
+    from kwok_tpu.utils.trace import peek_global
+
+    tracer = peek_global()
+    endpoint = (
+        tracer.endpoint if tracer is not None and tracer.endpoint else ""
+    ) or os.environ.get("KWOK_TRACE_ENDPOINT", "")
+    if not endpoint:
+        return ""
+    return endpoint.split("/v1/traces")[0].rstrip("/")
+
+
+# ------------------------------------------------------------------ journey
+
+
+class JourneyRecorder:
+    """Bounded per-object lifecycle timeline, keyed by uid.
+
+    Fed observation-only from the store's commit hooks and the watch
+    servers' delivery hooks (``cluster/store.py`` ``_note_commit`` /
+    ``observe_watch_delivery``): every single-object commit appends one
+    ``commit`` hop (rv, event type, phase, committing trace id) and
+    every watch-burst flush appends one ``watch`` hop (delivery lag) —
+    so ``/debug/journey?kind=&ns=&name=`` answers "what happened to
+    THIS pod, when, and under which trace" without touching metric
+    label space (per-object detail stays in this bounded ring; kwoklint
+    ``metric-cardinality`` forbids it in labels).
+
+    Bounds: at most ``SIZE`` objects (LRU-evicted, counted) with at
+    most ``HOPS`` hops each (oldest-dropped, counted); both counters
+    surface at ``/metrics`` so truncation is visible, never silent.
+    The bulk drain lane deliberately bypasses this recorder (its
+    per-batch commit note carries no object), keeping the 1M-pod hot
+    path at PR 12's measured overhead."""
+
+    SIZE = int(os.environ.get("KWOK_JOURNEY_N", "512"))
+    HOPS = int(os.environ.get("KWOK_JOURNEY_HOPS", "64"))
+
+    def __init__(self, size: Optional[int] = None, hops: Optional[int] = None):
+        self.size = max(1, self.SIZE if size is None else int(size))
+        self.hops = max(1, self.HOPS if hops is None else int(hops))
+        self._mut = make_lock("utils.telemetry.JourneyRecorder._mut")
+        #: uid -> {"uid","kind","namespace","name","hops": deque}
+        self._objects: "OrderedDict[str, Dict[str, object]]" = OrderedDict()
+        #: objects LRU-evicted by the SIZE bound (drop counter)
+        self.evicted_objects = 0
+        #: hops dropped by a full per-object ring (drop counter)
+        self.dropped_hops = 0
+
+    def record(
+        self,
+        uid: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        hop: str,
+        dedupe_rv: Optional[int] = None,
+        **attrs,
+    ) -> None:
+        """Append one hop to an object's timeline.  ``dedupe_rv``
+        collapses repeats of the same (hop, rv) — several watch streams
+        deliver the same commit, and one ``watch`` hop per rv is the
+        useful record.  The check scans a small recent window (not just
+        the newest entries) because deliveries from independent streams
+        interleave with newer commits."""
+        if not _STATE.enabled or not uid:
+            return
+        entry = {
+            "hop": str(hop),
+            "t_wall": time.time(),
+            "t_mono": time.monotonic(),
+        }
+        entry.update(attrs)
+        with self._mut:
+            obj = self._objects.get(uid)
+            if obj is None:
+                if len(self._objects) >= self.size:
+                    self._objects.popitem(last=False)
+                    self.evicted_objects += 1
+                obj = self._objects[uid] = {
+                    "uid": uid,
+                    "kind": str(kind),
+                    "namespace": str(namespace or ""),
+                    "name": str(name),
+                    "hops": deque(maxlen=self.hops),
+                }
+            else:
+                self._objects.move_to_end(uid)
+            ring: deque = obj["hops"]
+            if dedupe_rv is not None:
+                recent = 0
+                for h in reversed(ring):
+                    if h.get("hop") == entry["hop"] and h.get("rv") == dedupe_rv:
+                        return
+                    recent += 1
+                    if recent >= 16:
+                        break
+            if len(ring) == ring.maxlen:
+                self.dropped_hops += 1
+            ring.append(entry)
+
+    # ------------------------------------------------------------- querying
+
+    @staticmethod
+    def _render(obj: Dict[str, object]) -> Dict[str, object]:
+        out = {k: v for k, v in obj.items() if k != "hops"}
+        out["hops"] = [dict(h) for h in obj["hops"]]
+        return out
+
+    def lookup(
+        self,
+        kind: Optional[str] = None,
+        namespace: Optional[str] = None,
+        name: Optional[str] = None,
+        uid: Optional[str] = None,
+    ) -> Optional[Dict[str, object]]:
+        """One object's timeline by uid, or by (kind, namespace, name)
+        — newest match wins when a name was reused."""
+        with self._mut:
+            if uid:
+                obj = self._objects.get(uid)
+                return self._render(obj) if obj is not None else None
+            k = (kind or "").lower()
+            for obj in reversed(self._objects.values()):
+                if k and str(obj["kind"]).lower() not in (
+                    k,
+                    k.rstrip("s"),
+                ):
+                    continue
+                if namespace is not None and obj["namespace"] != namespace:
+                    continue
+                if name is not None and obj["name"] != name:
+                    continue
+                return self._render(obj)
+        return None
+
+    def journeys(
+        self, kind: Optional[str] = None, limit: int = 20
+    ) -> List[Dict[str, object]]:
+        """Most-recently-touched timelines, newest first."""
+        out: List[Dict[str, object]] = []
+        k = (kind or "").lower()
+        with self._mut:
+            for obj in reversed(self._objects.values()):
+                if k and str(obj["kind"]).lower() not in (k, k.rstrip("s")):
+                    continue
+                out.append(self._render(obj))
+                if len(out) >= limit:
+                    break
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._mut:
+            return {
+                "objects": len(self._objects),
+                "size": self.size,
+                "hops_per_object": self.hops,
+                "evicted_objects": self.evicted_objects,
+                "dropped_hops": self.dropped_hops,
+            }
+
+    def reset(self) -> None:
+        with self._mut:
+            self._objects.clear()
+            self.evicted_objects = 0
+            self.dropped_hops = 0
 
 
 # ------------------------------------------------------------------ registry
@@ -372,6 +557,7 @@ class Telemetry:
         self._mut = make_lock("utils.telemetry.Telemetry._mut")
         self._families: Dict[str, HistogramFamily] = {}
         self.recorder = FlightRecorder()
+        self.journey = JourneyRecorder()
 
     def histogram(
         self,
@@ -433,6 +619,7 @@ class Telemetry:
         for fam in self.families():
             fam.clear()
         self.recorder.reset()
+        self.journey.reset()
 
 
 class _State:
@@ -473,6 +660,10 @@ def histogram(
 
 def flight_recorder() -> FlightRecorder:
     return _REGISTRY.recorder
+
+
+def journey() -> JourneyRecorder:
+    return _REGISTRY.journey
 
 
 def set_enabled(on: bool) -> bool:

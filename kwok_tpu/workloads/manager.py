@@ -152,6 +152,12 @@ class WorkloadManager:
         }
         self._events: Queue = Queue()
         self._queue = _KeyedQueue()
+        #: reconcile key -> causing write's span context (latest event
+        #: wins; popped when the key is reconciled, so the map stays
+        #: bounded by queued keys).  The reconcile span continues/links
+        #: it — the kcm half of the watch-boundary stitch.
+        self._key_ctx: Dict[Key, tuple] = {}
+        self._ctx_mut = threading.Lock()
         self._done = threading.Event()
         self._threads = []
         self._workers = max(1, workers)
@@ -186,25 +192,30 @@ class WorkloadManager:
 
     # -------------------------------------------------------------- mapping
 
-    def _map_event(self, obj: dict) -> None:
+    def _map_event(self, obj: dict, ctx=None) -> None:
         kind = obj.get("kind") or ""
         meta = obj.get("metadata") or {}
         ns = meta.get("namespace") or "default"
         name = meta.get("name") or ""
+
+        def enqueue(key: Key) -> None:
+            if ctx is not None:
+                with self._ctx_mut:
+                    self._key_ctx[key] = ctx
+            self._queue.add(key)
+
         if kind == "Pod":
             for ref in meta.get("ownerReferences") or []:
                 rkind = ref.get("kind")
                 if rkind in ("ReplicaSet", "Job"):
-                    self._queue.add((rkind, ns, ref.get("name") or ""))
+                    enqueue((rkind, ns, ref.get("name") or ""))
             return
         if kind in self._dispatch:
-            self._queue.add((kind, ns, name))
+            enqueue((kind, ns, name))
             if kind == "ReplicaSet":
                 for ref in meta.get("ownerReferences") or []:
                     if ref.get("kind") == "Deployment":
-                        self._queue.add(
-                            ("Deployment", ns, ref.get("name") or "")
-                        )
+                        enqueue(("Deployment", ns, ref.get("name") or ""))
 
     def _resync(self) -> None:
         for kind in ("Deployment", "ReplicaSet", "Job", "HorizontalPodAutoscaler"):
@@ -226,7 +237,7 @@ class WorkloadManager:
             ev, ok = self._events.get_or_wait(timeout=0.2, done=self._done)
             if ok and ev is not None:
                 try:
-                    self._map_event(ev.object)
+                    self._map_event(ev.object, ctx=getattr(ev, "ctx", None))
                 except Exception:  # noqa: BLE001 — one event must not kill it
                     import traceback
 
@@ -248,12 +259,30 @@ class WorkloadManager:
         letting a bad object kill the caller — shared by the worker
         threads and the synchronous drain."""
         kind, ns, name = key
+        with self._ctx_mut:
+            ctx = self._key_ctx.pop(key, None)
         try:
             ctrl = self._dispatch.get(kind)
             if ctrl is not None and not (
                 self._active is not None and not self._active()
             ):
-                ctrl.reconcile(ns, name)
+                from kwok_tpu.utils.trace import get_tracer
+
+                tracer = get_tracer()
+                if tracer.enabled:
+                    # continuation of the causing write's trace (ctx
+                    # stitched across the watch boundary; resync keys
+                    # open fresh roots)
+                    tid, pid = ctx if ctx else (None, None)
+                    with tracer.span(
+                        "workloads.reconcile", trace_id=tid, parent_id=pid
+                    ) as sp:
+                        if ctx:
+                            sp.add_link(*ctx)
+                        sp.set("object", f"{kind}:{ns}/{name}")
+                        ctrl.reconcile(ns, name)
+                else:
+                    ctrl.reconcile(ns, name)
                 self.reconciles += 1
         except Exception as exc:  # noqa: BLE001 — a bad object must not kill
             from kwok_tpu.cluster.client import ApiUnavailable

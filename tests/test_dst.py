@@ -315,6 +315,36 @@ def test_cross_shard_txn_regression_is_caught_and_replays_identically():
     assert replay["violations"] == first["violations"]
 
 
+def test_causal_tracing_armed_vs_disarmed_25_seeds_byte_identical():
+    """The causal-tracing layer (ISSUE 13) is side-channel only: 25
+    DST seeds with the tracer + journey hooks ARMED (spans opened and
+    linked in every consumer, commit ring carrying contexts, journey
+    hops recorded) must produce byte-identical trace digests to fully
+    DISARMED runs — object payloads and digest-feeding event bytes are
+    untouched by the stitch."""
+    from kwok_tpu.utils import telemetry
+    from kwok_tpu.utils.trace import Tracer, set_global
+
+    prev = telemetry.set_enabled(True)
+    # port 9 (discard) is closed: spans are created and then dropped by
+    # the exporter — exactly the armed-span code path, no collector
+    tracer = Tracer("dst-armed", endpoint="http://127.0.0.1:9/v1/traces")
+    set_global(tracer)
+    try:
+        armed = [run_seed(seed, SimOptions())["trace_digest"] for seed in range(25)]
+    finally:
+        set_global(None)
+        tracer.stop()
+    try:
+        telemetry.set_enabled(False)
+        disarmed = [
+            run_seed(seed, SimOptions())["trace_digest"] for seed in range(25)
+        ]
+    finally:
+        telemetry.set_enabled(prev)
+    assert armed == disarmed
+
+
 def test_telemetry_armed_vs_disarmed_digests_byte_identical():
     """SLO telemetry is observation-only: a DST run with every observed
     histogram armed must produce the SAME trace digest as a disarmed

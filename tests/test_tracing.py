@@ -261,6 +261,81 @@ def test_cluster_with_tracing_component(tmp_path, monkeypatch):
         kwokctl_main(["--name", name, "delete", "cluster"])
 
 
+# ------------------------------------------- retry traceparent continuity
+
+
+class _ShedOnce:
+    """Fault-injector duck type: reject the first matching mutation
+    with a 429 + Retry-After, pass everything after — the
+    deterministic 429-then-success sequence."""
+
+    def __init__(self, status=429):
+        self.status = status
+        self.fired = 0
+
+    def on_request(self, method, path, client_id):
+        if method == "POST" and path.startswith("/r/") and self.fired == 0:
+            self.fired += 1
+            return {
+                "action": "reject",
+                "status": self.status,
+                "retry_after": 0.05,
+            }
+        return None
+
+    def on_watch_tick(self, client_id):
+        return False
+
+
+@pytest.mark.parametrize("status", [429, 503])
+def test_retry_attempts_are_child_spans_of_originating_span(collector, status):
+    """Traceparent continuity across client retries: a 429/503-then-
+    success sequence yields ONE trace in which each retry attempt is a
+    child span of the originating client span, and the eventually-
+    successful server span parents to the retry attempt that carried
+    it."""
+    store, url = collector
+    tracer = Tracer("retry-e2e", endpoint=f"{url}/v1/traces")
+    set_global(tracer)
+    rstore = ResourceStore()
+    shed = _ShedOnce(status=status)
+    with APIServer(rstore, fault_injector=shed) as srv:
+        client = ClusterClient(srv.url)
+        with tracer.span("client.create-pod") as sp:
+            client.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {"name": "retried", "namespace": "default"},
+                    "spec": {"nodeName": "n", "containers": [{"name": "c"}]},
+                    "status": {},
+                }
+            )
+            trace_id = sp.trace_id
+            origin_span_id = sp.span_id
+    assert shed.fired == 1, "the injector never shed"
+    tracer.flush()
+    tracer.stop()
+    spans = (TraceStore.get(store, trace_id) or {}).get("spans") or []
+    names = [s["name"] for s in spans]
+    assert "client.create-pod" in names
+    retries = [s for s in spans if s["name"] == "client.retry"]
+    assert retries, f"no retry spans in {names}"
+    # every retry attempt is a CHILD of the originating client span —
+    # one trace, not N disconnected ones
+    for r in retries:
+        assert r["traceId"] == trace_id
+        assert r["parentSpanId"] == origin_span_id
+        attrs = {a["key"]: a["value"] for a in r["attributes"]}
+        assert attrs["attempt"] == {"intValue": "2"}
+        assert attrs["http.status"] == {"intValue": "201"}
+    # the successful server-side span parents to the retry attempt
+    posts = [s for s in spans if s["name"] == "apiserver.POST"]
+    assert any(p["parentSpanId"] == retries[0]["spanId"] for p in posts), (
+        [(p["name"], p["parentSpanId"]) for p in posts]
+    )
+
+
 # ------------------------------------------------- exporter drop accounting
 
 

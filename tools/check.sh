@@ -13,6 +13,15 @@ cd "$(dirname "$0")/.."
 echo "== kwoklint (python -m kwok_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m kwok_tpu.analysis
 
+if [[ "${FAST:-0}" == "1" ]]; then
+    # CI-annotation artifact on the fast path: the git-diff-scoped walk
+    # is sub-second and the SARIF lands where code-review tooling can
+    # pick it up (the full walk above still gates cross-file rules)
+    echo "== kwoklint --changed-only (SARIF -> ${KWOKLINT_SARIF:-/tmp/kwoklint.sarif}) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.analysis --changed-only \
+        --format sarif > "${KWOKLINT_SARIF:-/tmp/kwoklint.sarif}"
+fi
+
 echo "== tier-1 tests (pytest -m 'not slow') =="
 PYTEST_ARGS=(-q -m 'not slow' -p no:cacheprovider)
 if [[ "${FAST:-0}" == "1" ]]; then
