@@ -82,6 +82,19 @@ STORE_HTTP_BUDGET_S = float(os.environ.get("BENCH_STORE_HTTP_BUDGET_S", 45))
 OBS_PODS = int(
     os.environ.get("BENCH_OBS_PODS", min(40_000, max(5_000, N_PODS)))
 )
+#: fleet-isolation bench (kwok_tpu.fleet): N virtual control planes on
+#: one apiserver — per-tenant time-to-first-write after cold-start and
+#: the victim-neighbor p99 while another tenant's APF level is flooded
+#: (0 disables the section)
+FLEET_TENANTS = int(os.environ.get("BENCH_FLEET_TENANTS", 200))
+FLEET_FLOOD_S = float(os.environ.get("BENCH_FLEET_FLOOD_S", 1.5))
+#: isolation gate: the flooded-neighbor p99 may be at most this
+#: multiple of the victim's quiet baseline p99 (the smoke floors the
+#: denominator at 5ms so a sub-ms baseline doesn't inflate GIL jitter
+#: into a fake starvation signal)
+FLEET_ISOLATION_RATIO = float(
+    os.environ.get("BENCH_FLEET_ISOLATION_RATIO", 20.0)
+)
 
 
 def run_overload_bench() -> dict:
@@ -100,6 +113,39 @@ def run_overload_bench() -> dict:
         "queued_peak": be["queued_peak"],
         "canary_writes": rep["canary_writes"],
         "canary_worst_latency_s": rep["canary_worst_latency_s"],
+    }
+
+
+def run_fleet_bench() -> dict:
+    """Multi-tenant isolation trajectory: run the in-process fleet
+    smoke (N tenants on one apiserver, seeded neighbor flood,
+    scale-to-zero) and distill its cold-start/isolation numbers.  On
+    top of the smoke's absolute bounds this asserts the isolation
+    RATIO — the flooded neighbor's p99 relative to its own quiet
+    baseline — so a per-tenant APF regression that merely *slows*
+    neighbors (without breaching the absolute bound) still fails."""
+    from kwok_tpu.chaos.__main__ import run_fleet_smoke
+
+    rep = run_fleet_smoke(
+        seed=42, tenants=FLEET_TENANTS, flood_seconds=FLEET_FLOOD_S
+    )
+    victim = rep["victim"]
+    ratio = victim["isolation_ratio"]
+    assert ratio <= FLEET_ISOLATION_RATIO, (
+        f"fleet bench: victim p99 {victim['p99_s']}s is {ratio}x its "
+        f"quiet baseline {victim['baseline_p99_s']}s under a flooded "
+        f"neighbor (gate {FLEET_ISOLATION_RATIO}x)"
+    )
+    return {
+        "tenants": rep["tenants"],
+        "cold_start_p50_s": rep["cold_start_p50_s"],
+        "cold_start_p99_s": rep["cold_start_p99_s"],
+        "flood_shed": rep["flood"]["shed"],
+        "victim_p99_s": victim["p99_s"],
+        "victim_baseline_p99_s": victim["baseline_p99_s"],
+        "victim_shed": victim["shed"],
+        "isolation_ratio": ratio,
+        "recold_start_s": rep["recold_start_s"],
     }
 
 
@@ -899,6 +945,19 @@ def main() -> int:
 
                 traceback.print_exc()
                 out["overload"] = {"error": f"{type(e).__name__}: {e}"}
+
+        if FLEET_TENANTS > 0:
+            # multi-tenant isolation: N virtual control planes on one
+            # apiserver; cold-start time-to-first-write, victim p99
+            # under a flooded neighbor, asserted isolation ratio
+            # (kwok_tpu.chaos fleet smoke, scaled down)
+            try:
+                out["fleet"] = run_fleet_bench()
+            except (Exception, SystemExit) as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                out["fleet"] = {"error": f"{type(e).__name__}: {e}"}
     except Exception as e:  # noqa: BLE001 — always emit the one JSON line
         import traceback
 

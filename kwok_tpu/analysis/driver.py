@@ -59,7 +59,7 @@ PER_FILE_RULES = frozenset(
 )
 
 #: bump when any rule's semantics change — invalidates the on-disk cache
-CACHE_VERSION = 12
+CACHE_VERSION = 13
 
 
 def repo_root(start: Optional[str] = None) -> str:

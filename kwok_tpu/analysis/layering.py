@@ -56,6 +56,7 @@ LAYERS: List[Tuple[str, ...]] = [
     ("native",),
     ("cluster",),
     ("cluster.sharding",),
+    ("fleet",),
     ("sched",),
     ("controllers", "workloads", "metrics", "snapshot", "cni"),
     ("server", "tools"),

@@ -43,6 +43,10 @@ SCOPE = (
     "kwok_tpu/cluster/",
     "kwok_tpu/controllers/",
     "kwok_tpu/sched/",
+    # fleet views label by TENANT id (bounded: the fleet roster) —
+    # per-object names off a tenant's journey stream must never reach
+    # a label
+    "kwok_tpu/fleet/",
     # journey/timeline modules (causal lifecycle tracing): these hold
     # per-object detail BY DESIGN — in bounded rings and span
     # attributes — so a per-object reach leaking into a metric label

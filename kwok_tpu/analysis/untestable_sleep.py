@@ -34,6 +34,7 @@ SCOPE = (
     "kwok_tpu/sched/",
     "kwok_tpu/controllers/",
     "kwok_tpu/workloads/",
+    "kwok_tpu/fleet/",
 )
 
 _MSG = (

@@ -44,6 +44,7 @@ SCOPE = (
     "kwok_tpu/sched/",
     "kwok_tpu/controllers/",
     "kwok_tpu/ctl/",
+    "kwok_tpu/fleet/",
 )
 
 #: assignment targets that make a bare ``time.time()`` a deadline

@@ -1072,6 +1072,269 @@ def run_overload_smoke(
     }
 
 
+def run_fleet_smoke(
+    seed: int = 42, tenants: int = 1000, flood_seconds: float = 1.5
+) -> dict:
+    """In-process fleet smoke (kwok_tpu.fleet): one apiserver hosting
+    ``tenants`` virtual control planes.  Four phases, SystemExit on any
+    violation:
+
+    1. cold-start every tenant with its first write (per-tenant APF
+       level + namespace bootstrap + shard pin on first touch) and
+       bound the cold-start latency;
+    2. seeded neighbor flood: saturate ONE tenant's priority level
+       from threads while a victim tenant keeps issuing its own
+       traffic — the victim must see ZERO 429s and a bounded p99, the
+       host system level must shed nothing, and the flood itself must
+       have been shed (else the probe is vacuous);
+    3. scale-to-zero: advance the registry's injected clock past the
+       cold threshold, sweep, assert every binding was dropped, then
+       cold-start one tenant again within the bound — with its data
+       intact across the park/unpark;
+    4. leak check: sampled tenants each see exactly their own objects
+       through the scoped surface while the host store carries every
+       tenant's (prefixed) truth.
+    """
+    import random
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from kwok_tpu.cluster.apiserver import APIServer
+    from kwok_tpu.cluster.flowcontrol import FlowController
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.fleet import FleetRegistry, fleet_flow_config
+    from kwok_tpu.fleet.tenant import fleet_tenant_ids
+    from kwok_tpu.utils.clock import FakeClock
+
+    cold_start_bound_s = 2.0
+    victim_p99_bound_s = 1.0
+
+    ids = fleet_tenant_ids(tenants)
+    clock = FakeClock(0.0)
+    store = ResourceStore()
+    registry = FleetRegistry(
+        store, ids, clock=clock, idle_after_s=60.0, cold_after_s=120.0
+    )
+    # tiny per-tenant budget: one guaranteed seat, almost no queue —
+    # the flood saturates its own level instantly while every other
+    # level keeps its seats
+    flow = FlowController(
+        fleet_flow_config(ids, max_inflight=16, queue_wait_s=0.1, queue_limit=2),
+        seed=seed,
+    )
+
+    def percentile(vals, q):
+        if not vals:
+            return 0.0
+        s = sorted(vals)
+        return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+    with APIServer(store, flow=flow, fleet=registry) as srv:
+
+        def req(method, path, tenant=None, body=None, timeout=10.0):
+            data = json.dumps(body).encode() if body is not None else None
+            r = urllib.request.Request(
+                srv.url + path, data=data, method=method
+            )
+            if tenant is not None:
+                r.add_header("X-Kwok-Tenant", tenant)
+            if data is not None:
+                r.add_header("Content-Type", "application/json")
+            try:
+                with urllib.request.urlopen(r, timeout=timeout) as resp:
+                    return resp.status, json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code, None
+
+        # ----- phase 1: cold-start every tenant with its first write
+        cold_lat = []
+        for tid in ids:
+            t0 = time.monotonic()
+            status, _ = req(
+                "POST",
+                "/r/configmaps",
+                tenant=tid,
+                body={
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": f"{tid}-cm", "namespace": "default"},
+                    "data": {"owner": tid},
+                },
+            )
+            cold_lat.append(time.monotonic() - t0)
+            if status not in (200, 201):
+                raise SystemExit(
+                    f"fleet smoke FAILED: tenant {tid} first write -> {status}"
+                )
+        cold_p99 = percentile(cold_lat, 0.99)
+        if cold_p99 > cold_start_bound_s:
+            raise SystemExit(
+                f"fleet smoke FAILED: cold-start p99 {cold_p99:.3f}s "
+                f"exceeds {cold_start_bound_s}s across {tenants} tenants"
+            )
+        snap = registry.snapshot()
+        if snap["warm"] != tenants or snap["cold_starts"] != tenants:
+            raise SystemExit(
+                f"fleet smoke FAILED: expected {tenants} warm tenants "
+                f"after first touch, got {snap}"
+            )
+
+        # ----- phase 2: seeded neighbor flood -----------------------
+        rng = random.Random(seed)
+        flooder = ids[rng.randrange(len(ids))]
+        victim = ids[(ids.index(flooder) + 1) % len(ids)]
+        # quiet baseline for the victim: its own list latency with no
+        # neighbor load, so the report can carry an isolation RATIO
+        # (flooded p99 / quiet p99) alongside the absolute bound
+        baseline_lat = []
+        for _ in range(30):
+            t0 = time.monotonic()
+            req("GET", "/r/configmaps", tenant=victim)
+            baseline_lat.append(time.monotonic() - t0)
+        baseline_p99 = percentile(baseline_lat, 0.99)
+        stop = threading.Event()
+        flood_counts = {"ok": 0, "shed": 0, "errors": 0}
+        lock = threading.Lock()
+
+        def flood_worker():
+            while not stop.is_set():
+                try:
+                    status, _ = req(
+                        "GET", "/r/configmaps", tenant=flooder, timeout=5.0
+                    )
+                except Exception:  # noqa: BLE001 — hung/reset socket
+                    with lock:
+                        flood_counts["errors"] += 1
+                    continue
+                with lock:
+                    if status == 429:
+                        flood_counts["shed"] += 1
+                    elif status == 200:
+                        flood_counts["ok"] += 1
+                    else:
+                        flood_counts["errors"] += 1
+
+        threads = [
+            threading.Thread(target=flood_worker, daemon=True)
+            for _ in range(6)
+        ]
+        for th in threads:
+            th.start()
+        victim_lat = []
+        victim_shed = 0
+        t_flood0 = time.monotonic()
+        while time.monotonic() - t_flood0 < flood_seconds:
+            t0 = time.monotonic()
+            status, _ = req("GET", "/r/configmaps", tenant=victim)
+            victim_lat.append(time.monotonic() - t0)
+            if status == 429:
+                victim_shed += 1
+        stop.set()
+        for th in threads:
+            th.join(timeout=10.0)
+        levels_snap = flow.snapshot()
+        if flood_counts["shed"] == 0:
+            raise SystemExit(
+                "fleet smoke FAILED: the tenant flood was never shed "
+                f"(per-tenant level inactive? {flood_counts})"
+            )
+        if flood_counts["errors"]:
+            raise SystemExit(
+                f"fleet smoke FAILED: {flood_counts['errors']} flood "
+                "requests hung/reset instead of a typed rejection"
+            )
+        if victim_shed:
+            raise SystemExit(
+                f"fleet smoke FAILED: neighbor {victim} saw "
+                f"{victim_shed} 429s while {flooder} was flooded"
+            )
+        victim_p99 = percentile(victim_lat, 0.99)
+        if victim_p99 > victim_p99_bound_s:
+            raise SystemExit(
+                f"fleet smoke FAILED: neighbor p99 {victim_p99:.3f}s "
+                f"exceeds {victim_p99_bound_s}s under {flooder}'s flood"
+            )
+        if levels_snap["system"]["rejected"]:
+            raise SystemExit(
+                "fleet smoke FAILED: the host system level was shed "
+                f"during a tenant flood ({levels_snap['system']})"
+            )
+        if levels_snap[victim]["rejected"]:
+            raise SystemExit(
+                f"fleet smoke FAILED: victim level {victim} recorded "
+                f"rejections ({levels_snap[victim]})"
+            )
+
+        # ----- phase 3: scale-to-zero + cold-start bound ------------
+        clock.advance(300.0)
+        registry.sweep(force=True)
+        snap = registry.snapshot()
+        if snap["cold"] != tenants:
+            raise SystemExit(
+                "fleet smoke FAILED: expected every tenant parked after "
+                f"the idle horizon, got {snap}"
+            )
+        reborn = ids[rng.randrange(len(ids))]
+        t0 = time.monotonic()
+        status, listing = req("GET", "/r/configmaps", tenant=reborn)
+        restart_s = time.monotonic() - t0
+        if status != 200 or restart_s > cold_start_bound_s:
+            raise SystemExit(
+                f"fleet smoke FAILED: re-cold-start of {reborn} -> "
+                f"{status} in {restart_s:.3f}s (bound {cold_start_bound_s}s)"
+            )
+        names = [
+            (o.get("metadata") or {}).get("name")
+            for o in (listing or {}).get("items", [])
+        ]
+        if names != [f"{reborn}-cm"]:
+            raise SystemExit(
+                f"fleet smoke FAILED: {reborn} lost or gained state "
+                f"across scale-to-zero: {names}"
+            )
+
+        # ----- phase 4: cross-tenant leak check ---------------------
+        sample = [ids[0], ids[len(ids) // 2], ids[-1], flooder, victim]
+        for tid in dict.fromkeys(sample):
+            _status, listing = req("GET", "/r/configmaps", tenant=tid)
+            names = sorted(
+                (o.get("metadata") or {}).get("name")
+                for o in (listing or {}).get("items", [])
+            )
+            if names != [f"{tid}-cm"]:
+                raise SystemExit(
+                    f"fleet smoke FAILED: tenant {tid} sees {names} — "
+                    "cross-tenant leak or lost write"
+                )
+        if store.count("ConfigMap") != tenants:
+            raise SystemExit(
+                "fleet smoke FAILED: host store carries "
+                f"{store.count('ConfigMap')} ConfigMaps, want {tenants}"
+            )
+
+    return {
+        "seed": seed,
+        "tenants": tenants,
+        "cold_start_p50_s": round(percentile(cold_lat, 0.5), 4),
+        "cold_start_p99_s": round(cold_p99, 4),
+        "flood": {"tenant": flooder, **flood_counts},
+        "victim": {
+            "tenant": victim,
+            "requests": len(victim_lat),
+            "shed": victim_shed,
+            "p99_s": round(victim_p99, 4),
+            "baseline_p99_s": round(baseline_p99, 4),
+            # denominator floored at 5ms: a sub-millisecond quiet
+            # baseline would turn pure GIL jitter into a huge ratio
+            "isolation_ratio": round(victim_p99 / max(baseline_p99, 0.005), 2),
+        },
+        "recold_start_s": round(restart_s, 4),
+        "leaks": 0,
+    }
+
+
 def run_failover_smoke(seed: int = 42, lease_duration: float = 2.5) -> dict:
     """In-process HA smoke: three electors on one apiserver (APF on).
 
@@ -1292,6 +1555,21 @@ def build_parser() -> argparse.ArgumentParser:
         "fencing (used by tools/check.sh)",
     )
     p.add_argument(
+        "--fleet-smoke",
+        action="store_true",
+        help="run the in-process multi-tenant fleet smoke: N virtual "
+        "control planes on one apiserver — cold-start bound, neighbor "
+        "flood shed WITHOUT starving the victim tenant or the system "
+        "level, scale-to-zero + re-cold-start with state intact, zero "
+        "cross-tenant leaks (used by tools/check.sh)",
+    )
+    p.add_argument(
+        "--fleet-tenants",
+        type=int,
+        default=1000,
+        help="fleet smoke tenant count",
+    )
+    p.add_argument(
         "--lease-seconds",
         type=float,
         default=2.5,
@@ -1318,12 +1596,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--dst-bug",
         default=None,
-        choices=[None, "ungated-writer", "partial-gang", "cross-shard-txn"],
+        choices=[
+            None,
+            "ungated-writer",
+            "partial-gang",
+            "cross-shard-txn",
+            "tenant-leak",
+        ],
         help="inject a test-only regression (must be caught): "
         "ungated-writer reconciles without the lease, partial-gang "
         "binds PodGroups per-pod instead of atomically, "
         "cross-shard-txn makes the shard router place txn ops "
-        "per-object and split atomic batches into per-shard sub-txns",
+        "per-object and split atomic batches into per-shard sub-txns, "
+        "tenant-leak un-scopes one fleet tenant's watch stream",
+    )
+    p.add_argument(
+        "--dst-fleet-tenants",
+        type=int,
+        default=2,
+        help="fleet tenants the DST co-hosts (kwok_tpu.fleet; "
+        "0 disables the fleet composition)",
     )
     p.add_argument(
         "--dst-shards",
@@ -1356,6 +1648,7 @@ def run_dst(args) -> int:
         duration=args.dst_duration,
         bug=args.dst_bug,
         store_shards=args.dst_shards,
+        fleet_tenants=args.dst_fleet_tenants,
     )
     violating = {}
     runs = []
@@ -1406,6 +1699,14 @@ def main(argv=None) -> int:
         report = run_exhaustion_smoke(
             seed=args.seed if args.seed is not None else 42,
             pods=args.pods,
+        )
+        print(json.dumps(report))
+        return 0
+    if args.fleet_smoke:
+        report = run_fleet_smoke(
+            seed=args.seed if args.seed is not None else 42,
+            tenants=args.fleet_tenants,
+            flood_seconds=args.flood_seconds,
         )
         print(json.dumps(report))
         return 0

@@ -80,6 +80,15 @@ _BOOKMARK_EVERY = 15.0
 #: (same reason the chaos injector exempts them)
 _FLOW_EXEMPT = {"healthz", "readyz", "livez", "metrics"}
 
+#: fleet tenant-routing header (duck-type seam, same pattern as the
+#: chaos injector: this module never imports kwok_tpu.fleet — the
+#: attached registry object carries the behavior; fleet/tenant.py
+#: declares the same literal as TENANT_HEADER)
+_TENANT_HEADER = "X-Kwok-Tenant"
+
+#: path dialect equivalent of the header: /fleet/t/{tenant}/{path...}
+_TENANT_PREFIX = "t"
+
 #: default server-side watch deadline (seconds): a real apiserver caps
 #: every watch at --min-request-timeout-ish horizons and clients resume
 #: transparently; this bounds how long a dead peer can pin a thread
@@ -116,6 +125,7 @@ _ROUTE_HEADS = frozenset(
         "dashboard",
         "version",
         "openapi",
+        "fleet",
     }
 )
 
@@ -350,6 +360,66 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         return True
 
+    # -------------------------------------------------------- fleet tenancy
+
+    _tenant: Optional[str] = None
+    _k8s = None
+
+    def _facade(self):
+        """The wire-protocol facade for this request: the tenant's own
+        (bound to its prefixed object space) when routed by the fleet,
+        else the server-wide one."""
+        return getattr(self, "_k8s", None) or self.server.k8s
+
+    def _enter_tenant(self) -> bool:
+        """Resolve fleet tenancy for this request (header or path
+        dialect) and scope ``self.store`` / the k8s facade to the
+        tenant's virtual control plane.  Returns False when the request
+        was consumed (unknown tenant → 404).
+
+        Handler instances persist across keep-alive requests, so the
+        per-request tenant state is RESET here first — a tenant-scoped
+        store left on the instance would leak into the connection's
+        next request."""
+        self.__dict__.pop("store", None)  # back to the class-level host store
+        self._k8s = None
+        self._tenant = None
+        fleet = getattr(self.server, "fleet", None)
+        if fleet is None:
+            return True
+        tenant = self.headers.get(_TENANT_HEADER) or None
+        # path dialect: /fleet/t/{tenant}/{path...} — rewrite to the
+        # inner path; _route() re-parses on the changed self.path
+        u = urlsplit(self.path)
+        parts = [p for p in u.path.split("/") if p]
+        if len(parts) >= 2 and parts[0] == "fleet" and parts[1] == _TENANT_PREFIX:
+            if len(parts) < 3:
+                self._send_json(
+                    404, {"error": "no tenant in path", "reason": "NotFound"}
+                )
+                return False
+            tenant = unquote(parts[2])
+            inner_path = "/" + "/".join(parts[3:])
+            self.path = inner_path + (f"?{u.query}" if u.query else "")
+        if tenant is None:
+            return True
+        head = self._route()[0]
+        if head in _FLOW_EXEMPT:
+            # liveness and scrapes are host surfaces even when a client
+            # stamps every request with its tenant header
+            return True
+        try:
+            binding, _cold = fleet.touch(tenant)
+        except KeyError as exc:
+            self._send_json(404, {"error": str(exc), "reason": "NotFound"})
+            return False
+        # instance attribute shadows the class-level host store: every
+        # verb handler and the watch loop below sees the tenant slice
+        self.store = binding.store
+        self._k8s = binding.k8s
+        self._tenant = tenant
+        return True
+
     # --------------------------------------------------------- flow control
 
     def _dispatch(self, inner) -> None:
@@ -362,6 +432,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self._inject_fault():
             return
         if self._fenced_out():
+            return
+        if not self._enter_tenant():
             return
         flow = getattr(self.server, "flow", None)
         self._flow_level = None
@@ -381,7 +453,16 @@ class _Handler(BaseHTTPRequestHandler):
                 inner()
                 return
             cid = self.headers.get("X-Kwok-Client") or ""
-            self._flow_level = flow.classify(cid)
+            if self._tenant is not None:
+                # tenant traffic is classified into the tenant's OWN
+                # priority level before admission (the fleet isolation
+                # contract: one tenant's flood saturates its own seats
+                # and queues, never a neighbor's); admit() falls back
+                # to client classification if the level is undeclared
+                cid = cid or f"tenant:{self._tenant}"
+                self._flow_level = self._tenant
+            else:
+                self._flow_level = flow.classify(cid)
             t_admit = time.monotonic()
             try:
                 ticket = flow.admit(
@@ -451,6 +532,14 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception:  # noqa: BLE001 — NotFound on junk plurals
                 kind = "(unknown)"
         _H_REQ.observe(dur, self.command, kind, level, shard)
+        if self._tenant is not None:
+            # per-tenant duration via the fleet seam (the registry
+            # observes into the bounded tenant-labeled family,
+            # kwok_tpu/fleet/views.py — this module stays below fleet
+            # in the layer map)
+            fleet = getattr(self.server, "fleet", None)
+            if fleet is not None:
+                fleet.observe(self._tenant, dur)
         rec = _telemetry.flight_recorder()
         tid = ""
         if dur >= rec.slow_threshold_s:
@@ -493,7 +582,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_get(self):
         head, rest, q = self._route()
-        if head in _K8S_HEADS and self.server.k8s.handle(self, "GET", head, rest, q):
+        if head in _K8S_HEADS and self._facade().handle(self, "GET", head, rest, q):
             return
         try:
             if head == "healthz" or head == "livez":
@@ -580,6 +669,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # histograms (kwokctl get components renders the
                     # request-duration row as its latency column)
                     body["latency"] = lat
+                fleet = getattr(self.server, "fleet", None)
+                if fleet is not None:
+                    # tenant count + cold/warm/idle split (kwokctl get
+                    # components grows a fleet= column from this)
+                    body["fleet"] = fleet.snapshot()
                 self._send_json(200, body)
             elif head == "debug" and rest == ["flightrecorder"]:
                 # the flight recorder: last-N tick stage breakdowns +
@@ -624,6 +718,26 @@ class _Handler(BaseHTTPRequestHandler):
                             ),
                         },
                     )
+            elif head == "fleet":
+                # fleet status (host surface): per-tenant lifecycle
+                # state, pinned shard, and latency quantiles — what
+                # `kwokctl get fleet` renders.  ?tenant= adds the
+                # tenant's journey/critical-path slice.
+                fleet = getattr(self.server, "fleet", None)
+                if fleet is None:
+                    self._send_json(
+                        404,
+                        {"error": "not a fleet apiserver", "reason": "NotFound"},
+                    )
+                elif q.get("tenant"):
+                    try:
+                        self._send_json(200, fleet.tenant_detail(q["tenant"]))
+                    except KeyError as exc:
+                        self._send_json(
+                            404, {"error": str(exc), "reason": "NotFound"}
+                        )
+                else:
+                    self._send_json(200, fleet.report())
             elif head == "r" and len(rest) == 1:
                 # canonical watch values only — must stay in lockstep
                 # with _dispatch's long-running classification, or a
@@ -669,7 +783,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_post(self):
         head, rest, q = self._route()
-        if head in _K8S_HEADS and self.server.k8s.handle(self, "POST", head, rest, q):
+        if head in _K8S_HEADS and self._facade().handle(self, "POST", head, rest, q):
             return
         try:
             body = self._read_body()
@@ -735,7 +849,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_put(self):
         head, rest, q = self._route()
-        if head in _K8S_HEADS and self.server.k8s.handle(self, "PUT", head, rest, q):
+        if head in _K8S_HEADS and self._facade().handle(self, "PUT", head, rest, q):
             return
         try:
             body = self._read_body()
@@ -758,7 +872,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_patch(self):
         head, rest, q = self._route()
-        if head in _K8S_HEADS and self.server.k8s.handle(self, "PATCH", head, rest, q):
+        if head in _K8S_HEADS and self._facade().handle(self, "PATCH", head, rest, q):
             return
         try:
             ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
@@ -786,7 +900,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_delete(self):
         head, rest, q = self._route()
-        if head in _K8S_HEADS and self.server.k8s.handle(self, "DELETE", head, rest, q):
+        if head in _K8S_HEADS and self._facade().handle(self, "DELETE", head, rest, q):
             return
         try:
             if head == "r" and len(rest) == 2:
@@ -997,6 +1111,7 @@ class APIServer:
         fault_injector=None,
         flow=None,
         watch_timeout: float = DEFAULT_WATCH_TIMEOUT,
+        fleet=None,
     ):
         # acquire the audit file before binding the port so a bad path
         # fails without leaking a listening socket; unbuffered O_APPEND
@@ -1019,6 +1134,12 @@ class APIServer:
             # APF seam (cluster.flowcontrol.FlowController); None = no
             # admission control (bare in-process test servers)
             self._httpd.flow = flow
+            # fleet seam (kwok_tpu.fleet.FleetRegistry duck type:
+            # touch/observe/snapshot/report/tenant_detail); None = a
+            # plain single-tenant apiserver.  cmd/apiserver wires it
+            # from --fleet-tenants — only the hook lives here, keeping
+            # cluster below fleet in the layer map.
+            self._httpd.fleet = fleet
             # default server-side watch deadline; 0 disables
             self._httpd.watch_timeout = float(watch_timeout or 0)
             # Kubernetes wire-protocol facade (k8s_api.py): /api, /apis,
@@ -1056,6 +1177,11 @@ class APIServer:
     def flow(self):
         """The attached FlowController (None when admission is off)."""
         return self._httpd.flow
+
+    @property
+    def fleet(self):
+        """The attached fleet registry (None for single-tenant)."""
+        return self._httpd.fleet
 
     def ensure_namespaces(self) -> None:
         """Re-run the bootstrap namespace creation (idempotent) — the

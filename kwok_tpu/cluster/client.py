@@ -825,6 +825,15 @@ class ClusterClient:
         recovery counters)."""
         return self._request("GET", "/stats")
 
+    def fleet(self, tenant: Optional[str] = None) -> dict:
+        """The fleet-host report (``GET /fleet``): tenant lifecycle
+        counts, cold-start latency quantiles, and per-tenant rows
+        (state/shard/request p50-p99).  With ``tenant``, that tenant's
+        deep view — journeys and the critical-path budget scoped to its
+        object space.  404s (NotFound) when the apiserver hosts no
+        fleet."""
+        return self._request("GET", "/fleet" + self._q(tenant=tenant))
+
     def debug_journey(
         self,
         kind: Optional[str] = None,

@@ -285,7 +285,12 @@ class FlowController:
         for spec in self.config.levels:
             # every level keeps at least one seat: a starved system
             # level under a tiny --max-inflight would invert the whole
-            # point of priority isolation
+            # point of priority isolation.  The floor doubles as the
+            # fleet sizing contract (kwok_tpu/fleet/flow.py): a level
+            # declaring shares=0 costs nothing in total_shares — the
+            # default levels keep their exact seat split — yet still
+            # holds one guaranteed seat, which is how 1000 tenant
+            # levels coexist on one apiserver.
             seats = max(
                 1, round(self.config.max_inflight * spec.shares / total_shares)
             )

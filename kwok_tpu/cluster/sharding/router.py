@@ -103,13 +103,21 @@ class RvSource:
             self._rv = max(self._rv, int(rv))
 
 
+#: fleet tenant separator: namespaces named ``<tenant>--<ns>`` hash by
+#: the tenant segment alone, so every namespace of one fleet tenant —
+#: and therefore every tenant transaction — lands on one shard
+#: (kwok_tpu/fleet/).  Plain namespaces are unaffected.
+TENANT_SEP = "--"
+
+
 def shard_key(namespaced: bool, kind: str, namespace: Optional[str]) -> str:
     """The stable placement key: namespace for namespaced kinds (the
-    store's own ``ns or "default"`` convention), a kind-tagged key for
-    cluster-scoped kinds (the whole kind lives on one shard, keeping
-    its lists/watches single-shard)."""
+    store's own ``ns or "default"`` convention, truncated at the fleet
+    tenant separator so a tenant's namespaces co-locate), a kind-tagged
+    key for cluster-scoped kinds (the whole kind lives on one shard,
+    keeping its lists/watches single-shard)."""
     if namespaced:
-        return namespace or "default"
+        return (namespace or "default").split(TENANT_SEP, 1)[0]
     return "kind:" + (kind or "").lower()
 
 

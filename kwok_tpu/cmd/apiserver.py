@@ -95,6 +95,29 @@ def build_parser() -> argparse.ArgumentParser:
         "and client classification",
     )
     p.add_argument(
+        "--fleet-tenants",
+        type=int,
+        default=0,
+        help="host N virtual control planes (fleet tenants) on this "
+        "apiserver: tenant-scoped routing via the X-Kwok-Tenant header "
+        "or the /fleet/t/{tenant}/ path prefix, per-tenant APF levels, "
+        "cold-start/scale-to-zero lifecycle (kwok_tpu.fleet; 0 = a "
+        "plain single-tenant apiserver)",
+    )
+    p.add_argument(
+        "--fleet-idle-s",
+        type=float,
+        default=300.0,
+        help="seconds without a request before a fleet tenant is idle",
+    )
+    p.add_argument(
+        "--fleet-cold-s",
+        type=float,
+        default=900.0,
+        help="seconds without a request before a fleet tenant scales "
+        "to zero (binding dropped; durable state stays in the store)",
+    )
+    p.add_argument(
         "--watch-timeout",
         type=float,
         default=3600.0,
@@ -305,6 +328,26 @@ def _serve(args, store, wal, wals, pitrs, sharded: bool) -> int:
             flush=True,
         )
 
+    fleet = None
+    tenant_ids = []
+    if args.fleet_tenants > 0:
+        from kwok_tpu.fleet import FleetRegistry, fleet_tenant_ids
+
+        tenant_ids = fleet_tenant_ids(args.fleet_tenants)
+        fleet = FleetRegistry(
+            store,
+            tenant_ids,
+            idle_after_s=args.fleet_idle_s,
+            cold_after_s=args.fleet_cold_s,
+            kubelet_url=args.kubelet_url or None,
+        )
+        print(
+            f"fleet: hosting {len(tenant_ids)} virtual control planes "
+            f"(idle after {args.fleet_idle_s}s, cold after "
+            f"{args.fleet_cold_s}s)",
+            flush=True,
+        )
+
     flow = None
     if args.max_inflight > 0 or args.flow_config:
         from kwok_tpu.cluster.flowcontrol import (
@@ -315,15 +358,29 @@ def _serve(args, store, wal, wals, pitrs, sharded: bool) -> int:
 
         if args.flow_config:
             config = load_flow_config(args.flow_config)
+        elif tenant_ids:
+            # one APF level per tenant (shares=0 = guaranteed-minimum
+            # seat) on top of the default split — the fleet isolation
+            # contract (kwok_tpu.fleet.flow)
+            from kwok_tpu.fleet import fleet_flow_config
+
+            config = fleet_flow_config(
+                tenant_ids, max_inflight=args.max_inflight
+            )
         else:
             config = FlowConfig(max_inflight=args.max_inflight)
         flow = FlowController(
             config, seed=plan.seed if plan is not None else 0
         )
+        levels = [lv.name for lv in config.levels]
+        shown = (
+            f"{levels[:4]} + {len(levels) - 4} tenant levels"
+            if tenant_ids and len(levels) > 4
+            else f"{levels}"
+        )
         print(
             "flowcontrol: APF armed "
-            f"(max_inflight={config.max_inflight}, levels="
-            f"{[lv.name for lv in config.levels]})",
+            f"(max_inflight={config.max_inflight}, levels={shown})",
             flush=True,
         )
 
@@ -339,6 +396,7 @@ def _serve(args, store, wal, wals, pitrs, sharded: bool) -> int:
         fault_injector=injector,
         flow=flow,
         watch_timeout=args.watch_timeout,
+        fleet=fleet,
     )
     srv.start()
     print(f"apiserver listening on {srv.url}", flush=True)

@@ -98,6 +98,86 @@ def cmd_create_cluster(args) -> int:
     return 0
 
 
+def cmd_create_fleet(args) -> int:
+    """Create a *fleet*: one cluster whose apiserver hosts N virtual
+    control planes as in-process tenants (kwok_tpu.fleet) — the
+    reference's many-clusters surface (one runtime dir per cluster)
+    collapsed into one control plane with enforced isolation."""
+    rt = _runtime(args)
+    if rt.exists() and not dry_run.enabled:
+        print(f"cluster {rt.name!r} already exists", file=sys.stderr)
+        return 1
+    if args.clusters < 1:
+        raise SystemExit(f"--clusters must be >= 1 (got {args.clusters})")
+    if args.store_shards < 1:
+        raise SystemExit(
+            f"--store-shards must be >= 1 (got {args.store_shards})"
+        )
+    rt.install(
+        secure=args.secure,
+        config_paths=args.config,
+        enable_tracing=args.enable_tracing,
+        chaos_profile=args.chaos_profile or None,
+        flow_config=args.flow_config or None,
+        max_inflight=args.max_inflight,
+        store_shards=args.store_shards,
+        fleet_tenants=args.clusters,
+        fleet_idle_s=args.idle_after,
+        fleet_cold_s=args.cold_after,
+    )
+    rt.up(wait=args.wait)
+    if not dry_run.enabled:
+        if not rt.ready(timeout=args.wait):
+            print("fleet failed to become ready; see logs", file=sys.stderr)
+            return 1
+        print(
+            f"fleet {rt.name!r} is ready at "
+            f"{rt.load_config()['serverURL']} "
+            f"({args.clusters} tenants; route with X-Kwok-Tenant or "
+            f"/fleet/t/<tenant>/)"
+        )
+    return 0
+
+
+def cmd_get_fleet(args) -> int:
+    """Per-tenant fleet state: lifecycle (cold/warm/idle), pinned
+    shard, cold-start count, and observed request p50/p99 — the
+    many-clusters listing (reference kwokctl get clusters iterates
+    runtime dirs) for tenants of one apiserver."""
+    rt = _require_cluster(args)
+    client = rt.client(timeout=5.0)
+    if getattr(args, "tenant", None):
+        _print_yaml(client.fleet(tenant=args.tenant))
+        return 0
+    report = client.fleet()
+    cs = report.get("cold_start_latency")
+    summary = (
+        f"tenants={report.get('tenants')} warm={report.get('warm')} "
+        f"idle={report.get('idle')} cold={report.get('cold')} "
+        f"cold_starts={report.get('cold_starts')}"
+    )
+    if cs:
+        summary += (
+            f" cold-start={cs['p50'] * 1000:.1f}/"
+            f"{cs['p99'] * 1000:.1f}ms(p50/p99)"
+        )
+    print(summary)
+    for row in report.get("rows") or []:
+        line = (
+            f"{row['tenant']}\t{row['state']}\tshard={row['shard']}"
+            f"\tcold-starts={row['cold_starts']}"
+            f"\trequests={row['requests']}"
+        )
+        lat = row.get("latency")
+        if lat:
+            line += (
+                f"\tlat={lat['p50'] * 1000:.1f}/"
+                f"{lat['p99'] * 1000:.1f}ms(p50/p99)"
+            )
+        print(line)
+    return 0
+
+
 def cmd_delete_cluster(args) -> int:
     rt = _runtime(args)
     rt.down()
@@ -136,6 +216,7 @@ def cmd_get_components(args) -> int:
     election = {}  # holder instance -> (lease, transitions, renew age)
     wal = None
     latency = None
+    fleet_info = None
     try:
         client = rt.client(timeout=2.0)
         leases, _rv = client.list("Lease", namespace="kube-system")
@@ -157,6 +238,7 @@ def cmd_get_components(args) -> int:
         stats = client.stats() or {}
         wal = stats.get("wal")
         latency = stats.get("latency")
+        fleet_info = stats.get("fleet")
     except Exception:  # noqa: BLE001 — a down apiserver degrades to
         # the plain liveness listing rather than failing the command
         pass
@@ -205,6 +287,16 @@ def cmd_get_components(args) -> int:
                 line += f"\tfsynced={fs_age:.1f}s ago"
             if wal.get("corruptions"):
                 line += f"\tcorruptions={wal['corruptions']}"
+        if name == "apiserver" and fleet_info:
+            # fleet tenancy at a glance: tenant count + lifecycle split
+            # (kwok_tpu.fleet via /stats; `kwokctl get fleet` has the
+            # per-tenant rows)
+            line += (
+                f"\tfleet={fleet_info.get('tenants')}"
+                f"(warm:{fleet_info.get('warm')}"
+                f" idle:{fleet_info.get('idle')}"
+                f" cold:{fleet_info.get('cold')})"
+            )
         if name == "apiserver" and latency:
             # observed SLO latency summary (utils/telemetry via /stats):
             # request-duration p50/p99 — the live answer to "is the
@@ -1680,6 +1772,79 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--wait", type=float, default=60.0)
     c.set_defaults(fn=cmd_create_cluster)
 
+    cf = pcs.add_parser(
+        "fleet",
+        help="one apiserver hosting N virtual control planes "
+        "(kwok_tpu.fleet): per-tenant object spaces, APF levels, "
+        "cold-start/scale-to-zero lifecycle",
+    )
+    cf.add_argument(
+        "--clusters",
+        type=int,
+        required=True,
+        metavar="N",
+        help="virtual control planes (tenants) to host; tenant ids "
+        "t000..t{N-1} double as the APF level names",
+    )
+    cf.add_argument("--secure", action="store_true", help="TLS apiserver with generated PKI")
+    cf.add_argument("--config", action="append", default=[])
+    cf.add_argument(
+        "--enable-tracing",
+        "--trace",
+        dest="enable_tracing",
+        action="store_true",
+        help="run the trace collector component (per-tenant journeys "
+        "feed `kwokctl get fleet` and GET /fleet?tenant=)",
+    )
+    cf.add_argument(
+        "--chaos-profile",
+        default="",
+        help="arm apiserver HTTP fault injection from this seeded "
+        "profile YAML (tenant floods ride the same injector)",
+    )
+    cf.add_argument(
+        "--flow-config",
+        default="",
+        help="override the generated per-tenant FlowConfiguration "
+        "(default: one level per tenant with a guaranteed-minimum "
+        "seat, kwok_tpu.fleet.flow)",
+    )
+    cf.add_argument(
+        "--max-inflight",
+        type=int,
+        default=None,
+        help="apiserver global inflight budget (default 64); tenant "
+        "levels take guaranteed-minimum seats on top of the default "
+        "split",
+    )
+    cf.add_argument(
+        "--store-shards",
+        type=int,
+        default=1,
+        metavar="M",
+        help="shard the shared store M ways; each tenant's whole "
+        "object space pins to one shard (the placement hash truncates "
+        "at the tenant separator), so tenant txns stay single-shard",
+    )
+    cf.add_argument(
+        "--idle-after",
+        type=float,
+        default=None,
+        metavar="S",
+        help="seconds without a request before a tenant is idle "
+        "(default 300)",
+    )
+    cf.add_argument(
+        "--cold-after",
+        type=float,
+        default=None,
+        metavar="S",
+        help="seconds without a request before a tenant scales to "
+        "zero (binding dropped, durable state kept; default 900)",
+    )
+    cf.add_argument("--wait", type=float, default=60.0)
+    cf.set_defaults(fn=cmd_create_fleet)
+
     pd = sub.add_parser("delete", help="delete a resource")
     pds = pd.add_subparsers(dest="what", required=True)
     d = pds.add_parser("cluster")
@@ -1702,6 +1867,18 @@ def build_parser() -> argparse.ArgumentParser:
     pgs = pg.add_subparsers(dest="what", required=True)
     pgs.add_parser("clusters").set_defaults(fn=cmd_get_clusters)
     pgs.add_parser("components").set_defaults(fn=cmd_get_components)
+    gf = pgs.add_parser(
+        "fleet",
+        help="per-tenant fleet state: cold/warm/idle, pinned shard, "
+        "request p50/p99",
+    )
+    gf.add_argument(
+        "--tenant",
+        default="",
+        help="one tenant's deep view (journeys + critical-path budget) "
+        "as YAML",
+    )
+    gf.set_defaults(fn=cmd_get_fleet)
     pgs.add_parser("kubeconfig").set_defaults(fn=cmd_get_kubeconfig)
     ga = pgs.add_parser(
         "artifacts", help="list binaries or images used by a cluster"

@@ -84,6 +84,9 @@ def build_apiserver_component(
     flow_config: Optional[str] = None,
     max_inflight: Optional[int] = None,
     store_shards: int = 1,
+    fleet_tenants: int = 0,
+    fleet_idle_s: Optional[float] = None,
+    fleet_cold_s: Optional[float] = None,
 ) -> Component:
     """(reference components/kube_apiserver.go:60 BuildKubeApiserverComponent)"""
     args = [
@@ -122,6 +125,16 @@ def build_apiserver_component(
         # shards/NN/.  Pinned in argv so the shard count is auditable
         # and survives restarts (the layout must match what's on disk)
         args += ["--store-shards", str(int(store_shards))]
+    if int(fleet_tenants) > 0:
+        # fleet mode (kwok_tpu.fleet): N virtual control planes as
+        # tenants of this one apiserver, each with its own APF level
+        # and cold-start/scale-to-zero lifecycle.  Pinned in argv so
+        # the tenant set is auditable and survives restarts.
+        args += ["--fleet-tenants", str(int(fleet_tenants))]
+        if fleet_idle_s is not None:
+            args += ["--fleet-idle-s", str(fleet_idle_s)]
+        if fleet_cold_s is not None:
+            args += ["--fleet-cold-s", str(fleet_cold_s)]
     if flow_config:
         args += ["--flow-config", flow_config]
     if chaos_profile:
@@ -328,6 +341,9 @@ def build_core_components(
     leader_elect: bool = True,
     gang_policy: str = "binpack",
     store_shards: int = 1,
+    fleet_tenants: int = 0,
+    fleet_idle_s: Optional[float] = None,
+    fleet_cold_s: Optional[float] = None,
 ) -> List[Component]:
     """The standard control-plane seat list, in dependency order
     (reference binary/cluster.go:217-314 composes the same set).  The
@@ -352,6 +368,9 @@ def build_core_components(
             flow_config=flow_config,
             max_inflight=max_inflight,
             store_shards=store_shards,
+            fleet_tenants=fleet_tenants,
+            fleet_idle_s=fleet_idle_s,
+            fleet_cold_s=fleet_cold_s,
         )
     ]
     for i in range(replicas):

@@ -116,6 +116,9 @@ class BinaryRuntime:
         leader_elect: bool = True,
         gang_policy: str = "binpack",
         store_shards: int = 1,
+        fleet_tenants: int = 0,
+        fleet_idle_s: Optional[float] = None,
+        fleet_cold_s: Optional[float] = None,
     ) -> dict:
         """Generate pki/config/component specs (reference
         binary/cluster.go:217-314 Install)."""
@@ -185,6 +188,9 @@ class BinaryRuntime:
             leader_elect=leader_elect,
             gang_policy=gang_policy,
             store_shards=store_shards,
+            fleet_tenants=fleet_tenants,
+            fleet_idle_s=fleet_idle_s,
+            fleet_cold_s=fleet_cold_s,
         )
         tracing_port = 0
         if enable_tracing:
@@ -224,6 +230,12 @@ class BinaryRuntime:
             conf["gangPolicy"] = gang_policy
         if int(store_shards) > 1:
             conf["storeShards"] = int(store_shards)
+        if int(fleet_tenants) > 0:
+            conf["fleetTenants"] = int(fleet_tenants)
+            if fleet_idle_s is not None:
+                conf["fleetIdleSeconds"] = float(fleet_idle_s)
+            if fleet_cold_s is not None:
+                conf["fleetColdSeconds"] = float(fleet_cold_s)
         self.write_prometheus_config(kubelet_port, secure=secure)
         self._installed_components = components
         if dry_run.enabled:

@@ -36,6 +36,7 @@ from kwok_tpu.cluster.store import (
     ADDED,
     DELETED,
     MODIFIED,
+    Conflict,
     EventRecorder,
     Expired,
     NotFound,
@@ -55,6 +56,8 @@ __all__ = [
     "SchedulerActor",
     "LifecycleActor",
     "ObserverActor",
+    "FleetWriterActor",
+    "TenantObserverActor",
 ]
 
 #: kinds the GC seat pumps (the interesting owner graph; the daemon
@@ -658,6 +661,122 @@ class LifecycleActor(_GatedControllerActor):
         if result is not None and stage.immediate_next_stage:
             self._preprocess(result)
         return False
+
+
+class FleetWriterActor(Actor):
+    """One fleet tenant's client workload: periodic ConfigMap creates
+    through the tenant's scoped store view (``kwok_tpu/fleet/tenant.py``
+    TenantStore over the actor/network boundary), the simulated form of
+    a virtual control plane's traffic.  Object names carry the owning
+    tenant (``{tid}-cm-{seq}``) so the tenant-isolation invariant can
+    attribute anything that surfaces in a NEIGHBOR's stream.  Not
+    leader-gated: tenants are clients, like the scenario operator."""
+
+    def __init__(self, sim, tenant: str):
+        super().__init__(sim, f"fleet/{tenant}", None, period=1.1)
+        from kwok_tpu.fleet.tenant import TenantStore
+
+        self.tenant = tenant
+        self.store = TenantStore(
+            ActorStore(sim, f"fleet/{tenant}", f"tenant:{tenant}"), tenant
+        )
+        self.seq = 0
+        #: last virtual instant a write round-tripped (the region-move
+        #: probe asserts this advances past every transfer window)
+        self.last_ok_t = -1.0
+        self._bootstrapped = False
+
+    def step(self) -> None:
+        if not self._bootstrapped:
+            # the cold-start bootstrap the live FleetRegistry performs:
+            # the tenant's default namespace, through the scoped view
+            try:
+                self.store.create(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Namespace",
+                        "metadata": {"name": "default"},
+                    }
+                )
+            except Conflict:
+                pass
+            self._bootstrapped = True
+            self.last_ok_t = self.sim.clock.now()
+            return
+        try:
+            self.store.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": f"{self.tenant}-cm-{self.seq}",
+                        "namespace": "default",
+                    },
+                    "data": {"seq": str(self.seq)},
+                }
+            )
+        except Conflict:
+            # an earlier eaten ack applied this seq; the write IS
+            # durable — advance past it
+            pass
+        self.seq += 1
+        self.last_ok_t = self.sim.clock.now()
+
+
+class TenantObserverActor(Actor):
+    """Per-tenant passive watch consumer: the tenant's own informer,
+    recording every ConfigMap name its scoped stream delivers
+    (``RunRecord.tenant_streams``).  The tenant-isolation invariant
+    asserts no recorded name belongs to another tenant.  ``leaky``
+    (the ``--dst-bug tenant-leak`` regression) subscribes to the RAW
+    store instead of the TenantStore view — the unscoped-watch bug
+    class the invariant exists to catch."""
+
+    def __init__(self, sim, tenant: str, leaky: bool = False):
+        super().__init__(sim, f"fleet-observer/{tenant}", None, period=0.6)
+        self.tenant = tenant
+        self.leaky = leaky
+        self.names: List[str] = []
+        self._w = None
+        self._gen: Optional[int] = None
+        self._rv: Optional[int] = None
+
+    def _scoped_store(self):
+        if self.leaky:
+            return self.sim.store
+        from kwok_tpu.fleet.tenant import TenantStore
+
+        return TenantStore(self.sim.store, self.tenant)
+
+    def step(self) -> None:
+        sim = self.sim
+        if (
+            self._gen != sim.store_generation
+            or self._w is None
+            or getattr(self._w, "stopped", False)
+        ):
+            self._gen = sim.store_generation
+            if self._w is not None:
+                self._w.stop()
+            self._w = None
+            store = self._scoped_store()
+            if self._rv is not None:
+                try:
+                    self._w = store.watch("ConfigMap", since_rv=self._rv)
+                except Expired:
+                    self._w = None  # rollback: heal via re-list
+            if self._w is None:
+                _items, rv = store.list("ConfigMap")
+                self._rv = rv
+                self._w = store.watch("ConfigMap", since_rv=rv)
+        for ev in self._w.drain():
+            rv = getattr(ev, "rv", 0) or 0
+            if self._rv is None or rv > self._rv:
+                self._rv = rv
+            meta = (getattr(ev, "object", None) or {}).get("metadata") or {}
+            name = str(meta.get("name") or "")
+            if name:
+                self.names.append(name)
 
 
 class ObserverActor(Actor):

@@ -207,6 +207,26 @@ class FaultTimeline:
         ]
         return min(ends) if ends else t + 1.0
 
+    def add_region_move(
+        self, client_id: str, at: float, duration: float
+    ) -> None:
+        """Tenant region transfer (kwok_tpu/fleet): the tenant's
+        clients go dark for the cutover window — cross-region latency
+        taken to its limit on the virtual clock — then resume against
+        the same store.  Expressed as a partition window so every
+        existing retry/fence seam covers it; the harness records the
+        window and the tenant-isolation invariant asserts the tenant
+        resumed writes after it (bounded disruption)."""
+        self.windows.append(_Window("partition", client_id, at, duration))
+        self.scheduled.append(
+            _Scheduled(
+                t=at,
+                kind="tenant-region-move",
+                params={"client": client_id, "duration": duration},
+            )
+        )
+        self.scheduled.sort(key=lambda s: s.t)
+
     def partitioned(self, client_id: str, t: float) -> bool:
         return any(
             w.kind == "partition" and w.covers(client_id, t)

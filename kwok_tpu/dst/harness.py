@@ -37,11 +37,13 @@ from kwok_tpu.cluster.store import (
 from kwok_tpu.cluster.wal import WriteAheadLog
 from kwok_tpu.dst.actors import (
     ElectorActor,
+    FleetWriterActor,
     KcmActor,
     LifecycleActor,
     ObserverActor,
     Replica,
     SchedulerActor,
+    TenantObserverActor,
 )
 from kwok_tpu.dst.faults import ActorStore, FaultTimeline, SimCrash
 from kwok_tpu.dst.invariants import run_checks
@@ -78,7 +80,9 @@ class SimOptions:
     #: standby reconcile without holding the lease; "partial-gang"
     #: un-atomics the gang bind; "cross-shard-txn" makes the shard
     #: router place txn ops per-object and split atomic batches into
-    #: per-shard sub-txns (needs store_shards > 1)
+    #: per-shard sub-txns (needs store_shards > 1); "tenant-leak"
+    #: un-scopes one fleet tenant's watch stream (needs
+    #: fleet_tenants > 0)
     bug: Optional[str] = None
     #: store shards (kwok_tpu/cluster/sharding): the default DST run
     #: exercises the sharded composition — per-shard WALs on one
@@ -96,6 +100,12 @@ class SimOptions:
     gang_size: int = 3
     #: simulated topology shape for the scenario nodes (hosts/slice)
     gang_slice_hosts: int = 2
+    #: fleet tenants co-hosted on the simulated control plane
+    #: (kwok_tpu/fleet): each runs a writer + a scoped observer, one
+    #: seeded tenant rides a region-move window, and the
+    #: tenant-isolation invariant audits the streams + flow probe.
+    #: 0 disables the fleet composition entirely
+    fleet_tenants: int = 2
 
 
 @dataclass
@@ -118,6 +128,18 @@ class RunRecord:
     #: a bound strict subset surviving a recovery is the atomicity
     #: violation the gang-atomicity invariant flags
     gang_checks: List[dict] = field(default_factory=list)
+    #: fleet probes (tenant-isolation invariant): per tenant, every
+    #: ConfigMap name its scoped watch stream delivered — a name owned
+    #: by a DIFFERENT tenant is the cross-tenant leak
+    tenant_streams: Dict[str, List[str]] = field(default_factory=dict)
+    #: deterministic APF probes: flooding one tenant's level to
+    #: rejection must leave a neighbor tenant and the system level
+    #: admitting (the per-tenant-level starvation contract of
+    #: kwok_tpu/fleet/flow.py)
+    tenant_flow_checks: List[dict] = field(default_factory=list)
+    #: region-move probes: per transfer window, did the moved tenant
+    #: resume writes after it (bounded disruption)
+    tenant_region_checks: List[dict] = field(default_factory=list)
     replay_matches: Optional[bool] = None
     replay_detail: str = ""
     converged: bool = False
@@ -264,6 +286,28 @@ class Simulation:
         self.observer = ObserverActor(self, "Pod")
         self.actors.append(self.observer)
 
+        # ----- fleet tenants (kwok_tpu/fleet) -----------------------
+        # each tenant: one writer (its control-plane traffic, through
+        # the TenantStore scoping) + one scoped observer (its informer).
+        # "tenant-leak" un-scopes the FIRST tenant's observer — the
+        # regression the tenant-isolation invariant must catch.
+        self.fleet_writers: List[FleetWriterActor] = []
+        self.fleet_observers: List[TenantObserverActor] = []
+        fleet_ids: List[str] = []
+        if opts.fleet_tenants > 0:
+            from kwok_tpu.fleet.tenant import fleet_tenant_ids
+
+            fleet_ids = fleet_tenant_ids(opts.fleet_tenants)
+            for i, tid in enumerate(fleet_ids):
+                w = FleetWriterActor(self, tid)
+                self.fleet_writers.append(w)
+                self.actors.append(w)
+                ob = TenantObserverActor(
+                    self, tid, leaky=(opts.bug == "tenant-leak" and i == 0)
+                )
+                self.fleet_observers.append(ob)
+                self.actors.append(ob)
+
         self.faults = FaultTimeline(
             seed=opts.seed,
             t0=EPOCH + 4.0,
@@ -274,6 +318,27 @@ class Simulation:
             ],
             enable=opts.faults,
         )
+        if fleet_ids and opts.faults:
+            # one seeded tenant rides a region transfer: its clients go
+            # dark for the cutover window (cross-region latency at its
+            # limit, on the virtual clock), then must resume — the
+            # bounded-disruption probe the tenant-isolation invariant
+            # audits
+            frng = self.faults.rng
+            moved = fleet_ids[frng.randrange(len(fleet_ids))]
+            at = EPOCH + 4.0 + frng.uniform(
+                2.0, max(4.0, opts.duration - 10.0) * 0.5
+            )
+            dur = frng.uniform(2.0, 4.0)
+            self.faults.add_region_move(f"tenant:{moved}", at, dur)
+            self.record.tenant_region_checks.append(
+                {
+                    "tenant": moved,
+                    "t": round(at - EPOCH, 3),
+                    "t_end": at + dur,
+                    "duration": round(dur, 3),
+                }
+            )
         self._killed: Dict[str, Replica] = {}
         self._paused: Dict[str, Replica] = {}
         self._scenario = self._build_scenario()
@@ -665,6 +730,13 @@ class Simulation:
             if target is not None:
                 target.paused = False
                 self.trace.add(t, "faults", "resume", target.name)
+        elif kind == "tenant-region-move":
+            self.trace.add(
+                t,
+                "faults",
+                "tenant-region-move",
+                f"{params['client']} dur={params['duration']:.2f}",
+            )
         elif kind == "disk-corrupt":
             self._disk_fault(params["mode"])
         elif kind == "pressure-start":
@@ -858,10 +930,81 @@ class Simulation:
                 return False, f"pod {meta.get('name')} not Running"
         return True, ""
 
+    def _fleet_flow_probe(self) -> None:
+        """Deterministic APF starvation probe (no HTTP, no threads):
+        build the fleet's generated FlowConfiguration with a zero queue
+        wait, flood ONE tenant's level to rejection while holding every
+        granted seat, then assert a neighbor tenant and the system
+        level still admit — the per-tenant-level isolation contract
+        (kwok_tpu/fleet/flow.py seat floors) checked in-process, where
+        single-threadedness makes the outcome a pure function of the
+        config."""
+        from kwok_tpu.cluster.flowcontrol import FlowController, FlowRejected
+        from kwok_tpu.fleet.flow import fleet_flow_config, tenant_client_id
+
+        tids = [w.tenant for w in self.fleet_writers]
+        if len(tids) < 2:
+            return
+        fc = FlowController(
+            fleet_flow_config(tids, queue_wait_s=0.0, queue_limit=2)
+        )
+        flooded, victim = tids[0], tids[1]
+        held = []
+        rejections = 0
+        for _ in range(64):
+            try:
+                held.append(
+                    fc.admit(
+                        tenant_client_id(flooded),
+                        "POST",
+                        "/r/configmaps",
+                        level=flooded,
+                    )
+                )
+            except FlowRejected:
+                rejections += 1
+                break
+        victim_ok = True
+        try:
+            fc.release(
+                fc.admit(
+                    tenant_client_id(victim), "POST", "/r/configmaps",
+                    level=victim,
+                )
+            )
+        except FlowRejected:
+            victim_ok = False
+        system_ok = True
+        try:
+            fc.release(fc.admit("system:probe", "GET", "/r/pods"))
+        except FlowRejected:
+            system_ok = False
+        for t in held:
+            fc.release(t)
+        self.record.tenant_flow_checks.append(
+            {
+                "flooded": flooded,
+                "victim": victim,
+                "flood_rejections": rejections,
+                "victim_ok": victim_ok,
+                "system_ok": system_ok,
+            }
+        )
+
     def _finish(self) -> RunRecord:
         rec = self.record
         rec.converged, rec.convergence_detail = self._converged()
         rec.streams = self.observer.streams
+        for ob in self.fleet_observers:
+            rec.tenant_streams[ob.tenant] = ob.names
+        for chk in rec.tenant_region_checks:
+            w = next(
+                (w for w in self.fleet_writers if w.tenant == chk["tenant"]),
+                None,
+            )
+            chk["resumed"] = bool(w is not None and w.last_ok_t > chk["t_end"])
+        if self.fleet_writers:
+            self._fleet_flow_probe()
         rec.crash_checks = self.crash_checks
         rec.disk_checks = self.disk_checks
         rec.exhaustion_checks = self.exhaustion_checks
@@ -929,6 +1072,8 @@ def run_seed(
         "disk_faults": len(rec.disk_checks),
         "pressure_windows": len(rec.exhaustion_checks),
         "gang_probes": len(rec.gang_checks),
+        "fleet_tenants": len(rec.tenant_streams),
+        "region_moves": len(rec.tenant_region_checks),
         "counts": rec.final_counts,
         "violations": violations,
     }

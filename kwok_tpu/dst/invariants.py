@@ -30,7 +30,12 @@ specifies for this repo:
   ``kwok_tpu/chaos/fs_pressure.py:1``),
 - gang atomicity (no recovered, final, or WAL-replayed state shows a
   bound strict subset of a PodGroup — the all-or-nothing admission
-  contract of ``kwok_tpu/sched/engine.py:1``).
+  contract of ``kwok_tpu/sched/engine.py:1``),
+- tenant isolation (no fleet tenant's write surfaces in another
+  tenant's scoped watch stream, a flooded tenant's APF level never
+  starves a neighbor or the system level, and a region-moved tenant
+  resumes inside a bounded window — the enforced-isolation contract
+  of ``kwok_tpu/fleet/tenant.py``).
 
 Pluggable: ``INVARIANTS`` maps name → checker; ``run_checks`` runs a
 selection and returns ``{name: [violations]}``.
@@ -271,6 +276,65 @@ def check_gang_atomicity(record) -> List[str]:
     return out
 
 
+#: fleet writers name their objects ``{tenant}-cm-{seq}`` so ownership
+#: is derivable from the name alone, even off a raw (leaked) stream
+_TENANT_CM_RE = re.compile(r"^(?P<owner>t\d+)-cm-\d+$")
+
+
+def check_tenant_isolation(record) -> List[str]:
+    """The fleet's enforced-isolation contract
+    (``kwok_tpu/fleet/tenant.py``), three probes per run:
+
+    - **streams**: no tenant's scoped watch stream may deliver an
+      object owned by a DIFFERENT tenant
+      (``RunRecord.tenant_streams`` — the TenantStore/TenantWatcher
+      prefix scoping, audited from the consumer side);
+    - **flow**: flooding one tenant's APF level to rejection must
+      leave a neighbor tenant and the system level admitting
+      (``RunRecord.tenant_flow_checks`` — the per-tenant-level seat
+      floors of ``kwok_tpu/fleet/flow.py``), and the flood itself
+      must have been rejected at least once or the probe is vacuous;
+    - **region moves**: a tenant whose clients rode a region-transfer
+      window must resume writes after it — disruption is bounded to
+      the window (``RunRecord.tenant_region_checks``)."""
+    out: List[str] = []
+    for tid in sorted(getattr(record, "tenant_streams", {}) or {}):
+        for name in record.tenant_streams[tid]:
+            m = _TENANT_CM_RE.match(name)
+            if m and m.group("owner") != tid:
+                out.append(
+                    f"tenant {tid} observed {name!r} (owned by "
+                    f"{m.group('owner')}) — cross-tenant watch leak"
+                )
+                break
+    for i, probe in enumerate(getattr(record, "tenant_flow_checks", []) or []):
+        if probe.get("flood_rejections", 0) <= 0:
+            out.append(
+                f"flow probe #{i}: flood against {probe.get('flooded')} "
+                "was never rejected (probe vacuous — level unbounded?)"
+            )
+        if not probe.get("victim_ok", True):
+            out.append(
+                f"flow probe #{i}: flooding {probe.get('flooded')} "
+                f"starved neighbor tenant {probe.get('victim')}"
+            )
+        if not probe.get("system_ok", True):
+            out.append(
+                f"flow probe #{i}: flooding {probe.get('flooded')} "
+                "starved the system level"
+            )
+    for i, chk in enumerate(
+        getattr(record, "tenant_region_checks", []) or []
+    ):
+        if not chk.get("resumed", False):
+            out.append(
+                f"region move #{i}: tenant {chk.get('tenant')} never "
+                "resumed writes after the transfer window "
+                f"(t={chk.get('t')} dur={chk.get('duration')})"
+            )
+    return out
+
+
 def check_trace_complete(record) -> List[str]:
     if record.audit_overflow:
         return [
@@ -290,6 +354,7 @@ INVARIANTS: Dict[str, Callable] = {
     "recovery-honesty": check_recovery_honesty,
     "exhaustion-honesty": check_exhaustion_honesty,
     "gang-atomicity": check_gang_atomicity,
+    "tenant-isolation": check_tenant_isolation,
 }
 
 

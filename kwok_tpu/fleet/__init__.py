@@ -1,0 +1,49 @@
+"""Cluster fleets: many virtual control planes on one apiserver.
+
+The north star is heavy traffic from millions of users — thousands of
+cheap clusters, not one big one (ROADMAP open item 2).  A *fleet* makes
+a cluster an in-process tenant of a single apiserver: each tenant owns
+a namespace-prefixed slice of the shared :class:`ResourceStore`
+(``<tenant>--<namespace>``), a lifecycle (cold → warm on first request,
+warm → idle → cold again on the injected clock, the scale-to-zero shape
+of on-demand Wasm/WASI edge control planes re-expressed over this
+substrate — PAPERS.md), a pinned store shard (the placement hash
+truncates at the tenant separator, so a tenant's whole object space —
+and therefore its transactions — stays single-shard,
+``kwok_tpu/cluster/sharding/router.py``), and a dedicated APF priority
+level (``level == tenant id``, generated into a ``FlowConfiguration``
+with ``shares: 0`` = guaranteed-minimum seats, so one tenant's flood
+saturates only its own queues and can never consume a neighbor's — or
+the system level's — seats, ``kwok_tpu/cluster/flowcontrol.py``).
+
+Layering: ``fleet`` sits ABOVE ``cluster``/``cluster.sharding`` in the
+kwoklint layer map — the apiserver reaches it only through the
+duck-typed ``fleet=`` constructor seam (the same pattern the chaos
+fault injector uses), never by import.
+
+Reference surface: kwokctl manages many clusters side by side
+(reference pkg/kwokctl/cmd/create/cluster + ``kwokctl get clusters``
+iterate independent runtime dirs); a fleet is that multi-cluster
+surface collapsed into one process.
+"""
+
+from kwok_tpu.fleet.flow import fleet_flow_config, tenant_client_id
+from kwok_tpu.fleet.tenant import (
+    TENANT_HEADER,
+    FleetRegistry,
+    TenantStore,
+    TenantWatcher,
+    UnknownTenant,
+    fleet_tenant_ids,
+)
+
+__all__ = [
+    "TENANT_HEADER",
+    "FleetRegistry",
+    "TenantStore",
+    "TenantWatcher",
+    "UnknownTenant",
+    "fleet_tenant_ids",
+    "fleet_flow_config",
+    "tenant_client_id",
+]

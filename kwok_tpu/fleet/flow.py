@@ -1,0 +1,96 @@
+"""Per-tenant APF priority levels, generated as a FlowConfiguration.
+
+Every fleet tenant gets its OWN priority level named by its tenant id,
+so the apiserver's flow controller gives each tenant private seats and
+private fair queues: a flooded tenant saturates only its own level —
+its 429s are its own, and its backlog can never occupy a neighbor's
+(or the system level's) queue capacity.  This is the per-tenant level
+derivation ROADMAP open item 2 asked for, built entirely out of the
+existing PR 4 machinery: we emit a plain ``FlowConfiguration`` dict and
+feed it through :meth:`FlowConfig.from_dict` — no new admission code.
+
+The sizing trick: tenant levels declare ``shares: 0``.  The seat
+formula (``max(1, round(max_inflight * shares / total_shares))``,
+cluster/flowcontrol.py) floors every level at one seat without letting
+a thousand tenant levels dilute the default levels' shares — system /
+controllers / workloads keep exactly the seat split they'd have in a
+fleet-less cluster, and each tenant holds a guaranteed-minimum seat.
+That keeps the config sound at ``--clusters 1000`` on one apiserver.
+
+Reference: kube-apiserver APF expresses the same idea as one
+PriorityLevelConfiguration per isolation domain (reference
+runtime/binary/cluster.go:316-728 carries the inflight flags this
+module partitions).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from kwok_tpu.cluster.flowcontrol import FlowConfig
+
+__all__ = ["fleet_flow_config", "tenant_client_id", "fleet_flow_dict"]
+
+#: queue shape of one tenant level: tiny on purpose — a tenant's
+#: backlog bound is (queues * queueLimit) requests and one queue-wait of
+#: latency, so a flood sheds fast instead of building a deep queue
+_TENANT_QUEUES = 2
+_TENANT_QUEUE_WAIT_S = 0.5
+_TENANT_QUEUE_LIMIT = 64
+
+
+def tenant_client_id(tenant: str) -> str:
+    """The ``X-Kwok-Client`` identity a tenant's traffic classifies
+    under (exact-match flow rule → O(1) classification)."""
+    return f"tenant:{tenant}"
+
+
+def fleet_flow_dict(
+    tenants: Sequence[str],
+    max_inflight: Optional[int] = None,
+    queue_wait_s: float = _TENANT_QUEUE_WAIT_S,
+    queue_limit: int = _TENANT_QUEUE_LIMIT,
+) -> Dict[str, object]:
+    """The generated ``FlowConfiguration`` document (kind +
+    levels + flows) for a fleet — the YAML-equivalent form, so it can
+    be dumped, diffed, or shipped through ``--flow-config`` unchanged."""
+    levels: List[dict] = [
+        {
+            "name": t,
+            "shares": 0,  # guaranteed-minimum seat; see module docstring
+            "queues": _TENANT_QUEUES,
+            "queueWaitSeconds": queue_wait_s,
+            "queueLimit": queue_limit,
+        }
+        for t in tenants
+    ]
+    flows: List[dict] = [
+        {"level": t, "clients": [tenant_client_id(t)]} for t in tenants
+    ]
+    doc: Dict[str, object] = {
+        "kind": "FlowConfiguration",
+        "levels": levels,
+        "flows": flows,
+    }
+    if max_inflight is not None:
+        doc["maxInflight"] = int(max_inflight)
+    return doc
+
+
+def fleet_flow_config(
+    tenants: Sequence[str],
+    max_inflight: Optional[int] = None,
+    queue_wait_s: float = _TENANT_QUEUE_WAIT_S,
+    queue_limit: int = _TENANT_QUEUE_LIMIT,
+) -> FlowConfig:
+    """Parsed :class:`FlowConfig` with one priority level per tenant on
+    top of the default system/controllers/workloads/best-effort split.
+    Feed straight to :class:`FlowController`."""
+    return FlowConfig.from_dict(
+        fleet_flow_dict(
+            tenants,
+            max_inflight=max_inflight,
+            queue_wait_s=queue_wait_s,
+            queue_limit=queue_limit,
+        )
+    )

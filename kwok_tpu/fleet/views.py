@@ -1,0 +1,177 @@
+"""Per-tenant observability: latency quantiles, journeys, critical path.
+
+Everything here is a *view* over the bounded instrumentation that
+already exists (utils/telemetry histograms + JourneyRecorder) — no new
+per-object state, no unbounded labels.  The only label this module ever
+attaches is the tenant id, whose set is fixed at fleet creation
+(``kwokctl create fleet --clusters N``), so cardinality is bounded by
+configuration; ``max_children`` is raised accordingly and the overflow
+still folds into ``(other)`` as a backstop.
+
+Per-tenant journeys need no tenant label at all: a tenant's objects
+live in ``<tenant>--*`` namespaces, so the journey ring's existing
+namespace field IS the tenant attribution — we filter at read time.
+
+Reference: kwokctl renders per-cluster status by iterating runtime dirs
+(reference pkg/kwokctl/cmd/get/clusters/clusters.go:40); here the
+per-tenant view is one process's telemetry sliced by tenant.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kwok_tpu.cluster.sharding.router import TENANT_SEP
+from kwok_tpu.utils import telemetry as _telemetry
+
+__all__ = [
+    "observe_request",
+    "observe_cold_start",
+    "tenant_latency",
+    "latency_summary",
+    "cold_start_quantiles",
+    "tenant_journeys",
+    "tenant_critical_path",
+]
+
+#: request duration per tenant.  The "tenant" label is bounded by the
+#: fleet's fixed tenant set (never an object name); max_children covers
+#: a 1k-tenant fleet with headroom before the (other) fold kicks in.
+_H_TENANT_REQ = _telemetry.histogram(
+    "kwok_fleet_tenant_request_seconds",
+    help="apiserver request duration per fleet tenant",
+    labelnames=("tenant",),
+    max_children=4096,
+)
+
+#: cold-start cost: binding + bootstrap-namespace materialization on a
+#: tenant's first request after scale-to-zero (no labels — the
+#: distribution is the fleet-wide SLO, per-tenant counts live in
+#: FleetRegistry.describe())
+_H_COLD_START = _telemetry.histogram(
+    "kwok_fleet_cold_start_seconds",
+    help="tenant cold-start duration (binding + bootstrap)",
+)
+
+
+def observe_request(tenant: str, seconds: float) -> None:
+    _H_TENANT_REQ.observe(seconds, tenant)
+
+
+def observe_cold_start(seconds: float) -> None:
+    _H_COLD_START.observe(seconds)
+
+
+def _child_quantile(
+    counts: Sequence[int], bounds: Sequence[float], q: float
+) -> Optional[float]:
+    """Cumulative-bucket interpolation over ONE child's counts (the
+    family's ``quantile`` aggregates across children — per-tenant views
+    need the single-child form)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    run = 0.0
+    for i, n in enumerate(counts):
+        prev = run
+        run += n
+        if run >= target and n:
+            if i >= len(bounds):
+                return bounds[-1] if bounds else 0.0
+            lo = bounds[i - 1] if i else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * ((target - prev) / n)
+    return bounds[-1] if bounds else 0.0
+
+
+def tenant_latency(tenant: str) -> Optional[Dict[str, float]]:
+    """One tenant's observed request-latency summary
+    (p50/p99/count), or None before its first observation."""
+    data = _H_TENANT_REQ.snapshot().get((tenant,))
+    if data is None or not data["count"]:
+        return None
+    bounds = _H_TENANT_REQ.bounds
+    return {
+        "p50": round(_child_quantile(data["counts"], bounds, 0.50) or 0.0, 6),
+        "p99": round(_child_quantile(data["counts"], bounds, 0.99) or 0.0, 6),
+        "count": int(data["count"]),
+    }
+
+
+def latency_summary() -> Dict[str, Dict[str, float]]:
+    """{tenant: {p50, p99, count}} for every tenant that has traffic
+    (the ``kwokctl get fleet`` latency columns)."""
+    bounds = _H_TENANT_REQ.bounds
+    out: Dict[str, Dict[str, float]] = {}
+    for lv, data in _H_TENANT_REQ.snapshot().items():
+        if not data["count"]:
+            continue
+        t = lv[0] if lv else ""
+        out[t] = {
+            "p50": round(_child_quantile(data["counts"], bounds, 0.50) or 0.0, 6),
+            "p99": round(_child_quantile(data["counts"], bounds, 0.99) or 0.0, 6),
+            "count": int(data["count"]),
+        }
+    return out
+
+
+def cold_start_quantiles() -> Optional[Dict[str, float]]:
+    """Fleet-wide cold-start p50/p99 (None before any cold start)."""
+    if not _H_COLD_START.total_count():
+        return None
+    return {
+        "p50": round(_H_COLD_START.quantile(0.50) or 0.0, 6),
+        "p99": round(_H_COLD_START.quantile(0.99) or 0.0, 6),
+        "count": int(_H_COLD_START.total_count()),
+    }
+
+
+def tenant_journeys(
+    tenant: str, kind: Optional[str] = None, limit: int = 20
+) -> List[Dict[str, object]]:
+    """The tenant's slice of the journey ring: timelines whose
+    namespace carries the tenant prefix, rendered with the prefix
+    stripped so they match what the tenant's own API surface shows."""
+    prefix = tenant + TENANT_SEP
+    out: List[Dict[str, object]] = []
+    # over-fetch: the ring interleaves every tenant's objects
+    for j in _telemetry.journey().journeys(kind=kind, limit=max(limit * 8, 64)):
+        ns = str(j.get("namespace") or "")
+        if not ns.startswith(prefix):
+            continue
+        j = dict(j)
+        j["namespace"] = ns[len(prefix):]
+        out.append(j)
+        if len(out) >= limit:
+            break
+    return out
+
+
+def tenant_critical_path(
+    tenant: str, kind: Optional[str] = None, limit: int = 50
+) -> Dict[str, object]:
+    """The tenant's time budget: per-hop totals aggregated from its
+    journey timelines (each inter-hop gap attributed to the later hop,
+    the same accounting as the collector's critical-path view) — where
+    this tenant's objects actually spend their lifecycle time."""
+    budget: Dict[str, float] = {}
+    hops_seen = 0
+    journeys = tenant_journeys(tenant, kind=kind, limit=limit)
+    for j in journeys:
+        prev_t: Optional[float] = None
+        for hop in j.get("hops") or []:
+            t = hop.get("t_mono")
+            name = str(hop.get("hop") or "")
+            if not name or not isinstance(t, (int, float)):
+                continue
+            hops_seen += 1
+            if prev_t is not None and t >= prev_t:
+                budget[name] = budget.get(name, 0.0) + (t - prev_t)
+            prev_t = t
+    return {
+        "tenant": tenant,
+        "journeys": len(journeys),
+        "hops": hops_seen,
+        "budget_s": {k: round(v, 6) for k, v in sorted(budget.items())},
+    }

@@ -117,3 +117,16 @@ def test_create_cluster_no_leader_elect_golden(home):
          "--no-leader-elect"],
     )
     check_golden("create_cluster_no_leader_elect.txt", got)
+
+
+def test_create_fleet_golden(home):
+    """create fleet: one cluster whose apiserver argv carries the
+    tenant roster size + lifecycle knobs (kwok_tpu.fleet) — tenants
+    are in-process, so no extra component processes appear."""
+    got = run_dry(
+        home,
+        ["--name", "golden", "--dry-run", "create", "fleet",
+         "--clusters", "4", "--store-shards", "2",
+         "--idle-after", "300", "--cold-after", "900"],
+    )
+    check_golden("create_fleet.txt", got)

@@ -362,3 +362,77 @@ def test_telemetry_armed_vs_disarmed_digests_byte_identical():
     assert armed["trace_digest"] == disarmed["trace_digest"]
     assert armed == disarmed
     assert armed["violations"] == {}
+
+
+def test_tenant_isolation_checker_flags_synthetic_violations():
+    """Each probe group of the tenant-isolation invariant
+    (kwok_tpu/dst/invariants.py check_tenant_isolation) against
+    synthetic records: clean passes, and every violation class is
+    named — watch leak, starved neighbor, starved system, vacuous
+    flood, unresumed region move."""
+    clean = _record(
+        Trace(),
+        tenant_streams={"t000": ["t000-cm-0", "t000-cm-1"], "t001": ["t001-cm-0"]},
+        tenant_flow_checks=[
+            {"flooded": "t000", "victim": "t001", "flood_rejections": 5,
+             "victim_ok": True, "system_ok": True},
+        ],
+        tenant_region_checks=[
+            {"tenant": "t001", "t": 4.0, "t_end": 7.0, "duration": 3.0,
+             "resumed": True},
+        ],
+    )
+    assert INVARIANTS["tenant-isolation"](clean) == []
+
+    leak = _record(
+        Trace(), tenant_streams={"t000": ["t000-cm-0", "t001-cm-3"]}
+    )
+    found = INVARIANTS["tenant-isolation"](leak)
+    assert found and "cross-tenant watch leak" in found[0]
+    assert "t001-cm-3" in found[0]
+
+    starved = _record(
+        Trace(),
+        tenant_flow_checks=[
+            {"flooded": "t000", "victim": "t001", "flood_rejections": 0,
+             "victim_ok": False, "system_ok": False},
+        ],
+    )
+    msgs = INVARIANTS["tenant-isolation"](starved)
+    assert any("vacuous" in m for m in msgs)
+    assert any("starved neighbor tenant t001" in m for m in msgs)
+    assert any("starved the system level" in m for m in msgs)
+
+    stalled = _record(
+        Trace(),
+        tenant_region_checks=[
+            {"tenant": "t000", "t": 4.0, "t_end": 7.0, "duration": 3.0,
+             "resumed": False},
+        ],
+    )
+    found = INVARIANTS["tenant-isolation"](stalled)
+    assert found and "never resumed writes" in found[0]
+
+
+def test_tenant_leak_regression_is_caught_and_replays_identically():
+    """Acceptance gate for the fleet composition: --dst-bug tenant-leak
+    subscribes one tenant's observer to the RAW store instead of its
+    TenantStore — the cross-tenant watch leak the tenant-isolation
+    invariant must flag, reproducibly."""
+    opts = SimOptions(bug="tenant-leak")
+    caught = None
+    for seed in range(5):
+        r = run_seed(seed, opts)
+        if r["violations"]:
+            caught = (seed, r)
+            break
+    assert caught is not None, "seed search never caught tenant-leak"
+    seed, first = caught
+    assert "tenant-isolation" in first["violations"]
+    assert any(
+        "cross-tenant watch leak" in v
+        for v in first["violations"]["tenant-isolation"]
+    )
+    replay = run_seed(seed, opts)
+    assert replay["trace_digest"] == first["trace_digest"]
+    assert replay["violations"] == first["violations"]

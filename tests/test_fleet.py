@@ -1,0 +1,458 @@
+"""Cluster fleets (kwok_tpu.fleet): tenant object-space mapping, watch
+isolation, APF level derivation, lifecycle on the injected clock, shard
+pinning, and the apiserver's tenant routing dialects — all in-process
+except the slow-marked live-daemon e2e at the bottom."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kwok_tpu.cluster.apiserver import APIServer
+from kwok_tpu.cluster.flowcontrol import FlowController, FlowRejected
+from kwok_tpu.cluster.sharding.router import (
+    TENANT_SEP,
+    build_sharded_store,
+    shard_of,
+)
+from kwok_tpu.cluster.store import NotFound, ResourceStore
+from kwok_tpu.fleet import (
+    FleetRegistry,
+    TenantStore,
+    UnknownTenant,
+    fleet_flow_config,
+    fleet_tenant_ids,
+    tenant_client_id,
+)
+from kwok_tpu.fleet.flow import fleet_flow_dict
+from kwok_tpu.utils.clock import FakeClock
+
+
+def _cm(name, ns=None, **data):
+    obj = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name},
+        "data": dict(data) or {"k": "v"},
+    }
+    if ns is not None:
+        obj["metadata"]["namespace"] = ns
+    return obj
+
+
+# ------------------------------------------------------------- tenant ids
+
+
+def test_fleet_tenant_ids_sort_and_width():
+    assert fleet_tenant_ids(3) == ["t000", "t001", "t002"]
+    ids = fleet_tenant_ids(1500)
+    assert ids[0] == "t0000" and ids[-1] == "t1499"
+    assert ids == sorted(ids)
+    assert fleet_tenant_ids(0) == []
+
+
+# -------------------------------------------------------- object mapping
+
+
+def test_tenant_store_prefixes_and_strips_namespaces():
+    host = ResourceStore()
+    a = TenantStore(host, "t000")
+    b = TenantStore(host, "t001")
+    a.create(_cm("shared-name", owner="a"))
+    b.create(_cm("shared-name", owner="b"))
+
+    # same (name, visible-namespace) coexists: the host keeps them in
+    # prefixed namespaces, each tenant sees only its own, stripped
+    got_a = a.get("ConfigMap", "shared-name")
+    got_b = b.get("ConfigMap", "shared-name")
+    assert got_a["metadata"]["namespace"] == "default"
+    assert got_a["data"]["owner"] == "a" and got_b["data"]["owner"] == "b"
+
+    host_ns = {
+        o["metadata"]["namespace"] for o in host.list("ConfigMap")[0]
+    }
+    assert host_ns == {f"t000{TENANT_SEP}default", f"t001{TENANT_SEP}default"}
+
+    # all-namespaces list filters to the tenant's prefix
+    items, _rv = a.list("ConfigMap")
+    assert [o["data"]["owner"] for o in items] == ["a"]
+    # explicit-namespace list maps the namespace in
+    items, _rv = b.list("ConfigMap", namespace="default")
+    assert [o["data"]["owner"] for o in items] == ["b"]
+
+    # delete is tenant-scoped: a's delete cannot touch b's object
+    a.delete("ConfigMap", "shared-name")
+    with pytest.raises(NotFound):
+        a.get("ConfigMap", "shared-name")
+    assert b.get("ConfigMap", "shared-name")["data"]["owner"] == "b"
+
+
+def test_tenant_store_namespace_kind_maps_names():
+    host = ResourceStore()
+    a = TenantStore(host, "t000")
+    b = TenantStore(host, "t001")
+    a.create({"kind": "Namespace", "metadata": {"name": "apps"}})
+    b.create({"kind": "Namespace", "metadata": {"name": "batch"}})
+
+    # the host carries prefixed Namespace names; each tenant lists only
+    # its own, stripped — the virtual cluster looks complete
+    host_names = {o["metadata"]["name"] for o in host.list("Namespace")[0]}
+    assert f"t000{TENANT_SEP}apps" in host_names
+    assert f"t001{TENANT_SEP}batch" in host_names
+    assert {o["metadata"]["name"] for o in a.list("Namespace")[0]} == {"apps"}
+    assert a.get("Namespace", "apps")["metadata"]["name"] == "apps"
+    with pytest.raises(NotFound):
+        a.get("Namespace", "batch")
+
+
+def test_tenant_store_cluster_scoped_kinds_pass_through():
+    host = ResourceStore()
+    host.create({"apiVersion": "v1", "kind": "Node",
+                 "metadata": {"name": "node-0"}, "spec": {}, "status": {}})
+    a = TenantStore(host, "t000")
+    # the fleet shares its simulated substrate: tenants see host Nodes
+    assert a.get("Node", "node-0")["metadata"]["name"] == "node-0"
+    assert [o["metadata"]["name"] for o in a.list("Node")[0]] == ["node-0"]
+
+
+def test_tenant_store_over_sharded_store_no_copy_kwarg():
+    """ShardedStore.list (and ClusterClient.list) take no ``copy=``;
+    TenantStore must probe the duck and drop the hint (regression: the
+    fleet daemon 500ed on every tenant list over --store-shards 2)."""
+    host = build_sharded_store(2)
+    a = TenantStore(host, "t000")
+    b = TenantStore(host, "t001")
+    a.create(_cm("cm", owner="a"))
+    b.create(_cm("cm", owner="b"))
+    assert [o["data"]["owner"] for o in a.list("ConfigMap")[0]] == ["a"]
+    assert [o["data"]["owner"] for o in a.list("ConfigMap", namespace="default")[0]] == ["a"]
+    assert a.count("ConfigMap") == 1
+    assert {o["metadata"]["name"] for o in a.list("Namespace")[0]} == set()
+
+
+def test_tenant_transact_maps_and_stays_single_shard():
+    host = build_sharded_store(4)
+    a = TenantStore(host, "t000")
+    # a multi-op tenant txn: both ops share the tenant prefix, and the
+    # placement hash truncates at the separator — single-shard by
+    # construction, so the router must NOT 409 it as cross-shard
+    res = a.transact([
+        {"verb": "create", "kind": "ConfigMap", "data": _cm("x", owner="a")},
+        {"verb": "create", "kind": "ConfigMap",
+         "data": _cm("y", ns="other", owner="a")},
+    ])
+    assert len(res) == 2
+    assert res[0]["metadata"]["namespace"] == "default"
+    assert res[1]["metadata"]["namespace"] == "other"
+    assert a.count("ConfigMap") == 2
+
+
+# ------------------------------------------------------- watch isolation
+
+
+def test_cross_tenant_watch_isolation():
+    host = ResourceStore()
+    a = TenantStore(host, "t000")
+    b = TenantStore(host, "t001")
+    wa = a.watch("ConfigMap")
+    wb = b.watch("ConfigMap")
+    try:
+        a.create(_cm("a-only"))
+        b.create(_cm("b-only"))
+        ev_a = wa.drain()
+        ev_b = wb.drain()
+        assert [e.object["metadata"]["name"] for e in ev_a] == ["a-only"]
+        assert [e.object["metadata"]["name"] for e in ev_b] == ["b-only"]
+        # delivered objects are stripped — the consumer sees its
+        # virtual cluster, never the host-prefixed truth
+        assert ev_a[0].object["metadata"]["namespace"] == "default"
+    finally:
+        wa.stop()
+        wb.stop()
+
+
+def test_watch_strip_does_not_mutate_stored_object():
+    host = ResourceStore()
+    a = TenantStore(host, "t000")
+    w = a.watch("ConfigMap")
+    try:
+        a.create(_cm("cm"))
+        ev = w.drain()[0]
+        assert ev.object["metadata"]["namespace"] == "default"
+        # the host's stored instance keeps its prefix (watch rings hand
+        # out shared references; stripping must shallow-copy)
+        host_obj = host.list("ConfigMap", copy=False)[0][0]
+        assert host_obj["metadata"]["namespace"] == f"t000{TENANT_SEP}default"
+    finally:
+        w.stop()
+
+
+def test_namespace_kind_watch_is_tenant_scoped():
+    host = ResourceStore()
+    a = TenantStore(host, "t000")
+    b = TenantStore(host, "t001")
+    w = a.watch("Namespace")
+    try:
+        a.create({"kind": "Namespace", "metadata": {"name": "apps"}})
+        b.create({"kind": "Namespace", "metadata": {"name": "batch"}})
+        names = [e.object["metadata"]["name"] for e in w.drain()]
+        assert names == ["apps"]
+    finally:
+        w.stop()
+
+
+# ----------------------------------------------------- APF level per tenant
+
+
+def test_fleet_flow_config_derives_level_per_tenant():
+    ids = fleet_tenant_ids(5)
+    cfg = fleet_flow_config(ids, max_inflight=16)
+    level_names = {lv.name for lv in cfg.levels}
+    # every tenant level exists ON TOP of the default split
+    assert set(ids) <= level_names
+    assert {"system", "controllers", "workloads", "best-effort"} <= level_names
+    ctl = FlowController(cfg, seed=1)
+    assert FleetRegistry.level_for("t003") == "t003"
+    assert ctl.classify(tenant_client_id("t003")) == "t003"
+    # non-tenant traffic still lands on the default schema
+    assert ctl.classify("kwokctl") == "system"
+    assert ctl.classify("stranger") == "best-effort"
+
+
+def test_tenant_levels_have_guaranteed_seat_without_diluting_defaults():
+    ids = fleet_tenant_ids(1000)
+    doc = fleet_flow_dict(ids)
+    assert all(lv["shares"] == 0 for lv in doc["levels"])
+    cfg = fleet_flow_config(ids, max_inflight=16)
+    ctl = FlowController(cfg, seed=1)
+    snap = ctl.snapshot()
+    # shares: 0 floors every tenant at one seat; a thousand tenant
+    # levels must not dilute the defaults' seat split
+    assert snap[ids[0]]["seats"] >= 1
+    assert snap["system"]["seats"] >= 2
+
+
+def test_flooded_tenant_sheds_alone():
+    ids = fleet_tenant_ids(3)
+    ctl = FlowController(
+        fleet_flow_config(ids, max_inflight=8, queue_wait_s=0.0, queue_limit=1),
+        seed=7,
+    )
+    held = []
+    # saturate t000's level: seats then queue, until typed rejection
+    with pytest.raises(FlowRejected):
+        for _ in range(64):
+            held.append(ctl.admit(tenant_client_id("t000"), level="t000"))
+    try:
+        # a neighbor and the system level still admit on their own seats
+        ctl.release(ctl.admit(tenant_client_id("t001"), level="t001"))
+        ctl.release(ctl.admit("kwokctl"))
+    finally:
+        for t in held:
+            ctl.release(t)
+    snap = ctl.snapshot()
+    assert snap["t000"]["rejected"] >= 1
+    assert snap["t001"]["rejected"] == 0
+    assert snap["system"]["rejected"] == 0
+
+
+# ------------------------------------------------- lifecycle on the clock
+
+
+def test_registry_lifecycle_cold_warm_idle_cold():
+    clock = FakeClock(0.0)
+    store = ResourceStore()
+    ids = fleet_tenant_ids(2)
+    reg = FleetRegistry(store, ids, clock=clock, idle_after_s=10.0,
+                        cold_after_s=30.0)
+    assert reg.state_of("t000") == "cold"
+
+    binding, cold = reg.touch("t000")
+    assert cold and reg.state_of("t000") == "warm"
+    # cold-start bootstrapped the tenant's default namespace
+    assert binding.store.get("Namespace", "default")
+    binding.store.create(_cm("cm"))
+
+    # second request on a warm binding is NOT a cold start
+    again, cold2 = reg.touch("t000")
+    assert not cold2 and again is binding
+
+    clock.advance(15.0)
+    assert reg.state_of("t000") == "idle"
+    # an idle binding survives: the next touch is warm-path
+    _b, cold3 = reg.touch("t000")
+    assert not cold3 and reg.state_of("t000") == "warm"
+
+    clock.advance(31.0)
+    assert reg.state_of("t000") == "cold"
+    assert reg.sweep(force=True) == 1
+    snap = reg.snapshot()
+    assert snap == {"tenants": 2, "warm": 0, "idle": 0, "cold": 2,
+                    "cold_starts": 1}
+
+    # scale-to-zero dropped the binding, not the data
+    reborn, cold4 = reg.touch("t000")
+    assert cold4 and reborn is not binding
+    assert reborn.store.get("ConfigMap", "cm")["data"] == {"k": "v"}
+    assert reg.snapshot()["cold_starts"] == 2
+
+
+def test_registry_unknown_tenant_is_typed():
+    reg = FleetRegistry(ResourceStore(), fleet_tenant_ids(2),
+                        clock=FakeClock(0.0))
+    with pytest.raises(UnknownTenant):
+        reg.touch("t999")
+    with pytest.raises(UnknownTenant):
+        reg.state_of("nope")
+
+
+# ----------------------------------------------------------- shard pinning
+
+
+def test_shard_pinning_is_stable_per_tenant():
+    ids = fleet_tenant_ids(50)
+    host = build_sharded_store(4)
+    reg = FleetRegistry(host, ids, clock=FakeClock(0.0))
+    assert set(reg.shards) == set(ids)
+    for t in ids:
+        pin = reg.shards[t]
+        assert 0 <= pin < 4
+        # the placement hash truncates at the tenant separator: EVERY
+        # namespace of the tenant (and both kinds) lands on its pin
+        for ns in ("default", "apps", "kube-system"):
+            assert shard_of(True, "Pod", f"{t}{TENANT_SEP}{ns}", 4) == pin
+            assert shard_of(True, "ConfigMap", f"{t}{TENANT_SEP}{ns}", 4) == pin
+    # a real write lands on the pinned shard
+    t0 = ids[0]
+    TenantStore(host, t0).create(_cm("cm"))
+    shard = host._shards[reg.shards[t0]]
+    assert shard.count("ConfigMap") == 1
+
+
+# ------------------------------------------------------- apiserver routing
+
+
+def _req(url, path, method="GET", tenant=None, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url + path, data=data, method=method)
+    if tenant is not None:
+        r.add_header("X-Kwok-Tenant", tenant)
+    if data is not None:
+        r.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(r, timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else None
+
+
+def test_apiserver_tenant_routing_header_and_path_dialects():
+    store = ResourceStore()
+    clock = FakeClock(0.0)
+    ids = fleet_tenant_ids(3)
+    reg = FleetRegistry(store, ids, clock=clock, idle_after_s=5.0,
+                        cold_after_s=10.0)
+    with APIServer(store, fleet=reg) as srv:
+        # header dialect writes; path dialect reads the same object
+        st, _ = _req(srv.url, "/r/configmaps", "POST", tenant="t000",
+                     body=_cm("via-header"))
+        assert st in (200, 201)
+        st, got = _req(srv.url, "/fleet/t/t000/r/configmaps/via-header")
+        assert st == 200 and got["metadata"]["namespace"] == "default"
+
+        # tenants are isolated across dialects too
+        st, listing = _req(srv.url, "/fleet/t/t001/r/configmaps")
+        assert st == 200 and listing["items"] == []
+
+        # unknown tenant is a typed 404, not a new level or namespace
+        st, err = _req(srv.url, "/r/configmaps", tenant="t999")
+        assert st == 404 and err["reason"] == "NotFound"
+
+        # host surface without a tenant sees the prefixed truth
+        st, host_list = _req(srv.url, "/r/configmaps")
+        assert st == 200
+        assert [o["metadata"]["namespace"] for o in host_list["items"]] == [
+            f"t000{TENANT_SEP}default"
+        ]
+
+        # /fleet report + /stats snapshot carry the lifecycle split
+        st, rep = _req(srv.url, "/fleet")
+        assert st == 200 and rep["tenants"] == 3
+        assert rep["warm"] == 2 and rep["cold"] == 1  # t002 never touched
+        rows = {r["tenant"]: r for r in rep["rows"]}
+        assert rows["t002"]["state"] == "cold"
+        st, stats = _req(srv.url, "/stats")
+        assert st == 200 and stats["fleet"]["tenants"] == 3
+
+        # per-tenant detail view
+        st, det = _req(srv.url, "/fleet?tenant=t000")
+        assert st == 200 and det["tenant"] == "t000"
+        assert det["state"] == "warm" and "latency" in det
+
+        # scale-to-zero over HTTP: advance the injected clock, the next
+        # request cold-starts with data intact
+        clock.advance(60.0)
+        reg.sweep(force=True)
+        assert reg.state_of("t000") == "cold"
+        st, got = _req(srv.url, "/r/configmaps/via-header", tenant="t000")
+        assert st == 200 and got["metadata"]["name"] == "via-header"
+        assert reg.snapshot()["cold_starts"] >= 2
+
+
+def test_apiserver_tenant_watch_isolation_over_http():
+    store = ResourceStore()
+    ids = fleet_tenant_ids(2)
+    reg = FleetRegistry(store, ids, clock=FakeClock(0.0))
+    with APIServer(store, fleet=reg) as srv:
+        for tid, name in (("t000", "mine"), ("t001", "theirs")):
+            st, _ = _req(srv.url, "/r/configmaps", "POST", tenant=tid,
+                         body=_cm(name))
+            assert st in (200, 201)
+        # tenant-scoped watch from rv 0 replays only the tenant's slice
+        r = urllib.request.Request(
+            srv.url + "/r/configmaps?watch=1&resourceVersion=0"
+            "&timeoutSeconds=2"
+        )
+        r.add_header("X-Kwok-Tenant", "t000")
+        names = []
+        with urllib.request.urlopen(r, timeout=10.0) as resp:
+            for line in resp:
+                ev = json.loads(line)
+                if ev.get("type") in ("ADDED", "MODIFIED"):
+                    names.append(ev["object"]["metadata"]["name"])
+        assert names == ["mine"]
+
+
+# ---------------------------------------------------------------- live e2e
+
+
+@pytest.mark.slow
+def test_fleet_live_isolation_e2e(tmp_path, monkeypatch):
+    """kwokctl create fleet → tenant writes via both dialects → get
+    fleet → cross-tenant isolation over live daemons → delete."""
+    monkeypatch.setenv("KWOK_TPU_HOME", str(tmp_path))
+    from kwok_tpu.cmd.kwokctl import main as kwokctl_main
+    from kwok_tpu.ctl.runtime import BinaryRuntime
+
+    name = "fleet-e2e"
+    assert kwokctl_main(
+        ["--name", name, "create", "fleet", "--clusters", "3",
+         "--store-shards", "2", "--wait", "60"]
+    ) == 0
+    try:
+        rt = BinaryRuntime(name)
+        url = rt.load_config()["serverURL"]
+        for tid in ("t000", "t001"):
+            st, _ = _req(url, "/r/configmaps", "POST", tenant=tid,
+                         body=_cm(f"{tid}-cm", owner=tid))
+            assert st in (200, 201), (tid, st)
+        st, listing = _req(url, "/fleet/t/t000/r/configmaps")
+        assert st == 200
+        assert [o["metadata"]["name"] for o in listing["items"]] == ["t000-cm"]
+        st, rep = _req(url, "/fleet")
+        assert st == 200 and rep["tenants"] == 3 and rep["warm"] >= 2
+        assert kwokctl_main(["--name", name, "get", "fleet"]) == 0
+    finally:
+        kwokctl_main(["--name", name, "delete", "cluster"])

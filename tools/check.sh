@@ -42,6 +42,9 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== failover smoke (leader kill/release -> bounded takeover, fenced writes) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --failover-smoke \
         --lease-seconds "${FAILOVER_LEASE_SECONDS:-2.5}"
+    echo "== fleet smoke (1k tenants on one apiserver: flood isolation, scale-to-zero, no leaks) =="
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --fleet-smoke \
+        --fleet-tenants "${FLEET_TENANTS:-1000}"
     echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks; lock sentinel armed) =="
     # KWOK_LOCK_SENTINEL=1 arms the runtime deadlock sentinel
     # (kwok_tpu/utils/locks.py): every seed doubles as a lock-order
