@@ -112,6 +112,7 @@ def all_rules() -> "dict[str, object]":
     ``python -m kwok_tpu.analysis --rules layering`` never pays for the
     rest."""
     from kwok_tpu.analysis import (
+        guarded_by,
         layering,
         lock_discipline,
         lock_order,
@@ -130,6 +131,7 @@ def all_rules() -> "dict[str, object]":
         "store-boundary": store_boundary.analyze,
         "lock-discipline": lock_discipline.analyze,
         "lock-order": lock_order.analyze,
+        "guarded-by": guarded_by.analyze,
         "metric-cardinality": metric_cardinality.analyze,
         "tracer-safety": tracer_safety.analyze,
         "parity-citations": parity_citations.analyze,

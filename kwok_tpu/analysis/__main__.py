@@ -94,7 +94,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="analyze only git-changed files (pre-commit path; falls "
         "back to the full walk outside a git repo; whole-graph "
-        "conclusions and the suppression audit need the full run)",
+        "conclusions and stale-suppression detection need the full "
+        "run — reason-less suppressions in changed files still warn)",
     )
     parser.add_argument(
         "--root",
@@ -127,7 +128,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--cache",
         default=None,
-        help="per-file findings cache (JSON), keyed by content hash",
+        help="per-file findings cache (JSON), keyed by content hash; "
+        "also persists the shared call graph to <cache>.graph so "
+        "graph rules skip the rebuild when no file changed",
     )
     args = parser.parse_args(argv)
 
@@ -135,6 +138,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         root=args.root,
         reference_root=args.reference,
         rules=args.rules.split(",") if args.rules else None,
+        graph_cache_path=f"{args.cache}.graph" if args.cache else None,
     )
     if args.changed_only and args.update_baseline:
         # a baseline rewritten from the changed-file subset would drop
@@ -189,6 +193,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # artifact; None when no lock rule ran
                     "callgraph_build_seconds": (
                         round(cg.build_seconds, 3) if cg is not None else None
+                    ),
+                    # "hit"/"miss" when --cache persisted the graph,
+                    # None when the graph lived in memory only (or no
+                    # graph rule ran at all)
+                    "callgraph_cache": (
+                        cg.cache_state if cg is not None else None
                     ),
                 },
                 indent=2,

@@ -33,6 +33,9 @@ output so the analysis-pass cost stays visible.
 from __future__ import annotations
 
 import ast
+import hashlib
+import os
+import pickle
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
@@ -105,7 +108,7 @@ class FuncInfo:
 
 class ClassInfo:
     __slots__ = ("qname", "module", "path", "node", "methods", "bases",
-                 "attr_types", "lock_attrs")
+                 "attr_types", "lock_attrs", "named_locks")
 
     def __init__(self, qname, module, path, node):
         self.qname = qname
@@ -118,6 +121,11 @@ class ClassInfo:
         self.attr_types: Dict[str, Set[str]] = {}
         #: attr name -> lock kind for lock-creating assignments
         self.lock_attrs: Dict[str, str] = {}
+        #: the subset of lock_attrs created through the named
+        #: ``kwok_tpu.utils.locks`` sentinel factories — the classes the
+        #: guarded-by analyzer scopes to (adopting the factory is the
+        #: opt-in to lockset checking)
+        self.named_locks: Set[str] = set()
 
 
 class ModuleEnv:
@@ -165,6 +173,8 @@ class CallGraph:
         #: func qname -> acquisition sites
         self.acquisitions: Dict[str, List[Acquisition]] = {}
         self.build_seconds: float = 0.0
+        #: "hit" / "miss" when a disk cache was consulted, else None
+        self.cache_state: Optional[str] = None
         self._ctx_cache: Dict[str, "_Ctx"] = {}
 
     def ctx(self, qname: str) -> "_Ctx":
@@ -471,10 +481,10 @@ class _Ctx:
         return None
 
 
-def _lock_ctor_kind(call: ast.Call, env: ModuleEnv) -> Optional[str]:
-    """Lock kind when ``call`` constructs a lock: ``threading.Lock()``,
-    bare ``Lock()`` imported from threading, or a
-    ``kwok_tpu.utils.locks`` sentinel factory."""
+def _lock_ctor_info(call: ast.Call, env: ModuleEnv) -> Optional[Tuple[str, bool]]:
+    """(kind, named) when ``call`` constructs a lock: ``named`` is True
+    for the ``kwok_tpu.utils.locks`` sentinel factories, False for
+    direct ``threading.Lock/RLock/Condition`` (or bare imports)."""
     func = call.func
     name = None
     if isinstance(func, ast.Attribute):
@@ -489,7 +499,19 @@ def _lock_ctor_kind(call: ast.Call, env: ModuleEnv) -> Optional[str]:
             name = func.id
     if name is None:
         return None
-    return _LOCK_CTORS.get(name) or _SENTINEL_CTORS.get(name)
+    kind = _LOCK_CTORS.get(name)
+    if kind is not None:
+        return kind, False
+    kind = _SENTINEL_CTORS.get(name)
+    if kind is not None:
+        return kind, True
+    return None
+
+
+def _lock_ctor_kind(call: ast.Call, env: ModuleEnv) -> Optional[str]:
+    """Lock kind when ``call`` constructs a lock (named or not)."""
+    hit = _lock_ctor_info(call, env)
+    return hit[0] if hit else None
 
 
 def _iter_defs(tree: ast.Module):
@@ -607,9 +629,12 @@ def build_callgraph(files: Iterable[SourceFile]) -> CallGraph:
                     continue
                 attr = stmt.targets[0].attr
                 if isinstance(stmt.value, ast.Call):
-                    kind = _lock_ctor_kind(stmt.value, env)
-                    if kind:
+                    hit = _lock_ctor_info(stmt.value, env)
+                    if hit:
+                        kind, named = hit
                         ci.lock_attrs.setdefault(attr, kind)
+                        if named:
+                            ci.named_locks.add(attr)
                         cg.locks.setdefault(f"{ci.qname}.{attr}", kind)
                         continue
                 if ctx is None:
@@ -664,17 +689,129 @@ def build_callgraph(files: Iterable[SourceFile]) -> CallGraph:
     return cg
 
 
+def _graph_digest(files: List[SourceFile]) -> str:
+    """Content identity of a walked file set: CACHE_VERSION + each
+    file's path and source hash.  Any rule-semantics change bumps
+    CACHE_VERSION (kwok_tpu/analysis/driver.py), any edit changes a
+    source hash — either invalidates the persisted graph."""
+    from kwok_tpu.analysis.driver import CACHE_VERSION
+
+    h = hashlib.sha256()
+    h.update(f"callgraph-v{CACHE_VERSION}".encode())
+    for sf in sorted(files, key=lambda s: s.path):
+        h.update(sf.path.encode())
+        h.update(hashlib.sha256(sf.source.encode()).digest())
+    return h.hexdigest()
+
+
+def _node_bearers(cg: CallGraph):
+    """Every (object, path) whose ``node`` attribute holds an AST node
+    — the part of the graph that must not be pickled (AST unpickling
+    costs nearly as much as a rebuild; a walk-index locator into the
+    freshly parsed trees is tiny and reattaches in milliseconds)."""
+    for fi in cg.functions.values():
+        yield fi, fi.path
+    for ci in cg.classes.values():
+        yield ci, ci.path
+    for q, accs in cg.acquisitions.items():
+        path = cg.functions[q].path
+        for a in accs:
+            yield a, path
+
+
+def _load_graph(
+    path: str, digest: str, files: List[SourceFile]
+) -> Optional[CallGraph]:
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+    except Exception:  # corrupt/stale/foreign file: rebuild
+        return None
+    if not isinstance(payload, dict) or payload.get("digest") != digest:
+        return None
+    cg = payload.get("graph")
+    if not isinstance(cg, CallGraph):
+        return None
+    cg._ctx_cache = {}
+    # reattach AST nodes: a digest match means byte-identical sources,
+    # so each tree's ast.walk order matches the one recorded at save
+    by_path = {sf.path: sf for sf in files}
+    walks: Dict[str, List[ast.AST]] = {}
+    try:
+        for obj, p in _node_bearers(cg):
+            nodes = walks.get(p)
+            if nodes is None:
+                nodes = walks[p] = list(ast.walk(by_path[p].tree))
+            obj.node = nodes[obj.node]
+    except (KeyError, IndexError, TypeError):
+        return None  # locator drift: treat as a miss
+    return cg
+
+
+def _save_graph(
+    path: str, digest: str, cg: CallGraph, files: List[SourceFile]
+) -> None:
+    indexes: Dict[str, Dict[int, int]] = {}
+    for sf in files:
+        indexes[sf.path] = {
+            id(n): i for i, n in enumerate(ast.walk(sf.tree))
+        }
+    saved = []
+    for obj, p in _node_bearers(cg):
+        idx = indexes.get(p, {}).get(id(obj.node))
+        if idx is None:
+            # node not from these trees — restore and don't persist
+            for prev, node in saved:
+                prev.node = node
+            return
+        saved.append((obj, obj.node))
+        obj.node = idx
+    ctxs = cg._ctx_cache
+    cg._ctx_cache = {}  # per-run resolution contexts don't persist
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump({"digest": digest, "graph": cg}, f)
+        os.replace(tmp, path)
+    except (OSError, pickle.PicklingError):
+        pass  # cache is best-effort; next run just rebuilds
+    finally:
+        cg._ctx_cache = ctxs
+        for obj, node in saved:
+            obj.node = node
+
+
 def get_callgraph(files: List[SourceFile], config) -> CallGraph:
     """Build-once accessor: memoized on the Config object (one driver
     run = one Config = one shared graph across analyzers).  Keyed on
     (path, source length) so each analyzer's own filtered COPY of the
     walked list still hits the cache — identity of the list object is
-    an accident of the call site, the file set is not."""
+    an accident of the call site, the file set is not.
+
+    When the Config carries a ``graph_cache_path`` (the CLI derives it
+    from ``--cache``), the built graph also persists to disk keyed on
+    the walked files' content hashes + the driver CACHE_VERSION —
+    across runs the ~second-scale build collapses to an unpickle
+    (``callgraph_build_seconds`` + ``callgraph_cache`` in ``--format
+    json`` show the hit/miss)."""
     key = tuple((sf.path, len(sf.source)) for sf in files)
     cached = getattr(config, "_callgraph", None)
     if cached is not None and getattr(config, "_callgraph_key", None) == key:
         return cached
-    cg = build_callgraph(files)
+    cg = None
+    disk = getattr(config, "graph_cache_path", None)
+    digest = _graph_digest(files) if disk else ""
+    if disk and os.path.exists(disk):
+        t0 = time.monotonic()
+        cg = _load_graph(disk, digest, files)
+        if cg is not None:
+            cg.build_seconds = time.monotonic() - t0
+            cg.cache_state = "hit"
+    if cg is None:
+        cg = build_callgraph(files)
+        if disk:
+            cg.cache_state = "miss"
+            _save_graph(disk, digest, cg, files)
     config._callgraph = cg
     config._callgraph_key = key
     return cg

@@ -49,7 +49,6 @@ _SUPPRESS_FILE_RE = re.compile(
 PER_FILE_RULES = frozenset(
     [
         "store-boundary",
-        "tracer-safety",
         "swallowed-errors",
         "unbounded-buffer",
         "untestable-sleep",
@@ -59,7 +58,7 @@ PER_FILE_RULES = frozenset(
 )
 
 #: bump when any rule's semantics change — invalidates the on-disk cache
-CACHE_VERSION = 13
+CACHE_VERSION = 14
 
 
 def repo_root(start: Optional[str] = None) -> str:
@@ -193,8 +192,9 @@ def collect_changed_files(
     subset is sound (per-file findings, upward imports), but
     whole-graph conclusions (import cycles, lock-order cycles,
     cross-module blocking chains into unchanged files) wait for the
-    full run — which is why the suppression audit is also skipped on
-    this path."""
+    full run — which is why the suppression audit's stale-detection
+    half is also deferred to it (the reason-hygiene half is per-file
+    and still runs here)."""
     import subprocess
 
     def git(*args: str) -> Optional[List[str]]:
@@ -242,10 +242,14 @@ class Config:
         root: Optional[str] = None,
         reference_root: str = "/root/reference",
         rules: Optional[Iterable[str]] = None,
+        graph_cache_path: Optional[str] = None,
     ):
         self.root = repo_root() if root is None else os.path.abspath(root)
         self.reference_root = reference_root
         self.rules = list(rules) if rules is not None else None
+        #: persist the shared call graph here (pickle, content-hash
+        #: keyed — see callgraph.get_callgraph); None = in-memory only
+        self.graph_cache_path = graph_cache_path
 
 
 def _finding_to_dict(f: Finding) -> dict:
@@ -351,11 +355,15 @@ def run(
         else:
             kept.append(f)
     findings = kept
-    # the hygiene audit needs the FULL picture — every rule over every
-    # file — or live suppressions would be misreported as unused, so
-    # --rules subsets and --changed-only walks skip it
-    if full_walk and config.rules is None:
-        findings.extend(_audit_suppressions(files, suppressed_hits))
+    # the STALE half of the hygiene audit needs the FULL picture —
+    # every rule over every file — or live suppressions would be
+    # misreported as unused, so --changed-only walks run only the
+    # per-file-sound reason check; --rules subsets skip the audit
+    # entirely (the other rules never fired)
+    if config.rules is None:
+        findings.extend(
+            _audit_suppressions(files, suppressed_hits, stale_check=full_walk)
+        )
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
 
@@ -366,11 +374,16 @@ AUDIT_RULE = "suppression-hygiene"
 def _audit_suppressions(
     files: List[SourceFile],
     suppressed_hits: Dict[str, List[Tuple[str, int]]],
+    stale_check: bool = True,
 ) -> List[Finding]:
     """Driver-level hygiene over the ``# kwoklint: disable=`` comments
     themselves: a suppression that no longer absorbs any finding is
     dead weight to drop, and a live one without a stated reason is an
-    unreviewable waiver.  Both surface as warnings."""
+    unreviewable waiver.  Both surface as warnings (SARIF ``level:
+    warning``).  ``stale_check=False`` is the --changed-only mode:
+    used-ness can't be judged from a file subset (the absorbing finding
+    may live in an unchanged file's graph context), but a missing
+    reason is a per-file fact and still reports."""
     out: List[Finding] = []
     for sf in files:
         hits = suppressed_hits.get(sf.path, [])
@@ -385,7 +398,7 @@ def _audit_suppressions(
                     for r, ln in hits
                 )
             label = ",".join(sorted(rules))
-            if not used:
+            if stale_check and not used:
                 out.append(
                     Finding(
                         rule=AUDIT_RULE,

@@ -1,0 +1,370 @@
+"""Guarded-by analyzer: RacerD-style lockset inference over the
+shared call graph — shared state must be reached under its lock.
+
+``lock-discipline``/``lock-order`` check how locks *nest*; nothing so
+far checked that the state a lock exists to protect is actually
+accessed under it.  DST is structurally blind to this bug class (the
+whole control plane runs single-threaded on a virtual clock, so an
+unguarded write never interleaves), and the next ROADMAP arc
+(ROADMAP.md:52-67, native patch pipeline + device-resident scheduling
++ online shard split) moves hot mutation paths into code shared across
+request threads, drain loops and per-shard mutex families.  Kivi's
+posture (PAPERS.md) is to *verify* executions rather than sample them;
+this rule is the static half of that for data races, and the
+``KWOK_RACE_SENTINEL=1`` runtime lockset checker
+(``kwok_tpu/utils/locks.py``) is the dynamic complement.
+
+How it works, over :mod:`kwok_tpu.analysis.callgraph`:
+
+- **scope**: classes that create a lock through the named
+  ``kwok_tpu.utils.locks`` factories (``make_lock``/``make_rlock``/
+  ``make_condition``) — ``ResourceStore``, ``FlowController``,
+  ``LeaderElector``, ``EventRecorder``, the per-shard families
+  (``RvSource``), fleet ``FleetRegistry``, the telemetry recorders.
+  Adopting the factory is the opt-in (CLAUDE.md documents the
+  convention for new shared-state locks).
+- **inference**: for each ``self.<attr>`` of such a class, count write
+  sites inside vs outside a lexical hold of each owned lock
+  (``with self._mut:`` bodies and raw ``.acquire()`` holds, as
+  recorded by the call-graph's acquisition table).  An attribute is
+  *guarded by L* when a strict majority of its non-``__init__`` write
+  sites sit under L — construction is happens-before publication, so
+  ``__init__`` never votes and is never checked.
+- **checking**: every read or write of a guarded attribute outside a
+  lexical hold is then checked *interprocedurally*: the access is fine
+  when every call path into its method enters through a hold of L
+  (holds propagate through call-graph reachability — a private helper
+  only ever called under the lock is protected).  Anything reachable
+  without the guard held is reported with a witness chain from an
+  unprotected entry point.
+
+Deliberate lock-free accesses (benign racy reads of a monotonic
+counter, single-owner-thread state) carry reasoned ``# kwoklint:
+disable=guarded-by`` suppressions; the runtime sentinel's
+``guarded()`` declarations then assert the same contract dynamically.
+Accesses inside nested defs/lambdas are out of scope (they run on
+another stack, often another thread — the runtime sentinel owns
+those), as are reaches from outside the owning class (store-boundary's
+business).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from kwok_tpu.analysis import Finding, SourceFile
+from kwok_tpu.analysis.callgraph import (
+    CallGraph,
+    _body_calls,
+    get_callgraph,
+)
+
+RULE = "guarded-by"
+
+#: container-mutation method names: a ``self._attr.append(...)`` is a
+#: write to the shared structure even though the attribute slot itself
+#: is only read
+_MUTATORS = frozenset(
+    {
+        "append", "appendleft", "extend", "insert", "add", "update",
+        "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+        "clear", "sort", "move_to_end",
+    }
+)
+
+#: methods exempt from both inference and checking: __init__ runs
+#: before the instance is published (happens-before), __getstate__ /
+#: __setstate__ run on pickle's single thread over a private copy
+_EXEMPT_METHODS = frozenset({"__init__", "__getstate__", "__setstate__"})
+
+
+class _Access:
+    """One ``self.<attr>`` touch inside a method body."""
+
+    __slots__ = ("attr", "line", "is_write", "func")
+
+    def __init__(self, attr: str, line: int, is_write: bool, func: str):
+        self.attr = attr
+        self.line = line
+        self.is_write = is_write
+        self.func = func  # method qname
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _walk_own(node: ast.AST):
+    """Descend without entering nested defs/lambdas — those bodies run
+    on their own stack (possibly another thread) and lexical holds do
+    not cover them."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield child
+        yield from _walk_own(child)
+
+
+def _collect_accesses(fn: ast.AST, qname: str) -> List[_Access]:
+    """Every ``self.<attr>`` read/write in ``fn``'s own body.
+
+    Writes: assignment/augassign/del targets, subscript stores
+    (``self._d[k] = v``), and container-mutator calls
+    (``self._q.append(x)``).  Everything else is a read."""
+    out: List[_Access] = []
+    #: attribute nodes already claimed by a write shape, so the
+    #: generic Load fallthrough does not double-count them
+    claimed: Set[int] = set()
+
+    for node in _walk_own(fn):
+        # self.A = ... / self.A += ... / del self.A
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is None:
+                continue
+            if isinstance(node.ctx, (ast.Store, ast.Del)):
+                claimed.add(id(node))
+                out.append(_Access(attr, node.lineno, True, qname))
+        elif isinstance(node, ast.Subscript):
+            # self.A[k] = v / del self.A[k] mutate the shared container
+            attr = _self_attr(node.value)
+            if attr is not None and isinstance(node.ctx, (ast.Store, ast.Del)):
+                claimed.add(id(node.value))
+                out.append(_Access(attr, node.lineno, True, qname))
+        elif isinstance(node, ast.Call):
+            # self.A.append(v) and friends
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATORS
+            ):
+                attr = _self_attr(func.value)
+                if attr is not None:
+                    claimed.add(id(func.value))
+                    out.append(_Access(attr, node.lineno, True, qname))
+
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Attribute) and id(node) not in claimed:
+            attr = _self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                out.append(_Access(attr, node.lineno, False, qname))
+    return out
+
+
+def _under_hold(cg: CallGraph, qname: str, lock_id: str, line: int) -> bool:
+    for acq in cg.acquisitions.get(qname, ()):
+        if acq.lock == lock_id and acq.line <= line <= acq.hold_until:
+            return True
+    return False
+
+
+class _Protection:
+    """Interprocedural hold propagation: a function is *protected* for
+    lock L when it has at least one resolvable caller and every call
+    path into it enters through a lexical hold of L.  Holds span the
+    callee's whole execution, so protection is transitive."""
+
+    def __init__(self, cg: CallGraph, lock_id: str):
+        self.cg = cg
+        self.lock_id = lock_id
+        #: callee qname -> caller qnames (lazy reverse edges)
+        self._rev: Optional[Dict[str, Set[str]]] = None
+        #: caller qname -> [(callee, line)] for EVERY call site (the
+        #: graph's edge_sites keep only the first site per callee)
+        self._sites: Dict[str, List[Tuple[str, int]]] = {}
+        #: qname -> (protected, witness chain root->qname when not)
+        self._memo: Dict[str, Tuple[bool, List[str]]] = {}
+
+    def _callers(self, qname: str) -> Set[str]:
+        if self._rev is None:
+            rev: Dict[str, Set[str]] = {}
+            for src, dsts in self.cg.edges.items():
+                for d in dsts:
+                    rev.setdefault(d, set()).add(src)
+            self._rev = rev
+        return self._rev.get(qname, set())
+
+    def _call_sites(self, caller: str, callee: str) -> List[int]:
+        sites = self._sites.get(caller)
+        if sites is None:
+            sites = []
+            fi = self.cg.functions[caller]
+            ctx = self.cg.ctx(caller)
+            for call in _body_calls(fi.node):
+                hit, _ = ctx.resolve_call(call)
+                for c in hit:
+                    sites.append((c, call.lineno))
+            self._sites[caller] = sites
+        return [ln for c, ln in sites if c == callee]
+
+    def check(self, qname: str) -> Tuple[bool, List[str]]:
+        """(protected, witness).  The witness is a call chain from an
+        unprotected entry point down to ``qname`` (entry first)."""
+        return self._check(qname, set())
+
+    def _check(self, qname: str, stack: Set[str]) -> Tuple[bool, List[str]]:
+        memo = self._memo.get(qname)
+        if memo is not None:
+            return memo
+        if qname in stack:
+            # a pure cycle has no independent entry: treat the back
+            # edge as protected, other paths decide the verdict
+            return True, []
+        callers = self._callers(qname)
+        if not callers:
+            result = (False, [qname])
+            self._memo[qname] = result
+            return result
+        stack = stack | {qname}
+        for caller in sorted(callers):
+            lines = self._call_sites(caller, qname)
+            if lines and all(
+                _under_hold(self.cg, caller, self.lock_id, ln) for ln in lines
+            ):
+                continue  # every site in this caller is under the hold
+            ok, chain = self._check(caller, stack)
+            if not ok:
+                result = (False, chain + [qname])
+                self._memo[qname] = result
+                return result
+        result = (True, [])
+        self._memo[qname] = result
+        return result
+
+
+def _lock_owners(cg: CallGraph) -> Dict[str, Dict[str, str]]:
+    """class qname -> {lock attr -> lock id} for every class that
+    creates a named lock, with subclasses inheriting the parent's
+    lock identity (same convention as the lock-order rule)."""
+    out: Dict[str, Dict[str, str]] = {}
+    for cq, ci in cg.classes.items():
+        owned: Dict[str, str] = {}
+        seen: Set[str] = set()
+        stack = [cq]
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            parent = cg.classes.get(c)
+            if parent is None:
+                continue
+            for attr in parent.named_locks:
+                owned.setdefault(attr, f"{parent.qname}.{attr}")
+            stack.extend(parent.bases)
+        if owned:
+            out[cq] = owned
+    return out
+
+
+def _short(qname: str) -> str:
+    return qname.split(".", 1)[-1] if qname.startswith("kwok_tpu.") else qname
+
+
+def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
+    files = [sf for sf in files if sf.path.startswith("kwok_tpu/")]
+    if not files:
+        return []
+    cg = get_callgraph(files, config)
+    owners = _lock_owners(cg)
+    if not owners:
+        return []
+
+    #: (owner class qname, attr) -> [accesses]; inference and checking
+    #: pool a base class and its subclasses onto the attr's OWNER (the
+    #: class whose chain created the lock), so a subclass method writing
+    #: a parent attr votes in the same election
+    accesses: Dict[Tuple[str, str], List[_Access]] = {}
+    #: method qname -> owner class qname (for lock attr exclusion)
+    lock_attr_names: Dict[str, Set[str]] = {}
+
+    for cq, locks in owners.items():
+        names: Set[str] = set()
+        seen: Set[str] = set()
+        stack = [cq]
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            ci = cg.classes.get(c)
+            if ci is None:
+                continue
+            names.update(ci.lock_attrs)
+            stack.extend(ci.bases)
+        lock_attr_names[cq] = names
+
+    for q, fi in cg.functions.items():
+        if fi.cls is None:
+            continue
+        locks = owners.get(fi.cls)
+        if not locks:
+            continue
+        name = q.rsplit(".", 1)[-1]
+        if name in _EXEMPT_METHODS:
+            continue
+        for acc in _collect_accesses(fi.node, q):
+            if acc.attr in lock_attr_names[fi.cls]:
+                continue
+            if cg.method_of(fi.cls, acc.attr) is not None:
+                continue  # bound-method reference, not shared state
+            accesses.setdefault((fi.cls, acc.attr), []).append(acc)
+
+    findings: List[Finding] = []
+    protections: Dict[str, _Protection] = {}
+
+    for (cq, attr), accs in sorted(accesses.items()):
+        locks = owners[cq]
+        # ---- inference: strict majority of write sites under one lock
+        guard: Optional[str] = None
+        evidence: Optional[_Access] = None
+        writes = [a for a in accs if a.is_write]
+        if not writes:
+            continue
+        for lock_attr, lock_id in sorted(locks.items()):
+            under = [
+                a for a in writes if _under_hold(cg, a.func, lock_id, a.line)
+            ]
+            if len(under) > len(writes) - len(under):
+                guard = lock_id
+                evidence = under[0]
+                break
+        if guard is None:
+            continue
+        prot = protections.get(guard)
+        if prot is None:
+            prot = protections[guard] = _Protection(cg, guard)
+        for acc in accs:
+            if _under_hold(cg, acc.func, guard, acc.line):
+                continue
+            ok, chain = prot.check(acc.func)
+            if ok:
+                continue
+            fi = cg.functions[acc.func]
+            witness = " -> ".join(_short(c) for c in chain)
+            op = "write" if acc.is_write else "read"
+            ev = cg.functions[evidence.func]
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=fi.path,
+                    line=acc.line,
+                    message=(
+                        f"{op} of '{_short(cq)}.{attr}' without "
+                        f"'{_short(guard)}' held — guarded-by inferred "
+                        f"from the write under the lock at "
+                        f"{ev.path}:{evidence.line}; reachable unguarded "
+                        f"via {witness} (hold the lock, or suppress with "
+                        "the invariant that makes lock-free access safe)"
+                    ),
+                )
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
+    return findings

@@ -23,30 +23,29 @@ Python-side nondeterminism are bugs that typecheck:
 Kernel discovery: a function is a kernel when (a) it is decorated with
 ``jax.jit``/``jit``, (b) its name appears as an argument to a call
 whose text mentions ``jit`` (covers ``functools.partial(jax.jit,
-...)(_tick_impl)`` and ``jax.jit(run, ...)``), or (c) it is called by
-another kernel in the same module (transitive, per module).  Parameters
-named in a ``static_argnames`` literal at the jit site are static and
-exempt from the traced-``if`` check.  The check only runs over the
-files named in ``KERNEL_FILES`` — host-side numpy in the rest of the
-repo is fine.
+...)(_tick_impl)`` and ``jax.jit(run, ...)``), (c) it is called by
+another kernel in the same module (transitive, per module — covers
+nested defs handed to ``lax.scan``/``fori_loop``), or (d) it is
+REACHABLE from any kernel over the shared project call graph
+(:mod:`kwok_tpu.analysis.callgraph` — transitive, cross-module, so a
+jitted ``score()`` in ``sched/`` or a native-pipeline feeder is
+covered the day it lands, with no allowlist to forget to grow).
+Parameters named in a ``static_argnames`` literal at the jit site are
+static and exempt from the traced-``if`` check; reachability-
+discovered callees treat every parameter as traced.  Host-side numpy
+in code no kernel reaches is fine.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from kwok_tpu.analysis import Finding, SourceFile, dotted_name
+from kwok_tpu.analysis.callgraph import get_callgraph
 
 RULE = "tracer-safety"
-
-#: the modules that define/jit device kernels
-KERNEL_FILES = (
-    "kwok_tpu/ops/tick.py",
-    "kwok_tpu/engine/simulator.py",
-    "kwok_tpu/parallel/mesh.py",
-)
 
 #: attribute-call names that force a host sync on a traced value
 _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
@@ -208,19 +207,76 @@ def _check_kernel(sf: SourceFile, fn: ast.FunctionDef, static: Set[str]) -> List
     return findings
 
 
+def _nested_defs(fn: ast.AST):
+    """Every def nested (at any depth) inside ``fn``."""
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node is not fn
+        ):
+            yield node
+
+
 def analyze(files: Iterable[SourceFile], config) -> List[Finding]:
-    findings: List[Finding] = []
+    files = [sf for sf in files if sf.path.startswith("kwok_tpu/")]
+    by_path = {sf.path: sf for sf in files}
+
+    # stage 1: per-module discovery (jit sites + module-local closure,
+    # including nested scan bodies the call graph does not model)
+    module_kernels: Dict[str, Dict[str, Set[str]]] = {}
     for sf in files:
-        if sf.path not in KERNEL_FILES:
-            continue
-        kernels = _find_kernels(sf.tree)
-        if not kernels:
-            continue
+        k = _find_kernels(sf.tree)
+        if k:
+            module_kernels[sf.path] = k
+    if not module_kernels:
+        return []
+
+    # stage 2: cross-module closure — everything a kernel can reach
+    # over the project call graph runs under the tracer too
+    cg = get_callgraph(files, config)
+    name_index: Dict[Tuple[str, str], List[str]] = {}
+    for q, fi in cg.functions.items():
+        name_index.setdefault((fi.path, fi.node.name), []).append(q)
+
+    seeds: List[str] = []
+    for path, kernels in module_kernels.items():
+        for name in kernels:
+            seeds.extend(name_index.get((path, name), ()))
+    reached: Set[str] = set(seeds)
+    queue = list(seeds)
+    while queue:
+        q = queue.pop()
+        for callee in cg.edges.get(q, ()):
+            if callee not in reached:
+                reached.add(callee)
+                queue.append(callee)
+
+    #: (sf, function node, static params) — deduped on the node
+    units: Dict[int, Tuple[SourceFile, ast.FunctionDef, Set[str]]] = {}
+
+    for path, kernels in module_kernels.items():
+        sf = by_path[path]
         by_name: Dict[str, List[ast.FunctionDef]] = {}
         for node in ast.walk(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 by_name.setdefault(node.name, []).append(node)
-        for name, static in sorted(kernels.items()):
+        for name, static in kernels.items():
             for fn in by_name.get(name, []):
-                findings.extend(_check_kernel(sf, fn, static))
+                units.setdefault(id(fn), (sf, fn, static))
+
+    for q in reached:
+        fi = cg.functions[q]
+        sf = by_path.get(fi.path)
+        if sf is None:
+            continue
+        units.setdefault(id(fi.node), (sf, fi.node, set()))
+        # the graph has no nodes for defs nested inside a reached
+        # function, but they trace with it (scan/cond bodies)
+        for nested in _nested_defs(fi.node):
+            units.setdefault(id(nested), (sf, nested, set()))
+
+    findings: List[Finding] = []
+    for sf, fn, static in units.values():
+        findings.extend(_check_kernel(sf, fn, static))
+    findings.sort(key=lambda f: (f.path, f.line, f.message))
     return findings

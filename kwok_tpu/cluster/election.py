@@ -48,7 +48,7 @@ from typing import Callable, Optional, Tuple
 
 from kwok_tpu.cluster.store import Conflict, NotFound
 from kwok_tpu.utils.clock import Clock, MonotonicClock
-from kwok_tpu.utils.locks import make_lock
+from kwok_tpu.utils.locks import guarded, make_lock
 
 __all__ = [
     "LeaderElector",
@@ -193,6 +193,9 @@ class LeaderElector:
         self._observed_at = 0.0
         self._observed_holder = ""
         self._observed_duration = self.lease_duration
+        # the elector thread and is_leader()/status callers share the
+        # observed record — declared to the runtime race sentinel
+        guarded(self, "_observed_key", "cluster.election.LeaderElector._mut")
 
         self._done = threading.Event()
         self._wake = threading.Event()
@@ -257,10 +260,9 @@ class LeaderElector:
             spec.get("renewTime"),
             spec.get("leaseTransitions"),
         )
-        changed = key != self._observed_key
         new_leader = None
         with self._mut:
-            if changed:
+            if key != self._observed_key:
                 self._observed_key = key
                 self._observed_at = self.clock.now()
                 if holder != self._observed_holder:

@@ -41,7 +41,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from kwok_tpu.utils import telemetry as _telemetry
-from kwok_tpu.utils.locks import make_lock
+from kwok_tpu.utils.locks import guarded, make_lock
 
 #: observed seat-wait latency per priority level (SLO telemetry): how
 #: long admission held a request before granting its seat — ~0 for an
@@ -294,7 +294,11 @@ class FlowController:
             seats = max(
                 1, round(self.config.max_inflight * spec.shares / total_shares)
             )
-            self._levels[spec.name] = _Level(spec, seats)
+            lvl = _Level(spec, seats)
+            # seat accounting is the contended hot state — declare it
+            # to the runtime race sentinel (KWOK_RACE_SENTINEL=1)
+            guarded(lvl, "inflight", "cluster.flowcontrol.FlowController._mut")
+            self._levels[spec.name] = lvl
         # exact-match index over the rules, first writer wins (rule
         # order IS the precedence order within a match kind)
         self._exact: Dict[str, str] = {}

@@ -95,8 +95,8 @@ class CacheGetter:
         """Apply many (etype, obj) under one lock hold (the reflector
         forwards store batches; a lock per event was measurable at
         drain rates)."""
-        items = self._items
         with self._mut:
+            items = self._items
             for etype, obj in pairs:
                 meta = obj.get("metadata") or {}
                 key = (meta.get("namespace") or "", meta.get("name") or "")

@@ -38,7 +38,7 @@ from kwok_tpu.cluster.wal import StorageDegraded, WalExhausted
 from kwok_tpu.utils import telemetry as _telemetry
 from kwok_tpu.utils import trace as _trace
 from kwok_tpu.utils.clock import Clock, RealClock
-from kwok_tpu.utils.locks import make_lock, make_rlock
+from kwok_tpu.utils.locks import guarded, make_lock, make_rlock
 from kwok_tpu.utils.patch import apply_patch
 
 # drain accelerator (native/kwok_fastdrain.c); None -> pure Python
@@ -721,6 +721,10 @@ class ResourceStore:
         #: (audit_overflow), not silent: trace-replaying invariant
         #: checks must be able to tell "clean" from "truncated".
         self._audit: _AuditRing = _AuditRing(maxlen=1_000_000)
+        # runtime twin of the static guarded-by contract: under
+        # KWOK_RACE_SENTINEL=1 any cross-thread access to the ring
+        # without the store mutex raises RaceWitness
+        guarded(self, "_audit", "cluster.store.ResourceStore._mut")
         #: per-watcher undelivered-event bound (0 disables eviction)
         self.watch_high_water = (
             self.WATCH_HIGH_WATER
@@ -952,13 +956,20 @@ class ResourceStore:
         return self._state(kind).rtype
 
     def kinds(self) -> List[ResourceType]:
-        seen = []
-        for st in self._types.values():
-            if st.rtype not in seen:
-                seen.append(st.rtype)
-        return seen
+        # iteration would raise if register_type() resized the dict
+        # mid-walk, so unlike _state this discovery path takes the lock
+        with self._mut:
+            seen = []
+            for st in self._types.values():
+                if st.rtype not in seen:
+                    seen.append(st.rtype)
+            return seen
 
     def _state(self, kind: str) -> _TypeState:
+        # every-request hot path; types register at boot (register_type
+        # holds the mutex) and entries are never replaced or removed,
+        # so a GIL-atomic dict.get sees a fully-built state or misses
+        # kwoklint: disable=guarded-by — boot-registered dict, atomic get
         st = self._types.get(kind.lower())
         if st is None:
             raise NotFound(f"unknown resource type {kind!r}")
@@ -1106,9 +1117,12 @@ class ResourceStore:
                     st.watchers.remove(watcher)
 
     def _note_eviction(self, watcher: Watcher) -> None:
-        # always called with the mutex held (pushes happen under it)
-        self.watch_evictions += 1
-        self._audit.append(("watch-evicted", "", None))
+        # pushes happen under the mutex, but the re-entrant hold is
+        # cheap and _AuditRing.dropped is a naked read-modify-write —
+        # don't trust every future _push caller to keep the invariant
+        with self._mut:
+            self.watch_evictions += 1
+            self._audit.append(("watch-evicted", "", None))
 
     def _bump(self, obj: dict) -> int:
         src = self._rv_source
@@ -2006,18 +2020,22 @@ class ResourceStore:
                     for op in dict_ops
                 }
             )
-            self._audit.append(
-                (
-                    "bulk",
-                    f"{'+'.join(kinds)}:{len(ops)}",
-                    as_user
-                    or (dict_ops[0].get("as_user") if dict_ops else None),
+            with self._mut:
+                # the ring's overflow counter is a read-modify-write —
+                # append only under the mutex like every per-op entry
+                self._audit.append(
+                    (
+                        "bulk",
+                        f"{'+'.join(kinds)}:{len(ops)}",
+                        as_user
+                        or (dict_ops[0].get("as_user") if dict_ops else None),
+                    )
                 )
-            )
         results: List[dict] = []
         # defer this thread's WAL records and land the whole batch with
         # one write+flush — per-op flushes were the WAL's only
         # measurable cost at device-drain rates
+        # kwoklint: disable=guarded-by — attach-once WAL slot, GIL-atomic identity read
         defer_wal = self._wal is not None
         if defer_wal:
             # degraded read-only gate up front: refusing the whole batch
@@ -2533,6 +2551,7 @@ class ResourceStore:
         may mutate stored objects, so the deep-copy capture is kept."""
         from kwok_tpu.cluster.wal import write_state_file
 
+        # kwoklint: disable=guarded-by — attach-once WAL slot, GIL-atomic identity read
         state = self.dump_state(copy=self._wal is None)
         write_state_file(path, state)
         self.compact_wal(int(state["resourceVersion"]))
@@ -2835,10 +2854,10 @@ class ResourceStore:
             if self._wal is None:
                 return None
             h = dict(self._wal.health())
-        h["recoveries"] = self.wal_recoveries
-        h["corruptions"] = self.wal_corruptions
-        h["missing_rvs"] = self.wal_missing_rvs
-        h["snapshot_fallbacks"] = self.snapshot_fallbacks
+            h["recoveries"] = self.wal_recoveries
+            h["corruptions"] = self.wal_corruptions
+            h["missing_rvs"] = self.wal_missing_rvs
+            h["snapshot_fallbacks"] = self.snapshot_fallbacks
         return h
 
 
@@ -2928,6 +2947,7 @@ class EventRecorder:
         self._suffix = suffix or (lambda: f"{time.monotonic_ns():x}")
         self._mut = make_lock("cluster.store.EventRecorder._mut")
         self._keys: "OrderedDict[Tuple, str]" = OrderedDict()
+        guarded(self, "_keys", "cluster.store.EventRecorder._mut")
 
     def _now_string(self) -> str:
         """Event timestamps are client-side in k8s (the recording

@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 from kwok_tpu.cluster.sharding.router import TENANT_SEP, shard_of
 from kwok_tpu.cluster.store import AlreadyExists, NotFound
 from kwok_tpu.utils.clock import Clock, MonotonicClock
-from kwok_tpu.utils.locks import make_lock
+from kwok_tpu.utils.locks import guarded, make_lock
 
 __all__ = [
     "TENANT_HEADER",
@@ -627,6 +627,9 @@ class FleetRegistry:
         self._kubelet_url = kubelet_url
         self._mut = make_lock("fleet.tenant.FleetRegistry._mut")
         self._bindings: Dict[str, _Binding] = {}
+        # request threads + the lifecycle sweep share the binding map —
+        # declared to the runtime race sentinel (KWOK_RACE_SENTINEL=1)
+        guarded(self, "_bindings", "fleet.tenant.FleetRegistry._mut")
         self._last_seen: Dict[str, float] = {}
         self._cold_starts: Dict[str, int] = {t: 0 for t in self._ids}
         self._requests: Dict[str, int] = {t: 0 for t in self._ids}

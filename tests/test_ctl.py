@@ -210,10 +210,13 @@ def test_cluster_lifecycle_end_to_end(home, capsys, monkeypatch):
     """create → scale → kubectl → snapshot → stop → start (state
     persists) → hack → delete.  Real subprocess components.
 
-    Runs with the deadlock sentinel armed (utils/locks.py): every
-    daemon inherits KWOK_LOCK_SENTINEL=1, so a lock-order inversion
-    anywhere in the control plane fails this tier-1 e2e loudly."""
+    Runs with both runtime sentinels armed (utils/locks.py): every
+    daemon inherits KWOK_LOCK_SENTINEL=1 + KWOK_RACE_SENTINEL=1, so a
+    lock-order inversion or an unguarded access to a declared shared
+    attribute anywhere in the control plane fails this tier-1 e2e
+    loudly."""
     monkeypatch.setenv("KWOK_LOCK_SENTINEL", "1")
+    monkeypatch.setenv("KWOK_RACE_SENTINEL", "1")
     name = "e2e"
     logf = os.path.join(str(home), "container.log")
     with open(logf, "w", encoding="utf-8") as f:

@@ -1,0 +1,574 @@
+"""guarded-by analyzer + the KWOK_RACE_SENTINEL runtime lockset.
+
+Synthetic positive/negative fixtures in a throwaway repo layout (the
+test_analysis.py pattern), shaped after the real adoption surfaces:
+the sharding per-shard mutex families and the fleet
+TenantStore/FleetRegistry registry.  The injected-race test drives the
+SAME bug through both halves of the detector — the static rule over
+the fixture source, and the armed runtime sentinel over a live object
+— so a regression in either half fails loudly.
+
+Also covers the analyzer-infrastructure satellites that ride with the
+rule: the persisted call-graph disk cache (hit/miss + corruption
+fallback), the --changed-only fast path skipping the graph build for
+non-graph rule subsets, and the suppression audit surfacing as SARIF
+``level: warning``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kwok_tpu.analysis.driver import Config, collect_files, run
+
+from tests.test_analysis import REPO, run_rules, write_repo
+
+#: minimal named-lock factory stub so fixture repos resolve the
+#: kwok_tpu.utils.locks import the way the real tree does
+_LOCKS_STUB = """
+import threading
+
+def make_lock(name):
+    return threading.Lock()
+
+def make_rlock(name):
+    return threading.RLock()
+"""
+
+
+# ------------------------------------------------------------- inference
+
+
+def test_unguarded_write_fires_with_inference_evidence(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+
+                def drop(self, k):
+                    with self._mut:
+                        self._items.pop(k, None)
+
+                def sneak(self, k, v):
+                    self._items[k] = v
+            """,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    msg = fs[0].message
+    assert "write of 'cluster.s.Store._items'" in msg
+    assert "'cluster.s.Store._mut' held" in msg
+    assert "guarded-by inferred from the write under the lock" in msg
+    assert fs[0].line == 18  # the sneak() body line
+
+
+def test_unguarded_read_fires_with_witness_chain(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+
+                def peek(self):
+                    return len(self._items)
+            """,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "read of 'cluster.s.Store._items'" in fs[0].message
+    assert "reachable unguarded via cluster.s.Store.peek" in fs[0].message
+
+
+def test_unnamed_threading_lock_is_out_of_scope(tmp_path):
+    """Adopting the utils.locks factory is the opt-in: the same racy
+    shape over a direct threading.Lock() stays a lock-order concern,
+    not a guarded-by one."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/cluster/s.py": """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._mut = threading.Lock()
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+
+                def sneak(self, k, v):
+                    self._items[k] = v
+            """,
+        },
+    )
+    assert run_rules(root, ["guarded-by"]) == []
+
+
+def test_no_majority_no_inference(tmp_path):
+    """One write under the lock, one outside: no strict majority, so no
+    guard is inferred and nothing fires (ambient state, not protected
+    state)."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._hint = None
+
+                def locked_set(self, v):
+                    with self._mut:
+                        self._hint = v
+
+                def free_set(self, v):
+                    self._hint = v
+            """,
+        },
+    )
+    assert run_rules(root, ["guarded-by"]) == []
+
+
+# ------------------------------------------- interprocedural protection
+
+
+def test_helper_only_called_under_hold_is_clean(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+                        self._note(k)
+
+                def evict(self, k):
+                    with self._mut:
+                        self._items.pop(k, None)
+                        self._note(k)
+
+                def _note(self, k):
+                    self._items.setdefault("log", []).append(k)
+            """,
+        },
+    )
+    assert run_rules(root, ["guarded-by"]) == []
+
+
+def test_one_unprotected_path_into_helper_fires(tmp_path):
+    """The same helper reached from one caller OUTSIDE the hold: the
+    witness names the unprotected entry point."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._items = {}
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+                        self._note(k)
+
+                def evict(self, k):
+                    with self._mut:
+                        self._items.pop(k, None)
+
+                def stats(self):
+                    return self._note("stats")
+
+                def _note(self, k):
+                    self._items.setdefault("log", []).append(k)
+            """,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "cluster.s.Store.stats -> cluster.s.Store._note" in fs[0].message
+
+
+# -------------------------------------------- real-tree-shaped fixtures
+
+
+def test_sharded_per_shard_mutex_family(tmp_path):
+    """The cluster.sharding shape: every shard owns a lock from the
+    SAME named family; per-shard state must be touched under the
+    shard's own hold even when reached through the router."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/sharding/shard.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Shard:
+                def __init__(self, idx):
+                    self._mut = make_lock("cluster.sharding.Shard._mut")
+                    self._objects = {}
+                    self._watch_rings = []
+
+                def apply(self, key, obj):
+                    with self._mut:
+                        self._objects[key] = obj
+                        self._watch_rings.append(obj)
+
+                def compact(self):
+                    with self._mut:
+                        self._watch_rings.clear()
+
+                def snapshot(self):
+                    return dict(self._objects)
+            """,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "read of 'cluster.sharding.shard.Shard._objects'" in fs[0].message
+    assert "Shard.snapshot" in fs[0].message
+
+
+def test_fleet_registry_shape_and_reasoned_suppression(tmp_path):
+    """The fleet.tenant FleetRegistry shape: RLock-guarded bindings
+    dict.  The unguarded mutator fires; the deliberate lock-free read
+    carries a reasoned suppression and stays clean."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/fleet/tenant.py": """
+            from kwok_tpu.utils.locks import make_rlock
+
+            class FleetRegistry:
+                def __init__(self):
+                    self._mut = make_rlock("fleet.tenant.FleetRegistry._mut")
+                    self._bindings = {}
+
+                def bind(self, tenant, shard):
+                    with self._mut:
+                        self._bindings[tenant] = shard
+
+                def release(self, tenant):
+                    with self._mut:
+                        self._bindings.pop(tenant, None)
+
+                def evict_unlocked(self, tenant):
+                    self._bindings.pop(tenant, None)
+
+                def count(self):
+                    # monotonic len() on a GIL-atomic dict, stats only
+                    return len(self._bindings)  # kwoklint: disable=guarded-by
+            """,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) == 1, [f.render() for f in fs]
+    assert "write of 'fleet.tenant.FleetRegistry._bindings'" in fs[0].message
+    assert "evict_unlocked" in fs[0].message
+
+
+def test_init_and_pickle_methods_are_exempt(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/s.py": """
+            from kwok_tpu.utils.locks import make_lock
+
+            class Store:
+                def __init__(self):
+                    self._mut = make_lock("cluster.s.Store._mut")
+                    self._items = {}
+                    self._items["boot"] = 1
+
+                def put(self, k, v):
+                    with self._mut:
+                        self._items[k] = v
+
+                def bump(self, k):
+                    with self._mut:
+                        self._items[k] = self._items.get(k, 0) + 1
+
+                def __getstate__(self):
+                    return dict(self._items)
+            """,
+        },
+    )
+    assert run_rules(root, ["guarded-by"]) == []
+
+
+# ------------------------------- the injected race, caught both ways
+
+
+_RACY_SOURCE = """
+from kwok_tpu.utils.locks import make_lock
+
+class Tally:
+    def __init__(self):
+        self._mut = make_lock("cluster.racy.Tally._mut")
+        self._counts = {}
+
+    def bump(self, key):
+        with self._mut:
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    def reset(self, key):
+        with self._mut:
+            self._counts.pop(key, None)
+
+    def bump_unlocked(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1
+"""
+
+
+def test_injected_race_caught_by_static_rule(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/racy.py": _RACY_SOURCE,
+        },
+    )
+    fs = run_rules(root, ["guarded-by"])
+    assert len(fs) >= 1, [f.render() for f in fs]
+    assert all("Tally._counts" in f.message for f in fs)
+    assert any("bump_unlocked" in f.message for f in fs)
+
+
+def test_injected_race_caught_by_armed_sentinel(monkeypatch):
+    """The SAME bug shape at runtime: two threads, one of them
+    touching the declared-guarded dict without the lock.  The armed
+    sentinel must raise RaceWitness naming both access sites."""
+    monkeypatch.setenv("KWOK_RACE_SENTINEL", "1")
+    from kwok_tpu.utils.locks import RaceWitness, guarded, make_lock
+
+    class Tally:
+        def __init__(self):
+            self._mut = make_lock("cluster.racy.Tally._mut")
+            self._counts = {}
+            guarded(self, "_counts", "cluster.racy.Tally._mut")
+
+        def bump(self, key):
+            with self._mut:
+                self._counts[key] = self._counts.get(key, 0) + 1
+
+        def bump_unlocked(self, key):
+            self._counts[key] = self._counts.get(key, 0) + 1
+
+    t = Tally()
+    t.bump("a")  # main thread claims the attr (EXCLUSIVE)
+
+    caught = []
+
+    def racer():
+        try:
+            t.bump_unlocked("a")
+        except RaceWitness as exc:
+            caught.append(exc)
+
+    th = threading.Thread(target=racer)
+    th.start()
+    th.join(timeout=10)
+    assert len(caught) == 1, "unguarded cross-thread access must raise"
+    msg = str(caught[0])
+    assert "_counts" in msg and "cluster.racy.Tally._mut" in msg
+    assert "this access" in msg and "previous access" in msg
+
+    # the guarded path from the second thread is fine
+    ok = []
+    th2 = threading.Thread(target=lambda: (t.bump("b"), ok.append(True)))
+    th2.start()
+    th2.join(timeout=10)
+    assert ok == [True]
+
+
+def test_sentinel_disarmed_is_inert(monkeypatch):
+    monkeypatch.delenv("KWOK_RACE_SENTINEL", raising=False)
+    from kwok_tpu.utils.locks import guarded, make_lock
+
+    class Plain:
+        def __init__(self):
+            self._mut = make_lock("cluster.racy.Plain._mut")
+            self._counts = {}
+            guarded(self, "_counts", "cluster.racy.Plain._mut")
+
+    p = Plain()
+    p._counts["x"] = 1  # no declaration installed, no descriptor cost
+    assert p._counts == {"x": 1}
+
+
+# ----------------------------------------- call-graph disk cache (CLI)
+
+
+def _lint_json(root, cache, *extra):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--root", root,
+         "--format", "json", "--cache", cache, *extra],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    return proc, json.loads(proc.stdout)
+
+
+def test_graph_cache_miss_then_hit_same_findings(tmp_path):
+    root = write_repo(
+        tmp_path / "repo",
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/racy.py": _RACY_SOURCE,
+        },
+    )
+    cache = str(tmp_path / "kwoklint.json")
+    proc1, d1 = _lint_json(root, cache, "--rules", "guarded-by")
+    assert proc1.returncode == 1, proc1.stdout + proc1.stderr
+    assert d1["callgraph_cache"] == "miss"
+    assert os.path.exists(cache + ".graph")
+
+    proc2, d2 = _lint_json(root, cache, "--rules", "guarded-by")
+    assert proc2.returncode == 1
+    assert d2["callgraph_cache"] == "hit"
+    assert d2["findings"] == d1["findings"]
+
+    # an edit invalidates the content digest: back to a miss
+    mod = tmp_path / "repo" / "kwok_tpu" / "cluster" / "racy.py"
+    mod.write_text(mod.read_text() + "\n# touched\n")
+    proc3, d3 = _lint_json(root, cache, "--rules", "guarded-by")
+    assert d3["callgraph_cache"] == "miss"
+
+
+def test_graph_cache_corruption_falls_back_to_build(tmp_path):
+    root = write_repo(
+        tmp_path / "repo",
+        {
+            "kwok_tpu/utils/locks.py": _LOCKS_STUB,
+            "kwok_tpu/cluster/racy.py": _RACY_SOURCE,
+        },
+    )
+    cache = str(tmp_path / "kwoklint.json")
+    _lint_json(root, cache, "--rules", "guarded-by")
+    with open(cache + ".graph", "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00garbage\x00")
+    proc, d = _lint_json(root, cache, "--rules", "guarded-by")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert d["callgraph_cache"] == "miss"
+    assert len(d["findings"]) >= 1
+
+
+def test_changed_only_non_graph_rules_skip_graph_build():
+    """--changed-only with a per-file rule subset must never pay the
+    call-graph build: the JSON cost surface reports null."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--changed-only",
+         "--rules", "untestable-sleep,wallclock-deadline",
+         "--format", "json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode in (0, 1), proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["callgraph_build_seconds"] is None
+    assert data["callgraph_cache"] is None
+
+
+# ------------------------------------- audit in SARIF and changed-only
+
+
+def test_suppression_audit_is_sarif_level_warning(tmp_path):
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/bare.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(store):\n"
+                "    return store._types  # kwoklint: disable=store-boundary\n"
+            ),
+        },
+    )
+    (tmp_path / "SURVEY.md").write_text("doc\n")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kwok_tpu.analysis", "--root", root,
+         "--reference", "/nonexistent-reference", "--format", "sarif"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    results = doc["runs"][0]["results"]
+    audit = [r for r in results if r["ruleId"] == "suppression-hygiene"]
+    assert audit, results
+    assert all(r["level"] == "warning" for r in audit)
+    assert any("carries no reason" in r["message"]["text"] for r in audit)
+
+
+def test_changed_only_subset_keeps_reason_audit_drops_stale_audit(tmp_path):
+    """Driver semantics of the split audit: a file-subset run (the
+    --changed-only path) still warns on reason-less suppressions but
+    must NOT claim a suppression is stale — the absorbing finding may
+    live outside the subset."""
+    root = write_repo(
+        tmp_path,
+        {
+            "kwok_tpu/utils/bare.py": (
+                '"""Mod (SURVEY.md:1)."""\n'
+                "def f(x):\n"
+                "    return x  # kwoklint: disable=store-boundary\n"
+            ),
+        },
+    )
+    (tmp_path / "SURVEY.md").write_text("doc\n")
+    config = Config(root=root, reference_root="/nonexistent-reference")
+    subset = collect_files(root)
+
+    partial = run(config, files=subset)
+    assert [f.rule for f in partial] == ["suppression-hygiene"]
+    assert "carries no reason" in partial[0].message
+    assert not any("no longer matches" in f.message for f in partial)
+
+    full = run(Config(root=root, reference_root="/nonexistent-reference"))
+    msgs = [f.message for f in full]
+    assert any("no longer matches" in m for m in msgs)
+    assert any("carries no reason" in m for m in msgs)

@@ -34,6 +34,7 @@ def armed(monkeypatch):
 
 def test_disabled_returns_plain_threading_primitives(monkeypatch):
     monkeypatch.delenv("KWOK_LOCK_SENTINEL", raising=False)
+    monkeypatch.delenv("KWOK_RACE_SENTINEL", raising=False)
     assert isinstance(make_lock("a"), type(threading.Lock()))
     assert isinstance(make_rlock("a"), type(threading.RLock()))
     assert isinstance(make_condition("a"), threading.Condition)
@@ -204,3 +205,34 @@ def test_dst_digest_is_sentinel_neutral(monkeypatch):
     assert not on["violations"] and not off["violations"]
     assert on["trace_digest"] == off["trace_digest"]
     assert on["trace_events"] == off["trace_events"]
+
+
+def test_dst_digest_is_race_sentinel_neutral(monkeypatch):
+    """Same contract for the Eraser-style race sentinel: the guarded()
+    descriptors at the adopted store/flowcontrol/election/fleet sites
+    observe every access on the DST's single thread (all EXCLUSIVE,
+    never a violation) and read no clock/rng, so one seed's digest is
+    byte-identical armed vs disarmed."""
+    opts = SimOptions(duration=12.0, quiesce=30.0)
+    monkeypatch.delenv("KWOK_LOCK_SENTINEL", raising=False)
+    monkeypatch.delenv("KWOK_RACE_SENTINEL", raising=False)
+    off = run_seed(11, opts)
+    monkeypatch.setenv("KWOK_RACE_SENTINEL", "1")
+    on = run_seed(11, opts)
+    assert not on["violations"] and not off["violations"]
+    assert on["trace_digest"] == off["trace_digest"]
+    assert on["trace_events"] == off["trace_events"]
+
+
+def test_race_sentinel_adopted_store_site_registers(monkeypatch):
+    """ResourceStore declares _audit guarded by its mutex; under
+    KWOK_RACE_SENTINEL=1 the declaration installs a live descriptor
+    and normal (locked) operation stays silent."""
+    monkeypatch.setenv("KWOK_RACE_SENTINEL", "1")
+    from kwok_tpu.cluster.store import ResourceStore
+    from kwok_tpu.utils.locks import _GuardedAttr
+
+    store = ResourceStore()
+    assert isinstance(type(store).__dict__.get("_audit"), _GuardedAttr)
+    store.create({"kind": "Node", "metadata": {"name": "n"}})
+    assert store.get("Node", "n")["metadata"]["name"] == "n"

@@ -45,12 +45,13 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     echo "== fleet smoke (1k tenants on one apiserver: flood isolation, scale-to-zero, no leaks) =="
     JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --fleet-smoke \
         --fleet-tenants "${FLEET_TENANTS:-1000}"
-    echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks; lock sentinel armed) =="
-    # KWOK_LOCK_SENTINEL=1 arms the runtime deadlock sentinel
+    echo "== DST smoke (whole-cluster virtual-time seeds + invariant checks; lock + race sentinels armed) =="
+    # KWOK_LOCK_SENTINEL=1 arms the runtime deadlock sentinel and
+    # KWOK_RACE_SENTINEL=1 the Eraser-style lockset checker
     # (kwok_tpu/utils/locks.py): every seed doubles as a lock-order
-    # inversion detector, and trace digests are sentinel-neutral by
-    # construction (tests/test_locks.py pins that)
-    KWOK_LOCK_SENTINEL=1 JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
+    # inversion + data-race detector, and trace digests are
+    # sentinel-neutral by construction (tests/test_locks.py pins that)
+    KWOK_LOCK_SENTINEL=1 KWOK_RACE_SENTINEL=1 JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
