@@ -233,47 +233,66 @@ def run_store_bench() -> dict:
             t.join()
         return sum(created), time.time() - t0
 
-    # ---- leg 1: routed HTTP baseline (single store) ------------------
-    single = ResourceStore()
-    with APIServer(single) as srv:
-        local = threading.local()
-
-        def http_bulk(ops):
-            if not hasattr(local, "client"):
-                local.client = ClusterClient(srv.url)
-            local.client.bulk(ops)
-
-        pods, secs = drive(
-            http_bulk, STORE_PODS, budget_s=STORE_HTTP_BUDGET_S
-        )
-    routed = {
-        "tps": round(pods / secs) if secs else 0,
-        "pods": pods,
-        "seconds": round(secs, 1),
-    }
-    # a leg's dead store must not tax the next leg's gen2 collections
-    del single
-    gc.collect()
-
-    # ---- leg 2: sharded store, colocated direct dispatch -------------
-    sharded = build_sharded_store(STORE_SHARDS)
-    pods, secs = drive(
-        lambda ops: sharded.bulk(ops, copy_results=False), STORE_PODS
+    # ---- legs 1+2: routed HTTP baseline vs sharded direct dispatch ---
+    # best-of-windows, alternating, fresh stores per round — the same
+    # measurement discipline leg 3 adopted (r13): single-shot legs on
+    # the shared 1-core host skew 20%+ under co-load, and the 2x gate
+    # paid that noise with flakes.  Both legs are time-boxed per round
+    # (throughput = pods/secs is box-size independent), each round
+    # updates both legs' best, and the gate is checked after EVERY
+    # round — a clean box pays one round, a noisy one gets up to
+    # BENCH_STORE_MULTI_ROUNDS chances before asserting.
+    multi_rounds = max(
+        1, int(os.environ.get("BENCH_STORE_MULTI_ROUNDS", "3"))
     )
-    direct = {
-        "tps": round(pods / secs) if secs else 0,
-        "pods": pods,
-        "seconds": round(secs, 1),
-    }
-    speedup = direct["tps"] / max(1, routed["tps"])
+    round_budget = max(5.0, STORE_HTTP_BUDGET_S / multi_rounds)
+    routed = {"tps": 0, "pods": 0, "seconds": 0.0}
+    direct = {"tps": 0, "pods": 0, "seconds": 0.0}
+    speedup = 0.0
+    for _ in range(multi_rounds):
+        single = ResourceStore()
+        with APIServer(single) as srv:
+            local = threading.local()
+
+            def http_bulk(ops):
+                if not hasattr(local, "client"):
+                    local.client = ClusterClient(srv.url)
+                local.client.bulk(ops)
+
+            pods, secs = drive(http_bulk, STORE_PODS, budget_s=round_budget)
+        if secs and pods / secs > routed["tps"]:
+            routed = {
+                "tps": round(pods / secs),
+                "pods": pods,
+                "seconds": round(secs, 1),
+            }
+        # a leg's dead store must not tax the next leg's gen2 collections
+        del single
+        gc.collect()
+
+        sharded = build_sharded_store(STORE_SHARDS)
+        pods, secs = drive(
+            lambda ops: sharded.bulk(ops, copy_results=False),
+            STORE_PODS,
+            budget_s=round_budget,
+        )
+        if secs and pods / secs > direct["tps"]:
+            direct = {
+                "tps": round(pods / secs),
+                "pods": pods,
+                "seconds": round(secs, 1),
+            }
+        del sharded
+        gc.collect()
+        speedup = direct["tps"] / max(1, routed["tps"])
+        if speedup >= 2.0:
+            break
     assert speedup >= 2.0, (
         f"sharded direct dispatch {direct['tps']} pods/s is only "
         f"{speedup:.2f}x the routed single-store baseline "
-        f"{routed['tps']} pods/s (want >= 2x)"
+        f"{routed['tps']} pods/s over {multi_rounds} best-of windows "
+        "(want >= 2x)"
     )
-
-    del sharded
-    gc.collect()
 
     # ---- leg 3: 1-shard no-regression --------------------------------
     # best-of-windows, alternating, fresh store per round — the e2e

@@ -1602,13 +1602,19 @@ def build_parser() -> argparse.ArgumentParser:
             "partial-gang",
             "cross-shard-txn",
             "tenant-leak",
+            "shard-void-leak",
+            "fanin-stale-resume",
         ],
         help="inject a test-only regression (must be caught): "
         "ungated-writer reconciles without the lease, partial-gang "
         "binds PodGroups per-pod instead of atomically, "
         "cross-shard-txn makes the shard router place txn ops "
         "per-object and split atomic batches into per-shard sub-txns, "
-        "tenant-leak un-scopes one fleet tenant's watch stream",
+        "tenant-leak un-scopes one fleet tenant's watch stream, "
+        "shard-void-leak skips a rolled-back write's void accounting "
+        "(union rv-continuity hole), fanin-stale-resume pins a "
+        "caught-up shard's resume at horizon 0 in the watch fan-in "
+        "(stale replay breaks per-stream rv monotonicity)",
     )
     p.add_argument(
         "--dst-fleet-tenants",
@@ -1628,6 +1634,44 @@ def build_parser() -> argparse.ArgumentParser:
         "--dst-verbose",
         action="store_true",
         help="print one JSON line per seed as it finishes",
+    )
+    p.add_argument(
+        "--dst-search",
+        action="store_true",
+        help="coverage-guided fault search (kwok_tpu.dst.search): "
+        "mutate fault schedules toward novel trace coverage instead "
+        "of walking consecutive seeds; on violation, delta-debug to a "
+        "minimal fault set and verify a byte-identical replay.  With "
+        "--dst-bug armed, exit 0 iff the bug was found, minimized and "
+        "replay-verified; without, exit 0 iff the budget ran clean",
+    )
+    p.add_argument(
+        "--search-budget",
+        type=int,
+        default=48,
+        help="schedule executions the guided search may spend",
+    )
+    p.add_argument(
+        "--search-seed",
+        type=int,
+        default=0,
+        help="seed of the search's own rng (mutations + corpus picks) "
+        "— the whole search replays from this one value",
+    )
+    p.add_argument(
+        "--search-out",
+        default=None,
+        metavar="FILE",
+        help="write the minimized violation's replay artifact here "
+        "(the --dst-replay regression-pinning format)",
+    )
+    p.add_argument(
+        "--dst-replay",
+        default=None,
+        metavar="FILE",
+        help="re-execute a --search-out artifact and verify the "
+        "recorded trace digest + violations byte-identically "
+        "(exit 0 iff both match)",
     )
     p.add_argument("--pods", type=int, default=40, help="smoke population")
     p.add_argument(
@@ -1673,8 +1717,66 @@ def run_dst(args) -> int:
     return 1 if violating else 0
 
 
+def run_dst_search(args) -> int:
+    """Coverage-guided fault search; one JSON stats line.  Exit
+    contract: with an injected bug armed, success means found +
+    minimized + replay-verified; on a clean tree, success means the
+    whole budget ran without a violation."""
+    from kwok_tpu.dst import SimOptions
+    from kwok_tpu.dst.search import (
+        guided_search,
+        replay_artifact,
+        violation_artifact,
+    )
+
+    opts = SimOptions(
+        duration=args.dst_duration,
+        bug=args.dst_bug,
+        store_shards=args.dst_shards,
+        fleet_tenants=args.dst_fleet_tenants,
+    )
+    log = (lambda m: print(m, flush=True)) if args.dst_verbose else None
+    res = guided_search(
+        opts, budget=args.search_budget, search_seed=args.search_seed, log=log
+    )
+    stats = res.stats()
+    stats["search_seed"] = args.search_seed
+    stats["bug"] = args.dst_bug
+    if res.found is not None:
+        art = violation_artifact(opts, res.found, res.minimized)
+        rep = replay_artifact(art)
+        stats["replay_ok"] = rep["ok"]
+        if args.search_out:
+            with open(args.search_out, "w") as f:
+                json.dump(art, f, indent=1, sort_keys=True)
+            stats["artifact"] = args.search_out
+        print(json.dumps(stats))
+        # armed bug rediscovered and pinned = success; a violation on a
+        # clean tree is a real finding = failure
+        ok = rep["ok"] and (args.dst_bug is not None)
+        return 0 if ok else 1
+    print(json.dumps(stats))
+    return 1 if args.dst_bug is not None else 0
+
+
+def run_dst_replay(args) -> int:
+    """Re-execute a pinned violation artifact; exit 0 iff the trace
+    digest and the violation set replay byte-identically."""
+    from kwok_tpu.dst.search import replay_artifact
+
+    with open(args.dst_replay) as f:
+        doc = json.load(f)
+    rep = replay_artifact(doc)
+    print(json.dumps(rep))
+    return 0 if rep["ok"] else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.dst_replay:
+        return run_dst_replay(args)
+    if args.dst_search:
+        return run_dst_search(args)
     if args.dst:
         return run_dst(args)
     if args.smoke:

@@ -251,6 +251,19 @@ class ShardedStore:
         #: committed strands a bound strict subset, exactly the
         #: partial state the DST gang-atomicity invariant catches
         self.unsafe_split_cross_shard_txns = False
+        #: test-only injected regression (`--dst-bug
+        #: fanin-stale-resume`): the merged-watch resume classifies a
+        #: shard as "never written since the resume point" by testing
+        #: its CURRENT rv against the resume horizon (a plausible
+        #: optimization that intends rv == 0) and pins such a shard at
+        #: rv 0 — so a shard that merely went quiet replays its whole
+        #: history ring into a stream that already consumed those
+        #: events.  The duplicate (key, rv) deliveries violate the
+        #: per-object ordering the DST watch-rv-monotonic invariant
+        #: asserts, but only in the narrow interleaving where a
+        #: consumer resumes while fully caught up with the shard —
+        #: the window the coverage-guided search exists to find
+        self.unsafe_fanin_stale_resume = False
 
     # ------------------------------------------------------------- routing
 
@@ -612,11 +625,23 @@ class ShardedStore:
         parts: List[Watcher] = []
         try:
             for s in self._shards:
+                shard_since = since_rv
+                if (
+                    self.unsafe_fanin_stale_resume
+                    and since_rv is not None
+                    and s.resource_version <= since_rv
+                ):
+                    # injected regression: "this shard has written
+                    # nothing since the resume point, start it from
+                    # the beginning" — true for a never-written shard
+                    # (rv 0), catastrophically wrong for a caught-up
+                    # one, whose whole history replays as duplicates
+                    shard_since = 0
                 parts.append(
                     s.watch(
                         kind,
                         namespace=namespace,
-                        since_rv=since_rv,
+                        since_rv=shard_since,
                         label_selector=label_selector,
                         field_selector=field_selector,
                         status_interest=status_interest,

@@ -695,6 +695,12 @@ class ResourceStore:
         #: the zero-copy status lane assume local allocation and are
         #: disabled while a source is attached.
         self._rv_source = rv_source
+        #: test-only injected regression (`--dst-bug shard-void-leak`):
+        #: a failed write's rollback skips the shared-sequence void
+        #: accounting (see ``_unbump``) — the leaked rv is a silent
+        #: union-continuity hole the DST recovery-honesty invariant
+        #: must catch.  Only meaningful with an attached rv source
+        self.unsafe_skip_void_accounting = False
         #: uid striding (sharded stores): shard ``i`` of ``N`` draws
         #: uids ``i + k*N`` so uids never collide across shards without
         #: any shared state (replay only ever observes this shard's own
@@ -1146,6 +1152,15 @@ class ResourceStore:
             self._rv -= 1
             return
         self._rv = rv - 1
+        if self.unsafe_skip_void_accounting:
+            # injected regression (`--dst-bug shard-void-leak`): the
+            # rollback "forgets" the shared-sequence accounting — the
+            # rv is neither reclaimed at the tip nor voided, so the
+            # union rv continuity gains a hole that fsck/recovery can
+            # only read as a lost record.  The DST recovery-honesty
+            # invariant's void-accounting probe exists to catch
+            # exactly this
+            return
         if not src.unalloc(rv) and self._wal is not None:
             self._wal.note_void(rv)
 

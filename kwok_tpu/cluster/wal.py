@@ -220,6 +220,16 @@ def _parse_frame(line: str) -> Tuple[Optional[int], Dict[str, Any], bool]:
     if not head or not crc_hex or not payload:
         raise ValueError("short frame")
     seq = int(head)  # ValueError propagates as damage
+    # the writer only ever emits 8 lowercase hex digits ("%08x"), so a
+    # non-canonical checksum field IS frame damage.  int(x, 16) alone
+    # would read e.g. "Fe06bc6c" as the same value as "fe06bc6c" — a
+    # single bit flip on the 0x20 case bit of a hex letter would be
+    # silently absorbed (found by the DST coverage-guided fault
+    # search's recovery-honesty probe).
+    if len(crc_hex) != 8 or any(
+        c not in "0123456789abcdef" for c in crc_hex
+    ):
+        raise ValueError(f"non-canonical checksum field {crc_hex!r}")
     want = int(crc_hex, 16)
     got = zlib.crc32(f"{seq} {payload}".encode("utf-8")) & 0xFFFFFFFF
     if got != want:

@@ -11,8 +11,10 @@ the chaos subsystem from smoke tests into a reproducible bug search
 Layout: :mod:`~kwok_tpu.dst.harness` owns the simulation loop,
 :mod:`~kwok_tpu.dst.actors` the synchronous component drivers,
 :mod:`~kwok_tpu.dst.faults` the fault timeline and the per-actor store
-boundary, :mod:`~kwok_tpu.dst.invariants` the checkers, and
-:mod:`~kwok_tpu.dst.trace` the canonical hashable run trace.
+boundary, :mod:`~kwok_tpu.dst.invariants` the checkers,
+:mod:`~kwok_tpu.dst.trace` the canonical hashable run trace, and
+:mod:`~kwok_tpu.dst.search` the coverage-guided fault search over
+schedules (``--dst-search`` / ``--dst-replay``).
 """
 
 from kwok_tpu.dst.harness import RunRecord, SimOptions, Simulation, run_seed, run_seeds

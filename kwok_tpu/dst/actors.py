@@ -783,11 +783,15 @@ class ObserverActor(Actor):
     """Passive watch consumer recording per-stream
     ``(object key, resourceVersion)`` sequences for the
     rv-monotonicity invariant; reconnects across crashes like any
-    reflector (a rollback shows up as Expired and a fresh stream,
-    never as a silent rv regression).  The key is recorded because a
-    sharded store's merged watch promises PER-OBJECT rv ordering, not
-    a global total order (kwok_tpu/cluster/sharding/fanin.py) — the
-    checker asserts the contract that matches the store shape."""
+    reflector.  A successful resume-at-rv CONTINUES the same logical
+    stream — the reflector's cache survives a reconnect, so a resume
+    that replays already-delivered events is a real duplicate the
+    checker must see, not a fresh start that hides it.  Only a re-list
+    (Expired — a rollback legitimately restarts the world) opens a new
+    stream.  The key is recorded because a sharded store's merged
+    watch promises PER-OBJECT rv ordering, not a global total order
+    (kwok_tpu/cluster/sharding/fanin.py) — the checker asserts the
+    contract that matches the store shape."""
 
     def __init__(self, sim, kind: str = "Pod"):
         super().__init__(sim, "observer", None, period=0.5)
@@ -802,16 +806,19 @@ class ObserverActor(Actor):
         if self._gen != sim.store_generation or self._w is None or self._w.stopped:
             self._gen = sim.store_generation
             self._w = None
+            resumed = False
             if self._rv is not None:
                 try:
                     self._w = sim.store.watch(self.kind, since_rv=self._rv)
+                    resumed = True
                 except Expired:
                     self._w = None
             if self._w is None:
                 _items, rv = sim.store.list(self.kind)
                 self._rv = rv
                 self._w = sim.store.watch(self.kind, since_rv=rv)
-            self.streams.append([])
+            if not (resumed and self.streams):
+                self.streams.append([])
         for ev in self._w.drain():
             rv = getattr(ev, "rv", 0) or 0
             meta = (getattr(ev, "object", None) or {}).get("metadata") or {}

@@ -19,6 +19,15 @@ the in-process store boundary instead of the HTTP one:
 
 Every decision draws from one seeded rng, so a fault schedule is a
 pure function of the seed.
+
+The schedule is also a first-class *value*: ``FaultTimeline.to_spec``
+serializes the constructed windows + point faults to a JSON-able dict
+and ``FaultTimeline.from_spec`` rebuilds a timeline from one — the
+mutation space of the coverage-guided search
+(``kwok_tpu/dst/search.py``).  A from_spec timeline keeps the SAME
+seeded rng for the runtime draws (shed probability tests, eaten acks,
+fire-time shard targeting), so a (seed, spec) pair replays
+byte-identically.
 """
 
 from __future__ import annotations
@@ -92,6 +101,7 @@ class FaultTimeline:
         replica_clients: List[str],
         enable: bool = True,
     ):
+        self.seed = seed
         self.rng = random.Random((seed << 1) ^ 0x5F5E5F)
         self.windows: List[_Window] = []
         self.scheduled: List[_Scheduled] = []
@@ -182,6 +192,77 @@ class FaultTimeline:
             )
         )
         self.scheduled.sort(key=lambda s: s.t)
+
+    def seal_runtime_rng(self) -> None:
+        """Reseed ``self.rng`` onto the runtime draw stream — a pure
+        function of the seed, independent of how many draws
+        construction consumed.  Both construction paths call this once
+        the schedule is final (``seeded_timeline`` after its region-move
+        draw; ``from_spec`` after rebuilding), so a timeline built from
+        a seed and one rebuilt from any spec under that seed make
+        byte-identical runtime draws (shed p-tests, eaten acks,
+        fire-time shard targeting).  That is what makes a mutated
+        schedule's run a pure function of (seed, spec) — the replay
+        contract of the coverage-guided search."""
+        self.rng = random.Random((self.seed << 2) ^ 0x0D15EA5E)
+
+    # ----------------------------------------------------- spec round-trip
+
+    def to_spec(self) -> Dict[str, Any]:
+        """Serialize the constructed schedule to a JSON-able dict (the
+        corpus-entry format of the coverage-guided search).  Captures
+        construction-time state only — call before the run consumes
+        ``fired`` flags."""
+        return {
+            "enabled": self.enabled,
+            "ack_window": [self.ack_window[0], self.ack_window[1]],
+            "windows": [
+                {
+                    "kind": w.kind,
+                    "target": w.target,
+                    "at": w.at,
+                    "duration": w.duration,
+                    "p": w.p,
+                }
+                for w in self.windows
+            ],
+            "scheduled": [
+                {"t": s.t, "kind": s.kind, "params": dict(s.params)}
+                for s in self.scheduled
+            ],
+        }
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any], seed: int) -> "FaultTimeline":
+        """Rebuild a timeline from a spec.  Windows and point faults
+        come from the spec verbatim; the runtime rng is sealed onto the
+        same seed-derived stream ``seeded_timeline`` ends on, so the
+        run is a pure function of (seed, spec) and a replayed spec is
+        byte-identical to the search's own execution of it."""
+        tl = cls.__new__(cls)
+        tl.seed = seed
+        tl.rng = random.Random((seed << 1) ^ 0x5F5E5F)
+        tl.enabled = bool(spec.get("enabled", True))
+        tl.ack_window = tuple(spec.get("ack_window") or (0.0, 0.0))
+        tl.windows = [
+            _Window(
+                kind=w["kind"],
+                target=w.get("target", ""),
+                at=float(w["at"]),
+                duration=float(w["duration"]),
+                p=float(w.get("p", 1.0)),
+            )
+            for w in spec.get("windows") or []
+        ]
+        tl.scheduled = [
+            _Scheduled(
+                t=float(s["t"]), kind=s["kind"], params=dict(s.get("params") or {})
+            )
+            for s in spec.get("scheduled") or []
+        ]
+        tl.scheduled.sort(key=lambda s: s.t)
+        tl.seal_runtime_rng()
+        return tl
 
     # ------------------------------------------------------------ queries
 

@@ -50,11 +50,30 @@ from kwok_tpu.dst.invariants import run_checks
 from kwok_tpu.dst.trace import Trace
 from kwok_tpu.utils.clock import VirtualClock
 
-__all__ = ["SimOptions", "RunRecord", "Simulation", "run_seed", "run_seeds"]
+__all__ = [
+    "SimOptions",
+    "RunRecord",
+    "Simulation",
+    "run_record",
+    "run_seed",
+    "run_seeds",
+    "seeded_timeline",
+    "seeded_schedule_spec",
+]
 
 #: virtual epoch the simulation starts at (a fixed instant, so every
 #: rendered timestamp is seed-stable)
 EPOCH = 1_600_000_000.0
+
+#: DST WALs run with NO emergency reserve: a released reserve credits
+#: the pressure shim with headroom that absorbs a whole window's
+#: writes, so the commit-boundary rollback path (``_unbump`` — where
+#: the shared-sequence void accounting lives) would be unreachable in
+#: a short virtual window.  Zero reserve is strictly more adversarial:
+#: every refused append surfaces at the commit boundary.  The
+#: reserve-powered degraded mode keeps its own wall-clock gate
+#: (``python -m kwok_tpu.chaos --exhaustion-smoke``)
+WAL_RESERVE_BYTES = 0
 
 #: seats: (short name, election lease)
 SEATS = (
@@ -82,8 +101,22 @@ class SimOptions:
     #: router place txn ops per-object and split atomic batches into
     #: per-shard sub-txns (needs store_shards > 1); "tenant-leak"
     #: un-scopes one fleet tenant's watch stream (needs
-    #: fleet_tenants > 0)
+    #: fleet_tenants > 0); "shard-void-leak" makes a failed sharded
+    #: write skip the shared-sequence void accounting — the leaked rv
+    #: is a silent hole in the union continuity recovery-honesty
+    #: audits (needs store_shards > 1); "fanin-stale-resume" makes the
+    #: merged watch fan-in pin a shard that LOOKS idle at the resume
+    #: horizon to rv 0, replaying its whole history into a continued
+    #: stream — the duplicate delivery watch-rv-monotonic catches
+    #: (needs store_shards > 1)
     bug: Optional[str] = None
+    #: explicit fault schedule (a ``FaultTimeline.to_spec`` dict) —
+    #: the coverage-guided search's injection seam.  None derives the
+    #: schedule from the seed as always; a spec replaces the
+    #: constructed windows/point-faults verbatim while runtime draws
+    #: still come from the seeded rng, so a (seed, schedule) pair is
+    #: exactly replayable
+    schedule: Optional[dict] = None
     #: store shards (kwok_tpu/cluster/sharding): the default DST run
     #: exercises the sharded composition — per-shard WALs on one
     #: shared rv sequence, recovery through the union continuity
@@ -106,6 +139,52 @@ class SimOptions:
     #: tenant-isolation invariant audits the streams + flow probe.
     #: 0 disables the fleet composition entirely
     fleet_tenants: int = 2
+
+
+def seeded_timeline(opts: SimOptions, fleet_ids: List[str]) -> FaultTimeline:
+    """The seed-derived fault schedule — the exact construction
+    :class:`Simulation` runs when no explicit spec is given, factored
+    out so the coverage-guided search generates fresh corpus entries
+    from the same distribution (``seeded_schedule_spec``)."""
+    tl = FaultTimeline(
+        seed=opts.seed,
+        t0=EPOCH + 4.0,
+        window_s=max(4.0, opts.duration - 10.0),
+        seats=[s for s, _ in SEATS],
+        replica_clients=[
+            f"{seat}-{i}" for seat, _ in SEATS for i in range(opts.replicas)
+        ],
+        enable=opts.faults,
+    )
+    if fleet_ids and opts.faults:
+        # one seeded tenant rides a region transfer: its clients go
+        # dark for the cutover window (cross-region latency at its
+        # limit, on the virtual clock), then must resume — the
+        # bounded-disruption probe the tenant-isolation invariant
+        # audits
+        frng = tl.rng
+        moved = fleet_ids[frng.randrange(len(fleet_ids))]
+        at = EPOCH + 4.0 + frng.uniform(
+            2.0, max(4.0, opts.duration - 10.0) * 0.5
+        )
+        dur = frng.uniform(2.0, 4.0)
+        tl.add_region_move(f"tenant:{moved}", at, dur)
+    tl.seal_runtime_rng()
+    return tl
+
+
+def seeded_schedule_spec(seed: int, opts: Optional[SimOptions] = None) -> dict:
+    """The seed's fault schedule as a mutable spec (to_spec form) —
+    how the search turns a plain seed into a corpus entry."""
+    o = SimOptions(
+        **{**(opts or SimOptions()).__dict__, "seed": seed, "schedule": None}
+    )
+    fleet_ids: List[str] = []
+    if o.fleet_tenants > 0:
+        from kwok_tpu.fleet.tenant import fleet_tenant_ids
+
+        fleet_ids = fleet_tenant_ids(o.fleet_tenants)
+    return seeded_timeline(o, fleet_ids).to_spec()
 
 
 @dataclass
@@ -191,7 +270,7 @@ class Simulation:
         self.n_shards = max(1, int(opts.store_shards))
         if self.n_shards == 1:
             self.wal_paths = [os.path.join(wal_dir, "dst-wal.jsonl")]
-            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off")]
+            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off", reserve_bytes=WAL_RESERVE_BYTES)]
             self.store = ResourceStore(clock=self.clock)
             self.store.attach_wal(self.wals[0])
         else:
@@ -219,13 +298,18 @@ class Simulation:
                 for i in range(self.n_shards)
             ]
             self.wals = [
-                WriteAheadLog(p, fsync="off") for p in self.wal_paths
+                WriteAheadLog(p, fsync="off", reserve_bytes=WAL_RESERVE_BYTES) for p in self.wal_paths
             ]
             for s, w in zip(shards, self.wals):
                 s.attach_wal(w)
             self.store = ShardedStore(shards, source)
             if opts.bug == "cross-shard-txn":
                 self.store.unsafe_split_cross_shard_txns = True
+            elif opts.bug == "fanin-stale-resume":
+                self.store.unsafe_fanin_stale_resume = True
+            elif opts.bug == "shard-void-leak":
+                for s in shards:
+                    s.unsafe_skip_void_accounting = True
         #: shard index an open pressure window targets (0 on a single
         #: store); a crash inside the window reinstalls the shim there
         self._pressure_shard = 0
@@ -308,34 +392,26 @@ class Simulation:
                 self.fleet_observers.append(ob)
                 self.actors.append(ob)
 
-        self.faults = FaultTimeline(
-            seed=opts.seed,
-            t0=EPOCH + 4.0,
-            window_s=max(4.0, opts.duration - 10.0),
-            seats=[s for s, _ in SEATS],
-            replica_clients=[
-                r.name for reps in self.seats.values() for r in reps
-            ],
-            enable=opts.faults,
-        )
-        if fleet_ids and opts.faults:
-            # one seeded tenant rides a region transfer: its clients go
-            # dark for the cutover window (cross-region latency at its
-            # limit, on the virtual clock), then must resume — the
-            # bounded-disruption probe the tenant-isolation invariant
-            # audits
-            frng = self.faults.rng
-            moved = fleet_ids[frng.randrange(len(fleet_ids))]
-            at = EPOCH + 4.0 + frng.uniform(
-                2.0, max(4.0, opts.duration - 10.0) * 0.5
-            )
-            dur = frng.uniform(2.0, 4.0)
-            self.faults.add_region_move(f"tenant:{moved}", at, dur)
+        if opts.schedule is not None:
+            self.faults = FaultTimeline.from_spec(opts.schedule, opts.seed)
+        else:
+            self.faults = seeded_timeline(opts, fleet_ids)
+        # region-move probes derive from the schedule itself (seeded
+        # or spec'd) so a mutated/minimized schedule keeps — or
+        # provably drops — its bounded-disruption probe with the fault
+        for s in self.faults.scheduled:
+            if s.kind != "tenant-region-move":
+                continue
+            client = str(s.params.get("client") or "")
+            tid = client.split(":", 1)[1] if ":" in client else client
+            if tid not in fleet_ids:
+                continue
+            dur = float(s.params.get("duration") or 0.0)
             self.record.tenant_region_checks.append(
                 {
-                    "tenant": moved,
-                    "t": round(at - EPOCH, 3),
-                    "t_end": at + dur,
+                    "tenant": tid,
+                    "t": round(s.t - EPOCH, 3),
+                    "t_end": s.t + dur,
                     "duration": round(dur, 3),
                 }
             )
@@ -366,6 +442,12 @@ class Simulation:
         self.trace.add(self.clock.now(), actor, "degraded-rejected", verb)
         if self._pressure_probe is not None:
             self._pressure_probe["rejections"] += 1
+            if verb in ("txn", "bulk"):
+                # batch lanes refuse the ack WITHOUT rolling back (the
+                # ops stay committed in memory, their rvs not yet
+                # durable) — a legitimate union-continuity hole, so
+                # the void-accounting probe excuses this window
+                self._pressure_probe["batch_rejections"] += 1
 
     def _crash_dispatch(self, phase: str) -> None:
         arm = self._crash_arm
@@ -388,7 +470,7 @@ class Simulation:
         if self.n_shards == 1:
             recovered = ResourceStore(clock=self.clock)
             rep = recovered.recover_wal(self.wal_paths[0])
-            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off")]
+            self.wals = [WriteAheadLog(self.wal_paths[0], fsync="off", reserve_bytes=WAL_RESERVE_BYTES)]
             recovered.attach_wal(self.wals[0])
         else:
             # per-shard tolerant replay + the union rv-continuity
@@ -399,12 +481,19 @@ class Simulation:
             recovered = out["store"]
             rep = out["report"]
             self.wals = [
-                WriteAheadLog(p, fsync="off") for p in self.wal_paths
+                WriteAheadLog(p, fsync="off", reserve_bytes=WAL_RESERVE_BYTES) for p in self.wal_paths
             ]
             for i, w in enumerate(self.wals):
                 recovered.shard_lane(i).attach_wal(w)
             if self.opts.bug == "cross-shard-txn":
                 recovered.unsafe_split_cross_shard_txns = True
+            elif self.opts.bug == "fanin-stale-resume":
+                recovered.unsafe_fanin_stale_resume = True
+            elif self.opts.bug == "shard-void-leak":
+                for i in range(self.n_shards):
+                    recovered.shard_lane(
+                        i
+                    ).unsafe_skip_void_accounting = True
         if self._active_pressure is not None:
             # a crash inside a pressure window: the disk is still full
             # when the process comes back
@@ -475,7 +564,7 @@ class Simulation:
                 }
             )
 
-    def _disk_fault(self, mode: str) -> None:
+    def _disk_fault(self, mode: str, shard: Optional[int] = None) -> None:
         """Seeded storage corruption against the live WAL, then an
         immediate crash-recovery through the tolerant path.  The probe
         records, at fault time, how every acked rv was accounted for —
@@ -486,14 +575,19 @@ class Simulation:
         from kwok_tpu.chaos import disk_faults
 
         t = self.clock.now()
-        # seeded target shard (always 0 on a single store): damage
-        # lands on ONE shard's log, recovery must bound the loss to
-        # that shard's slice of the rv sequence
-        shard = (
-            self.faults.rng.randrange(self.n_shards)
-            if self.n_shards > 1
-            else 0
-        )
+        # target shard (always 0 on a single store): damage lands on
+        # ONE shard's log, recovery must bound the loss to that
+        # shard's slice of the rv sequence.  An explicit shard comes
+        # from a mutated schedule spec (the search's retarget
+        # operator); otherwise the draw stays at fire time so
+        # seed-derived runs are unchanged
+        if shard is None:
+            shard = (
+                self.faults.rng.randrange(self.n_shards)
+                if self.n_shards > 1
+                else 0
+            )
+        shard = min(max(int(shard), 0), self.n_shards - 1)
         path = self.wal_paths[shard]
         if mode == "bit-flip":
             info = disk_faults.bit_flip_line(
@@ -738,13 +832,13 @@ class Simulation:
                 f"{params['client']} dur={params['duration']:.2f}",
             )
         elif kind == "disk-corrupt":
-            self._disk_fault(params["mode"])
+            self._disk_fault(params["mode"], params.get("shard"))
         elif kind == "pressure-start":
-            self._pressure_start(params["mode"])
+            self._pressure_start(params["mode"], params.get("shard"))
         elif kind == "pressure-end":
             self._pressure_end(params["mode"])
 
-    def _pressure_start(self, mode: str) -> None:
+    def _pressure_start(self, mode: str, shard: Optional[int] = None) -> None:
         """Open a storage-exhaustion window: the WAL's writes start
         being refused (disk-full/quota semantics, fs_pressure shim);
         the first failing append releases the emergency reserve and
@@ -754,19 +848,23 @@ class Simulation:
         t = self.clock.now()
         shim = FsPressure(mode)
         self._active_pressure = shim
-        # seeded target shard: exhaustion degrades ONE shard's writes
-        # (the per-shard StorageDegraded story); other shards stay
-        # writable through the window
-        self._pressure_shard = (
-            self.faults.rng.randrange(self.n_shards)
-            if self.n_shards > 1
-            else 0
-        )
+        # target shard: exhaustion degrades ONE shard's writes (the
+        # per-shard StorageDegraded story); other shards stay writable
+        # through the window.  Explicit shard = mutated-spec retarget;
+        # else the fire-time draw, unchanged for seed-derived runs
+        if shard is None:
+            shard = (
+                self.faults.rng.randrange(self.n_shards)
+                if self.n_shards > 1
+                else 0
+            )
+        self._pressure_shard = min(max(int(shard), 0), self.n_shards - 1)
         self.wals[self._pressure_shard].set_pressure(shim)
         self._pressure_probe = {
             "mode": mode,
             "start_acked": set(self.acked_rvs),
             "rejections": 0,
+            "batch_rejections": 0,
         }
         self.trace.add(
             t,
@@ -796,10 +894,26 @@ class Simulation:
         # Deliberately NOT include_void: an acked rv that was voided
         # is a lost write, not a covered one
         observed: set = set()
+        voided: set = set()
         for path in self.wal_paths:
             for rec in walmod.scan(path).records:
                 observed.update(walmod.record_rvs(rec))
+                voided.update(walmod.record_rvs(rec, include_void=True))
+        voided -= observed
         silent = sorted(rv for rv in acked_during if rv not in observed)
+        # void-accounting probe (recovery-honesty): every allocated rv
+        # must be durable in the union or voided — a rolled-back write
+        # that skips BOTH leaks a hole fsck/recovery can only read as
+        # a lost record.  Only checkable when no batch-lane refusal
+        # (rvs legitimately committed-in-memory-only) and no earlier
+        # disk damage (corrupt records legitimately unreadable) can
+        # explain a hole
+        top = max(observed | voided, default=0)
+        holes = sorted(
+            rv
+            for rv in range(1, top + 1)
+            if rv not in observed and rv not in voided
+        )
         self.exhaustion_checks.append(
             {
                 "mode": mode,
@@ -807,6 +921,9 @@ class Simulation:
                 "rejections": probe["rejections"],
                 "silent_lost": silent,
                 "rearmed": bool(rearmed),
+                "unaccounted_rvs": holes[:16],
+                "batch_rejections": probe.get("batch_rejections", 0),
+                "prior_damage": len(self.disk_checks),
             }
         )
         self.trace.add(
@@ -1041,11 +1158,12 @@ class Simulation:
         return rec
 
 
-def run_seed(
+def run_record(
     seed: int, opts: Optional[SimOptions] = None
-) -> Dict:
-    """Run one seeded simulation; returns the JSON-able report
-    (violations, trace digest, convergence, counters)."""
+) -> tuple:
+    """Run one seeded simulation; returns ``(RunRecord, violations)``
+    — the full-evidence form the coverage-guided search extracts its
+    feature vector from (``run_seed`` is the JSON-report wrapper)."""
     from kwok_tpu.utils import sprig
 
     o = opts or SimOptions()
@@ -1061,8 +1179,17 @@ def run_seed(
             violations = run_checks(rec)
     finally:
         sprig.set_default_rng(prev_rng)
+    return rec, violations
+
+
+def run_seed(
+    seed: int, opts: Optional[SimOptions] = None
+) -> Dict:
+    """Run one seeded simulation; returns the JSON-able report
+    (violations, trace digest, convergence, counters)."""
+    rec, violations = run_record(seed, opts)
     return {
-        "seed": seed,
+        "seed": rec.seed,
         "trace_digest": rec.trace.digest(),
         "trace_events": len(rec.trace),
         "steps": rec.steps,

@@ -212,7 +212,17 @@ def check_recovery_honesty(record) -> List[str]:
     recovered state plus the reported-lost set together account for
     every acked resourceVersion (``RunRecord.disk_checks`` probes,
     evaluated at fault time against the storage-integrity layer's
-    RecoveryReport — ``kwok_tpu/cluster/store.py:2024``)."""
+    RecoveryReport — ``kwok_tpu/cluster/store.py:2024``).
+
+    The void-accounting side of the same contract: every rv the
+    shared sequence allocated must be durable in the WAL union or
+    covered by a ``void`` marker (``ResourceStore._unbump``) — a
+    rolled-back write that skips both leaks a hole recovery/fsck can
+    only read as a lost record.  Audited at each pressure-window end
+    (``RunRecord.exhaustion_checks``), excused when a batch-lane
+    refusal (rvs legitimately committed in memory, not yet durable) or
+    earlier disk damage (corrupt records legitimately unreadable)
+    explains the hole."""
     out: List[str] = []
     for i, probe in enumerate(record.disk_checks):
         if probe["silent_lost"]:
@@ -228,6 +238,21 @@ def check_recovery_honesty(record) -> List[str]:
             out.append(
                 f"disk fault #{i} ({probe['mode']}): injected corruption "
                 "was silently absorbed (no detection signal)"
+            )
+    for i, probe in enumerate(
+        getattr(record, "exhaustion_checks", []) or []
+    ):
+        holes = probe.get("unaccounted_rvs") or []
+        if (
+            holes
+            and not probe.get("batch_rejections", 0)
+            and not probe.get("prior_damage", 0)
+        ):
+            out.append(
+                f"pressure window #{i} ({probe.get('mode')}): allocated "
+                f"rvs {holes[:5]} are neither durable in the WAL union "
+                "nor voided — continuity hole with no damage to explain "
+                "it"
             )
     return out
 

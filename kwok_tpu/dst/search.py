@@ -1,0 +1,665 @@
+"""Coverage-guided fault search over the DST harness.
+
+Plain ``--dst`` walks consecutive seeds — uniform sampling of the
+schedule space.  That finds shallow bugs fast (a bug caught on a third
+of all seeds appears within the first handful) but is blind to narrow
+interleavings: a regression that needs a crash landing inside a
+specific two-commit window can hide for hundreds of seeds.  This
+module is the greybox-fuzzing answer (AFL's corpus/mutation loop and
+coverage signal in PAPERS.md, applied to fault *schedules* instead of
+byte inputs; the reference control plane gets the equivalent depth
+from etcd's failpoint robustness tests —
+``/root/reference/test/e2e/kwokctl_test.go:1`` exercises only the happy
+path, which is exactly the gap ROADMAP.md:101 names):
+
+- **signal**: a bounded feature vector extracted from the finished
+  run's :class:`~kwok_tpu.dst.harness.RunRecord`
+  (:func:`extract_features`) — per-actor action bigrams, fault-kind ×
+  actor-state pairs, and log2-bucketed invariant-probe counters.
+  Everything feeds off digest-stable content (trace events + probe
+  dicts), so arming telemetry/tracing cannot change coverage.
+- **corpus**: schedules that light ≥1 never-seen feature are kept as
+  ``(seed, spec)`` pairs (``FaultTimeline.to_spec`` form).
+- **mutation**: seeded operators over fault *groups* (a pause rides
+  with its resume, a pressure window with its end, a region move with
+  its partition window — :func:`schedule_groups`): shift a group's
+  virtual instant, retarget its seat/replica/shard/tenant, duplicate
+  it into overlap, splice two corpus schedules, drop a group.  Every
+  draw comes from one ``random.Random(search_seed)`` stream and the
+  harness's runtime rng is a pure function of the run seed
+  (``FaultTimeline.seal_runtime_rng``), so the whole search is
+  replayable from ``--search-seed`` alone.
+- **on violation**: delta-debug the schedule to a minimal group set
+  (:func:`minimize`, greedy ddmin over groups) and emit a replay
+  artifact (:func:`violation_artifact`) that ``--dst-replay FILE``
+  re-executes byte-identically — the regression-pinning format.
+
+CLI: ``python -m kwok_tpu.chaos --dst-search [--search-budget N]
+[--search-seed S] [--dst-bug B] [--search-out FILE]`` and
+``--dst-replay FILE``.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import hashlib
+import json
+import random
+import re
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from kwok_tpu.dst.harness import (
+    SimOptions,
+    run_record,
+    seeded_schedule_spec,
+)
+
+__all__ = [
+    "SearchResult",
+    "extract_features",
+    "guided_search",
+    "minimize",
+    "replay_artifact",
+    "schedule_groups",
+    "spec_digest",
+    "violation_artifact",
+]
+
+#: SimOptions fields a replay artifact must pin to reproduce the run
+#: (everything that shapes the simulation except the schedule itself,
+#: which travels separately)
+ARTIFACT_OPTS = (
+    "duration",
+    "quiesce",
+    "replicas",
+    "lease_duration",
+    "faults",
+    "bug",
+    "store_shards",
+    "nodes",
+    "deployment_replicas",
+    "scale_to",
+    "scale_back",
+    "gang_size",
+    "gang_slice_hosts",
+    "fleet_tenants",
+)
+
+#: fresh seed-derived schedules executed before mutation starts (the
+#: corpus needs something to mutate), and the probability of taking
+#: another fresh seed later instead of mutating (keeps exploring the
+#: seed distribution so the corpus never inbreeds)
+INIT_FRESH = 4
+FRESH_P = 0.15
+
+
+# --------------------------------------------------------------- features
+
+
+_REPLICA_IDX = re.compile(r"-\d+")
+_TENANT_IDX = re.compile(r"\bt\d+$")
+
+
+def _norm_actor(actor: str) -> str:
+    """Collapse replica/tenant indices so the feature space stays
+    bounded no matter how many replicas or tenants a run composes:
+    ``kcm-1/elector`` -> ``kcm/elector``, ``fleet/t013`` -> ``fleet/t``."""
+    return _TENANT_IDX.sub("t", _REPLICA_IDX.sub("", actor))
+
+
+def _bucket(n: int) -> int:
+    """log2 bucket — counters contribute O(log n) features, not O(n)."""
+    b = 0
+    while n:
+        n >>= 1
+        b += 1
+    return b
+
+
+def extract_features(record) -> FrozenSet[Tuple]:
+    """The bounded coverage signal for one finished run.
+
+    Three families, all derived from digest-stable content (the trace
+    and the invariant probes — never telemetry, never wall time):
+
+    - ``("bg", actor, a1, a2)``: consecutive action pairs per
+      normalized actor — which *state transitions* each component
+      exercised.
+    - ``("fs", fault, killed, paused, pressure, armed)``: each injected
+      fault tagged with the system state it landed in (how many seats
+      dead / paused, a pressure window open, a crash armed) — the
+      interleaving context uniform seeding can't target.
+    - ``("ct", name, bucket)``: log2-bucketed probe counters (crashes,
+      reported/silent losses, degraded rejections, observer streams)
+      plus exact small-int gang occupancy pairs — the invariant
+      checkers' intermediate states.
+    """
+    feats: Set[Tuple] = set()
+    last_action: Dict[str, str] = {}
+    killed: Set[str] = set()
+    paused: Set[str] = set()
+    pressure = 0
+    armed = False
+    for ev in record.trace.events:
+        actor = _norm_actor(ev.actor)
+        prev = last_action.get(actor)
+        if prev is not None:
+            feats.add(("bg", actor, prev, ev.action))
+        last_action[actor] = ev.action
+        if ev.actor == "faults":
+            feats.add(
+                (
+                    "fs",
+                    ev.action,
+                    len(killed),
+                    len(paused),
+                    pressure > 0,
+                    armed,
+                )
+            )
+            seat = ev.detail.split()[0] if ev.detail else ""
+            if ev.action == "leader-kill":
+                killed.add(seat)
+            elif ev.action == "restart":
+                killed.discard(seat)
+            elif ev.action == "pause":
+                paused.add(seat)
+            elif ev.action == "resume":
+                paused.discard(seat)
+            elif ev.action == "pressure-start":
+                pressure += 1
+            elif ev.action == "arm-crash":
+                armed = True
+        elif ev.actor == "store":
+            if ev.action == "pressure-end":
+                pressure = max(0, pressure - 1)
+            elif ev.action == "crash":
+                armed = False
+    feats.add(("ct", "crashes", _bucket(len(record.crash_checks))))
+    feats.add(("ct", "disk", _bucket(len(record.disk_checks))))
+    reported = sum(len(c.get("reported_lost") or []) for c in record.disk_checks)
+    silent = sum(len(c.get("silent_lost") or []) for c in record.disk_checks)
+    feats.add(("ct", "reported-lost", _bucket(reported)))
+    feats.add(("ct", "silent-lost", _bucket(silent)))
+    rej = sum(c.get("rejections", 0) for c in record.exhaustion_checks)
+    feats.add(("ct", "rejections", _bucket(rej)))
+    brej = sum(c.get("batch_rejections", 0) for c in record.exhaustion_checks)
+    feats.add(("ct", "batch-rejections", _bucket(brej)))
+    feats.add(("ct", "streams", _bucket(len(record.streams))))
+    feats.add(
+        ("ct", "region-moves", _bucket(len(record.tenant_region_checks)))
+    )
+    for g in record.gang_checks:
+        # exact small ints: a (bound, present) occupancy pair is the
+        # gang engine's intermediate state — (2, 3) mid-recovery is a
+        # near-miss of the atomicity violation, worth steering toward
+        feats.add(
+            (
+                "ct",
+                f"gang-{g.get('at')}",
+                min(int(g.get("bound", 0)), 8),
+                min(int(g.get("present", 0)), 8),
+            )
+        )
+    return frozenset(feats)
+
+
+# ----------------------------------------------------------------- groups
+
+
+def schedule_groups(spec: dict) -> List[dict]:
+    """Partition a schedule spec into fault groups that only make sense
+    together: each group is ``{"scheduled": [idx...], "windows":
+    [idx...]}``.  Pairing rules mirror construction
+    (``FaultTimeline.__init__`` / ``add_region_move``): leader-kill
+    with the next restart on the same seat, pause with the next resume
+    on the same seat, pressure-start with the next pressure-end of the
+    same mode, a tenant-region-move with its partition window; crashes,
+    disk corruptions and plain windows stand alone.  Mutators shift /
+    retarget / duplicate / drop whole groups, and the minimizer's unit
+    of deletion is one group — dropping half a pair would change the
+    fault's meaning, not remove it."""
+    sched = spec.get("scheduled") or []
+    wins = spec.get("windows") or []
+    claimed_s: Set[int] = set()
+    claimed_w: Set[int] = set()
+    groups: List[dict] = []
+
+    def _pair(i: int, kind: str, match: Callable[[dict], bool]) -> List[int]:
+        for j in range(len(sched)):
+            if (
+                j not in claimed_s
+                and j != i
+                and sched[j]["kind"] == kind
+                and sched[j]["t"] >= sched[i]["t"]
+                and match(sched[j].get("params") or {})
+            ):
+                return [i, j]
+        return [i]
+
+    for i, s in enumerate(sched):
+        if i in claimed_s:
+            continue
+        params = s.get("params") or {}
+        kind = s["kind"]
+        if kind == "leader-kill":
+            idxs = _pair(i, "restart", lambda p: p.get("seat") == params.get("seat"))
+        elif kind == "pause":
+            idxs = _pair(i, "resume", lambda p: p.get("seat") == params.get("seat"))
+        elif kind == "pressure-start":
+            idxs = _pair(
+                i, "pressure-end", lambda p: p.get("mode") == params.get("mode")
+            )
+        else:
+            idxs = [i]
+        claimed_s.update(idxs)
+        widxs: List[int] = []
+        if kind == "tenant-region-move":
+            for k, w in enumerate(wins):
+                if (
+                    k not in claimed_w
+                    and w.get("target") == params.get("client")
+                    and abs(w.get("at", -1) - s["t"]) < 1e-9
+                ):
+                    widxs = [k]
+                    claimed_w.add(k)
+                    break
+        groups.append({"scheduled": idxs, "windows": widxs})
+    for k in range(len(wins)):
+        if k not in claimed_w:
+            groups.append({"scheduled": [], "windows": [k]})
+    return groups
+
+
+def _drop_group(spec: dict, group: dict) -> dict:
+    out = copy.deepcopy(spec)
+    out["scheduled"] = [
+        s
+        for i, s in enumerate(out.get("scheduled") or [])
+        if i not in set(group["scheduled"])
+    ]
+    out["windows"] = [
+        w
+        for i, w in enumerate(out.get("windows") or [])
+        if i not in set(group["windows"])
+    ]
+    return out
+
+
+# --------------------------------------------------------------- mutators
+
+
+def _clamp_t(spec: dict, t: float) -> float:
+    lo, hi = spec.get("ack_window") or (t, t)
+    return min(max(t, lo), hi)
+
+
+def _mut_shift(spec: dict, rng: random.Random, ctx: dict) -> dict:
+    """Shift one fault group's virtual instant, preserving the group's
+    internal spacing (a pause keeps its duration, a pressure window its
+    width)."""
+    out = copy.deepcopy(spec)
+    groups = schedule_groups(out)
+    if not groups:
+        return out
+    g = groups[rng.randrange(len(groups))]
+    delta = rng.uniform(-4.0, 4.0)
+    for i in g["scheduled"]:
+        out["scheduled"][i]["t"] = _clamp_t(out, out["scheduled"][i]["t"] + delta)
+    for i in g["windows"]:
+        out["windows"][i]["at"] = _clamp_t(out, out["windows"][i]["at"] + delta)
+    return out
+
+
+def _mut_retarget(spec: dict, rng: random.Random, ctx: dict) -> dict:
+    """Re-aim one fault group: another seat for kills/pauses, another
+    replica client for partitions, an explicit shard for disk faults,
+    another tenant for region moves — and for crashes, a fresh
+    phase/skip draw (the commit-window targeting knob)."""
+    out = copy.deepcopy(spec)
+    groups = schedule_groups(out)
+    if not groups:
+        return out
+    g = groups[rng.randrange(len(groups))]
+    seats: List[str] = ctx["seats"]
+    clients: List[str] = ctx["replica_clients"]
+    for i in g["scheduled"]:
+        s = out["scheduled"][i]
+        p = s.setdefault("params", {})
+        if "seat" in p and seats:
+            p["seat"] = seats[rng.randrange(len(seats))]
+        if s["kind"] == "crash":
+            p["phase"] = rng.choice(["before-commit", "after-commit"])
+            p["skip"] = rng.randint(0, 8)
+        if s["kind"] == "disk-corrupt":
+            p["mode"] = rng.choice(["bit-flip", "truncate"])
+            if ctx["n_shards"] > 1:
+                p["shard"] = rng.randrange(ctx["n_shards"])
+        if s["kind"] == "pressure-start" and ctx["n_shards"] > 1:
+            p["shard"] = rng.randrange(ctx["n_shards"])
+        if s["kind"] == "tenant-region-move" and ctx["fleet_ids"]:
+            tid = ctx["fleet_ids"][rng.randrange(len(ctx["fleet_ids"]))]
+            old = p.get("client")
+            p["client"] = f"tenant:{tid}"
+            for w in out.get("windows") or []:
+                if w.get("target") == old:
+                    w["target"] = p["client"]
+    for i in g["windows"]:
+        w = out["windows"][i]
+        if w.get("kind") == "partition" and not str(
+            w.get("target", "")
+        ).startswith("tenant:") and clients:
+            w["target"] = clients[rng.randrange(len(clients))]
+    return out
+
+
+def _mut_duplicate(spec: dict, rng: random.Random, ctx: dict) -> dict:
+    """Copy one fault group to a shifted instant so the original and
+    the copy overlap — two crashes bracketing one commit burst, nested
+    pressure windows, back-to-back partitions."""
+    out = copy.deepcopy(spec)
+    groups = schedule_groups(out)
+    if not groups:
+        return out
+    g = groups[rng.randrange(len(groups))]
+    delta = rng.uniform(0.5, 6.0) * (1 if rng.random() < 0.5 else -1)
+    for i in g["scheduled"]:
+        s = copy.deepcopy(out["scheduled"][i])
+        s["t"] = _clamp_t(out, s["t"] + delta)
+        out["scheduled"].append(s)
+    for i in g["windows"]:
+        w = copy.deepcopy(out["windows"][i])
+        w["at"] = _clamp_t(out, w["at"] + delta)
+        out["windows"].append(w)
+    return out
+
+
+def _mut_drop(spec: dict, rng: random.Random, ctx: dict) -> dict:
+    """Remove one fault group (never the last one) — less noise around
+    whatever feature the schedule lights."""
+    groups = schedule_groups(spec)
+    if len(groups) <= 1:
+        return copy.deepcopy(spec)
+    return _drop_group(spec, groups[rng.randrange(len(groups))])
+
+
+_MUTATORS: List[Tuple[str, Callable]] = [
+    ("shift", _mut_shift),
+    ("retarget", _mut_retarget),
+    ("duplicate", _mut_duplicate),
+    ("drop", _mut_drop),
+]
+
+
+def _splice(a: dict, b: dict, rng: random.Random) -> dict:
+    """Coin-flip merge of two corpus schedules' fault groups (keeps
+    ``a``'s envelope).  The crossover operator: a crash placement that
+    lights gang features joined with a pressure window from another
+    lineage."""
+    out = copy.deepcopy(a)
+    out["scheduled"] = []
+    out["windows"] = []
+    took = 0
+    for src in (a, b):
+        for g in schedule_groups(src):
+            if rng.random() < 0.5:
+                for i in g["scheduled"]:
+                    out["scheduled"].append(copy.deepcopy(src["scheduled"][i]))
+                for i in g["windows"]:
+                    out["windows"].append(copy.deepcopy(src["windows"][i]))
+                took += 1
+    if not took:  # degenerate flip — keep a verbatim
+        out["scheduled"] = copy.deepcopy(a.get("scheduled") or [])
+        out["windows"] = copy.deepcopy(a.get("windows") or [])
+    return out
+
+
+def spec_digest(seed: int, spec: dict) -> str:
+    """Canonical digest of one executed candidate — the determinism
+    test compares the full sequence of these across two searches."""
+    body = json.dumps({"seed": seed, "spec": spec}, sort_keys=True)
+    return hashlib.sha256(body.encode("utf-8")).hexdigest()
+
+
+# ----------------------------------------------------------------- search
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """Outcome of one :func:`guided_search` run."""
+
+    executed: int
+    corpus_size: int
+    features: int
+    #: digest of every executed (seed, spec), in order — the replayable
+    #: fingerprint of the whole search
+    schedule_digests: List[str]
+    #: None, or the violating candidate
+    found: Optional[dict] = None
+    #: schedules executed when the violation surfaced (1-based)
+    time_to_find: Optional[int] = None
+    minimized: Optional[dict] = None
+    #: extra runs the minimizer spent (not counted against the budget)
+    minimize_trials: int = 0
+
+    def stats(self) -> dict:
+        out = {
+            "schedules": self.executed,
+            "corpus": self.corpus_size,
+            "features": self.features,
+            "time_to_find": self.time_to_find,
+            "minimize_trials": self.minimize_trials,
+        }
+        if self.found is not None:
+            out["violations"] = sorted(self.found["violations"])
+            out["minimized_groups"] = (
+                len(schedule_groups(self.minimized["schedule"]))
+                if self.minimized
+                else None
+            )
+        return out
+
+
+def _mutation_ctx(opts: SimOptions) -> dict:
+    from kwok_tpu.dst.harness import SEATS
+
+    fleet_ids: List[str] = []
+    if opts.fleet_tenants > 0:
+        from kwok_tpu.fleet.tenant import fleet_tenant_ids
+
+        fleet_ids = fleet_tenant_ids(opts.fleet_tenants)
+    return {
+        "seats": [s for s, _ in SEATS],
+        "replica_clients": [
+            f"{seat}-{i}" for seat, _ in SEATS for i in range(opts.replicas)
+        ],
+        "n_shards": opts.store_shards,
+        "fleet_ids": fleet_ids,
+    }
+
+
+def _execute(seed: int, opts: SimOptions, spec: dict):
+    o = dataclasses.replace(opts, seed=seed, schedule=spec)
+    return run_record(seed, o)
+
+
+def guided_search(
+    opts: SimOptions,
+    budget: int,
+    search_seed: int = 0,
+    minimize_found: bool = True,
+    log: Optional[Callable[[str], None]] = None,
+) -> SearchResult:
+    """Run the coverage-guided loop for at most ``budget`` schedule
+    executions; stop at the first invariant violation.
+
+    Deterministic by construction: one rng seeded from ``search_seed``
+    drives every pick and mutation, fresh corpus entries come from
+    consecutive run seeds, and each execution is a pure function of its
+    (seed, spec) — same arguments, byte-identical search."""
+    rng = random.Random((search_seed << 1) ^ 0x6A1DED)
+    seen: Set[Tuple] = set()
+    corpus: List[dict] = []
+    digests: List[str] = []
+    executed = 0
+    next_fresh = 0
+    found: Optional[dict] = None
+
+    def _run_candidate(seed: int, spec: dict, origin: str):
+        nonlocal executed, found
+        rec, violations = _execute(seed, opts, spec)
+        executed += 1
+        digests.append(spec_digest(seed, spec))
+        if violations:
+            found = {
+                "seed": seed,
+                "schedule": spec,
+                "violations": dict(violations),
+                "trace_digest": rec.trace.digest(),
+                "origin": origin,
+            }
+            return
+        feats = extract_features(rec)
+        novel = feats - seen
+        if novel:
+            seen.update(novel)
+            corpus.append({"seed": seed, "spec": spec})
+            if log:
+                log(
+                    f"[search] +corpus #{len(corpus)} ({origin}, "
+                    f"{len(novel)} new features, {executed}/{budget})"
+                )
+
+    while executed < budget and found is None:
+        fresh = (
+            next_fresh < INIT_FRESH
+            or not corpus
+            or rng.random() < FRESH_P
+        )
+        if fresh:
+            seed = next_fresh
+            next_fresh += 1
+            _run_candidate(seed, seeded_schedule_spec(seed, opts), "seed")
+            continue
+        ctx = _mutation_ctx(opts)
+        # recency-biased parent pick: newest entries carry the newest
+        # features, but the whole corpus stays reachable
+        idx = max(rng.randrange(len(corpus)), rng.randrange(len(corpus)))
+        parent = corpus[idx]
+        if len(corpus) >= 2 and rng.random() < 0.2:
+            other = corpus[rng.randrange(len(corpus))]
+            spec = _splice(parent["spec"], other["spec"], rng)
+            origin = "splice"
+        else:
+            spec = parent["spec"]
+            ops = []
+            for _ in range(rng.randint(1, 2)):
+                name, fn = _MUTATORS[rng.randrange(len(_MUTATORS))]
+                spec = fn(spec, rng, ctx)
+                ops.append(name)
+            origin = "+".join(ops)
+        _run_candidate(parent["seed"], spec, origin)
+
+    result = SearchResult(
+        executed=executed,
+        corpus_size=len(corpus),
+        features=len(seen),
+        schedule_digests=digests,
+        found=found,
+        time_to_find=executed if found is not None else None,
+    )
+    if found is not None and minimize_found:
+        minimized, trials = minimize(
+            opts,
+            found["seed"],
+            found["schedule"],
+            set(found["violations"]),
+            log=log,
+        )
+        rec, violations = _execute(found["seed"], opts, minimized)
+        result.minimized = {
+            "schedule": minimized,
+            "violations": dict(violations),
+            "trace_digest": rec.trace.digest(),
+        }
+        result.minimize_trials = trials + 1
+    return result
+
+
+# --------------------------------------------------------------- minimizer
+
+
+def minimize(
+    opts: SimOptions,
+    seed: int,
+    spec: dict,
+    target: Set[str],
+    log: Optional[Callable[[str], None]] = None,
+) -> Tuple[dict, int]:
+    """Greedy delta-debugging over fault groups: repeatedly try
+    dropping one group; keep the drop when the run still raises every
+    invariant in ``target``.  Deterministic (no rng — groups are tried
+    last-first so earlier indices stay stable within a pass) and
+    terminates at a 1-minimal schedule: no single remaining group can
+    be removed without losing the violation."""
+    cur = spec
+    trials = 0
+    changed = True
+    while changed:
+        changed = False
+        groups = schedule_groups(cur)
+        for gi in range(len(groups) - 1, -1, -1):
+            cand = _drop_group(cur, groups[gi])
+            _, violations = _execute(seed, opts, cand)
+            trials += 1
+            if target <= set(violations):
+                cur = cand
+                changed = True
+                if log:
+                    log(
+                        f"[minimize] dropped group {gi} "
+                        f"({len(schedule_groups(cur))} left, trial {trials})"
+                    )
+                break
+    return cur, trials
+
+
+# ---------------------------------------------------------------- artifact
+
+
+def violation_artifact(opts: SimOptions, found: dict, minimized: dict) -> dict:
+    """The regression-pinning format ``--dst-replay`` consumes: the
+    minimal violating schedule plus everything needed to re-execute it
+    byte-identically and verify the outcome."""
+    return {
+        "version": 1,
+        "seed": found["seed"],
+        "opts": {k: getattr(opts, k) for k in ARTIFACT_OPTS},
+        "schedule": minimized["schedule"],
+        "expect": {
+            "trace_digest": minimized["trace_digest"],
+            "violations": sorted(minimized["violations"]),
+        },
+    }
+
+
+def replay_artifact(doc: dict) -> dict:
+    """Re-execute a violation artifact and verify byte-identity: the
+    replayed trace digest must equal the recorded one and the same
+    invariants must fire.  Returns ``{"ok", "digest_match",
+    "violations_match", "trace_digest", "violations"}``."""
+    opts = SimOptions(seed=int(doc["seed"]), **dict(doc.get("opts") or {}))
+    opts = dataclasses.replace(opts, schedule=doc["schedule"])
+    rec, violations = run_record(opts.seed, opts)
+    expect = doc.get("expect") or {}
+    digest = rec.trace.digest()
+    digest_match = digest == expect.get("trace_digest")
+    violations_match = sorted(violations) == list(expect.get("violations") or [])
+    return {
+        "ok": digest_match and violations_match,
+        "digest_match": digest_match,
+        "violations_match": violations_match,
+        "trace_digest": digest,
+        "violations": sorted(violations),
+    }

@@ -269,26 +269,29 @@ def test_gang_atomicity_checker_flags_bound_strict_subset():
 def test_partial_gang_regression_is_caught_and_replays_identically():
     """Acceptance gate for the gang engine: un-atomic the bind lane
     (--dst-bug partial-gang: per-pod patches instead of one txn) and
-    the seed search must find a crash window that strands a bound
-    strict subset — and the violating seed must replay exactly.
-    Pinned to the single-store composition: the bug lives in the
-    engine's bind lane, and the 1-shard fault schedule is the one
-    whose seeds land a crash inside the per-pod bind window (the
-    sharded router has its own injected regression,
-    --dst-bug cross-shard-txn)."""
+    the fault search must find a crash window that strands a bound
+    strict subset — and the violating schedule must replay exactly.
+    The catch needs the crash to land INSIDE the per-pod bind window,
+    an interleaving narrow enough that uniform consecutive-seed
+    walking misses it for dozens of seeds — the motivating case for
+    the coverage-guided search (kwok_tpu.dst.search), which shifts and
+    re-draws the crash placement until gang occupancy features lead it
+    there.  Pinned to the single-store composition: the bug lives in
+    the engine's bind lane (the sharded router has its own injected
+    regression, --dst-bug cross-shard-txn)."""
+    from kwok_tpu.dst.search import (
+        guided_search,
+        replay_artifact,
+        violation_artifact,
+    )
+
     opts = SimOptions(bug="partial-gang", store_shards=1)
-    caught = None
-    for seed in range(10):
-        r = run_seed(seed, opts)
-        if r["violations"]:
-            caught = (seed, r)
-            break
-    assert caught is not None, "seed search never caught partial-gang"
-    seed, first = caught
-    assert "gang-atomicity" in first["violations"]
-    replay = run_seed(seed, opts)
-    assert replay["trace_digest"] == first["trace_digest"]
-    assert replay["violations"] == first["violations"]
+    res = guided_search(opts, budget=48, search_seed=0)
+    assert res.found is not None, "guided search never caught partial-gang"
+    assert "gang-atomicity" in res.found["violations"]
+    assert "gang-atomicity" in res.minimized["violations"]
+    rep = replay_artifact(violation_artifact(opts, res.found, res.minimized))
+    assert rep["ok"], rep
 
 
 def test_cross_shard_txn_regression_is_caught_and_replays_identically():

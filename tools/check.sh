@@ -52,6 +52,14 @@ if [[ "${SKIP_CHAOS:-0}" != "1" ]]; then
     # inversion + data-race detector, and trace digests are
     # sentinel-neutral by construction (tests/test_locks.py pins that)
     KWOK_LOCK_SENTINEL=1 KWOK_RACE_SENTINEL=1 JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst --seeds "${DST_SEEDS:-25}"
+    echo "== guided fault search smoke (coverage-guided rediscovery of an injected bug, minimized + replay-verified) =="
+    # fixed search seed + small budget: the loop must find the
+    # fanin-stale-resume regression, delta-debug the schedule to a
+    # minimal fault set, and verify a byte-identical replay (exit 0
+    # covers all three — kwok_tpu/dst/search.py)
+    JAX_PLATFORMS=cpu python -m kwok_tpu.chaos --dst-search \
+        --dst-bug fanin-stale-resume \
+        --search-budget "${DST_SEARCH_BUDGET:-16}" --search-seed 0
 fi
 
 if [[ "${SKIP_BENCH:-0}" != "1" ]]; then
